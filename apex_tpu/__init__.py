@@ -1,0 +1,46 @@
+"""apex_tpu — a TPU-native training-acceleration framework.
+
+A from-scratch reimplementation of the *capabilities* of NVIDIA Apex
+(reference: zhaoguochun1995/apex, ``apex/__init__.py:8``) on JAX/XLA/Pallas:
+
+- ``apex_tpu.amp``            — mixed-precision policies (O0–O3) + dynamic loss scaling
+- ``apex_tpu.optimizers``     — fused optimizers (Adam/LAMB/SGD/NovoGrad/Adagrad/…)
+- ``apex_tpu.normalization``  — fused LayerNorm / RMSNorm (Pallas kernels)
+- ``apex_tpu.parallel``       — data parallelism, SyncBatchNorm, LARC
+- ``apex_tpu.transformer``    — Megatron-style tensor/sequence/pipeline/context parallelism
+- ``apex_tpu.ops``            — Pallas TPU kernels (norms, softmax, rope, attention, xentropy)
+- ``apex_tpu.contrib``        — optional extensions (focal loss, group norm, transducer, …)
+
+Where the reference dispatches CUDA kernels through pybind11 extensions
+(``setup.py:110-860``), this package dispatches Pallas TPU kernels with pure-XLA
+fallbacks; where the reference speaks NCCL through ``torch.distributed``
+(SURVEY.md §2.5), this package speaks XLA collectives over a ``jax.sharding.Mesh``.
+"""
+
+from apex_tpu import amp
+from apex_tpu import fp16_utils
+from apex_tpu import multi_tensor_apply
+from apex_tpu import normalization
+from apex_tpu import ops
+from apex_tpu import optimizers
+from apex_tpu import parallel
+from apex_tpu import transformer
+from apex_tpu.utils.logging import get_logger, RankInfoFormatter
+from apex_tpu.utils.deprecation import deprecated_warning
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "amp",
+    "fp16_utils",
+    "multi_tensor_apply",
+    "normalization",
+    "ops",
+    "optimizers",
+    "parallel",
+    "transformer",
+    "get_logger",
+    "RankInfoFormatter",
+    "deprecated_warning",
+    "__version__",
+]

@@ -1,0 +1,48 @@
+"""Mixed-precision training (capability of ``apex/amp``).
+
+The reference implements amp by monkey-patching torch namespaces at runtime
+(``apex/amp/amp.py:74-183``) — not possible or desirable under JAX tracing.
+The TPU-native design is a *policy* applied at function boundaries
+(``Policy(param_dtype, compute_dtype, output_dtype)``) plus a functional
+dynamic loss scaler carried as jittable state, preserving the reference's
+semantics: O0–O3 opt levels (``apex/amp/frontend.py:104-193``), dynamic loss
+scaling with overflow skip-step (``apex/amp/scaler.py:33-217``,
+``apex/amp/handle.py:17-158``), and scaler ``state_dict`` round-trip
+(``apex/amp/frontend.py:365-404``).
+"""
+
+from apex_tpu.amp.policy import (
+    Policy,
+    half_function,
+    float_function,
+    promote_function,
+)
+from apex_tpu.amp.scaler import LossScaler, LossScalerState, all_finite
+from apex_tpu.amp.frontend import (
+    AmpState,
+    Properties,
+    initialize,
+    state_dict,
+    load_state_dict,
+    OPT_LEVELS,
+)
+from apex_tpu.amp.handle import scale_loss, unscale_and_update, apply_if_finite
+
+__all__ = [
+    "Policy",
+    "half_function",
+    "float_function",
+    "promote_function",
+    "LossScaler",
+    "LossScalerState",
+    "all_finite",
+    "AmpState",
+    "Properties",
+    "initialize",
+    "state_dict",
+    "load_state_dict",
+    "OPT_LEVELS",
+    "scale_loss",
+    "unscale_and_update",
+    "apply_if_finite",
+]

@@ -1,0 +1,152 @@
+"""amp opt-level frontend.
+
+Reimagines ``amp.initialize(models, optimizers, opt_level="O0..O3")``
+(``apex/amp/frontend.py:197``) for a functional framework: instead of mutating
+models/optimizers in place, :func:`initialize` returns an :class:`AmpState`
+bundling the precision :class:`Policy`, loss scalers (one per loss,
+``num_losses`` parity), and the O-level properties table
+(``apex/amp/frontend.py:104-193``).
+
+Opt-level semantics, translated to TPU dtypes (bf16 default half type):
+
+- **O0** — fp32 everything; loss scale 1.
+- **O1** — fp32 params, half compute at op boundaries ("cast per-call");
+  dynamic loss scale. The reference patches torch namespaces; here the policy
+  is applied via ``Policy.wrap`` / module integration.
+- **O2** — half params + half compute, fp32 master weights in the optimizer,
+  fp32 batchnorm, dynamic loss scale.
+- **O3** — half everything, no master weights, loss scale 1.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+import jax.numpy as jnp
+
+from apex_tpu.amp.policy import Policy
+from apex_tpu.amp.scaler import LossScaler, LossScalerState
+from apex_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclasses.dataclass
+class Properties:
+    """Mirror of amp ``Properties`` (``apex/amp/frontend.py:9-101``)."""
+
+    enabled: bool = False
+    opt_level: Optional[str] = None
+    cast_model_type: Optional[Any] = None
+    cast_ops: bool = False              # "patch_torch_functions" analog
+    keep_batchnorm_fp32: Optional[bool] = None
+    master_weights: Optional[bool] = None
+    loss_scale: Any = 1.0
+
+
+def _o0() -> Properties:
+    return Properties(enabled=True, opt_level="O0", cast_model_type=jnp.float32,
+                      cast_ops=False, keep_batchnorm_fp32=None,
+                      master_weights=False, loss_scale=1.0)
+
+
+def _o1() -> Properties:
+    return Properties(enabled=True, opt_level="O1", cast_model_type=None,
+                      cast_ops=True, keep_batchnorm_fp32=None,
+                      master_weights=False, loss_scale="dynamic")
+
+
+def _o2() -> Properties:
+    return Properties(enabled=True, opt_level="O2", cast_model_type=jnp.bfloat16,
+                      cast_ops=False, keep_batchnorm_fp32=True,
+                      master_weights=True, loss_scale="dynamic")
+
+
+def _o3() -> Properties:
+    return Properties(enabled=True, opt_level="O3", cast_model_type=jnp.bfloat16,
+                      cast_ops=False, keep_batchnorm_fp32=False,
+                      master_weights=False, loss_scale=1.0)
+
+
+OPT_LEVELS = {"O0": _o0, "O1": _o1, "O2": _o2, "O3": _o3}
+
+
+@dataclasses.dataclass
+class AmpState:
+    properties: Properties
+    policy: Policy
+    scaler: LossScaler
+    scaler_states: List[LossScalerState]
+
+    @property
+    def loss_scale(self):
+        return self.scaler_states[0].loss_scale
+
+
+def initialize(
+    opt_level: str = "O1",
+    *,
+    half_dtype=jnp.bfloat16,
+    cast_model_type=None,
+    keep_batchnorm_fp32: Optional[bool] = None,
+    master_weights: Optional[bool] = None,
+    loss_scale: Any = None,
+    min_loss_scale: Optional[float] = None,
+    max_loss_scale: float = 2.0 ** 24,
+    num_losses: int = 1,
+) -> AmpState:
+    """Build amp state for an opt level, with the reference's override rules
+    (explicit kwargs override the O-level defaults, ``frontend.py:331-360``)."""
+    if opt_level not in OPT_LEVELS:
+        raise ValueError(
+            f"Unexpected optimization level {opt_level!r}; options are 'O0', 'O1', 'O2', 'O3'"
+        )
+    props = OPT_LEVELS[opt_level]()
+    if cast_model_type is not None:
+        props.cast_model_type = cast_model_type
+    if keep_batchnorm_fp32 is not None:
+        props.keep_batchnorm_fp32 = keep_batchnorm_fp32
+    if master_weights is not None:
+        props.master_weights = master_weights
+    if loss_scale is not None:
+        props.loss_scale = loss_scale
+
+    if props.cast_model_type == jnp.bfloat16 and half_dtype != jnp.bfloat16:
+        props.cast_model_type = half_dtype
+
+    if props.opt_level == "O0":
+        policy = Policy(jnp.float32, jnp.float32, jnp.float32)
+    elif props.opt_level == "O1":
+        policy = Policy(jnp.float32, half_dtype, jnp.float32)
+    elif props.opt_level == "O2":
+        policy = Policy(half_dtype, half_dtype, half_dtype)
+    else:  # O3
+        policy = Policy(half_dtype, half_dtype, half_dtype)
+
+    scaler = LossScaler(
+        props.loss_scale,
+        min_loss_scale=min_loss_scale,
+        max_loss_scale=max_loss_scale,
+    )
+    states = [scaler.init() for _ in range(num_losses)]
+    logger.info("amp initialized: %s (policy=%s)", props, policy)
+    return AmpState(properties=props, policy=policy, scaler=scaler, scaler_states=states)
+
+
+def state_dict(amp_state: AmpState) -> Dict[str, dict]:
+    """Reference: ``apex/amp/frontend.py:365-384`` — one entry per loss scaler."""
+    return {
+        f"loss_scaler{i}": amp_state.scaler.state_dict(s)
+        for i, s in enumerate(amp_state.scaler_states)
+    }
+
+
+def load_state_dict(amp_state: AmpState, d: Dict[str, dict]) -> AmpState:
+    """Reference: ``apex/amp/frontend.py:387-404``."""
+    states = list(amp_state.scaler_states)
+    for i in range(len(states)):
+        key = f"loss_scaler{i}"
+        if key in d:
+            states[i] = amp_state.scaler.load_state_dict(d[key])
+    return dataclasses.replace(amp_state, scaler_states=states)

@@ -1,0 +1,54 @@
+"""Loss scaling flow helpers (``apex/amp/handle.py:17-158`` capability).
+
+The reference's ``with amp.scale_loss(loss, optimizer) as scaled_loss`` context
+manager scales, backprops, unscales, checks overflow, and patches
+``optimizer.step`` into a no-op on overflow. The functional equivalent:
+
+    scaled = amp.scale_loss(loss, state)                     # inside value_and_grad fn
+    grads, found_inf = scaler.unscale(grads, state)
+    new_params, new_opt = amp.apply_if_finite(found_inf, step_fn, params, opt_state)
+    state = scaler.update(state, found_inf)
+
+or in one call: ``unscale_and_update``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.scaler import LossScaler, LossScalerState
+
+
+def scale_loss(loss: jax.Array, scaler_state: LossScalerState) -> jax.Array:
+    return loss.astype(jnp.float32) * scaler_state.loss_scale
+
+
+def unscale_and_update(
+    grads: Any,
+    scaler: LossScaler,
+    scaler_state: LossScalerState,
+) -> Tuple[Any, jax.Array, LossScalerState]:
+    """Unscale grads, detect overflow, advance scaler state. Jittable."""
+    grads, found_inf = scaler.unscale(grads, scaler_state)
+    new_state = scaler.update(scaler_state, found_inf)
+    return grads, found_inf, new_state
+
+
+def apply_if_finite(found_inf: jax.Array, step_fn: Callable, *trees: Any) -> Any:
+    """Run ``step_fn(*trees)`` and keep its result only when grads were finite —
+    the on-device analog of patching ``optimizer.step`` to a no-op
+    (``apex/amp/handle.py:128-154``), with no host sync."""
+    new_trees = step_fn(*trees)
+    skip = found_inf
+
+    def _select(new, old):
+        return jax.tree_util.tree_map(
+            lambda n, o: jnp.where(skip, o, n), new, old
+        )
+
+    if len(trees) == 1:
+        return _select(new_trees, trees[0])
+    return tuple(_select(n, o) for n, o in zip(new_trees, trees))

@@ -1,0 +1,113 @@
+"""Precision policies applied at function boundaries.
+
+Replaces the reference's per-op cast lists and monkey-patching
+(``apex/amp/lists/*.py``, ``apex/amp/wrap.py:10-276``) with an explicit,
+trace-friendly policy object. The decorators below reproduce the public
+``amp.half_function`` / ``float_function`` / ``promote_function`` registration
+API (``apex/amp/amp.py:30-48``) as plain function wrappers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.utils.tree import tree_cast
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """``Policy(param_dtype, compute_dtype, output_dtype)``.
+
+    ``bf16`` is the TPU-native half type (fp16 is supported for parity; it is
+    what makes the loss scaler load-bearing).
+    """
+
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.float32
+    output_dtype: Any = jnp.float32
+
+    def cast_to_param(self, tree):
+        return tree_cast(tree, self.param_dtype)
+
+    def cast_to_compute(self, tree):
+        return tree_cast(tree, self.compute_dtype)
+
+    def cast_to_output(self, tree):
+        return tree_cast(tree, self.output_dtype)
+
+    def wrap(self, fn: Callable) -> Callable:
+        """Run ``fn`` with inputs cast to compute dtype, outputs to output dtype."""
+
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            args = self.cast_to_compute(args)
+            kwargs = self.cast_to_compute(kwargs)
+            out = fn(*args, **kwargs)
+            return self.cast_to_output(out)
+
+        return wrapped
+
+    @staticmethod
+    def from_names(names: str) -> "Policy":
+        """Parse ``"params=float32,compute=bfloat16,output=float32"`` or the
+        short form ``"p=f32,c=bf16,o=f32"``."""
+        mapping = {
+            "f32": jnp.float32,
+            "float32": jnp.float32,
+            "bf16": jnp.bfloat16,
+            "bfloat16": jnp.bfloat16,
+            "f16": jnp.float16,
+            "float16": jnp.float16,
+        }
+        kw = {}
+        for part in names.split(","):
+            k, v = part.split("=")
+            k = {"p": "param_dtype", "params": "param_dtype",
+                 "c": "compute_dtype", "compute": "compute_dtype",
+                 "o": "output_dtype", "output": "output_dtype"}[k.strip()]
+            kw[k] = mapping[v.strip()]
+        return Policy(**kw)
+
+
+def _cast_fn(fn: Callable, dtype) -> Callable:
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        args = tree_cast(args, dtype)
+        kwargs = tree_cast(kwargs, dtype)
+        return fn(*args, **kwargs)
+
+    return wrapped
+
+
+def half_function(fn: Callable, dtype=jnp.bfloat16) -> Callable:
+    """Always run ``fn`` in half precision (reference: ``amp/amp.py:30``)."""
+    return _cast_fn(fn, dtype)
+
+
+def float_function(fn: Callable) -> Callable:
+    """Always run ``fn`` in fp32 (reference: ``amp/amp.py:38``)."""
+    return _cast_fn(fn, jnp.float32)
+
+
+def promote_function(fn: Callable) -> Callable:
+    """Run ``fn`` in the widest floating dtype among its arguments
+    (reference: ``amp/amp.py:46``; promote wrapper ``amp/wrap.py:92-116``)."""
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        leaves = jax.tree_util.tree_leaves((args, kwargs))
+        f_dtypes = [x.dtype for x in leaves
+                    if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)]
+        if not f_dtypes:
+            return fn(*args, **kwargs)
+        target = functools.reduce(jnp.promote_types, f_dtypes)
+        args = tree_cast(args, target)
+        kwargs = tree_cast(kwargs, target)
+        return fn(*args, **kwargs)
+
+    return wrapped

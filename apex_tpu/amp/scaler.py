@@ -1,0 +1,155 @@
+"""Dynamic loss scaling as jittable functional state.
+
+Semantics ported (not code) from the reference's ``LossScaler``
+(``apex/amp/scaler.py:33-217``) and the hysteresis variant used by the
+capturable/CUDA-graph path (``csrc/update_scale_hysteresis.cu:5-48``):
+
+- overflow → consume one hysteresis credit; when credits are exhausted the
+  scale is multiplied by ``1/scale_factor`` (floored at ``min_loss_scale``)
+  and the growth tracker resets;
+- ``scale_window`` consecutive finite steps → scale grows by ``scale_factor``
+  (capped at ``max_loss_scale``) and hysteresis credits refill.
+
+Unlike the reference's eager path — which does a device→host sync per step to
+read the overflow flag (``scaler.py:197-217``) — everything here stays on
+device; the "skip step" is a ``jnp.where`` select, mirroring the design of the
+capturable FusedAdam (``apex/optimizers/fused_adam.py:199-263``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import chex
+import jax
+import jax.numpy as jnp
+
+
+def all_finite(tree: Any) -> jax.Array:
+    """Fused finiteness check over a pytree (capability of
+    ``amp_C.multi_tensor_scale``'s inf/nan flag, ``csrc/multi_tensor_scale_kernel.cu``)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.array(True)
+    finite = [jnp.all(jnp.isfinite(x)) for x in leaves]
+    return jnp.stack(finite).all()
+
+
+@chex.dataclass
+class LossScalerState:
+    loss_scale: jax.Array          # f32 scalar
+    growth_tracker: jax.Array      # i32 scalar — consecutive finite steps
+    hysteresis_tracker: jax.Array  # i32 scalar — overflow credits remaining
+    unskipped: jax.Array           # i32 scalar — steps since last skip (state_dict parity)
+
+
+class LossScaler:
+    """Static or dynamic loss scaler.
+
+    ``LossScaler("dynamic")`` matches the reference default
+    (init 2**16, factor 2, window 2000, ``apex/amp/scaler.py:33-60``);
+    ``LossScaler(128.0)`` gives a static scale.
+    """
+
+    def __init__(
+        self,
+        loss_scale: Any = "dynamic",
+        init_scale: float = 2.0 ** 16,
+        scale_factor: float = 2.0,
+        scale_window: int = 2000,
+        min_loss_scale: Optional[float] = None,
+        max_loss_scale: float = 2.0 ** 24,
+        hysteresis: int = 1,
+    ):
+        self.dynamic = loss_scale == "dynamic"
+        self._init_scale = float(init_scale if self.dynamic else loss_scale)
+        self.scale_factor = float(scale_factor)
+        self.scale_window = int(scale_window)
+        self.min_loss_scale = float(min_loss_scale) if min_loss_scale is not None else 1.0
+        self.max_loss_scale = float(max_loss_scale)
+        self.hysteresis = int(hysteresis)
+
+    # -- state ------------------------------------------------------------
+    def init(self) -> LossScalerState:
+        return LossScalerState(
+            loss_scale=jnp.asarray(self._init_scale, jnp.float32),
+            growth_tracker=jnp.zeros((), jnp.int32),
+            hysteresis_tracker=jnp.asarray(self.hysteresis, jnp.int32),
+            unskipped=jnp.zeros((), jnp.int32),
+        )
+
+    # -- per-step ops (all jittable) --------------------------------------
+    def scale(self, loss: jax.Array, state: LossScalerState) -> jax.Array:
+        """``amp.scale_loss`` body (``apex/amp/handle.py:113``)."""
+        return loss.astype(jnp.float32) * state.loss_scale
+
+    def unscale(
+        self, grads: Any, state: LossScalerState
+    ) -> Tuple[Any, jax.Array]:
+        """Unscale gradients and report overflow.
+
+        One fused multiply over the grad pytree + finiteness reduction —
+        the ``multi_tensor_scale`` capability (``apex/amp/scaler.py:105-119``).
+        Non-finite gradients are zeroed so downstream optimizer math stays
+        finite; the step is skipped via :func:`update` / ``apply_if_finite``.
+        """
+        inv = 1.0 / state.loss_scale
+        found_inf = jnp.logical_not(all_finite(grads))
+        grads = jax.tree_util.tree_map(
+            lambda g: jnp.where(
+                jnp.isfinite(g), g.astype(jnp.float32) * inv, 0.0
+            ).astype(g.dtype),
+            grads,
+        )
+        return grads, found_inf
+
+    def update(self, state: LossScalerState, found_inf: jax.Array) -> LossScalerState:
+        """Scale-update with hysteresis (``update_scale_hysteresis.cu:5-48``)."""
+        if not self.dynamic:
+            return LossScalerState(
+                loss_scale=state.loss_scale,
+                growth_tracker=state.growth_tracker,
+                hysteresis_tracker=state.hysteresis_tracker,
+                unskipped=jnp.where(found_inf, 0, state.unskipped + 1),
+            )
+        hyst = jnp.where(found_inf, state.hysteresis_tracker - 1, state.hysteresis_tracker)
+        do_backoff = jnp.logical_and(found_inf, hyst <= 0)
+        new_scale = jnp.where(
+            do_backoff,
+            jnp.maximum(state.loss_scale / self.scale_factor, self.min_loss_scale),
+            state.loss_scale,
+        )
+        growth = jnp.where(found_inf, 0, state.growth_tracker + 1)
+        do_growth = growth >= self.scale_window
+        new_scale = jnp.where(
+            do_growth,
+            jnp.minimum(new_scale * self.scale_factor, self.max_loss_scale),
+            new_scale,
+        )
+        growth = jnp.where(do_growth, 0, growth)
+        hyst = jnp.where(do_backoff | do_growth, self.hysteresis, hyst)
+        return LossScalerState(
+            loss_scale=new_scale,
+            growth_tracker=growth.astype(jnp.int32),
+            hysteresis_tracker=hyst.astype(jnp.int32),
+            unskipped=jnp.where(found_inf, 0, state.unskipped + 1).astype(jnp.int32),
+        )
+
+    # -- persistence (reference: apex/amp/frontend.py:365-404) -------------
+    def state_dict(self, state: LossScalerState) -> dict:
+        return {
+            "loss_scale": float(state.loss_scale),
+            "growth_tracker": int(state.growth_tracker),
+            "hysteresis_tracker": int(state.hysteresis_tracker),
+            "unskipped": int(state.unskipped),
+        }
+
+    def load_state_dict(self, d: dict) -> LossScalerState:
+        return LossScalerState(
+            loss_scale=jnp.asarray(d["loss_scale"], jnp.float32),
+            growth_tracker=jnp.asarray(d.get("growth_tracker", 0), jnp.int32),
+            hysteresis_tracker=jnp.asarray(
+                d.get("hysteresis_tracker", self.hysteresis), jnp.int32
+            ),
+            unskipped=jnp.asarray(d.get("unskipped", 0), jnp.int32),
+        )

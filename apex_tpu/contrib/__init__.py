@@ -1,0 +1,1 @@
+"""Optional extensions (capability of ``apex/contrib``)."""

@@ -1,0 +1,3 @@
+from apex_tpu.contrib.clip_grad.clip_grad import clip_grad_norm_, clip_grad_norm
+
+__all__ = ["clip_grad_norm_", "clip_grad_norm"]

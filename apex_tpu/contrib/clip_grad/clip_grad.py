@@ -1,0 +1,38 @@
+"""Fused gradient clipping.
+
+Capability of ``apex.contrib.clip_grad.clip_grad_norm_``
+(``apex/contrib/clip_grad/clip_grad.py:16-60``): one fused global-norm
+reduction (``multi_tensor_l2norm``) + one fused scale
+(``multi_tensor_scale``). Functional: returns the clipped tree and the norm.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.utils.tree import global_norm
+
+
+def clip_grad_norm(grads: Any, max_norm: float,
+                   norm_type: float = 2.0) -> Tuple[Any, jax.Array]:
+    """Return ``(clipped_grads, total_norm)``."""
+    if norm_type == 2.0:
+        total = global_norm(grads)
+    elif norm_type == float("inf"):
+        leaves = jax.tree_util.tree_leaves(grads)
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(x)) for x in leaves]))
+    else:
+        leaves = jax.tree_util.tree_leaves(grads)
+        total = jnp.sum(
+            jnp.stack([jnp.sum(jnp.abs(x.astype(jnp.float32)) ** norm_type)
+                       for x in leaves])) ** (1.0 / norm_type)
+    coef = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    clipped = jax.tree_util.tree_map(lambda g: (g * coef).astype(g.dtype), grads)
+    return clipped, total
+
+
+# reference-named alias (the trailing underscore loses its in-place meaning here)
+clip_grad_norm_ = clip_grad_norm

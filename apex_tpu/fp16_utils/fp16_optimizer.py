@@ -1,0 +1,64 @@
+"""Legacy ``FP16_Optimizer`` wrapper (``apex/fp16_utils/fp16_optimizer.py:13``).
+
+Functional re-design: wraps any apex_tpu optimizer, holding fp32 master
+params + a loss scaler, exposing ``backward``-less JAX flow:
+
+    opt = FP16_Optimizer(FusedAdam(lr=1e-3), static_loss_scale="dynamic")
+    state = opt.init(model_params_bf16)
+    new_model_params, state = opt.step(grads_bf16, state)
+
+On overflow the step is skipped on-device (reference: ``step``/``backward``,
+``fp16_optimizer.py:275-436``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.scaler import LossScaler, LossScalerState
+from apex_tpu.utils.tree import tree_cast
+
+
+class FP16OptimizerState(NamedTuple):
+    master_params: Any
+    inner_state: Any
+    scaler_state: LossScalerState
+
+
+class FP16_Optimizer:
+    def __init__(self, inner, static_loss_scale: Any = 1.0, dynamic_loss_scale: bool = False,
+                 dynamic_loss_args: dict = None):
+        if dynamic_loss_scale:
+            self.scaler = LossScaler("dynamic", **(dynamic_loss_args or {}))
+        else:
+            self.scaler = LossScaler(static_loss_scale)
+        self.inner = inner
+
+    def init(self, model_params: Any) -> FP16OptimizerState:
+        master = tree_cast(model_params, jnp.float32)
+        return FP16OptimizerState(
+            master_params=master,
+            inner_state=self.inner.init(master),
+            scaler_state=self.scaler.init(),
+        )
+
+    def scale_loss(self, loss: jax.Array, state: FP16OptimizerState) -> jax.Array:
+        return self.scaler.scale(loss, state.scaler_state)
+
+    def step(self, model_grads: Any, state: FP16OptimizerState,
+             model_params: Any) -> Tuple[Any, FP16OptimizerState]:
+        grads, found_inf = self.scaler.unscale(
+            tree_cast(model_grads, jnp.float32), state.scaler_state
+        )
+        # found_inf makes the inner step a no-op on device (base-class contract)
+        new_master, new_inner = self.inner.step(
+            grads, state.master_params, state.inner_state, found_inf=found_inf
+        )
+        new_scaler = self.scaler.update(state.scaler_state, found_inf)
+        new_model = jax.tree_util.tree_map(
+            lambda m, p: m.astype(p.dtype), new_master, model_params
+        )
+        return new_model, FP16OptimizerState(new_master, new_inner, new_scaler)

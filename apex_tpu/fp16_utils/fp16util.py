@@ -1,0 +1,64 @@
+"""Manual master-weight mixed-precision utilities.
+
+Functional counterparts of ``apex/fp16_utils/fp16util.py:22-176``. Parameters
+are pytrees, not module attributes, so "convert network" means casting leaves —
+with an optional predicate to keep normalization parameters in fp32
+(``BN_convert_float`` capability, ``fp16util.py:60-71``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.utils.tree import tree_cast
+
+
+def _default_keep_fp32(path: Tuple, leaf) -> bool:
+    """Keep batchnorm/layernorm scale+bias in fp32 by path-name convention."""
+    names = "/".join(str(getattr(p, "key", getattr(p, "name", p))) for p in path).lower()
+    return any(k in names for k in ("batchnorm", "bn", "layernorm", "ln", "norm"))
+
+
+def convert_network(
+    params: Any,
+    dtype=jnp.bfloat16,
+    keep_fp32: Optional[Callable[[Tuple, Any], bool]] = _default_keep_fp32,
+) -> Any:
+    """Cast floating leaves to ``dtype``, keeping norm params fp32
+    (reference: ``convert_network``, ``fp16util.py:44-58``)."""
+
+    def _cast(path, x):
+        if not (hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)):
+            return x
+        if keep_fp32 is not None and keep_fp32(path, x):
+            return x.astype(jnp.float32)
+        return x.astype(dtype)
+
+    return jax.tree_util.tree_map_with_path(_cast, params)
+
+
+def network_to_half(params: Any, dtype=jnp.bfloat16) -> Any:
+    """Cast every floating leaf (reference: ``network_to_half``, ``fp16util.py:22``)."""
+    return tree_cast(params, dtype)
+
+
+def prep_param_lists(params: Any) -> Tuple[Any, Any]:
+    """Return ``(model_params, fp32_master_copy)``
+    (reference: ``prep_param_lists``, ``fp16util.py:92-141``)."""
+    return params, tree_cast(params, jnp.float32)
+
+
+def model_grads_to_master_grads(model_grads: Any) -> Any:
+    """``fp16util.py:143-160``."""
+    return tree_cast(model_grads, jnp.float32)
+
+
+def master_params_to_model_params(master_params: Any, model_params: Any) -> Any:
+    """Cast fp32 master values back into the model params' dtypes
+    (``fp16util.py:162-176``)."""
+    return jax.tree_util.tree_map(
+        lambda m, p: m.astype(p.dtype), master_params, model_params
+    )

@@ -1,0 +1,24 @@
+"""Legacy loss scalers (``apex/fp16_utils/loss_scaler.py:10,49`` capability).
+
+Thin aliases over the modern functional scaler in ``apex_tpu.amp.scaler``.
+"""
+
+from __future__ import annotations
+
+from apex_tpu.amp.scaler import LossScaler as _ModernScaler
+
+
+class LossScaler(_ModernScaler):
+    """Static scaler (reference: ``loss_scaler.py:10``)."""
+
+    def __init__(self, scale: float = 1.0):
+        super().__init__(loss_scale=scale)
+
+
+class DynamicLossScaler(_ModernScaler):
+    """Dynamic scaler (reference: ``loss_scaler.py:49``; factor 2, window 1000)."""
+
+    def __init__(self, init_scale: float = 2.0 ** 32, scale_factor: float = 2.0,
+                 scale_window: int = 1000):
+        super().__init__("dynamic", init_scale=init_scale,
+                         scale_factor=scale_factor, scale_window=scale_window)

@@ -1,0 +1,15 @@
+from apex_tpu.multi_tensor_apply.multi_tensor_apply import (
+    MultiTensorApply,
+    multi_tensor_applier,
+    BucketMeta,
+    flatten_by_dtype,
+    unflatten_by_dtype,
+)
+
+__all__ = [
+    "MultiTensorApply",
+    "multi_tensor_applier",
+    "BucketMeta",
+    "flatten_by_dtype",
+    "unflatten_by_dtype",
+]

@@ -1,0 +1,89 @@
+"""Fused normalization modules (flax.linen).
+
+Module-level parity with ``apex/normalization/fused_layer_norm.py``:
+``FusedLayerNorm`` (:230), ``FusedRMSNorm`` (:329), and the Megatron
+mixed-dtype variants ``MixedFusedLayerNorm``/``MixedFusedRMSNorm`` (:430,455)
+whose parameters live in fp32 while activations stay in the compute dtype.
+The compute path dispatches to the Pallas kernels in
+``apex_tpu.ops.layer_norm``.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.ops.layer_norm import (
+    fused_layer_norm,
+    fused_layer_norm_affine,
+    fused_rms_norm,
+    fused_rms_norm_affine,
+)
+
+Shape = Union[int, Sequence[int]]
+
+
+def _shape(s: Shape):
+    return (s,) if isinstance(s, int) else tuple(s)
+
+
+class FusedLayerNorm(nn.Module):
+    normalized_shape: Shape
+    eps: float = 1e-5
+    elementwise_affine: bool = True
+    memory_efficient: bool = False
+    param_dtype: type = jnp.float32
+    # Megatron SP: mark grads of these params for all-reduce over the TP group
+    # (reference: apex/transformer/layers/layer_norm.py:26-99)
+    sequence_parallel_enabled: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        shape = _shape(self.normalized_shape)
+        if not self.elementwise_affine:
+            return fused_layer_norm(x, shape, self.eps, self.memory_efficient)
+        weight = self.param(
+            "scale", nn.initializers.ones, shape, self.param_dtype)
+        bias = self.param(
+            "bias", nn.initializers.zeros, shape, self.param_dtype)
+        return fused_layer_norm_affine(
+            x, weight, bias, shape, self.eps, self.memory_efficient)
+
+
+class FusedRMSNorm(nn.Module):
+    normalized_shape: Shape
+    eps: float = 1e-5
+    elementwise_affine: bool = True
+    memory_efficient: bool = False
+    param_dtype: type = jnp.float32
+    sequence_parallel_enabled: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        shape = _shape(self.normalized_shape)
+        if not self.elementwise_affine:
+            return fused_rms_norm(x, shape, self.eps, self.memory_efficient)
+        weight = self.param(
+            "scale", nn.initializers.ones, shape, self.param_dtype)
+        return fused_rms_norm_affine(
+            x, weight, shape, self.eps, self.memory_efficient)
+
+
+class MixedFusedLayerNorm(FusedLayerNorm):
+    """fp32 params with half activations; output in activation dtype
+    (Megatron semantics, ``fused_layer_norm.py:430-452``)."""
+
+    @nn.compact
+    def __call__(self, x):
+        y = super().__call__(x)
+        return y.astype(x.dtype)
+
+
+class MixedFusedRMSNorm(FusedRMSNorm):
+    @nn.compact
+    def __call__(self, x):
+        y = super().__call__(x)
+        return y.astype(x.dtype)

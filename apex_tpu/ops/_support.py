@@ -1,0 +1,56 @@
+"""Kernel dispatch support.
+
+The reference gates CUDA kernels on availability predicates (e.g.
+``FusedScaleMaskSoftmax.is_kernel_available``, ``apex/transformer/functional/
+fused_softmax.py:222-248``) and falls back to eager torch. Here the analog:
+Pallas TPU kernels when running on TPU, pure-``jnp`` fallbacks elsewhere
+(interpret mode is available for kernel debugging via
+``APEX_TPU_FORCE_PALLAS=interpret``).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+
+
+@functools.lru_cache(maxsize=None)
+def pallas_mode() -> str:
+    """Return 'tpu' (compiled pallas), 'interpret', or 'off'."""
+    forced = os.environ.get("APEX_TPU_FORCE_PALLAS", "").lower()
+    if forced in ("interpret", "tpu", "off"):
+        return forced
+    try:
+        backend = jax.default_backend()
+    except Exception:
+        return "off"
+    return "tpu" if backend == "tpu" else "off"
+
+
+def use_pallas() -> bool:
+    return pallas_mode() != "off"
+
+
+def pallas_interpret() -> bool:
+    return pallas_mode() == "interpret"
+
+
+def round_up(x: int, multiple: int) -> int:
+    return ((x + multiple - 1) // multiple) * multiple
+
+
+def cdiv(a: int, b: int) -> int:
+    return (a + b - 1) // b
+
+
+def min_sublane(dtype) -> int:
+    """Minimum second-to-last tile dim for a dtype on TPU."""
+    import jax.numpy as jnp
+
+    if dtype in (jnp.bfloat16, jnp.float16):
+        return 16
+    if dtype in (jnp.int8, jnp.uint8):
+        return 32
+    return 8

@@ -1,0 +1,209 @@
+"""Fused scale + mask + softmax — Pallas TPU kernels with custom VJP.
+
+Capability parity with the four Megatron softmax extensions
+(``csrc/megatron/scaled_upper_triang_masked_softmax.{cpp,cu}``,
+``scaled_masked_softmax.{cpp,cu}``, ``scaled_softmax.{cpp,cu}``,
+``generic_scaled_masked_softmax.{cpp,cu}``): fused scale-by-alpha, mask fill,
+and numerically-stable softmax, with the matching backward
+``dx = scale * y * (dy - rowsum(dy * y))``.
+
+Unlike the CUDA kernels — which cap sequence length at 16384 and require
+power-of-two-friendly shapes (``csrc/megatron/scaled_masked_softmax.h:460``) —
+the Pallas kernels tile arbitrary row lengths, so the "generic" variant is the
+same code path. Masked positions are filled with ``-10000.0`` pre-softmax,
+matching the reference's fill value.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from apex_tpu.ops._support import cdiv, pallas_interpret, round_up, use_pallas
+
+_MASK_FILL = -10000.0
+_VMEM_BUDGET = 4 * 1024 * 1024
+
+
+def _block_rows(kp: int) -> int:
+    bm = max(8, min(512, _VMEM_BUDGET // (kp * 4)))
+    return round_up(min(bm, 512), 8)
+
+
+# ---------------------------------------------------------------------------
+# forward / backward row kernels
+# ---------------------------------------------------------------------------
+
+def _fwd_body(x, mask, scale, k, sq, causal, row_offset):
+    bm, kp = x.shape
+    col = jax.lax.broadcasted_iota(jnp.int32, (bm, kp), 1)
+    valid = col < k
+    logits = x.astype(jnp.float32) * scale
+    if mask is not None:
+        logits = jnp.where(mask, _MASK_FILL, logits)
+    if causal:
+        row = jax.lax.broadcasted_iota(jnp.int32, (bm, kp), 0) + row_offset
+        q_pos = row % sq
+        logits = jnp.where(col > q_pos, _MASK_FILL, logits)
+    logits = jnp.where(valid, logits, -jnp.inf)
+    m = jnp.max(logits, axis=1, keepdims=True)
+    e = jnp.exp(logits - m)
+    e = jnp.where(valid, e, 0.0)
+    return e / jnp.sum(e, axis=1, keepdims=True)
+
+
+def _fwd_pallas(x2, mask2, scale, k, sq, causal, out_dtype):
+    m_rows = x2.shape[0]
+    kp = round_up(k, 128)
+    bm = _block_rows(kp)
+    grid = (cdiv(m_rows, bm),)
+    pad = lambda a, v: jnp.pad(a, ((0, 0), (0, kp - k)), constant_values=v) if kp != k else a
+    args = [pad(x2, 0)]
+    in_specs = [pl.BlockSpec((bm, kp), lambda i: (i, 0), memory_space=pltpu.VMEM)]
+    if mask2 is not None:
+        args.append(pad(mask2.astype(jnp.int8), 0))
+        in_specs.append(pl.BlockSpec((bm, kp), lambda i: (i, 0), memory_space=pltpu.VMEM))
+
+    def kernel(*refs):
+        if mask2 is not None:
+            x_ref, m_ref, y_ref = refs
+            mask = m_ref[:] != 0
+        else:
+            x_ref, y_ref = refs
+            mask = None
+        row_offset = pl.program_id(0) * bm
+        y = _fwd_body(x_ref[:], mask, scale, k, sq, causal, row_offset)
+        y_ref[:] = y.astype(out_dtype)
+
+    y = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((bm, kp), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((m_rows, kp), out_dtype),
+        interpret=pallas_interpret(),
+    )(*args)
+    return y[:, :k] if kp != k else y
+
+
+def _fwd_jnp(x2, mask2, scale, k, sq, causal, out_dtype):
+    logits = x2.astype(jnp.float32) * scale
+    if mask2 is not None:
+        logits = jnp.where(mask2, _MASK_FILL, logits)
+    if causal:
+        rows = x2.shape[0]
+        q_pos = (jnp.arange(rows) % sq)[:, None]
+        col = jnp.arange(k)[None, :]
+        logits = jnp.where(col > q_pos, _MASK_FILL, logits)
+    return jax.nn.softmax(logits, axis=-1).astype(out_dtype)
+
+
+def _bwd_pallas(dy2, y2, scale, k):
+    m_rows = dy2.shape[0]
+    kp = round_up(k, 128)
+    bm = _block_rows(kp)
+    grid = (cdiv(m_rows, bm),)
+    pad = lambda a: jnp.pad(a, ((0, 0), (0, kp - k))) if kp != k else a
+
+    def kernel(dy_ref, y_ref, dx_ref):
+        dy = dy_ref[:].astype(jnp.float32)
+        y = y_ref[:].astype(jnp.float32)
+        s = jnp.sum(dy * y, axis=1, keepdims=True)
+        dx_ref[:] = (scale * y * (dy - s)).astype(dy_ref.dtype)
+
+    dx = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, kp), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bm, kp), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((bm, kp), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((m_rows, kp), dy2.dtype),
+        interpret=pallas_interpret(),
+    )(pad(dy2), pad(y2))
+    return dx[:, :k] if kp != k else dx
+
+
+def _bwd_jnp(dy2, y2, scale, k):
+    dy = dy2.astype(jnp.float32)
+    y = y2.astype(jnp.float32)
+    s = jnp.sum(dy * y, axis=1, keepdims=True)
+    return (scale * y * (dy - s)).astype(dy2.dtype)
+
+
+# ---------------------------------------------------------------------------
+# custom-vjp core over flattened rows
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _softmax_core(x2, mask2, scale, sq, causal):
+    k = x2.shape[-1]
+    fwd = _fwd_pallas if use_pallas() else _fwd_jnp
+    return fwd(x2, mask2, scale, k, sq, causal, x2.dtype)
+
+
+def _core_fwd(x2, mask2, scale, sq, causal):
+    y = _softmax_core(x2, mask2, scale, sq, causal)
+    return y, y
+
+
+def _core_bwd(scale, sq, causal, y, dy):
+    k = y.shape[-1]
+    bwd = _bwd_pallas if use_pallas() else _bwd_jnp
+    return bwd(dy, y, scale, k), None
+
+
+_softmax_core.defvjp(_core_fwd, _core_bwd)
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+def scaled_softmax(x: jax.Array, scale: float = 1.0) -> jax.Array:
+    """``softmax(scale * x)`` (reference: ``csrc/megatron/scaled_softmax.cpp``)."""
+    k = x.shape[-1]
+    y = _softmax_core(x.reshape(-1, k), None, float(scale), 0, False)
+    return y.reshape(x.shape)
+
+
+def scaled_masked_softmax(x: jax.Array, mask: Optional[jax.Array],
+                          scale: float = 1.0) -> jax.Array:
+    """``softmax(scale * x.masked_fill(mask, -10000))``.
+
+    ``x``: ``(b, np, sq, sk)``; ``mask``: broadcastable bool, True = masked out
+    (reference: ``csrc/megatron/scaled_masked_softmax.cpp``).
+    """
+    if mask is None:
+        return scaled_softmax(x, scale)
+    k = x.shape[-1]
+    mask_b = jnp.broadcast_to(mask, x.shape).reshape(-1, k)
+    y = _softmax_core(x.reshape(-1, k), mask_b, float(scale), 0, False)
+    return y.reshape(x.shape)
+
+
+def scaled_upper_triang_masked_softmax(x: jax.Array, scale: float = 1.0) -> jax.Array:
+    """Causal softmax over ``(attn_batches, sq, sk)`` with sq == sk
+    (reference: ``csrc/megatron/scaled_upper_triang_masked_softmax.cpp``)."""
+    sq, sk = x.shape[-2], x.shape[-1]
+    if sq != sk:
+        # the reference extension requires square attention scores; a
+        # flattened row%sq mask would silently mis-align for sq != sk
+        raise ValueError(
+            f"scaled_upper_triang_masked_softmax requires sq == sk, got {sq} != {sk}; "
+            "use scaled_masked_softmax with an explicit causal mask instead")
+    y = _softmax_core(x.reshape(-1, sk), None, float(scale), sq, True)
+    return y.reshape(x.shape)
+
+
+def generic_scaled_masked_softmax(x: jax.Array, mask: Optional[jax.Array],
+                                  scale: float = 1.0) -> jax.Array:
+    """No shape constraints (reference: ``generic_scaled_masked_softmax.cpp``) —
+    on TPU the main kernel already handles arbitrary row lengths."""
+    return scaled_masked_softmax(x, mask, scale)

@@ -1,0 +1,20 @@
+"""Fused optimizers (capability of ``apex/optimizers``)."""
+
+from apex_tpu.optimizers.base import FusedOptimizer
+from apex_tpu.optimizers.fused_adam import FusedAdam, FusedAdamW
+from apex_tpu.optimizers.fused_lamb import FusedLAMB
+from apex_tpu.optimizers.fused_sgd import FusedSGD
+from apex_tpu.optimizers.fused_novograd import FusedNovoGrad
+from apex_tpu.optimizers.fused_adagrad import FusedAdagrad
+from apex_tpu.optimizers.fused_mixed_precision_lamb import FusedMixedPrecisionLamb
+
+__all__ = [
+    "FusedOptimizer",
+    "FusedAdam",
+    "FusedAdamW",
+    "FusedLAMB",
+    "FusedSGD",
+    "FusedNovoGrad",
+    "FusedAdagrad",
+    "FusedMixedPrecisionLamb",
+]

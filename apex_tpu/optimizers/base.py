@@ -1,0 +1,118 @@
+"""Shared machinery for fused optimizers.
+
+The reference's optimizers exist to collapse hundreds of per-tensor CUDA
+launches into a few ``multi_tensor_*`` kernels (SURVEY.md §2.1). Under XLA the
+whole ``step`` is one compiled program and elementwise pytree math fuses into
+a handful of loops, so the *default* path here is plain fp32 tree math; the
+``multi_tensor_apply`` flat-bucket path exists for Pallas-kernel dispatch on
+very fragmented parameter sets.
+
+Conventions shared with the reference:
+
+- ``master_weights=True`` keeps an fp32 copy in optimizer state and writes
+  params back in their own dtype (amp O2, ``fused_adam.py:68-126``).
+- ``grad_scale`` / ``found_inf`` arguments mirror the capturable mode
+  (``apex/optimizers/fused_adam.py:199-263``): unscaling happens inside the
+  step and an overflow turns the whole update into a no-op **on device**.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def tree_map(f, *trees):
+    return jax.tree_util.tree_map(f, *trees)
+
+
+def tree_map_multi(f, n_out: int, *trees):
+    """``tree_map`` for a function returning an ``n_out``-tuple: returns
+    ``n_out`` trees with the structure of ``trees[0]``."""
+    leaves, treedef = jax.tree_util.tree_flatten(trees[0])
+    rest = [jax.tree_util.tree_leaves(t) for t in trees[1:]]
+    outs = [f(*args) for args in zip(leaves, *rest)]
+    return tuple(
+        jax.tree_util.tree_unflatten(treedef, [o[i] for o in outs])
+        for i in range(n_out)
+    )
+
+
+def f32(tree):
+    return tree_map(lambda x: x.astype(jnp.float32), tree)
+
+
+def like(tree, ref):
+    return tree_map(lambda x, r: x.astype(r.dtype), tree, ref)
+
+
+def select_tree(pred, on_true, on_false):
+    return tree_map(lambda a, b: jnp.where(pred, a, b), on_true, on_false)
+
+
+class FusedOptimizer:
+    """Base: ``init(params) -> state``; ``step(grads, params, state) -> (params, state)``.
+
+    Subclasses implement ``_update(g32, p32, slots, step, lr) -> (new_p32, new_slots)``
+    where ``slots`` is the subclass-specific moment pytree bundle.
+    """
+
+    def __init__(self, lr: float, weight_decay: float = 0.0,
+                 master_weights: bool = False):
+        self.lr = lr
+        self.weight_decay = weight_decay
+        self.master_weights = master_weights
+
+    # -- subclass API -----------------------------------------------------
+    def _init_slots(self, params32) -> Any:
+        raise NotImplementedError
+
+    def _update(self, g32, p32, slots, step, lr) -> Tuple[Any, Any]:
+        raise NotImplementedError
+
+    # -- public API -------------------------------------------------------
+    def init(self, params) -> dict:
+        p32 = f32(params)
+        state = {
+            "step": jnp.zeros((), jnp.int32),
+            "slots": self._init_slots(p32),
+        }
+        if self.master_weights:
+            state["master"] = p32
+        return state
+
+    def step(self, grads, params, state, *, lr: Optional[Any] = None,
+             grad_scale: Optional[jax.Array] = None,
+             found_inf: Optional[jax.Array] = None) -> Tuple[Any, dict]:
+        lr = self.lr if lr is None else lr
+        step = state["step"] + 1
+        g32 = f32(grads)
+        if grad_scale is not None:
+            g32 = tree_map(lambda g: g * (1.0 / grad_scale), g32)
+        p32 = state.get("master", f32(params))
+        new_p32, new_slots = self._update(g32, p32, state["slots"], step, lr)
+        if found_inf is not None:
+            new_p32 = select_tree(found_inf, p32, new_p32)
+            new_slots = select_tree(found_inf, state["slots"], new_slots)
+            step = jnp.where(found_inf, state["step"], step)
+        new_state = {"step": step, "slots": new_slots}
+        if self.master_weights:
+            new_state["master"] = new_p32
+        return like(new_p32, params), new_state
+
+    # -- optax interop ----------------------------------------------------
+    def as_gradient_transformation(self):
+        """Expose as an ``optax.GradientTransformation`` (updates = new - old)."""
+        import optax
+
+        def init_fn(params):
+            return self.init(params)
+
+        def update_fn(grads, state, params=None):
+            new_params, new_state = self.step(grads, params, state)
+            updates = tree_map(lambda n, p: (n - p.astype(n.dtype)), new_params, params)
+            return updates, new_state
+
+        return optax.GradientTransformation(init_fn, update_fn)

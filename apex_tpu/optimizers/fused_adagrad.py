@@ -1,0 +1,40 @@
+"""FusedAdagrad.
+
+Semantics of ``apex.optimizers.FusedAdagrad``
+(``apex/optimizers/fused_adagrad.py:43-121``; kernel
+``csrc/multi_tensor_adagrad.cu``): ``h += g²; p -= lr * g / (sqrt(h) + eps)``
+with "modern" decoupled weight decay ``adagrad_w_mode``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from apex_tpu.optimizers.base import FusedOptimizer, tree_map, tree_map_multi
+
+
+class FusedAdagrad(FusedOptimizer):
+    def __init__(self, lr: float = 1e-2, eps: float = 1e-10,
+                 weight_decay: float = 0.0, adagrad_w_mode: bool = False,
+                 master_weights: bool = False):
+        super().__init__(lr, weight_decay, master_weights)
+        self.eps = eps
+        self.adagrad_w_mode = adagrad_w_mode
+
+    def _init_slots(self, params32):
+        return {"sum": tree_map(jnp.zeros_like, params32)}
+
+    def _update(self, g32, p32, slots, step, lr):
+        wd = self.weight_decay
+
+        def upd(g, p, h):
+            if not self.adagrad_w_mode and wd != 0.0:
+                g = g + wd * p
+            h = h + g * g
+            update = g / (jnp.sqrt(h) + self.eps)
+            if self.adagrad_w_mode and wd != 0.0:
+                update = update + wd * p
+            return p - lr * update, h
+
+        new_p, new_h = tree_map_multi(upd, 2, g32, p32, slots["sum"])
+        return new_p, {"sum": new_h}

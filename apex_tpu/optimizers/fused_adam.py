@@ -1,0 +1,67 @@
+"""FusedAdam / AdamW.
+
+Hyperparameter semantics of ``apex.optimizers.FusedAdam``
+(``apex/optimizers/fused_adam.py:68-305``; CUDA functor
+``csrc/multi_tensor_adam.cu:23-127``): ``adam_w_mode`` selects decoupled
+weight decay (MODE_ADAMW) vs L2 regularization (MODE_L2,
+``multi_tensor_adam.cu:16-19``), ``bias_correction`` toggles the 1/(1-βᵗ)
+factors, and the capturable-mode ``grad_scale``/``found_inf`` flow is the
+base-class contract — on TPU every step is "capturable": no host syncs.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+from apex_tpu.optimizers.base import FusedOptimizer, tree_map, tree_map_multi
+
+
+class FusedAdam(FusedOptimizer):
+    def __init__(self, lr: float = 1e-3, bias_correction: bool = True,
+                 betas: Tuple[float, float] = (0.9, 0.999), eps: float = 1e-8,
+                 adam_w_mode: bool = True, weight_decay: float = 0.0,
+                 amsgrad: bool = False, master_weights: bool = False,
+                 capturable: bool = False):
+        if amsgrad:
+            raise RuntimeError("FusedAdam does not support the AMSGrad variant "
+                               "(parity with apex/optimizers/fused_adam.py:112-113)")
+        super().__init__(lr, weight_decay, master_weights)
+        self.bias_correction = bias_correction
+        self.betas = betas
+        self.eps = eps
+        self.adam_w_mode = adam_w_mode
+        self.capturable = capturable  # kept for API parity; always true on TPU
+
+    def _init_slots(self, params32):
+        return {
+            "exp_avg": tree_map(jnp.zeros_like, params32),
+            "exp_avg_sq": tree_map(jnp.zeros_like, params32),
+        }
+
+    def _update(self, g32, p32, slots, step, lr):
+        b1, b2 = self.betas
+        t = step.astype(jnp.float32)
+        bc1 = 1.0 - b1 ** t if self.bias_correction else 1.0
+        bc2 = 1.0 - b2 ** t if self.bias_correction else 1.0
+        wd = self.weight_decay
+
+        def upd(g, p, m, v):
+            if not self.adam_w_mode and wd != 0.0:
+                g = g + wd * p
+            m = b1 * m + (1.0 - b1) * g
+            v = b2 * v + (1.0 - b2) * g * g
+            update = (m / bc1) / (jnp.sqrt(v / bc2) + self.eps)
+            if self.adam_w_mode and wd != 0.0:
+                update = update + wd * p
+            return p - lr * update, m, v
+
+        new_p, new_m, new_v = tree_map_multi(
+            upd, 3, g32, p32, slots["exp_avg"], slots["exp_avg_sq"])
+        return new_p, {"exp_avg": new_m, "exp_avg_sq": new_v}
+
+
+def FusedAdamW(lr: float = 1e-3, **kw) -> FusedAdam:
+    kw.setdefault("adam_w_mode", True)
+    return FusedAdam(lr=lr, **kw)

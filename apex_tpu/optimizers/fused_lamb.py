@@ -1,0 +1,82 @@
+"""FusedLAMB — NVLAMB with global grad-norm pre-scaling.
+
+Semantics of ``apex.optimizers.FusedLAMB`` (``apex/optimizers/fused_lamb.py:
+96-215``): phase 1 computes the *global* L2 norm over all gradients
+(``multi_tensor_l2norm``) and derives a clip factor from ``max_grad_norm``;
+phase 2 (``csrc/multi_tensor_lamb.cu:413``) does the Adam-style moment update
+followed by the per-tensor trust-ratio step
+``p -= lr * (||p|| / ||update||) * update``.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+from apex_tpu.optimizers.base import FusedOptimizer, tree_map, tree_map_multi
+from apex_tpu.utils.tree import global_norm
+
+
+class FusedLAMB(FusedOptimizer):
+    def __init__(self, lr: float = 1e-3, bias_correction: bool = True,
+                 betas: Tuple[float, float] = (0.9, 0.999), eps: float = 1e-6,
+                 weight_decay: float = 0.01, amsgrad: bool = False,
+                 adam_w_mode: bool = True, grad_averaging: bool = True,
+                 max_grad_norm: float = 1.0, trust_clip: bool = False,
+                 always_adapt: bool = False, master_weights: bool = False):
+        if amsgrad:
+            raise RuntimeError("FusedLAMB does not support the AMSGrad variant")
+        super().__init__(lr, weight_decay, master_weights)
+        self.bias_correction = bias_correction
+        self.betas = betas
+        self.eps = eps
+        self.adam_w_mode = adam_w_mode
+        self.grad_averaging = grad_averaging
+        self.max_grad_norm = max_grad_norm
+        self.trust_clip = trust_clip
+        self.always_adapt = always_adapt
+
+    def _init_slots(self, params32):
+        return {
+            "exp_avg": tree_map(jnp.zeros_like, params32),
+            "exp_avg_sq": tree_map(jnp.zeros_like, params32),
+        }
+
+    def _update(self, g32, p32, slots, step, lr):
+        b1, b2 = self.betas
+        t = step.astype(jnp.float32)
+        bc1 = 1.0 - b1 ** t if self.bias_correction else 1.0
+        bc2 = 1.0 - b2 ** t if self.bias_correction else 1.0
+        beta3 = 1.0 - b1 if self.grad_averaging else 1.0
+        wd = self.weight_decay
+
+        # phase 1: global grad norm → clip factor (fused_lamb.py:167-185)
+        gnorm = global_norm(g32)
+        clip = jnp.where(
+            (self.max_grad_norm > 0.0) & (gnorm > self.max_grad_norm),
+            gnorm / self.max_grad_norm, 1.0)
+
+        def upd(g, p, m, v):
+            g = g / clip
+            if not self.adam_w_mode and wd != 0.0:
+                g = g + wd * p
+            m = b1 * m + beta3 * g
+            v = b2 * v + (1.0 - b2) * g * g
+            update = (m / bc1) / (jnp.sqrt(v / bc2) + self.eps)
+            if self.adam_w_mode and wd != 0.0:
+                update = update + wd * p
+            # trust ratio (multi_tensor_lamb.cu stage 2)
+            if wd != 0.0 or self.always_adapt:
+                w_norm = jnp.sqrt(jnp.sum(p * p))
+                u_norm = jnp.sqrt(jnp.sum(update * update))
+                ratio = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
+                if self.trust_clip:
+                    ratio = jnp.minimum(ratio, 1.0)
+            else:
+                ratio = 1.0
+            return p - lr * ratio * update, m, v
+
+        new_p, new_m, new_v = tree_map_multi(
+            upd, 3, g32, p32, slots["exp_avg"], slots["exp_avg_sq"])
+        return new_p, {"exp_avg": new_m, "exp_avg_sq": new_v}

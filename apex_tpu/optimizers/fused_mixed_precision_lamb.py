@@ -1,0 +1,28 @@
+"""FusedMixedPrecisionLamb.
+
+Semantics of ``apex.optimizers.FusedMixedPrecisionLamb``
+(``apex/optimizers/fused_mixed_precision_lamb.py:10-260``): LAMB with fp32
+master weights held by the optimizer, traced-tensor ``lr``/``step`` (the
+reference keeps them as device tensors for CUDA-graph capture; here every
+hyperparameter is already traceable), and in-step grad unscaling via
+``grad_scale``/``found_inf`` (kernel ``multi_tensor_lamb_mp``,
+``csrc/multi_tensor_lamb_mp.cu:496``).
+"""
+
+from __future__ import annotations
+
+from apex_tpu.optimizers.fused_lamb import FusedLAMB
+
+
+class FusedMixedPrecisionLamb(FusedLAMB):
+    def __init__(self, lr: float = 1e-3, step: int = 0, bias_correction: bool = True,
+                 betas=(0.9, 0.999), eps: float = 1e-6, weight_decay: float = 0.01,
+                 amsgrad: bool = False, grad_averaging: bool = True,
+                 max_grad_norm: float = 1.0, use_nvlamb: bool = False,
+                 reduced_precision_dtype=None):
+        super().__init__(
+            lr=lr, bias_correction=bias_correction, betas=betas, eps=eps,
+            weight_decay=weight_decay, amsgrad=amsgrad,
+            grad_averaging=grad_averaging, max_grad_norm=max_grad_norm,
+            always_adapt=use_nvlamb, master_weights=True)
+        self.reduced_precision_dtype = reduced_precision_dtype

@@ -1,0 +1,78 @@
+"""FusedNovoGrad — per-tensor second-moment norms.
+
+Semantics of ``apex.optimizers.FusedNovoGrad`` (``apex/optimizers/
+fused_novograd.py:67-214``; kernel ``csrc/multi_tensor_novograd.cu:188``):
+the second moment is a *scalar per tensor* (norm of the gradient), options
+``reg_inside_moment``, ``grad_averaging``, ``norm_type`` (0 = inf, 2 = L2),
+``init_zero``.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+from apex_tpu.optimizers.base import FusedOptimizer, tree_map, tree_map_multi
+
+
+class FusedNovoGrad(FusedOptimizer):
+    def __init__(self, lr: float = 1e-3, bias_correction: bool = True,
+                 betas: Tuple[float, float] = (0.95, 0.98), eps: float = 1e-8,
+                 weight_decay: float = 0.0, grad_averaging: bool = False,
+                 amsgrad: bool = False, reg_inside_moment: bool = False,
+                 norm_type: int = 2, init_zero: bool = False,
+                 master_weights: bool = False):
+        if amsgrad:
+            raise RuntimeError("FusedNovoGrad does not support the AMSGrad variant")
+        if norm_type not in (0, 2):
+            raise RuntimeError(f"FusedNovoGrad only supports l2/inf norm now, got {norm_type}")
+        super().__init__(lr, weight_decay, master_weights)
+        self.bias_correction = bias_correction
+        self.betas = betas
+        self.eps = eps
+        self.grad_averaging = grad_averaging
+        self.reg_inside_moment = reg_inside_moment
+        self.norm_type = norm_type
+        self.init_zero = init_zero
+
+    def _norm(self, g):
+        if self.norm_type == 2:
+            return jnp.sqrt(jnp.sum(g * g))
+        return jnp.max(jnp.abs(g))
+
+    def _init_slots(self, params32):
+        return {
+            "exp_avg": tree_map(jnp.zeros_like, params32),
+            # per-tensor scalar second moment (fused_novograd.py:188-200)
+            "exp_avg_sq": tree_map(lambda p: jnp.zeros((), jnp.float32), params32),
+        }
+
+    def _update(self, g32, p32, slots, step, lr):
+        b1, b2 = self.betas
+        t = step.astype(jnp.float32)
+        bc1 = 1.0 - b1 ** t if self.bias_correction else 1.0
+        bc2 = 1.0 - b2 ** t if self.bias_correction else 1.0
+        beta3 = 1.0 - b1 if self.grad_averaging else 1.0
+        wd = self.weight_decay
+        first = step == 1
+
+        def upd(g, p, m, v):
+            if wd != 0.0 and self.reg_inside_moment:
+                g = g + wd * p
+            gnorm = self._norm(g)
+            stat = gnorm * gnorm if self.norm_type == 2 else gnorm
+            ema = b2 * v + (1.0 - b2) * stat
+            # first step: v <- stat, unless init_zero keeps the EMA form
+            v_new = jnp.where(first & (not self.init_zero), stat, ema)
+            vhat = v_new / bc2
+            denom = (jnp.sqrt(vhat) if self.norm_type == 2 else vhat) + self.eps
+            scaled = g / denom
+            if wd != 0.0 and not self.reg_inside_moment:
+                scaled = scaled + wd * p
+            m_new = b1 * m + beta3 * scaled
+            return p - lr * (m_new / bc1), m_new, v_new
+
+        new_p, new_m, new_v = tree_map_multi(
+            upd, 3, g32, p32, slots["exp_avg"], slots["exp_avg_sq"])
+        return new_p, {"exp_avg": new_m, "exp_avg_sq": new_v}

@@ -1,0 +1,58 @@
+"""FusedSGD with momentum/nesterov/weight-decay variants.
+
+Semantics of ``apex.optimizers.FusedSGD`` (``apex/optimizers/fused_sgd.py:
+76-227``; kernel ``csrc/multi_tensor_sgd_kernel.cu``): first-step momentum
+buffers initialized to the gradient, ``wd_after_momentum`` ordering option,
+and the fp16-model + fp32-master copy flow handled by the base class.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from apex_tpu.optimizers.base import FusedOptimizer, tree_map, tree_map_multi
+
+
+class FusedSGD(FusedOptimizer):
+    def __init__(self, lr: float, momentum: float = 0.0, dampening: float = 0.0,
+                 weight_decay: float = 0.0, nesterov: bool = False,
+                 wd_after_momentum: bool = False,
+                 materialize_master_grads: bool = True,
+                 master_weights: bool = False):
+        if nesterov and (momentum <= 0 or dampening != 0):
+            raise ValueError("Nesterov momentum requires a momentum and zero dampening")
+        super().__init__(lr, weight_decay, master_weights)
+        self.momentum = momentum
+        self.dampening = dampening
+        self.nesterov = nesterov
+        self.wd_after_momentum = wd_after_momentum
+
+    def _init_slots(self, params32):
+        if self.momentum == 0.0:
+            return {"momentum_buffer": None}
+        return {"momentum_buffer": tree_map(jnp.zeros_like, params32)}
+
+    def _update(self, g32, p32, slots, step, lr):
+        wd = self.weight_decay
+        mom = self.momentum
+        first = step == 1
+
+        def upd(g, p, buf):
+            d_p = g
+            if wd != 0.0 and not self.wd_after_momentum:
+                d_p = d_p + wd * p
+            if mom != 0.0:
+                # first step: buf <- d_p (reference initializes buf to grad)
+                buf = jnp.where(first, d_p, mom * buf + (1.0 - self.dampening) * d_p)
+                d_p = d_p + mom * buf if self.nesterov else buf
+            if wd != 0.0 and self.wd_after_momentum:
+                d_p = d_p + wd * p
+            return p - lr * d_p, buf
+
+        if mom == 0.0:
+            new_p = tree_map(
+                lambda g, p: upd(g, p, jnp.zeros(()))[0], g32, p32)
+            return new_p, {"momentum_buffer": None}
+        new_p, new_buf = tree_map_multi(
+            upd, 2, g32, p32, slots["momentum_buffer"])
+        return new_p, {"momentum_buffer": new_buf}

@@ -1,0 +1,29 @@
+"""Data parallelism over the mesh ``data`` axis (capability of ``apex/parallel``).
+
+The reference's ``DistributedDataParallel`` exists to overlap bucketed NCCL
+allreduces with backward (``apex/parallel/distributed.py:131``). Under
+XLA/SPMD the same overlap is the *compiler's* job: gradients produced inside a
+``pjit``/``shard_map`` step are reduced with ``psum`` and XLA schedules the
+collectives into the backward automatically. What remains load-bearing —
+predivide/postdivide, fp32 allreduce, gradient averaging, the no-sync
+accumulation context — is provided here as explicit functions.
+"""
+
+from apex_tpu.parallel.distributed import (
+    DistributedDataParallel,
+    Reducer,
+    all_reduce_gradients,
+    flat_dist_call,
+)
+from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm, convert_syncbn_model
+from apex_tpu.parallel.larc import LARC
+
+__all__ = [
+    "DistributedDataParallel",
+    "Reducer",
+    "all_reduce_gradients",
+    "flat_dist_call",
+    "SyncBatchNorm",
+    "convert_syncbn_model",
+    "LARC",
+]

@@ -1,0 +1,123 @@
+"""Data-parallel gradient synchronization.
+
+Functional counterparts of ``apex.parallel.DistributedDataParallel``
+(``apex/parallel/distributed.py:131-643``). The bucketing/stream machinery
+(``create_hooks``/``comm_ready_buckets``/``allreduce_bucket``,
+``:323-560``) has no TPU analog — XLA fuses and schedules gradient ``psum``
+into the backward pass. Retained semantics:
+
+- ``gradient_average``: divide by the data-parallel world size (``:457-466``);
+- ``gradient_predivide_factor``: divide before the reduce, multiply the
+  remainder after (``:167-179``) for overflow headroom in fp16 sums;
+- ``allreduce_always_fp32``: upcast before reducing (``:452-455``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer import parallel_state
+
+
+def all_reduce_gradients(
+    grads: Any,
+    axis_name: str = parallel_state.DATA_AXIS,
+    *,
+    gradient_average: bool = True,
+    allreduce_always_fp32: bool = False,
+    gradient_predivide_factor: float = 1.0,
+) -> Any:
+    """psum gradients over ``axis_name``. Call inside ``shard_map``.
+
+    Under plain ``pjit`` with batch-sharded inputs this is unnecessary — XLA
+    inserts the reduction — but ``shard_map`` training steps need it, exactly
+    where the reference needed NCCL allreduce.
+    """
+    world = jax.lax.axis_size(axis_name)
+
+    def _reduce(g):
+        orig_dtype = g.dtype
+        if allreduce_always_fp32:
+            g = g.astype(jnp.float32)
+        if gradient_predivide_factor != 1.0:
+            g = g / gradient_predivide_factor
+        g = jax.lax.psum(g, axis_name)
+        if gradient_average:
+            postdiv = world / gradient_predivide_factor
+            if postdiv != 1.0:
+                g = g / postdiv
+        elif gradient_predivide_factor != 1.0:
+            g = g * gradient_predivide_factor
+        return g.astype(orig_dtype)
+
+    return jax.tree_util.tree_map(_reduce, grads)
+
+
+def flat_dist_call(tree: Any, op: Callable, axis_name: str) -> Any:
+    """Apply a collective to every leaf (reference flattens into dtype buckets
+    first, ``distributed.py:15-35``; XLA does that coalescing itself)."""
+    return jax.tree_util.tree_map(lambda x: op(x, axis_name), tree)
+
+
+class Reducer:
+    """Parity with ``apex.parallel.Reducer`` (``distributed.py:91-128``):
+    manual "reduce when you choose" — here a psum-mean over the data axis."""
+
+    def __init__(self, axis_name: str = parallel_state.DATA_AXIS):
+        self.axis_name = axis_name
+
+    def reduce(self, tree: Any) -> Any:
+        return jax.tree_util.tree_map(
+            lambda x: jax.lax.pmean(x, self.axis_name), tree)
+
+
+class DistributedDataParallel:
+    """Parity-API wrapper bundling the reduction options.
+
+    Typical use inside a ``shard_map``-based train step::
+
+        ddp = DistributedDataParallel(allreduce_always_fp32=True)
+        grads = jax.grad(loss_fn)(params, batch_shard)
+        grads = ddp.reduce_gradients(grads)
+
+    ``delay_allreduce`` (reference ``:164``) corresponds to simply not calling
+    ``reduce_gradients`` until the end of gradient accumulation — the
+    ``no_sync`` context capability.
+    """
+
+    def __init__(
+        self,
+        axis_name: str = parallel_state.DATA_AXIS,
+        message_size: int = 10_000_000,      # accepted for parity; XLA buckets itself
+        delay_allreduce: bool = False,
+        allreduce_always_fp32: bool = False,
+        gradient_average: bool = True,
+        gradient_predivide_factor: float = 1.0,
+    ):
+        self.axis_name = axis_name
+        self.delay_allreduce = delay_allreduce
+        self.allreduce_always_fp32 = allreduce_always_fp32
+        self.gradient_average = gradient_average
+        self.gradient_predivide_factor = gradient_predivide_factor
+
+    def reduce_gradients(self, grads: Any) -> Any:
+        return all_reduce_gradients(
+            grads,
+            self.axis_name,
+            gradient_average=self.gradient_average,
+            allreduce_always_fp32=self.allreduce_always_fp32,
+            gradient_predivide_factor=self.gradient_predivide_factor,
+        )
+
+    def broadcast_params(self, params: Any, src_index: int = 0) -> Any:
+        """Reference broadcasts rank-0 params at construction (``:258``);
+        the SPMD analog selects source-device values across the axis."""
+        def _bcast(x):
+            # all devices already hold a replicated copy under pjit; under
+            # shard_map, take the value from the source coordinate
+            return jax.lax.all_gather(x, self.axis_name)[src_index]
+
+        return jax.tree_util.tree_map(_bcast, params)

@@ -1,0 +1,62 @@
+"""LARC — Layer-wise Adaptive Rate Clipping/Scaling.
+
+Semantics of ``apex.parallel.LARC`` (``apex/parallel/LARC.py:5-100``): wraps
+any optimizer; before the inner step each tensor's gradient is rescaled by the
+local learning rate
+
+    local_lr = trust_coefficient * ||p|| / (||g|| + weight_decay * ||p|| + eps)
+
+with ``clip=True`` → ``min(local_lr / lr, 1)`` (clipping mode) or
+``clip=False`` → ``local_lr`` (scaling mode).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.optimizers.base import FusedOptimizer, f32, tree_map
+
+
+class LARC:
+    def __init__(self, optimizer: FusedOptimizer, trust_coefficient: float = 0.02,
+                 clip: bool = True, eps: float = 1e-8):
+        self.inner = optimizer
+        self.trust_coefficient = trust_coefficient
+        self.clip = clip
+        self.eps = eps
+
+    def init(self, params) -> Any:
+        return self.inner.init(params)
+
+    def _adapt(self, grads, params, lr):
+        wd = getattr(self.inner, "weight_decay", 0.0)
+
+        def one(g, p):
+            g32, p32 = g.astype(jnp.float32), p.astype(jnp.float32)
+            pn = jnp.sqrt(jnp.sum(p32 * p32))
+            gn = jnp.sqrt(jnp.sum(g32 * g32))
+            local_lr = self.trust_coefficient * pn / (gn + wd * pn + self.eps)
+            ok = (pn > 0) & (gn > 0)
+            if self.clip:
+                scale = jnp.where(ok, jnp.minimum(local_lr / lr, 1.0), 1.0)
+            else:
+                scale = jnp.where(ok, local_lr, 1.0)
+            # apex folds weight decay into the adapted gradient so the trust
+            # ratio scales it too, and zeroes the inner optimizer's wd
+            # (LARC.py step: p.grad += wd*p before scaling)
+            return ((g32 + wd * p32) * scale).astype(g.dtype)
+
+        return tree_map(one, grads, params)
+
+    def step(self, grads, params, state, *, lr=None, **kw) -> Tuple[Any, Any]:
+        eff_lr = self.inner.lr if lr is None else lr
+        grads = self._adapt(grads, params, eff_lr)
+        saved_wd = self.inner.weight_decay
+        self.inner.weight_decay = 0.0  # wd already applied in the adapted grad
+        try:
+            return self.inner.step(grads, params, state, lr=lr, **kw)
+        finally:
+            self.inner.weight_decay = saved_wd

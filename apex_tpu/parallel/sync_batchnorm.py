@@ -1,0 +1,126 @@
+"""Synchronized BatchNorm over the data-parallel axis.
+
+Math parity with the reference's optimized SyncBN
+(``apex/parallel/optimized_sync_batchnorm_kernel.py:7-120``, CUDA
+``csrc/welford.cu``): local Welford statistics per shard, a cross-replica
+merge, normalization, and a backward whose ``sum_dy``/``sum_dy_xmu`` terms are
+reduced across replicas. On TPU the merge is a ``psum`` of
+``(count, count·mean, count·E[x²])`` over the mesh axis; the backward
+reductions fall out of JAX autodiff *through the psum*, which is exactly the
+all-reduce the reference implements by hand.
+
+Two usage modes:
+
+- inside ``shard_map`` with ``axis_name`` set → explicit cross-shard stats;
+- under plain ``pjit`` (GSPMD) with ``axis_name=None`` → a global ``jnp.mean``
+  over the batch dim *is* the synchronized statistic (XLA inserts the
+  collective), so SyncBN degenerates to regular BN — the TPU-native free lunch.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def _sync_moments(x32: jax.Array, reduce_axes, axis_name: Optional[str],
+                  initializing: bool = False):
+    """Return (mean, var) over ``reduce_axes`` and, if given, ``axis_name``."""
+    n_local = 1
+    for a in reduce_axes:
+        n_local *= x32.shape[a]
+    count = jnp.asarray(n_local, jnp.float32)
+    local_sum = jnp.sum(x32, axis=reduce_axes)
+    sync = axis_name is not None and not initializing
+    if sync:
+        local_sum = jax.lax.psum(local_sum, axis_name)
+        count = jax.lax.psum(count, axis_name)
+    mean = local_sum / count
+    # two-pass variance: centering before squaring avoids the catastrophic
+    # cancellation of E[x²]-mean² — the stability property the reference's
+    # Welford kernels (csrc/welford.cu) exist to provide
+    shape = [1] * x32.ndim
+    for a in range(x32.ndim):
+        if a not in [ax % x32.ndim for ax in reduce_axes]:
+            shape[a] = x32.shape[a]
+    centered = x32 - mean.reshape(shape)
+    sqsum = jnp.sum(centered * centered, axis=reduce_axes)
+    if sync:
+        sqsum = jax.lax.psum(sqsum, axis_name)
+    var = sqsum / count
+    return mean, var, count
+
+
+class SyncBatchNorm(nn.Module):
+    """Drop-in BN synchronized across ``axis_name``
+    (module parity: ``apex/parallel/optimized_sync_batchnorm.py:9-107``).
+
+    ``channel_last=False`` expects NCHW-like inputs with channels at dim 1;
+    ``channel_last=True`` expects channels at the last dim (the reference's
+    NHWC fast path — on TPU NHWC is the native conv layout anyway).
+    """
+
+    num_features: int
+    eps: float = 1e-5
+    momentum: float = 0.1
+    affine: bool = True
+    track_running_stats: bool = True
+    channel_last: bool = True
+    axis_name: Optional[str] = None
+    fuse_relu: bool = False
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, use_running_stats: bool = False):
+        c = self.num_features
+        if self.channel_last:
+            reduce_axes = tuple(range(x.ndim - 1))
+        else:
+            reduce_axes = (0,) + tuple(range(2, x.ndim))
+
+        ra_mean = self.variable("batch_stats", "mean",
+                                lambda: jnp.zeros((c,), jnp.float32))
+        ra_var = self.variable("batch_stats", "var",
+                               lambda: jnp.ones((c,), jnp.float32))
+
+        x32 = x.astype(jnp.float32)
+        if use_running_stats:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            mean, var, count = _sync_moments(
+                x32, reduce_axes, self.axis_name,
+                initializing=self.is_initializing())
+            if self.track_running_stats and not self.is_initializing():
+                # unbiased variance for running stats (reference matches
+                # torch BN semantics)
+                unbiased = var * count / jnp.maximum(count - 1.0, 1.0)
+                ra_mean.value = (1 - self.momentum) * ra_mean.value + self.momentum * mean
+                ra_var.value = (1 - self.momentum) * ra_var.value + self.momentum * unbiased
+
+        shape = [1] * x.ndim
+        ch_axis = x.ndim - 1 if self.channel_last else 1
+        shape[ch_axis] = c
+        inv = jax.lax.rsqrt(var + self.eps).reshape(shape)
+        y = (x32 - mean.reshape(shape)) * inv
+        if self.affine:
+            weight = self.param("scale", nn.initializers.ones, (c,), self.param_dtype)
+            bias = self.param("bias", nn.initializers.zeros, (c,), self.param_dtype)
+            y = y * weight.reshape(shape) + bias.reshape(shape)
+        if self.fuse_relu:
+            y = jnp.maximum(y, 0.0)
+        return y.astype(x.dtype)
+
+
+def convert_syncbn_model(module: nn.Module, axis_name: Optional[str] = None) -> nn.Module:
+    """Parity stub for ``apex.parallel.convert_syncbn_model``
+    (``apex/parallel/__init__.py:21-77``). flax modules are immutable; models
+    in this framework take a ``norm`` factory instead — see
+    ``apex_tpu.models.resnet`` for the pattern. Raises with guidance."""
+    raise NotImplementedError(
+        "flax modules are declarative: construct the model with "
+        "SyncBatchNorm (e.g. ResNet(norm=SyncBatchNorm, axis_name=...)) "
+        "instead of converting after the fact."
+    )

@@ -1,0 +1,7 @@
+"""Megatron-style model parallelism on a TPU mesh (capability of
+``apex/transformer``): tensor, sequence, pipeline, and context parallelism
+plus the mesh registry (``parallel_state``)."""
+
+from apex_tpu.transformer import parallel_state
+
+__all__ = ["parallel_state"]

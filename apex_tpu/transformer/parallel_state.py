@@ -1,0 +1,249 @@
+"""Model-parallel mesh registry.
+
+TPU-native counterpart of the reference's process-group registry
+(``apex/transformer/parallel_state.py:155-419``). Where the reference creates
+NCCL process groups for tensor/pipeline/data/embedding parallelism, here a
+single :class:`jax.sharding.Mesh` carries named axes and every "group" is a
+mesh axis; XLA collectives (``psum``/``all_gather``/``psum_scatter``/
+``ppermute``) over an axis name replace group handles.
+
+Axis layout (outermost → innermost): ``(data, pipeline, context, tensor)``.
+The tensor axis is innermost so TP collectives — the most latency/bandwidth
+sensitive — map onto the shortest ICI hops; pipeline ``ppermute`` rides the
+next ring out; data-parallel gradient reductions tolerate the longest paths
+(DCN when multi-slice). This mirrors the reference's topology awareness
+(hybrid IB/socket groups keyed on ``NUM_GPUS_PER_IB_BLOCK``,
+``parallel_state.py:108-153``) in XLA terms.
+
+Rank getters follow the reference API (``get_tensor_model_parallel_rank`` etc.,
+``parallel_state.py:421-430``): inside ``shard_map`` they return the traced
+``lax.axis_index``; outside they return 0 (the "controller" view — JAX is
+single-controller per process, unlike torch's one-rank-per-process model).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec
+
+# Canonical axis names.
+DATA_AXIS = "data"
+PIPELINE_AXIS = "pipeline"
+CONTEXT_AXIS = "context"
+TENSOR_AXIS = "tensor"
+
+MESH_AXIS_NAMES = (DATA_AXIS, PIPELINE_AXIS, CONTEXT_AXIS, TENSOR_AXIS)
+
+_MESH: Optional[Mesh] = None
+
+# Interleaved-schedule virtual pipeline state
+# (reference: parallel_state.py:675-696).
+_VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK: Optional[int] = None
+_VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE: Optional[int] = None
+
+# Test-only world-size overrides (reference exposes the same "fake" setters).
+_FAKE_SIZES: dict = {}
+
+
+def initialize_model_parallel(
+    tensor_model_parallel_size: int = 1,
+    pipeline_model_parallel_size: int = 1,
+    virtual_pipeline_model_parallel_size: Optional[int] = None,
+    context_parallel_size: int = 1,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build and install the global mesh.
+
+    Data-parallel size is inferred as ``n_devices // (tp * pp * cp)``, exactly
+    as the reference infers ``data_parallel_size`` from the world size
+    (``apex/transformer/parallel_state.py:213-222``).
+    """
+    global _MESH, _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE
+    devs = list(devices) if devices is not None else jax.devices()
+    n = len(devs)
+    tp, pp, cp = tensor_model_parallel_size, pipeline_model_parallel_size, context_parallel_size
+    denom = tp * pp * cp
+    if n % denom != 0:
+        raise RuntimeError(
+            f"device count ({n}) is not divisible by tensor_model_parallel_size "
+            f"({tp}) x pipeline_model_parallel_size ({pp}) x context_parallel_size ({cp})"
+        )
+    dp = n // denom
+    dev_array = np.array(devs).reshape(dp, pp, cp, tp)
+    _MESH = Mesh(dev_array, MESH_AXIS_NAMES)
+    if virtual_pipeline_model_parallel_size is not None:
+        _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE = virtual_pipeline_model_parallel_size
+    return _MESH
+
+
+def model_parallel_is_initialized() -> bool:
+    return _MESH is not None
+
+
+def get_mesh() -> Mesh:
+    if _MESH is None:
+        raise RuntimeError(
+            "model parallel mesh is not initialized; call "
+            "apex_tpu.transformer.parallel_state.initialize_model_parallel() first"
+        )
+    return _MESH
+
+
+def destroy_model_parallel() -> None:
+    """Reference: ``parallel_state.py:761-792``."""
+    global _MESH, _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK
+    global _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE
+    _MESH = None
+    _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK = None
+    _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE = None
+    _FAKE_SIZES.clear()
+
+
+# ---------------------------------------------------------------------------
+# world sizes
+# ---------------------------------------------------------------------------
+
+def _axis_size(axis: str) -> int:
+    if axis in _FAKE_SIZES:
+        return _FAKE_SIZES[axis]
+    return get_mesh().shape[axis]
+
+
+def get_tensor_model_parallel_world_size() -> int:
+    return _axis_size(TENSOR_AXIS)
+
+
+def get_pipeline_model_parallel_world_size() -> int:
+    return _axis_size(PIPELINE_AXIS)
+
+
+def get_context_parallel_world_size() -> int:
+    return _axis_size(CONTEXT_AXIS)
+
+
+def get_data_parallel_world_size() -> int:
+    return _axis_size(DATA_AXIS)
+
+
+def get_model_parallel_world_size() -> int:
+    return get_tensor_model_parallel_world_size() * get_pipeline_model_parallel_world_size()
+
+
+# test-only overrides, mirroring the reference's set_*_world_size
+def set_tensor_model_parallel_world_size(size: Optional[int]) -> None:
+    _set_fake(TENSOR_AXIS, size)
+
+
+def set_pipeline_model_parallel_world_size(size: Optional[int]) -> None:
+    _set_fake(PIPELINE_AXIS, size)
+
+
+def _set_fake(axis: str, size: Optional[int]) -> None:
+    if size is None:
+        _FAKE_SIZES.pop(axis, None)
+    else:
+        _FAKE_SIZES[axis] = size
+
+
+# ---------------------------------------------------------------------------
+# ranks — traced inside shard_map, 0 on the controller
+# ---------------------------------------------------------------------------
+
+def _axis_rank(axis: str):
+    try:
+        return jax.lax.axis_index(axis)
+    except NameError:
+        return 0
+
+
+def get_tensor_model_parallel_rank():
+    return _axis_rank(TENSOR_AXIS)
+
+
+def get_pipeline_model_parallel_rank():
+    return _axis_rank(PIPELINE_AXIS)
+
+
+def get_context_parallel_rank():
+    return _axis_rank(CONTEXT_AXIS)
+
+
+def get_data_parallel_rank():
+    return _axis_rank(DATA_AXIS)
+
+
+def is_pipeline_first_stage(ignore_virtual: bool = False):
+    """Reference: ``parallel_state.py:589-600``."""
+    if not ignore_virtual:
+        vpp = get_virtual_pipeline_model_parallel_world_size()
+        if vpp is not None and get_virtual_pipeline_model_parallel_rank() != 0:
+            return False
+    return get_pipeline_model_parallel_rank() == 0
+
+
+def is_pipeline_last_stage(ignore_virtual: bool = False):
+    if not ignore_virtual:
+        vpp = get_virtual_pipeline_model_parallel_world_size()
+        if vpp is not None and get_virtual_pipeline_model_parallel_rank() != vpp - 1:
+            return False
+    return get_pipeline_model_parallel_rank() == get_pipeline_model_parallel_world_size() - 1
+
+
+def get_pipeline_model_parallel_next_rank():
+    rank = get_pipeline_model_parallel_rank()
+    return (rank + 1) % get_pipeline_model_parallel_world_size()
+
+
+def get_pipeline_model_parallel_prev_rank():
+    rank = get_pipeline_model_parallel_rank()
+    return (rank - 1) % get_pipeline_model_parallel_world_size()
+
+
+# ---------------------------------------------------------------------------
+# virtual pipeline (interleaved schedule) state
+# ---------------------------------------------------------------------------
+
+def get_virtual_pipeline_model_parallel_rank() -> Optional[int]:
+    return _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK
+
+
+def set_virtual_pipeline_model_parallel_rank(rank: Optional[int]) -> None:
+    global _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK
+    _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK = rank
+
+
+def get_virtual_pipeline_model_parallel_world_size() -> Optional[int]:
+    return _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE
+
+
+def set_virtual_pipeline_model_parallel_world_size(size: Optional[int]) -> None:
+    global _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE
+    _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE = size
+
+
+# ---------------------------------------------------------------------------
+# sharding helpers
+# ---------------------------------------------------------------------------
+
+def data_parallel_spec(*trailing: Optional[str]) -> PartitionSpec:
+    """PartitionSpec sharding dim 0 over the data axis."""
+    return PartitionSpec(DATA_AXIS, *trailing)
+
+
+def replicated_spec() -> PartitionSpec:
+    return PartitionSpec()
+
+
+def get_rank_info() -> str:
+    """Compact rank/topology string (reference: ``parallel_state.py:421-430``)."""
+    if not model_parallel_is_initialized():
+        return "mesh=uninitialized"
+    m = get_mesh()
+    return (
+        f"dp={m.shape[DATA_AXIS]} pp={m.shape[PIPELINE_AXIS]} "
+        f"cp={m.shape[CONTEXT_AXIS]} tp={m.shape[TENSOR_AXIS]}"
+    )

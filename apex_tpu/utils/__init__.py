@@ -1,0 +1,18 @@
+from apex_tpu.utils.logging import get_logger, RankInfoFormatter
+from apex_tpu.utils.deprecation import deprecated_warning
+from apex_tpu.utils.tree import (
+    tree_cast,
+    tree_size,
+    tree_zeros_like,
+    global_norm,
+)
+
+__all__ = [
+    "get_logger",
+    "RankInfoFormatter",
+    "deprecated_warning",
+    "tree_cast",
+    "tree_size",
+    "tree_zeros_like",
+    "global_norm",
+]

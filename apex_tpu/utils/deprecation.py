@@ -1,0 +1,23 @@
+"""Deprecation machinery (capability of ``apex/__init__.py:46-67``)."""
+
+from __future__ import annotations
+
+import warnings
+
+
+class DeprecatedFeatureWarning(FutureWarning):
+    pass
+
+
+_seen: set = set()
+
+
+def deprecated_warning(msg: str) -> None:
+    """Warn once per unique message, on process 0 only."""
+    import jax
+
+    if msg in _seen:
+        return
+    _seen.add(msg)
+    if jax.process_index() == 0:
+        warnings.warn(msg, DeprecatedFeatureWarning, stacklevel=2)

@@ -1,0 +1,53 @@
+"""Library logging with parallel-rank context.
+
+TPU-native counterpart of the reference's ``RankInfoFormatter``
+(``apex/__init__.py:31-44``), which prefixes every record with the
+``(dp, tp, pp, vpp)`` rank tuple from ``parallel_state.get_rank_info``
+(``apex/transformer/parallel_state.py:421-430``). Here ranks come from the
+process index and the active mesh registry instead of torch.distributed.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+
+def _rank_info() -> str:
+    """Return a compact rank string: process index plus mesh axis coordinates."""
+    parts = [f"proc={os.environ.get('JAX_PROCESS_INDEX', '0')}"]
+    try:
+        from apex_tpu.transformer import parallel_state
+
+        if parallel_state.model_parallel_is_initialized():
+            parts.append(parallel_state.get_rank_info())
+    except Exception:
+        pass
+    return " ".join(parts)
+
+
+class RankInfoFormatter(logging.Formatter):
+    """Formatter injecting ``%(rank_info)s`` into every record."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        record.rank_info = _rank_info()
+        return super().format(record)
+
+
+_LOGGER_NAME = "apex_tpu"
+
+
+def get_logger(name: str = _LOGGER_NAME) -> logging.Logger:
+    logger = logging.getLogger(name)
+    if not getattr(logger, "_apex_tpu_configured", False):
+        handler = logging.StreamHandler()
+        handler.setFormatter(
+            RankInfoFormatter(
+                "%(asctime)s [%(levelname)s] [%(rank_info)s] %(name)s: %(message)s"
+            )
+        )
+        logger.addHandler(handler)
+        logger.setLevel(os.environ.get("APEX_TPU_LOG_LEVEL", "WARNING"))
+        logger.propagate = False
+        logger._apex_tpu_configured = True  # type: ignore[attr-defined]
+    return logger

@@ -1,0 +1,46 @@
+"""Pytree utilities shared across the framework."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+def tree_cast(tree: Any, dtype) -> Any:
+    """Cast every floating-point leaf of ``tree`` to ``dtype``."""
+    if dtype is None:
+        return tree
+
+    def _cast(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+
+    return jax.tree_util.tree_map(_cast, tree)
+
+
+def tree_size(tree: Any) -> int:
+    """Total number of elements across all leaves."""
+    return sum(x.size for x in jax.tree_util.tree_leaves(tree))
+
+
+def tree_zeros_like(tree: Any, dtype=None) -> Any:
+    return jax.tree_util.tree_map(
+        lambda x: jnp.zeros(x.shape, dtype or x.dtype), tree
+    )
+
+
+def global_norm(tree: Any) -> jax.Array:
+    """L2 norm over all leaves, accumulated in fp32.
+
+    Capability parity with ``amp_C.multi_tensor_l2norm``
+    (``csrc/multi_tensor_l2norm_kernel.cu``): one fused reduction over the
+    whole parameter set (XLA fuses the per-leaf partial sums).
+    """
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.zeros((), jnp.float32)
+    sq = sum(jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves)
+    return jnp.sqrt(sq)

@@ -1,0 +1,134 @@
+"""amp policy + loss scaler tests (tier-L0 analog of ``tests/L0/run_amp``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import amp
+from apex_tpu.amp.scaler import LossScaler
+
+
+def test_opt_levels():
+    for lvl in ("O0", "O1", "O2", "O3"):
+        st = amp.initialize(lvl)
+        assert st.properties.opt_level == lvl
+    with pytest.raises(ValueError):
+        amp.initialize("O4")
+    o2 = amp.initialize("O2")
+    assert o2.properties.master_weights
+    assert o2.policy.param_dtype == jnp.bfloat16
+    o0 = amp.initialize("O0")
+    assert float(o0.loss_scale) == 1.0
+
+
+def test_policy_wrap():
+    policy = amp.Policy(jnp.float32, jnp.bfloat16, jnp.float32)
+    fn = policy.wrap(lambda x: x * 2)
+    out = fn(jnp.ones((4,), jnp.float32))
+    assert out.dtype == jnp.float32
+    seen = {}
+
+    def probe(x):
+        seen["dtype"] = x.dtype
+        return x
+
+    policy.wrap(probe)(jnp.ones((4,), jnp.float32))
+    assert seen["dtype"] == jnp.bfloat16
+
+
+def test_half_float_promote():
+    h = amp.half_function(lambda x: x)
+    assert h(jnp.ones(3, jnp.float32)).dtype == jnp.bfloat16
+    f = amp.float_function(lambda x: x)
+    assert f(jnp.ones(3, jnp.bfloat16)).dtype == jnp.float32
+    p = amp.promote_function(lambda x, y: x + y)
+    out = p(jnp.ones(3, jnp.bfloat16), jnp.ones(3, jnp.float32))
+    assert out.dtype == jnp.float32
+
+
+def test_scaler_static():
+    sc = LossScaler(128.0)
+    st = sc.init()
+    assert float(sc.scale(jnp.asarray(2.0), st)) == 256.0
+    grads = {"w": jnp.full((4,), 256.0)}
+    unscaled, found_inf = sc.unscale(grads, st)
+    np.testing.assert_allclose(unscaled["w"], 2.0)
+    assert not bool(found_inf)
+    st2 = sc.update(st, found_inf)
+    assert float(st2.loss_scale) == 128.0
+
+
+def test_scaler_dynamic_backoff_growth():
+    sc = LossScaler("dynamic", init_scale=2.0 ** 8, scale_window=3)
+    st = sc.init()
+    bad = {"w": jnp.array([jnp.inf, 1.0])}
+    _, found_inf = sc.unscale(bad, st)
+    assert bool(found_inf)
+    st = sc.update(st, found_inf)
+    assert float(st.loss_scale) == 2.0 ** 7  # halved
+    good = {"w": jnp.ones(2)}
+    for _ in range(3):
+        _, fi = sc.unscale(good, st)
+        st = sc.update(st, fi)
+    assert float(st.loss_scale) == 2.0 ** 8  # grew after window
+
+
+def test_scaler_hysteresis():
+    sc = LossScaler("dynamic", init_scale=2.0 ** 8, hysteresis=2)
+    st = sc.init()
+    fi = jnp.asarray(True)
+    st = sc.update(st, fi)
+    assert float(st.loss_scale) == 2.0 ** 8  # first overflow absorbed
+    st = sc.update(st, fi)
+    assert float(st.loss_scale) == 2.0 ** 7  # credits exhausted -> backoff
+
+
+def test_scaler_unscale_zeroes_nonfinite():
+    sc = LossScaler(1.0)
+    g = {"w": jnp.array([1.0, jnp.nan, jnp.inf])}
+    u, fi = sc.unscale(g, sc.init())
+    assert bool(fi)
+    assert np.isfinite(np.asarray(u["w"])).all()
+
+
+def test_state_dict_roundtrip():
+    st = amp.initialize("O2", num_losses=2)
+    d = amp.state_dict(st)
+    assert set(d) == {"loss_scaler0", "loss_scaler1"}
+    st2 = amp.load_state_dict(st, {"loss_scaler0": {"loss_scale": 42.0}})
+    assert float(st2.scaler_states[0].loss_scale) == 42.0
+
+
+def test_apply_if_finite():
+    params = jnp.ones((3,))
+    stepped = amp.apply_if_finite(jnp.asarray(False), lambda p: p + 1, params)
+    np.testing.assert_allclose(stepped, 2.0)
+    skipped = amp.apply_if_finite(jnp.asarray(True), lambda p: p + 1, params)
+    np.testing.assert_allclose(skipped, 1.0)
+
+
+def test_scale_skip_flow_jitted():
+    """End-to-end jitted train-step flow with an injected overflow."""
+    sc = LossScaler("dynamic", init_scale=2.0 ** 4)
+    opt_lr = 0.1
+
+    @jax.jit
+    def step(params, scaler_state, x):
+        def loss_fn(p):
+            loss = jnp.sum((p * x) ** 2)
+            return sc.scale(loss, scaler_state)
+
+        grads = jax.grad(loss_fn)(params)
+        grads, found_inf = sc.unscale(grads, scaler_state)
+        new_params = amp.apply_if_finite(
+            found_inf, lambda p: p - opt_lr * grads, params)
+        return new_params, sc.update(scaler_state, found_inf)
+
+    params = jnp.ones((4,))
+    st = sc.init()
+    params2, st2 = step(params, st, jnp.ones((4,)))
+    assert not np.allclose(params2, params)  # stepped
+    params3, st3 = step(params2, st2, jnp.full((4,), jnp.inf))
+    np.testing.assert_allclose(params3, params2)  # skipped
+    assert float(st3.loss_scale) == 2.0 ** 3

@@ -1,0 +1,52 @@
+"""multi_tensor_apply machinery tests (analog of the amp multi-tensor kernel
+tests, ``tests/L0/run_amp/test_multi_tensor_scale.py``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.multi_tensor_apply import (
+    flatten_by_dtype,
+    unflatten_by_dtype,
+    multi_tensor_applier,
+)
+
+
+def test_flatten_roundtrip_mixed_dtypes():
+    tree = {
+        "a": jnp.ones((3, 5), jnp.float32),
+        "b": jnp.full((7,), 2.0, jnp.bfloat16),
+        "c": {"d": jnp.arange(6, dtype=jnp.float32).reshape(2, 3)},
+    }
+    buffers, metas, aux = flatten_by_dtype(tree)
+    assert set(buffers) == {"float32", "bfloat16"}
+    for k, buf in buffers.items():
+        assert buf.shape[0] % 1024 == 0
+    back = unflatten_by_dtype(buffers, metas, aux)
+    for a, b in zip(jax.tree_util.tree_leaves(tree), jax.tree_util.tree_leaves(back)):
+        np.testing.assert_allclose(np.asarray(a, np.float32), np.asarray(b, np.float32))
+        assert a.dtype == b.dtype and a.shape == b.shape
+
+
+def test_multi_tensor_scale():
+    tensors = [jnp.ones((4, 4)), jnp.full((10,), 2.0), jnp.ones((3, 3, 3))]
+
+    def scale_op(flat, scale):
+        return flat * scale
+
+    (out,) = multi_tensor_applier(scale_op, [tensors], 0.5)
+    np.testing.assert_allclose(out[0], 0.5)
+    np.testing.assert_allclose(out[1], 1.0)
+    assert out[2].shape == (3, 3, 3)
+
+
+def test_multi_tensor_axpby():
+    xs = [jnp.ones((5,)), jnp.full((3, 2), 2.0)]
+    ys = [jnp.full((5,), 10.0), jnp.full((3, 2), 20.0)]
+
+    def axpby(fx, fy, a, b):
+        return a * fx + b * fy
+
+    (out,) = multi_tensor_applier(axpby, [xs, ys], 2.0, 0.5)
+    np.testing.assert_allclose(out[0], 2.0 + 5.0)
+    np.testing.assert_allclose(out[1], 4.0 + 10.0)

@@ -1,0 +1,85 @@
+"""Fused softmax parity (tier-L0 analog of the megatron softmax tests)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.ops import (
+    scaled_softmax,
+    scaled_masked_softmax,
+    scaled_upper_triang_masked_softmax,
+    generic_scaled_masked_softmax,
+)
+from apex_tpu.ops import _support
+
+
+def ref_masked(x, mask, scale):
+    logits = x.astype(jnp.float32) * scale
+    if mask is not None:
+        logits = jnp.where(jnp.broadcast_to(mask, x.shape), -10000.0, logits)
+    return jax.nn.softmax(logits, axis=-1)
+
+
+def test_scaled_softmax():
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 8, 24))
+    y = scaled_softmax(x, 0.5)
+    np.testing.assert_allclose(y, ref_masked(x, None, 0.5), atol=1e-6)
+    g = jax.grad(lambda x: jnp.sum(scaled_softmax(x, 0.5) * jnp.cos(x)))(x)
+    gr = jax.grad(lambda x: jnp.sum(ref_masked(x, None, 0.5) * jnp.cos(x)))(x)
+    np.testing.assert_allclose(g, gr, atol=1e-5)
+
+
+def test_scaled_masked_softmax():
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 8, 24))
+    mask = jax.random.bernoulli(jax.random.PRNGKey(1), 0.3, (2, 1, 8, 24))
+    y = scaled_masked_softmax(x, mask, 2.0)
+    np.testing.assert_allclose(y, ref_masked(x, mask, 2.0), atol=1e-6)
+    g = jax.grad(lambda x: jnp.sum(scaled_masked_softmax(x, mask, 2.0) ** 2))(x)
+    gr = jax.grad(lambda x: jnp.sum(ref_masked(x, mask, 2.0) ** 2))(x)
+    np.testing.assert_allclose(g, gr, atol=1e-5)
+    yg = generic_scaled_masked_softmax(x, mask, 2.0)
+    np.testing.assert_allclose(yg, y, atol=1e-7)
+
+
+def test_causal_softmax():
+    sq = 16
+    x = jax.random.normal(jax.random.PRNGKey(0), (6, sq, sq))
+    y = scaled_upper_triang_masked_softmax(x, 1.0)
+    causal = jnp.triu(jnp.ones((sq, sq), bool), k=1)
+    yr = ref_masked(x, causal[None], 1.0)
+    np.testing.assert_allclose(y, yr, atol=1e-6)
+    # strictly-upper entries ~0 probability mass
+    assert float(jnp.max(jnp.where(causal[None], y, 0.0))) < 1e-4
+    g = jax.grad(lambda x: jnp.sum(scaled_upper_triang_masked_softmax(x, 1.0) ** 2))(x)
+    gr = jax.grad(lambda x: jnp.sum(ref_masked(x, causal[None], 1.0) ** 2))(x)
+    np.testing.assert_allclose(g, gr, atol=1e-5)
+
+
+def test_bf16():
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 8, 32), jnp.bfloat16)
+    y = scaled_softmax(x, 1.0)
+    assert y.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(y, np.float32),
+        np.asarray(ref_masked(x, None, 1.0), np.float32), atol=0.01)
+
+
+def test_pallas_interpret_kernels(monkeypatch):
+    monkeypatch.setenv("APEX_TPU_FORCE_PALLAS", "interpret")
+    _support.pallas_mode.cache_clear()
+    try:
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 2, 8, 40))
+        mask = jax.random.bernoulli(jax.random.PRNGKey(1), 0.3, (2, 1, 8, 40))
+        y = scaled_masked_softmax(x, mask, 1.5)
+        np.testing.assert_allclose(y, ref_masked(x, mask, 1.5), atol=1e-6)
+        g = jax.grad(lambda x: jnp.sum(scaled_masked_softmax(x, mask, 1.5) ** 2))(x)
+        gr = jax.grad(lambda x: jnp.sum(ref_masked(x, mask, 1.5) ** 2))(x)
+        np.testing.assert_allclose(g, gr, atol=1e-5)
+
+        xc = jax.random.normal(jax.random.PRNGKey(2), (4, 8, 8))
+        yc = scaled_upper_triang_masked_softmax(xc, 1.0)
+        causal = jnp.triu(jnp.ones((8, 8), bool), k=1)
+        np.testing.assert_allclose(yc, ref_masked(xc, causal[None], 1.0), atol=1e-6)
+    finally:
+        _support.pallas_mode.cache_clear()

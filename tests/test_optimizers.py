@@ -1,0 +1,271 @@
+"""Fused-optimizer parity vs independent references (tier-L0 analog of
+``tests/L0/run_optimizers/test_fused_optimizer.py``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from apex_tpu.optimizers import (
+    FusedAdam,
+    FusedAdagrad,
+    FusedLAMB,
+    FusedMixedPrecisionLamb,
+    FusedNovoGrad,
+    FusedSGD,
+)
+from apex_tpu.parallel import LARC
+from apex_tpu.contrib.clip_grad import clip_grad_norm
+
+
+def make_params(key=0):
+    k = jax.random.PRNGKey(key)
+    ks = jax.random.split(k, 4)
+    return {
+        "w1": jax.random.normal(ks[0], (8, 16)),
+        "b1": jax.random.normal(ks[1], (16,)),
+        "nested": {"w2": jax.random.normal(ks[2], (16, 4)),
+                   "w3": jax.random.normal(ks[3], (3, 5, 7))},
+    }
+
+
+def make_grads(params, key=100):
+    flat, treedef = jax.tree_util.tree_flatten(params)
+    keys = jax.random.split(jax.random.PRNGKey(key), len(flat))
+    return jax.tree_util.tree_unflatten(
+        treedef, [jax.random.normal(k, x.shape) for k, x in zip(keys, flat)])
+
+
+def run_steps(opt, params, n=5, **kw):
+    state = opt.init(params)
+    for i in range(n):
+        grads = make_grads(params, key=100 + i)
+        params, state = opt.step(grads, params, state, **kw)
+    return params
+
+
+def test_adam_matches_optax_adamw():
+    params = make_params()
+    mine = FusedAdam(lr=1e-2, weight_decay=0.01, adam_w_mode=True)
+    ref = optax.adamw(1e-2, b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.01)
+    p1 = run_steps(mine, params)
+    state = ref.init(params)
+    p2 = params
+    for i in range(5):
+        grads = make_grads(p2, key=100 + i)
+        updates, state = ref.update(grads, state, p2)
+        p2 = optax.apply_updates(p2, updates)
+    for a, b in zip(jax.tree_util.tree_leaves(p1), jax.tree_util.tree_leaves(p2)):
+        np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5)
+
+
+def test_adam_l2_mode_matches_optax_adam_with_l2():
+    params = make_params()
+    wd = 0.05
+    mine = FusedAdam(lr=1e-2, weight_decay=wd, adam_w_mode=False)
+    ref = optax.adam(1e-2)
+    p1 = run_steps(mine, params)
+    state = ref.init(params)
+    p2 = params
+    for i in range(5):
+        grads = make_grads(p2, key=100 + i)
+        grads = jax.tree_util.tree_map(lambda g, p: g + wd * p, grads, p2)
+        updates, state = ref.update(grads, state, p2)
+        p2 = optax.apply_updates(p2, updates)
+    for a, b in zip(jax.tree_util.tree_leaves(p1), jax.tree_util.tree_leaves(p2)):
+        np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5)
+
+
+def test_sgd_momentum_matches_torch_semantics():
+    """First-step momentum buffer = d_p (torch/apex), then EMA."""
+    p0 = np.random.RandomState(0).randn(6).astype(np.float32)
+    g1 = np.random.RandomState(1).randn(6).astype(np.float32)
+    g2 = np.random.RandomState(2).randn(6).astype(np.float32)
+    lr, mom, wd = 0.1, 0.9, 0.01
+
+    # manual torch-style reference
+    d1 = g1 + wd * p0
+    buf = d1.copy()
+    p_ref = p0 - lr * buf
+    d2 = g2 + wd * p_ref
+    buf = mom * buf + d2
+    p_ref2 = p_ref - lr * buf
+
+    opt = FusedSGD(lr=lr, momentum=mom, weight_decay=wd)
+    params = {"p": jnp.asarray(p0)}
+    state = opt.init(params)
+    params, state = opt.step({"p": jnp.asarray(g1)}, params, state)
+    np.testing.assert_allclose(params["p"], p_ref, atol=1e-6)
+    params, state = opt.step({"p": jnp.asarray(g2)}, params, state)
+    np.testing.assert_allclose(params["p"], p_ref2, atol=1e-6)
+
+
+def test_sgd_nesterov_and_plain():
+    params = make_params()
+    # plain SGD == optax.sgd
+    p1 = run_steps(FusedSGD(lr=0.05), params)
+    ref = optax.sgd(0.05)
+    state = ref.init(params)
+    p2 = params
+    for i in range(5):
+        grads = make_grads(p2, key=100 + i)
+        updates, state = ref.update(grads, state, p2)
+        p2 = optax.apply_updates(p2, updates)
+    for a, b in zip(jax.tree_util.tree_leaves(p1), jax.tree_util.tree_leaves(p2)):
+        np.testing.assert_allclose(a, b, atol=1e-6)
+    with pytest.raises(ValueError):
+        FusedSGD(lr=0.1, nesterov=True)  # needs momentum
+
+
+def test_adagrad_matches_manual():
+    p0 = np.random.RandomState(0).randn(5).astype(np.float32)
+    lr, eps = 0.1, 1e-10
+    h = np.zeros_like(p0)
+    p_ref = p0.copy()
+    opt = FusedAdagrad(lr=lr, eps=eps)
+    params = {"p": jnp.asarray(p0)}
+    state = opt.init(params)
+    for i in range(4):
+        g = np.random.RandomState(10 + i).randn(5).astype(np.float32)
+        h += g * g
+        p_ref -= lr * g / (np.sqrt(h) + eps)
+        params, state = opt.step({"p": jnp.asarray(g)}, params, state)
+        np.testing.assert_allclose(params["p"], p_ref, atol=1e-6)
+
+
+def test_lamb_trust_ratio_and_clip():
+    params = make_params()
+    opt = FusedLAMB(lr=1e-2, weight_decay=0.01, max_grad_norm=1.0)
+    p1 = run_steps(opt, params)
+    # sanity: params moved, finite
+    for a, b in zip(jax.tree_util.tree_leaves(p1), jax.tree_util.tree_leaves(params)):
+        assert np.isfinite(np.asarray(a)).all()
+        assert not np.allclose(a, b)
+    # with tiny max_grad_norm, effective grads shrink -> smaller step
+    opt_clip = FusedLAMB(lr=1e-2, weight_decay=0.0, max_grad_norm=1e-6)
+    opt_free = FusedLAMB(lr=1e-2, weight_decay=0.0, max_grad_norm=0.0)
+    pc = run_steps(opt_clip, params, n=1)
+    pf = run_steps(opt_free, params, n=1)
+    d_clip = sum(float(jnp.sum(jnp.abs(a - b))) for a, b in
+                 zip(jax.tree_util.tree_leaves(pc), jax.tree_util.tree_leaves(params)))
+    d_free = sum(float(jnp.sum(jnp.abs(a - b))) for a, b in
+                 zip(jax.tree_util.tree_leaves(pf), jax.tree_util.tree_leaves(params)))
+    assert d_clip < d_free
+
+
+def test_lamb_without_wd_no_adaptation_matches_adamw_shape():
+    """weight_decay=0, always_adapt=False → trust ratio 1 → plain AdamW-like step."""
+    params = make_params()
+    lamb = FusedLAMB(lr=1e-3, weight_decay=0.0, max_grad_norm=0.0)
+    adam = FusedAdam(lr=1e-3, weight_decay=0.0, eps=1e-6)
+    p1 = run_steps(lamb, params, n=3)
+    p2 = run_steps(adam, params, n=3)
+    for a, b in zip(jax.tree_util.tree_leaves(p1), jax.tree_util.tree_leaves(p2)):
+        np.testing.assert_allclose(a, b, atol=1e-6)
+
+
+def test_novograd_runs_and_is_finite():
+    params = make_params()
+    opt = FusedNovoGrad(lr=1e-2, weight_decay=0.01, grad_averaging=True)
+    p1 = run_steps(opt, params)
+    for a in jax.tree_util.tree_leaves(p1):
+        assert np.isfinite(np.asarray(a)).all()
+    # per-tensor v is scalar
+    state = opt.init(params)
+    for v in jax.tree_util.tree_leaves(state["slots"]["exp_avg_sq"]):
+        assert v.shape == ()
+
+
+def test_master_weights_bf16():
+    params = jax.tree_util.tree_map(
+        lambda x: x.astype(jnp.bfloat16), make_params())
+    opt = FusedAdam(lr=1e-3, master_weights=True)
+    state = opt.init(params)
+    assert state["master"]["w1"].dtype == jnp.float32
+    grads = make_grads(params)
+    new_params, state = opt.step(grads, params, state)
+    assert new_params["w1"].dtype == jnp.bfloat16
+    # master retains precision across steps
+    assert state["master"]["w1"].dtype == jnp.float32
+
+
+def test_found_inf_skips_step():
+    params = make_params()
+    opt = FusedAdam(lr=1e-2)
+    state = opt.init(params)
+    grads = make_grads(params)
+    p_skip, st_skip = opt.step(grads, params, state, found_inf=jnp.asarray(True))
+    for a, b in zip(jax.tree_util.tree_leaves(p_skip), jax.tree_util.tree_leaves(params)):
+        np.testing.assert_allclose(a, b)
+    assert int(st_skip["step"]) == 0
+    p_go, st_go = opt.step(grads, params, state, found_inf=jnp.asarray(False))
+    assert int(st_go["step"]) == 1
+    assert not np.allclose(p_go["b1"], params["b1"])
+
+
+def test_grad_scale_unscales():
+    params = make_params()
+    opt = FusedAdam(lr=1e-2)
+    grads = make_grads(params)
+    scaled = jax.tree_util.tree_map(lambda g: g * 128.0, grads)
+    p1, _ = opt.step(grads, params, opt.init(params))
+    p2, _ = opt.step(scaled, params, opt.init(params),
+                     grad_scale=jnp.asarray(128.0))
+    for a, b in zip(jax.tree_util.tree_leaves(p1), jax.tree_util.tree_leaves(p2)):
+        np.testing.assert_allclose(a, b, atol=1e-6)
+
+
+def test_larc_clips_effective_lr():
+    params = {"p": jnp.ones((4,)) * 1e-3}
+    huge_grads = {"p": jnp.ones((4,)) * 1e3}
+    base = FusedSGD(lr=0.1)
+    larc = LARC(base, trust_coefficient=0.02)
+    state = larc.init(params)
+    p1, _ = larc.step(huge_grads, params, state)
+    p_plain, _ = base.step(huge_grads, params, base.init(params))
+    # LARC shrinks the step for tiny-norm params with huge grads
+    assert float(jnp.max(jnp.abs(p1["p"] - params["p"]))) < \
+        float(jnp.max(jnp.abs(p_plain["p"] - params["p"])))
+
+
+def test_clip_grad_norm():
+    grads = {"a": jnp.ones((10,)) * 3.0, "b": jnp.ones((5,)) * 4.0}
+    clipped, norm = clip_grad_norm(grads, max_norm=1.0)
+    expected = np.sqrt(10 * 9.0 + 5 * 16.0)
+    np.testing.assert_allclose(float(norm), expected, rtol=1e-6)
+    from apex_tpu.utils.tree import global_norm
+    np.testing.assert_allclose(float(global_norm(clipped)), 1.0, rtol=1e-4)
+    # under the limit -> unchanged
+    small = {"a": jnp.full((4,), 0.01)}
+    c2, _ = clip_grad_norm(small, 1.0)
+    np.testing.assert_allclose(c2["a"], small["a"], rtol=1e-5)
+
+
+def test_mixed_precision_lamb():
+    params = jax.tree_util.tree_map(
+        lambda x: x.astype(jnp.bfloat16), make_params())
+    opt = FusedMixedPrecisionLamb(lr=1e-3)
+    state = opt.init(params)
+    assert "master" in state
+    grads = make_grads(params)
+    new_params, state = opt.step(
+        jax.tree_util.tree_map(lambda g: g.astype(jnp.bfloat16), grads),
+        params, state)
+    assert new_params["w1"].dtype == jnp.bfloat16
+
+
+def test_jitted_step():
+    params = make_params()
+    opt = FusedAdam(lr=1e-3)
+    state = opt.init(params)
+    grads = make_grads(params)
+
+    @jax.jit
+    def step(g, p, s):
+        return opt.step(g, p, s)
+
+    p1, s1 = step(grads, params, state)
+    p2, s2 = opt.step(grads, params, state)
+    for a, b in zip(jax.tree_util.tree_leaves(p1), jax.tree_util.tree_leaves(p2)):
+        np.testing.assert_allclose(a, b, atol=1e-6)

@@ -1,0 +1,82 @@
+"""Mesh registry tests (analog of ``tests/L0/run_transformer/test_parallel_state.py``)."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.transformer import parallel_state as ps
+
+
+def teardown_function():
+    ps.destroy_model_parallel()
+
+
+def test_initialize_and_sizes():
+    mesh = ps.initialize_model_parallel(tensor_model_parallel_size=2,
+                                        pipeline_model_parallel_size=2)
+    assert ps.model_parallel_is_initialized()
+    assert ps.get_tensor_model_parallel_world_size() == 2
+    assert ps.get_pipeline_model_parallel_world_size() == 2
+    assert ps.get_data_parallel_world_size() == 2
+    assert ps.get_context_parallel_world_size() == 1
+    assert ps.get_model_parallel_world_size() == 4
+    assert mesh.axis_names == ps.MESH_AXIS_NAMES
+
+
+def test_invalid_sizes():
+    with pytest.raises(RuntimeError):
+        ps.initialize_model_parallel(tensor_model_parallel_size=3)
+
+
+def test_uninitialized_raises():
+    with pytest.raises(RuntimeError):
+        ps.get_mesh()
+
+
+def test_rank_inside_shard_map():
+    mesh = ps.initialize_model_parallel(tensor_model_parallel_size=2)
+    import jax.numpy as jnp
+
+    @jax.shard_map(mesh=mesh, in_specs=P("tensor"), out_specs=P("tensor"))
+    def get_rank(x):
+        return x + ps.get_tensor_model_parallel_rank()
+
+    out = get_rank(jnp.zeros((2, 1)))
+    np.testing.assert_allclose(np.asarray(out).ravel(), [0, 1])
+
+
+def test_rank_on_controller_is_zero():
+    ps.initialize_model_parallel()
+    assert ps.get_tensor_model_parallel_rank() == 0
+    assert ps.is_pipeline_first_stage()
+    assert ps.is_pipeline_last_stage()  # pp=1
+
+
+def test_virtual_pipeline_state():
+    ps.initialize_model_parallel(pipeline_model_parallel_size=2,
+                                 virtual_pipeline_model_parallel_size=2)
+    assert ps.get_virtual_pipeline_model_parallel_world_size() == 2
+    ps.set_virtual_pipeline_model_parallel_rank(1)
+    assert ps.get_virtual_pipeline_model_parallel_rank() == 1
+    assert not ps.is_pipeline_first_stage()
+
+
+def test_fake_world_size_override():
+    ps.initialize_model_parallel()
+    ps.set_tensor_model_parallel_world_size(8)
+    assert ps.get_tensor_model_parallel_world_size() == 8
+    ps.set_tensor_model_parallel_world_size(None)
+    assert ps.get_tensor_model_parallel_world_size() == 1
+
+
+def test_destroy():
+    ps.initialize_model_parallel()
+    ps.destroy_model_parallel()
+    assert not ps.model_parallel_is_initialized()
+
+
+def test_rank_info_string():
+    ps.initialize_model_parallel(tensor_model_parallel_size=2)
+    s = ps.get_rank_info()
+    assert "tp=2" in s and "dp=4" in s
