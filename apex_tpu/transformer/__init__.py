@@ -3,5 +3,6 @@
 plus the mesh registry (``parallel_state``)."""
 
 from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer import tensor_parallel
 
-__all__ = ["parallel_state"]
+__all__ = ["parallel_state", "tensor_parallel"]

@@ -1,0 +1,63 @@
+"""Tensor-parallel layers and collectives.
+
+TPU-native counterpart of ``apex/transformer/tensor_parallel/__init__.py``:
+the reference's autograd communication Functions become ``jax.custom_vjp``
+wrappers over XLA collectives, the layers become functional init/apply
+modules whose parameters carry :class:`jax.sharding.PartitionSpec` metadata,
+and the CUDA RNG tracker becomes a functional PRNG-key tracker built on
+``jax.random.fold_in``.
+"""
+
+from apex_tpu.transformer.tensor_parallel.mappings import (
+    copy_to_tensor_model_parallel_region,
+    reduce_from_tensor_model_parallel_region,
+    scatter_to_tensor_model_parallel_region,
+    gather_from_tensor_model_parallel_region,
+    scatter_to_sequence_parallel_region,
+    gather_from_sequence_parallel_region,
+    reduce_scatter_to_sequence_parallel_region,
+)
+from apex_tpu.transformer.tensor_parallel.layers import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    linear_with_grad_accumulation_and_async_allreduce,
+)
+from apex_tpu.transformer.tensor_parallel.cross_entropy import (
+    vocab_parallel_cross_entropy,
+)
+from apex_tpu.transformer.tensor_parallel.random import (
+    get_rng_tracker,
+    get_cuda_rng_tracker,
+    model_parallel_rng_key,
+    checkpoint,
+)
+from apex_tpu.transformer.tensor_parallel.data import broadcast_data
+from apex_tpu.transformer.tensor_parallel.utils import (
+    divide,
+    split_tensor_into_1d_equal_chunks,
+    gather_split_1d_tensor,
+)
+
+__all__ = [
+    "copy_to_tensor_model_parallel_region",
+    "reduce_from_tensor_model_parallel_region",
+    "scatter_to_tensor_model_parallel_region",
+    "gather_from_tensor_model_parallel_region",
+    "scatter_to_sequence_parallel_region",
+    "gather_from_sequence_parallel_region",
+    "reduce_scatter_to_sequence_parallel_region",
+    "ColumnParallelLinear",
+    "RowParallelLinear",
+    "VocabParallelEmbedding",
+    "linear_with_grad_accumulation_and_async_allreduce",
+    "vocab_parallel_cross_entropy",
+    "get_rng_tracker",
+    "get_cuda_rng_tracker",
+    "model_parallel_rng_key",
+    "checkpoint",
+    "broadcast_data",
+    "divide",
+    "split_tensor_into_1d_equal_chunks",
+    "gather_split_1d_tensor",
+]

@@ -1,0 +1,121 @@
+"""Vocab-parallel cross entropy.
+
+TPU-native counterpart of ``_VocabParallelCrossEntropy``
+(``apex/transformer/tensor_parallel/cross_entropy.py:23-134``): logits stay
+sharded along the vocab dim across the tensor axis and only three scalars per
+token cross the interconnect — the max logit (``:29``), the predicted logit
+(``:58``), and the softmax denominator (``:66``) — instead of gathering the
+full [tokens, vocab] logits. Backward is computed from saved softmax
+residuals without recomputation, as the reference does (``:100-134``).
+
+Label smoothing follows the reference's formulation (``:75-90``):
+``loss = (1 - s') * nll - s' * mean(log_probs)`` with
+``s' = label_smoothing * V / (V - 1)``.
+
+Runs inside ``shard_map`` with the tensor axis bound (sharded path) or
+standalone (degenerate world-size-1 path) — same code, collectives become
+identities.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+from apex_tpu.transformer.tensor_parallel.mappings import axis_bound
+
+__all__ = ["vocab_parallel_cross_entropy"]
+
+
+def _tp(axis_name):
+    if axis_bound(axis_name):
+        return lax.axis_index(axis_name), lax.axis_size(axis_name), True
+    return 0, 1, False
+
+
+def _forward(vocab_parallel_logits, target, label_smoothing, axis_name):
+    rank, size, bound = _tp(axis_name)
+    local_vocab = vocab_parallel_logits.shape[-1]
+    global_vocab = local_vocab * size
+    start = rank * local_vocab
+
+    # 1st all-reduce: max logit for numerical stability (reference :27-33).
+    logits_max = jnp.max(vocab_parallel_logits, axis=-1)
+    if bound:
+        logits_max = lax.pmax(logits_max, axis_name)
+    logits = vocab_parallel_logits - logits_max[..., None]
+
+    # Masked local lookup of the target logit (reference :36-56).
+    masked_target = target - start
+    in_range = (masked_target >= 0) & (masked_target < local_vocab)
+    masked_target = jnp.where(in_range, masked_target, 0)
+    predicted = jnp.take_along_axis(logits, masked_target[..., None], axis=-1)[..., 0]
+    predicted = jnp.where(in_range, predicted, 0.0)
+    # 2nd all-reduce: predicted logit (reference :58).
+    if bound:
+        predicted = lax.psum(predicted, axis_name)
+
+    # 3rd all-reduce: softmax denominator (reference :61-66).
+    exp_logits = jnp.exp(logits)
+    sum_exp = jnp.sum(exp_logits, axis=-1)
+    if bound:
+        sum_exp = lax.psum(sum_exp, axis_name)
+
+    loss = jnp.log(sum_exp) - predicted
+
+    softmax = exp_logits / sum_exp[..., None]
+
+    smoothing = 0.0
+    if label_smoothing > 0:
+        # Reference :75-90.
+        smoothing = label_smoothing * global_vocab / (global_vocab - 1)
+        log_probs = logits - jnp.log(sum_exp)[..., None]
+        sum_log_probs = jnp.sum(log_probs, axis=-1)
+        if bound:
+            sum_log_probs = lax.psum(sum_log_probs, axis_name)
+        mean_log_probs = sum_log_probs / global_vocab
+        loss = (1.0 - smoothing) * loss - smoothing * mean_log_probs
+
+    residuals = (softmax, in_range, masked_target, smoothing, global_vocab)
+    return loss, residuals
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def vocab_parallel_cross_entropy(
+    vocab_parallel_logits: jax.Array,
+    target: jax.Array,
+    label_smoothing: float = 0.0,
+    axis_name: str = TENSOR_AXIS,
+) -> jax.Array:
+    """Per-token CE loss from vocab-sharded logits [..., V/tp] and global ids."""
+    loss, _ = _forward(vocab_parallel_logits, target, label_smoothing, axis_name)
+    return loss
+
+
+def _vjp_fwd(vocab_parallel_logits, target, label_smoothing, axis_name):
+    loss, residuals = _forward(
+        vocab_parallel_logits, target, label_smoothing, axis_name)
+    return loss, residuals
+
+
+def _vjp_bwd(label_smoothing, axis_name, residuals, g):
+    # Reference backward (:100-134): grad = softmax - onehot(target) on the
+    # local shard, with the smoothing correction spread over the vocab.
+    softmax, in_range, masked_target, smoothing, global_vocab = residuals
+    grad = softmax
+    onehot = jax.nn.one_hot(
+        masked_target, softmax.shape[-1], dtype=softmax.dtype)
+    onehot = onehot * in_range[..., None].astype(softmax.dtype)
+    if smoothing > 0:
+        grad = grad - (1.0 - smoothing) * onehot - smoothing / global_vocab
+    else:
+        grad = grad - onehot
+    grad = grad * g[..., None]
+    return (grad.astype(softmax.dtype), None)
+
+
+vocab_parallel_cross_entropy.defvjp(_vjp_fwd, _vjp_bwd)

@@ -1,0 +1,55 @@
+"""Input-batch broadcast across the model-parallel group.
+
+The reference broadcasts the tokenized batch from TP-rank-0 to the other
+tensor-parallel ranks so every rank sees identical data
+(``apex/transformer/tensor_parallel/data.py:~30-122``: dtype/size checks,
+flatten, ``torch.distributed.broadcast``, unflatten). Under JAX's
+single-controller model, replication across a mesh axis is a *sharding*, not
+a communication call: the host hands the global batch to ``jit`` with a
+PartitionSpec that omits the tensor axis and XLA materializes the replicas.
+
+``broadcast_data`` keeps the reference's signature (keys + datatype check)
+and returns the batch with a replicated-over-tensor-axis sharding constraint
+applied, so it can be dropped into ported training loops.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec
+
+from apex_tpu.transformer import parallel_state
+
+__all__ = ["broadcast_data"]
+
+
+def _check_data_types(keys: List[str], data: Dict[str, jax.Array], target_dtype) -> None:
+    """Reference ``data.py:~35-45``: every broadcast member must share a dtype."""
+    for key in keys:
+        if data[key].dtype != target_dtype:
+            raise ValueError(
+                f"{key} has data type {data[key].dtype} while {target_dtype} is expected"
+            )
+
+
+def broadcast_data(keys: List[str], data: Dict[str, jax.Array], datatype) -> Dict[str, jax.Array]:
+    """Replicate ``data[keys]`` across the tensor-parallel axis.
+
+    Inside ``jit`` this is a sharding constraint (data-sharded over ``data``,
+    replicated over ``tensor``); outside it is the identity — either way every
+    TP rank observes the same values, matching the reference broadcast.
+    """
+    _check_data_types(keys, data, datatype)
+    out = {}
+    for key in keys:
+        x = data[key]
+        if parallel_state.model_parallel_is_initialized():
+            try:
+                x = jax.lax.with_sharding_constraint(x, PartitionSpec())
+            except Exception:  # outside jit/mesh context: already replicated
+                pass
+        out[key] = x
+    return out

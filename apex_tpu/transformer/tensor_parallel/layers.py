@@ -1,0 +1,257 @@
+"""Tensor-parallel layers.
+
+TPU-native counterpart of ``apex/transformer/tensor_parallel/layers.py``:
+
+- ``ColumnParallelLinear`` (reference class at ``layers.py:460``, forward at
+  ``:609-643``): weight split along the output dim, optional output
+  all-gather, optional Megatron sequence parallelism.
+- ``RowParallelLinear`` (reference ``layers.py:645``, forward ``:777-813``):
+  weight split along the input dim, output all-reduce (or reduce-scatter to
+  sequence shards under SP).
+- ``VocabParallelEmbedding`` (reference ``layers.py:174-276``): vocab-sharded
+  embedding with masked lookup + all-reduce.
+
+Design: layers are functional modules — ``init(key)`` builds **global**-shape
+parameters (so replicated init is rank-consistent by construction, the
+property the reference engineers via master-weight scatter in
+``_initialize_affine_weight_cpu``, ``layers.py:110-152``) and ``spec()``
+returns the matching :class:`PartitionSpec` pytree; ``apply(params, x)`` is
+written against the **local shard** view and is meant to run inside
+``shard_map`` over the ``tensor`` mesh axis, where the specs at the shard_map
+boundary slice the global params into per-rank shards. Outside ``shard_map``
+every collective degrades to the identity, so the same code path is the
+world-size-1 reference implementation.
+
+The reference's async-grad-allreduce / fused-wgrad-accumulation machinery
+(``LinearWithGradAccumulationAndAsyncCommunication``, ``layers.py:278-440``,
+calling ``fused_weight_gradient_mlp_cuda``) exists to overlap the input-grad
+all-reduce with the weight-grad GEMM and to accumulate dW in place. Under
+XLA both are compiler duties: the collective and the wgrad einsum have no
+data dependence, so the latency-hiding scheduler overlaps them, and donated
+gradient buffers give in-place accumulation (SURVEY.md §7 hard part (f)).
+``linear_with_grad_accumulation_and_async_allreduce`` is therefore a thin
+functional wrapper kept for API parity.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec
+
+from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+from apex_tpu.transformer.tensor_parallel.mappings import (
+    axis_bound,
+    copy_to_tensor_model_parallel_region,
+    gather_from_sequence_parallel_region,
+    gather_from_tensor_model_parallel_region,
+    reduce_from_tensor_model_parallel_region,
+    reduce_scatter_to_sequence_parallel_region,
+    scatter_to_tensor_model_parallel_region,
+)
+from apex_tpu.transformer.tensor_parallel.utils import divide
+
+__all__ = [
+    "ColumnParallelLinear",
+    "RowParallelLinear",
+    "VocabParallelEmbedding",
+    "linear_with_grad_accumulation_and_async_allreduce",
+]
+
+
+def _default_init() -> Callable:
+    # Reference default: ``init.xavier_normal_`` (layers.py:471,654).
+    return jax.nn.initializers.xavier_normal()
+
+
+def _tp_info(axis_name: str) -> Tuple[Any, int]:
+    """(rank, size) of the tensor axis; (0, 1) outside shard_map."""
+    if axis_bound(axis_name):
+        return lax.axis_index(axis_name), lax.axis_size(axis_name)
+    return 0, 1
+
+
+def linear_with_grad_accumulation_and_async_allreduce(
+    x: jax.Array,
+    weight: jax.Array,
+    bias: Optional[jax.Array],
+    *,
+    sequence_parallel_enabled: bool = False,
+    axis_name: str = TENSOR_AXIS,
+) -> jax.Array:
+    """Forward of the reference's fused linear Function (``layers.py:279-330``).
+
+    Under SP the sequence-sharded input is all-gathered into the matmul and
+    the backward reduce-scatters dX (the custom_vjp in
+    :func:`gather_from_sequence_parallel_region` encodes exactly the
+    reference's backward at ``layers.py:383-390,429-433``); otherwise the
+    input passes through the copy region whose backward all-reduces dX
+    (``layers.py:368-371``).
+    """
+    if sequence_parallel_enabled:
+        total_input = gather_from_sequence_parallel_region(
+            x, True, axis_name)
+    else:
+        total_input = copy_to_tensor_model_parallel_region(x, axis_name)
+    out = jnp.matmul(total_input, weight.T)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@dataclass
+class ColumnParallelLinear:
+    """Linear with weight W [out, in] split along out: Y_i = X A_i^T.
+
+    Reference: ``apex/transformer/tensor_parallel/layers.py:460-643``.
+    """
+
+    input_size: int
+    output_size: int
+    bias: bool = True
+    gather_output: bool = True
+    init_method: Optional[Callable] = None
+    skip_bias_add: bool = False
+    sequence_parallel_enabled: bool = False
+    params_dtype: Any = jnp.float32
+    axis_name: str = TENSOR_AXIS
+
+    def __post_init__(self):
+        if self.sequence_parallel_enabled and self.gather_output:
+            # Reference raises the same incompatibility (layers.py:553-558).
+            raise ValueError(
+                "`sequence_parallel_enabled` is incompatible with `gather_output`"
+            )
+
+    def init(self, key: jax.Array) -> Dict[str, jax.Array]:
+        init_fn = self.init_method or _default_init()
+        w = init_fn(key, (self.output_size, self.input_size), self.params_dtype)
+        params = {"weight": w}
+        if self.bias:
+            # Reference zero-initializes the bias (layers.py:601-607).
+            params["bias"] = jnp.zeros((self.output_size,), self.params_dtype)
+        return params
+
+    def spec(self) -> Dict[str, PartitionSpec]:
+        s = {"weight": PartitionSpec(self.axis_name, None)}
+        if self.bias:
+            s["bias"] = PartitionSpec(self.axis_name)
+        return s
+
+    def apply(self, params: Dict[str, jax.Array], x: jax.Array):
+        """Forward (reference ``layers.py:609-643``). Returns ``(out, bias)``
+        when ``skip_bias_add`` else ``out`` (bias folded in)."""
+        bias = params.get("bias")
+        fused_bias = bias if not self.skip_bias_add else None
+        out = linear_with_grad_accumulation_and_async_allreduce(
+            x, params["weight"], fused_bias,
+            sequence_parallel_enabled=self.sequence_parallel_enabled,
+            axis_name=self.axis_name,
+        )
+        if self.gather_output:
+            out = gather_from_tensor_model_parallel_region(out, self.axis_name)
+        if self.skip_bias_add:
+            return out, bias
+        return out
+
+
+@dataclass
+class RowParallelLinear:
+    """Linear with weight W [out, in] split along in: Y = sum_i X_i A_i^T.
+
+    Reference: ``apex/transformer/tensor_parallel/layers.py:645-813``.
+    """
+
+    input_size: int
+    output_size: int
+    bias: bool = True
+    input_is_parallel: bool = False
+    init_method: Optional[Callable] = None
+    skip_bias_add: bool = False
+    sequence_parallel_enabled: bool = False
+    params_dtype: Any = jnp.float32
+    axis_name: str = TENSOR_AXIS
+
+    def __post_init__(self):
+        if self.sequence_parallel_enabled and not self.input_is_parallel:
+            # Reference raises the same (layers.py:737-741).
+            raise ValueError(
+                "To enable `sequence_parallel_enabled`, `input_is_parallel` must be `True`"
+            )
+
+    def init(self, key: jax.Array) -> Dict[str, jax.Array]:
+        init_fn = self.init_method or _default_init()
+        w = init_fn(key, (self.output_size, self.input_size), self.params_dtype)
+        params = {"weight": w}
+        if self.bias:
+            params["bias"] = jnp.zeros((self.output_size,), self.params_dtype)
+        return params
+
+    def spec(self) -> Dict[str, PartitionSpec]:
+        s = {"weight": PartitionSpec(None, self.axis_name)}
+        if self.bias:
+            s["bias"] = PartitionSpec()  # replicated, added post-reduce
+        return s
+
+    def apply(self, params: Dict[str, jax.Array], x: jax.Array):
+        """Forward (reference ``layers.py:777-813``)."""
+        if not self.input_is_parallel:
+            x = scatter_to_tensor_model_parallel_region(x, self.axis_name)
+        partial_out = jnp.matmul(x, params["weight"].T)
+        if self.sequence_parallel_enabled:
+            out = reduce_scatter_to_sequence_parallel_region(
+                partial_out, self.axis_name)
+        else:
+            out = reduce_from_tensor_model_parallel_region(
+                partial_out, self.axis_name)
+        bias = params.get("bias")
+        if self.skip_bias_add:
+            return out, bias
+        if bias is not None:
+            out = out + bias
+        return out
+
+
+@dataclass
+class VocabParallelEmbedding:
+    """Embedding sharded along the vocab dim.
+
+    Each rank owns rows ``[rank*V/tp, (rank+1)*V/tp)``; out-of-range token ids
+    are masked to 0, looked up, zeroed, and the partial embeddings all-reduced
+    (reference ``layers.py:174-276``, masked lookup at ``:245-264``).
+    """
+
+    num_embeddings: int
+    embedding_dim: int
+    init_method: Optional[Callable] = None
+    params_dtype: Any = jnp.float32
+    axis_name: str = TENSOR_AXIS
+
+    def init(self, key: jax.Array) -> Dict[str, jax.Array]:
+        init_fn = self.init_method or jax.nn.initializers.normal(stddev=1.0)
+        w = init_fn(key, (self.num_embeddings, self.embedding_dim), self.params_dtype)
+        return {"weight": w}
+
+    def spec(self) -> Dict[str, PartitionSpec]:
+        return {"weight": PartitionSpec(self.axis_name, None)}
+
+    def apply(self, params: Dict[str, jax.Array], token_ids: jax.Array) -> jax.Array:
+        weight = params["weight"]  # local shard [V/tp, H] inside shard_map
+        rank, size = _tp_info(self.axis_name)
+        local_vocab = self.num_embeddings // size if size > 1 else weight.shape[0]
+        start = rank * local_vocab
+        if size > 1 or axis_bound(self.axis_name):
+            # Masked local lookup (reference layers.py:245-255).
+            masked = token_ids - start
+            in_range = (masked >= 0) & (masked < local_vocab)
+            masked = jnp.where(in_range, masked, 0)
+            out = jnp.take(weight, masked, axis=0)
+            out = jnp.where(in_range[..., None], out, 0.0)
+            out = reduce_from_tensor_model_parallel_region(out, self.axis_name)
+        else:
+            out = jnp.take(weight, token_ids, axis=0)
+        return out

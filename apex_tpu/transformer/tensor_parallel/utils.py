@@ -1,0 +1,43 @@
+"""Small tensor-parallel helpers (reference ``apex/transformer/utils.py:1-54``)."""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+
+__all__ = [
+    "ensure_divisibility",
+    "divide",
+    "split_tensor_into_1d_equal_chunks",
+    "gather_split_1d_tensor",
+]
+
+
+def ensure_divisibility(numerator: int, denominator: int) -> None:
+    if numerator % denominator != 0:
+        raise ValueError(f"{numerator} is not divisible by {denominator}")
+
+
+def divide(numerator: int, denominator: int) -> int:
+    """Reference ``utils.py:26-30``."""
+    ensure_divisibility(numerator, denominator)
+    return numerator // denominator
+
+
+def split_tensor_into_1d_equal_chunks(x: jax.Array, axis_name: str = TENSOR_AXIS) -> jax.Array:
+    """This rank's 1D chunk of the flattened tensor (reference ``utils.py:33-43``).
+
+    Must run inside ``shard_map`` with ``axis_name`` bound.
+    """
+    flat = x.reshape(-1)
+    n = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    chunk = flat.shape[0] // n
+    return lax.dynamic_slice_in_dim(flat, rank * chunk, chunk, axis=0)
+
+
+def gather_split_1d_tensor(x: jax.Array, axis_name: str = TENSOR_AXIS) -> jax.Array:
+    """All-gather 1D chunks back into the full flat tensor (reference ``utils.py:46-54``)."""
+    return lax.all_gather(x.reshape(-1), axis_name, axis=0, tiled=True)
