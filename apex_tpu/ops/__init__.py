@@ -16,6 +16,7 @@ from apex_tpu.ops.cross_entropy import (
     softmax_cross_entropy_loss,
     SoftmaxCrossEntropyLoss,
 )
+from apex_tpu.ops.attention import flash_attention
 from apex_tpu.ops.rope import (
     fused_rope,
     fused_rope_cached,
@@ -38,4 +39,5 @@ __all__ = [
     "fused_rope_cached",
     "fused_rope_thd",
     "fused_rope_2d",
+    "flash_attention",
 ]

@@ -1,0 +1,431 @@
+"""Flash attention — Pallas TPU kernels with custom VJP.
+
+Capability parity with the reference's fused attention extensions:
+
+- ``fmha`` (``apex/contrib/fmha/fmha.py:33-90``, kernels under
+  ``apex/contrib/csrc/fmha/``): BERT-style fused multi-head attention,
+  padded/varlen batches, seq <= 512.
+- ``fast_multihead_attn`` (``apex/contrib/multihead_attn/*.py``): fused
+  self/encdec attention fwd/bwd built from strided-batched GEMMs + fused
+  softmax (``softmax.cuh``).
+
+The TPU design is *not* a port of those kernels: it is an online-softmax
+(flash) attention tiled for the MXU, O(sq) memory, with no sequence-length
+cap (the CUDA kernels cap at 512/16k). The backward recomputes attention
+probabilities blockwise (the standard flash backward), trading FLOPs for HBM
+traffic — the right trade on TPU where HBM bandwidth is the bottleneck.
+
+Layout: ``[batch, heads, seq, head_dim]``; accumulation in fp32 regardless of
+input dtype (matching the CUDA kernels' fp32 softmax accumulators).
+
+Masking supports the reference's two modes: ``causal`` (upper-triangular,
+``scaled_upper_triang_masked_softmax`` semantics with the usual
+``sk - sq`` offset for cross/incremental attention) and per-batch valid
+key/value lengths ``kv_lengths`` (the fmha varlen/padded-batch capability,
+``fmha.py:41-56``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from apex_tpu.ops._support import pallas_interpret, round_up, use_pallas
+
+__all__ = ["flash_attention"]
+
+_NEG_INF = -1e30
+# lse sentinel for fully-masked (padding) query rows: exp(s - BIG) == 0 in the
+# backward recompute, so padded rows contribute nothing to dk/dv.
+_LSE_PAD = 1e30
+
+# Tuned on TPU v5e: (512, 1024) reaches ~60% of the chip's practical matmul
+# peak non-causal; smaller blocks lose to grid/DMA overhead.
+_DEFAULT_BLOCK_Q = 512
+_DEFAULT_BLOCK_K = 1024
+
+
+def _mask_block(s, i, j, bq, bk, sq, sk, kvl, causal):
+    """Mask a (bq, bk) logit block; returns (masked logits, validity)."""
+    row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + i * bq
+    col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + j * bk
+    limit = jnp.minimum(sk, kvl) if kvl is not None else sk
+    valid = col < limit
+    if causal:
+        valid = jnp.logical_and(valid, col <= row + (sk - sq))
+    return jnp.where(valid, s, _NEG_INF), valid
+
+
+def _causal_block_skip(i, j, bq, bk, sq, sk):
+    """True when k-block j has at least one unmasked column for q-block i."""
+    return j * bk <= i * bq + bq - 1 + (sk - sq)
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(kvl_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, scale, bq, bk, nk, sq, sk, causal):
+    b, i, j = pl.program_id(0), pl.program_id(2), pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    def _step():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        kvl = kvl_ref[b] if kvl_ref is not None else None
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        s, valid = _mask_block(s, i, j, bq, bk, sq, sk, kvl, causal)
+        m_prev = m_scr[:, :1]
+        l_prev = l_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
+        l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    if causal:
+        pl.when(_causal_block_skip(i, j, bq, bk, sq, sk))(_step)
+    else:
+        _step()
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        l = l_scr[:, :1]
+        m = m_scr[:, :1]
+        o = acc_scr[:] * jnp.where(l > 0, 1.0 / l, 0.0)
+        o_ref[0, 0] = o.astype(o_ref.dtype)
+        lse = jnp.where(l > 0, m + jnp.log(l), _LSE_PAD)
+        lse_ref[0, 0] = jnp.broadcast_to(lse.T, lse_ref.shape[2:])
+
+
+def _run_fwd(q, k, v, kv_lengths, scale, causal, sq, sk, bq, bk):
+    """q/k/v padded to block multiples; returns padded (o, lse)."""
+    batch, heads, sqp, dp = q.shape
+    skp = k.shape[2]
+    nq, nk = sqp // bq, skp // bk
+    grid = (batch, heads, nq, nk)
+    kvl_spec = []
+    args = []
+    if kv_lengths is not None:
+        kvl_spec = [pl.BlockSpec(memory_space=pltpu.SMEM)]
+        args = [kv_lengths.astype(jnp.int32)]
+    kernel = functools.partial(
+        _fwd_kernel if kv_lengths is not None else
+        (lambda *r, **kw: _fwd_kernel(None, *r, **kw)),
+        scale=scale, bq=bq, bk=bk, nk=nk, sq=sq, sk=sk, causal=causal)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=kvl_spec + [
+            pl.BlockSpec((1, 1, bq, dp), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bk, dp), lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, bk, dp), lambda b, h, i, j: (b, h, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, dp), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, 1, bq), lambda b, h, i, j: (b, h, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((batch, heads, sqp, dp), q.dtype),
+            jax.ShapeDtypeStruct((batch, heads, 1, sqp), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, dp), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=pallas_interpret(),
+    )(*args, q, k, v)
+    return o, lse[:, :, 0, :]
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _dq_kernel(kvl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+               dq_ref, dq_scr, *, scale, bq, bk, nk, sq, sk, causal):
+    b, i, j = pl.program_id(0), pl.program_id(2), pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    def _step():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0].reshape(1, bq).T          # (bq, 1)
+        delta = delta_ref[0, 0].reshape(1, bq).T      # (bq, 1)
+        kvl = kvl_ref[b] if kvl_ref is not None else None
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        s, _ = _mask_block(s, i, j, bq, bk, sq, sk, kvl, causal)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dq_scr[:] = dq_scr[:] + scale * jax.lax.dot(
+            ds.astype(k.dtype), k, preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(_causal_block_skip(i, j, bq, bk, sq, sk))(_step)
+    else:
+        _step()
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(kvl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr,
+                *, scale, bq, bk, nq, sq, sk, causal):
+    b, j, i = pl.program_id(0), pl.program_id(2), pl.program_id(3)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def _step():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0].reshape(1, bq).T
+        delta = delta_ref[0, 0].reshape(1, bq).T
+        kvl = kvl_ref[b] if kvl_ref is not None else None
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        s, _ = _mask_block(s, i, j, bq, bk, sq, sk, kvl, causal)
+        p = jnp.exp(s - lse)
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk_scr[:] = dk_scr[:] + scale * jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(_causal_block_skip(i, j, bq, bk, sq, sk))(_step)
+    else:
+        _step()
+
+    @pl.when(i == nq - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _run_bwd(q, k, v, do, lse, delta, kv_lengths, scale, causal,
+             sq, sk, bq, bk):
+    batch, heads, sqp, dp = q.shape
+    skp = k.shape[2]
+    nq, nk = sqp // bq, skp // bk
+    kvl_spec, args = [], []
+    if kv_lengths is not None:
+        kvl_spec = [pl.BlockSpec(memory_space=pltpu.SMEM)]
+        args = [kv_lengths.astype(jnp.int32)]
+
+    def wrap(fn, **kw):
+        if kv_lengths is not None:
+            return functools.partial(fn, **kw)
+        return functools.partial(lambda *r, **k2: fn(None, *r, **k2), **kw)
+
+    row_specs = [
+        pl.BlockSpec((1, 1, bq, dp), lambda b, h, i, j: (b, h, i, 0)),   # q
+        pl.BlockSpec((1, 1, bk, dp), lambda b, h, i, j: (b, h, j, 0)),   # k
+        pl.BlockSpec((1, 1, bk, dp), lambda b, h, i, j: (b, h, j, 0)),   # v
+        pl.BlockSpec((1, 1, bq, dp), lambda b, h, i, j: (b, h, i, 0)),   # do
+        pl.BlockSpec((1, 1, 1, bq), lambda b, h, i, j: (b, h, 0, i)),    # lse
+        pl.BlockSpec((1, 1, 1, bq), lambda b, h, i, j: (b, h, 0, i)),    # delta
+    ]
+    dq = pl.pallas_call(
+        wrap(_dq_kernel, scale=scale, bq=bq, bk=bk, nk=nk, sq=sq, sk=sk,
+             causal=causal),
+        grid=(batch, heads, nq, nk),
+        in_specs=kvl_spec + row_specs,
+        out_specs=pl.BlockSpec((1, 1, bq, dp), lambda b, h, i, j: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, dp), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=pallas_interpret(),
+    )(*args, q, k, v, do, lse, delta)
+
+    col_specs = [
+        pl.BlockSpec((1, 1, bq, dp), lambda b, h, j, i: (b, h, i, 0)),   # q
+        pl.BlockSpec((1, 1, bk, dp), lambda b, h, j, i: (b, h, j, 0)),   # k
+        pl.BlockSpec((1, 1, bk, dp), lambda b, h, j, i: (b, h, j, 0)),   # v
+        pl.BlockSpec((1, 1, bq, dp), lambda b, h, j, i: (b, h, i, 0)),   # do
+        pl.BlockSpec((1, 1, 1, bq), lambda b, h, j, i: (b, h, 0, i)),    # lse
+        pl.BlockSpec((1, 1, 1, bq), lambda b, h, j, i: (b, h, 0, i)),    # delta
+    ]
+    dk, dv = pl.pallas_call(
+        wrap(_dkv_kernel, scale=scale, bq=bq, bk=bk, nq=nq, sq=sq, sk=sk,
+             causal=causal),
+        grid=(batch, heads, nk, nq),
+        in_specs=kvl_spec + col_specs,
+        out_specs=[
+            pl.BlockSpec((1, 1, bk, dp), lambda b, h, j, i: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, bk, dp), lambda b, h, j, i: (b, h, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((bk, dp), jnp.float32),
+                        pltpu.VMEM((bk, dp), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=pallas_interpret(),
+    )(*args, q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# padding helpers + custom_vjp plumbing
+# ---------------------------------------------------------------------------
+
+def _pad_qkv(q, k, v, bq, bk):
+    sq, d = q.shape[2], q.shape[3]
+    sk = k.shape[2]
+    sqp, skp, dp = round_up(sq, bq), round_up(sk, bk), round_up(d, 128)
+
+    def pad(x, sp):
+        return jnp.pad(x, ((0, 0), (0, 0), (0, sp - x.shape[2]),
+                           (0, dp - d)))
+    return pad(q, sqp), pad(k, skp), pad(v, skp)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash(q, k, v, kv_lengths, scale, causal, bq, bk):
+    o, _ = _flash_fwd_impl(q, k, v, kv_lengths, scale, causal, bq, bk)
+    return o
+
+
+def _flash_fwd_impl(q, k, v, kv_lengths, scale, causal, bq, bk):
+    sq, d = q.shape[2], q.shape[3]
+    sk = k.shape[2]
+    qp, kp, vp = _pad_qkv(q, k, v, bq, bk)
+    o, lse = _run_fwd(qp, kp, vp, kv_lengths, scale, causal, sq, sk, bq, bk)
+    return o[:, :, :sq, :d], lse[:, :, :sq]
+
+
+def _flash_vjp_fwd(q, k, v, kv_lengths, scale, causal, bq, bk):
+    o, lse = _flash_fwd_impl(q, k, v, kv_lengths, scale, causal, bq, bk)
+    return o, (q, k, v, kv_lengths, o, lse)
+
+
+def _flash_vjp_bwd(scale, causal, bq, bk, res, do):
+    q, k, v, kv_lengths, o, lse = res
+    sq, d = q.shape[2], q.shape[3]
+    sk = k.shape[2]
+    sqp = round_up(sq, bq)
+    qp, kp, vp = _pad_qkv(q, k, v, bq, bk)
+    dop = jnp.pad(do, ((0, 0), (0, 0), (0, sqp - sq),
+                       (0, qp.shape[3] - d)))
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    delta = jnp.pad(delta, ((0, 0), (0, 0), (0, sqp - sq)))
+    lsep = jnp.pad(lse, ((0, 0), (0, 0), (0, sqp - sq)),
+                   constant_values=_LSE_PAD)
+    # reshape row-vectors to (B, H, 1, sqp) for the (1,1,1,bq) block specs
+    dq, dk, dv = _run_bwd(qp, kp, vp, dop, lsep[:, :, None, :],
+                          delta[:, :, None, :], kv_lengths, scale, causal,
+                          sq, sk, bq, bk)
+    dq = dq[:, :, :sq, :d]
+    dk = dk[:, :, :sk, :d]
+    dv = dv[:, :, :sk, :d]
+    if kv_lengths is None:
+        dkvl = None
+    else:
+        dkvl = np.zeros(kv_lengths.shape, dtype=jax.dtypes.float0)
+    return dq, dk, dv, dkvl
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+# ---------------------------------------------------------------------------
+# reference (XLA) path
+# ---------------------------------------------------------------------------
+
+def _mha_reference(q, k, v, kv_lengths, scale, causal):
+    sq, sk = q.shape[2], k.shape[2]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    col = jnp.arange(sk)[None, None, None, :]
+    row = jnp.arange(sq)[None, None, :, None]
+    valid = jnp.ones(s.shape, dtype=bool)
+    if kv_lengths is not None:
+        valid = jnp.logical_and(valid, col < kv_lengths[:, None, None, None])
+    if causal:
+        valid = jnp.logical_and(valid, col <= row + (sk - sq))
+    s = jnp.where(valid, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    # fully-masked rows (empty batch elements / kv_lengths == 0) get zero
+    # output + zero grads, matching the Pallas path's l == 0 guard
+    p = jnp.where(jnp.any(valid, axis=-1, keepdims=True), p, 0.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    softmax_scale: Optional[float] = None,
+    kv_lengths: Optional[jax.Array] = None,
+    block_q: int = _DEFAULT_BLOCK_Q,
+    block_k: int = _DEFAULT_BLOCK_K,
+) -> jax.Array:
+    """Multi-head attention ``softmax(scale * q @ k^T + mask) @ v``.
+
+    Args:
+      q: ``[batch, heads, seq_q, head_dim]``.
+      k, v: ``[batch, heads, seq_k, head_dim]`` (``heads`` must match; do any
+        GQA/MQA head broadcast before calling).
+      causal: upper-triangular mask with the standard ``seq_k - seq_q`` offset
+        (reference ``scaled_upper_triang_masked_softmax`` semantics).
+      softmax_scale: defaults to ``1/sqrt(head_dim)``.
+      kv_lengths: optional int32 ``[batch]`` valid key/value lengths (the
+        fmha padded-batch capability, ``apex/contrib/fmha/fmha.py:41-56``).
+    """
+    if q.ndim != 4 or k.ndim != 4 or v.ndim != 4:
+        raise ValueError("flash_attention expects [batch, heads, seq, dim]")
+    scale = float(softmax_scale if softmax_scale is not None
+                  else 1.0 / np.sqrt(q.shape[-1]))
+    if not use_pallas():
+        return _mha_reference(q, k, v, kv_lengths, scale, causal)
+    bq = min(block_q, round_up(q.shape[2], 8))
+    bk = min(block_k, round_up(k.shape[2], 128))
+    return _flash(q, k, v, kv_lengths, scale, causal, bq, bk)

@@ -2,7 +2,19 @@
 ``apex/transformer``): tensor, sequence, pipeline, and context parallelism
 plus the mesh registry (``parallel_state``)."""
 
+from apex_tpu.transformer import enums
+from apex_tpu.transformer import functional
 from apex_tpu.transformer import parallel_state
 from apex_tpu.transformer import tensor_parallel
+from apex_tpu.transformer.enums import AttnMaskType, AttnType, LayerType, ModelType
 
-__all__ = ["parallel_state", "tensor_parallel"]
+__all__ = [
+    "enums",
+    "functional",
+    "parallel_state",
+    "tensor_parallel",
+    "AttnMaskType",
+    "AttnType",
+    "LayerType",
+    "ModelType",
+]

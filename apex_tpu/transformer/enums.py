@@ -1,0 +1,27 @@
+"""Transformer enums.
+
+Mirror of the reference's ``apex/transformer/enums.py`` so code written
+against the reference API ports directly.
+"""
+
+import enum
+
+
+class LayerType(enum.Enum):
+    encoder = 1
+    decoder = 2
+
+
+class AttnType(enum.Enum):
+    self_attn = 1
+    cross_attn = 2
+
+
+class AttnMaskType(enum.Enum):
+    padding = 1
+    causal = 2
+
+
+class ModelType(enum.Enum):
+    encoder_or_decoder = 1
+    encoder_and_decoder = 2

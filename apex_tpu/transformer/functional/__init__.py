@@ -1,0 +1,25 @@
+"""Functional wrappers over the fused kernels (reference ``apex/transformer/functional/``)."""
+
+from apex_tpu.transformer.functional.fused_softmax import (
+    FusedScaleMaskSoftmax,
+    scaled_masked_softmax,
+    scaled_upper_triang_masked_softmax,
+    generic_scaled_masked_softmax,
+)
+from apex_tpu.transformer.functional.fused_rope import (
+    fused_apply_rotary_pos_emb,
+    fused_apply_rotary_pos_emb_cached,
+    fused_apply_rotary_pos_emb_thd,
+    fused_apply_rotary_pos_emb_2d,
+)
+
+__all__ = [
+    "FusedScaleMaskSoftmax",
+    "scaled_masked_softmax",
+    "scaled_upper_triang_masked_softmax",
+    "generic_scaled_masked_softmax",
+    "fused_apply_rotary_pos_emb",
+    "fused_apply_rotary_pos_emb_cached",
+    "fused_apply_rotary_pos_emb_thd",
+    "fused_apply_rotary_pos_emb_2d",
+]

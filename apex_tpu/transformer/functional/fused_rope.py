@@ -1,0 +1,24 @@
+"""Rotary position embedding wrappers.
+
+Counterpart of ``apex/transformer/functional/fused_rope.py:19-303`` — thin
+re-exports of the fused kernels under the reference's public names.
+"""
+
+from apex_tpu.ops.rope import (
+    fused_rope,
+    fused_rope_2d,
+    fused_rope_cached,
+    fused_rope_thd,
+)
+
+__all__ = [
+    "fused_apply_rotary_pos_emb",
+    "fused_apply_rotary_pos_emb_cached",
+    "fused_apply_rotary_pos_emb_thd",
+    "fused_apply_rotary_pos_emb_2d",
+]
+
+fused_apply_rotary_pos_emb = fused_rope
+fused_apply_rotary_pos_emb_cached = fused_rope_cached
+fused_apply_rotary_pos_emb_thd = fused_rope_thd
+fused_apply_rotary_pos_emb_2d = fused_rope_2d

@@ -1,0 +1,124 @@
+"""Fused scale+mask+softmax dispatcher.
+
+TPU-native counterpart of ``apex/transformer/functional/fused_softmax.py``:
+the reference's :class:`FusedScaleMaskSoftmax` picks between four CUDA
+kernels and a plain torch softmax via ``is_kernel_available``
+(``fused_softmax.py:222-248``). Here the Pallas kernels (``apex_tpu.ops``)
+have none of the CUDA constraints (dtype, 16 < sk <= 16384, power-of-two
+batch-per-block), so the predicate is kept for API/diagnostic parity and the
+fused path is the default whenever fusion is requested.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.softmax import (
+    generic_scaled_masked_softmax,
+    scaled_masked_softmax,
+    scaled_softmax,
+    scaled_upper_triang_masked_softmax,
+)
+from apex_tpu.transformer.enums import AttnMaskType
+
+__all__ = [
+    "FusedScaleMaskSoftmax",
+    "scaled_softmax",
+    "scaled_masked_softmax",
+    "scaled_upper_triang_masked_softmax",
+    "generic_scaled_masked_softmax",
+]
+
+
+class FusedScaleMaskSoftmax:
+    """Fused operation: scaling + mask + softmax.
+
+    Mirrors the reference constructor (``fused_softmax.py:181-220``):
+
+    Args:
+      input_in_fp16 / input_in_bf16: declared input dtype (diagnostic parity;
+        the kernels accept any float dtype).
+      attn_mask_type: :class:`AttnMaskType` (padding or causal).
+      scaled_masked_softmax_fusion: use the fused kernels (else pure XLA).
+      mask_func: mask application fn for the unfused path, called as
+        ``mask_func(scores, mask)``.
+      softmax_in_fp32: compute softmax in fp32 (the fused kernels always do).
+      scale: optional logit scale factor; requires ``softmax_in_fp32``
+        (reference assertion, ``fused_softmax.py:218-219``).
+    """
+
+    def __init__(
+        self,
+        input_in_fp16: bool = False,
+        input_in_bf16: bool = False,
+        attn_mask_type: AttnMaskType = AttnMaskType.padding,
+        scaled_masked_softmax_fusion: bool = True,
+        mask_func: Optional[Callable] = None,
+        softmax_in_fp32: bool = True,
+        scale: Optional[float] = None,
+    ):
+        if input_in_fp16 and input_in_bf16:
+            raise RuntimeError("both fp16 and bf16 flags cannot be active at the same time.")
+        if scale is not None and not softmax_in_fp32:
+            raise RuntimeError("softmax should be in fp32 when scaled")
+        self.input_in_fp16 = input_in_fp16
+        self.input_in_bf16 = input_in_bf16
+        self.input_in_float16 = input_in_fp16 or input_in_bf16
+        self.attn_mask_type = attn_mask_type
+        self.scaled_masked_softmax_fusion = scaled_masked_softmax_fusion
+        self.mask_func = mask_func
+        self.softmax_in_fp32 = softmax_in_fp32
+        self.scale = scale
+
+    def is_kernel_available(self, mask: Optional[jax.Array], b: int, np_: int,
+                            sq: int, sk: int) -> bool:
+        """Reference predicate (``fused_softmax.py:222-248``) — the CUDA
+        limits (sk <= 16384, fp16/bf16 only, sq % 4 == 0) don't apply to the
+        Pallas kernels; only the fusion flag gates the fused path."""
+        return bool(self.scaled_masked_softmax_fusion)
+
+    def __call__(self, x: jax.Array, mask: Optional[jax.Array]) -> jax.Array:
+        assert x.ndim == 4  # (b, np, sq, sk), reference `forward` assertion
+        b, np_, sq, sk = x.shape
+        if self.is_kernel_available(mask, b, np_, sq, sk):
+            return self.forward_fused_softmax(x, mask)
+        return self.forward_torch_softmax(x, mask)
+
+    def forward_fused_softmax(self, x, mask):
+        scale = self.scale if self.scale is not None else 1.0
+        if self.attn_mask_type == AttnMaskType.causal:
+            b, np_, sq, sk = x.shape
+            out = scaled_upper_triang_masked_softmax(
+                x.reshape(-1, sq, sk), scale)
+            return out.reshape(x.shape)
+        return scaled_masked_softmax(x, mask, scale)
+
+    def forward_torch_softmax(self, x, mask):
+        """Unfused path (reference ``fused_softmax.py:253-270``)."""
+        orig_dtype = x.dtype
+        if self.input_in_float16 and self.softmax_in_fp32:
+            x = x.astype(jnp.float32)
+        if self.scale is not None:
+            x = x * self.scale
+        if self.attn_mask_type == AttnMaskType.causal:
+            sq, sk = x.shape[-2], x.shape[-1]
+            causal = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+            x = jnp.where(causal, x, -10000.0)
+        if mask is not None:
+            x = self.mask_func(x, mask) if self.mask_func is not None else (
+                jnp.where(mask, -10000.0, x))
+        probs = jax.nn.softmax(x, axis=-1)
+        if self.input_in_float16 and self.softmax_in_fp32:
+            probs = probs.astype(orig_dtype)
+        return probs
+
+
+# Module-level aliases matching the reference's public autograd wrappers
+# (``fused_softmax.py:20-178`` exposes ScaledUpperTriangMaskedSoftmax etc.).
+ScaledSoftmax = scaled_softmax
+ScaledMaskedSoftmax = scaled_masked_softmax
+ScaledUpperTriangMaskedSoftmax = scaled_upper_triang_masked_softmax
+GenericScaledMaskedSoftmax = generic_scaled_masked_softmax

@@ -1,0 +1,83 @@
+"""FusedScaleMaskSoftmax dispatcher + fused RoPE wrapper tests.
+
+Mirrors ``/root/reference/tests/L0/run_transformer/test_fused_softmax.py``
+(fused vs torch-path parity for causal and padding mask types) and
+``test_fused_rope.py``.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+os.environ.setdefault("APEX_TPU_FORCE_PALLAS", "interpret")
+
+from apex_tpu.transformer import AttnMaskType  # noqa: E402
+from apex_tpu.transformer.functional import (  # noqa: E402
+    FusedScaleMaskSoftmax,
+    fused_apply_rotary_pos_emb,
+    fused_apply_rotary_pos_emb_cached,
+)
+
+
+def _rand(shape, dtype=jnp.float32, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.standard_normal(shape), dtype)
+
+
+@pytest.mark.parametrize("mask_type", [AttnMaskType.padding, AttnMaskType.causal])
+@pytest.mark.parametrize("scale", [None, 2.0])
+def test_fused_vs_unfused(mask_type, scale):
+    x = _rand((2, 4, 32, 32), seed=1)
+    mask = None
+    if mask_type == AttnMaskType.padding:
+        rng = np.random.default_rng(2)
+        mask = jnp.asarray(rng.random((2, 1, 32, 32)) < 0.3)
+    fused = FusedScaleMaskSoftmax(
+        attn_mask_type=mask_type, scaled_masked_softmax_fusion=True,
+        scale=scale)
+    unfused = FusedScaleMaskSoftmax(
+        attn_mask_type=mask_type, scaled_masked_softmax_fusion=False,
+        scale=scale)
+    np.testing.assert_allclose(np.asarray(fused(x, mask)),
+                               np.asarray(unfused(x, mask)),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_scale_requires_fp32_softmax():
+    with pytest.raises(RuntimeError):
+        FusedScaleMaskSoftmax(softmax_in_fp32=False, scale=2.0)
+
+
+def test_both_dtype_flags_rejected():
+    with pytest.raises(RuntimeError):
+        FusedScaleMaskSoftmax(input_in_fp16=True, input_in_bf16=True)
+
+
+def test_rope_grad_is_inverse_rotation():
+    s, b, h, d = 16, 2, 3, 32
+    t = _rand((s, b, h, d), seed=3)
+    inv_freq = 1.0 / (10000 ** (np.arange(0, d, 2) / d))
+    pos = np.arange(s)
+    f = np.einsum("s,d->sd", pos, inv_freq)
+    freqs = jnp.asarray(np.concatenate([f, f], axis=-1)[:, None, None, :],
+                        jnp.float32)
+
+    out = fused_apply_rotary_pos_emb(t, freqs)
+    # rotations are orthonormal: ||rope(t)|| == ||t|| per (s, position) pair
+    np.testing.assert_allclose(
+        np.asarray(jnp.linalg.norm(out, axis=-1)),
+        np.asarray(jnp.linalg.norm(t, axis=-1)), atol=1e-5, rtol=1e-5)
+
+    cached = fused_apply_rotary_pos_emb_cached(
+        t, jnp.cos(freqs), jnp.sin(freqs))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(cached),
+                               atol=1e-6, rtol=1e-6)
+
+    # grad of sum(rope(t)) == rope^{-1}(ones): orthogonality check via vjp
+    g = jax.grad(lambda t: jnp.sum(fused_apply_rotary_pos_emb(t, freqs)))(t)
+    _, vjp = jax.vjp(lambda t: fused_apply_rotary_pos_emb(t, freqs), t)
+    (g2,) = vjp(jnp.ones_like(t))
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g2), atol=1e-6)
