@@ -1,0 +1,28 @@
+"""Model zoo built on the parallel transformer toolkit.
+
+The reference ships its Megatron LM building blocks and standalone GPT/BERT
+as test fixtures (``apex/transformer/testing/standalone_transformer_lm.py``,
+``standalone_gpt.py``, ``standalone_bert.py``); here they are first-class
+models, plus the vision models exercised by the reference examples
+(``examples/imagenet``, ``examples/dcgan``).
+"""
+
+from apex_tpu.models.transformer import (
+    TransformerConfig,
+    ParallelMLP,
+    ParallelAttention,
+    ParallelTransformerLayer,
+    ParallelTransformer,
+)
+from apex_tpu.models.gpt import GPTModel
+from apex_tpu.models.bert import BertModel
+
+__all__ = [
+    "TransformerConfig",
+    "ParallelMLP",
+    "ParallelAttention",
+    "ParallelTransformerLayer",
+    "ParallelTransformer",
+    "GPTModel",
+    "BertModel",
+]

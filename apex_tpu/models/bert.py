@@ -1,0 +1,189 @@
+"""Standalone BERT — bidirectional encoder with MLM + binary heads.
+
+Capability counterpart of ``apex/transformer/testing/standalone_bert.py``
+(``BertModel`` on top of the Megatron blocks: padding-mask attention,
+pooler, ``BertLMHead`` with tied embeddings, binary [NSP] head).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec
+
+from apex_tpu.models.transformer import (
+    ParallelTransformer,
+    TransformerConfig,
+    _ln,
+    _ln_params,
+    _ln_spec,
+    embed_tokens,
+)
+from apex_tpu.transformer.enums import AttnMaskType
+from apex_tpu.transformer.tensor_parallel.cross_entropy import (
+    vocab_parallel_cross_entropy,
+)
+from apex_tpu.transformer.tensor_parallel.layers import (
+    VocabParallelEmbedding,
+    linear_with_grad_accumulation_and_async_allreduce,
+)
+from apex_tpu.transformer.tensor_parallel.mappings import (
+    gather_from_sequence_parallel_region,
+)
+
+__all__ = ["BertModel"]
+
+
+@dataclass
+class BertModel:
+    """BERT encoder: embeddings (word+position+tokentype) -> bidirectional
+    ParallelTransformer -> LM head (tied) + optional binary head."""
+
+    config: TransformerConfig
+    num_tokentypes: int = 2
+    add_binary_head: bool = True
+
+    def __post_init__(self):
+        c = self.config
+        if c.attn_mask_type == AttnMaskType.causal:
+            self.config = c = replace(c, attn_mask_type=AttnMaskType.padding)
+        self.embedding = VocabParallelEmbedding(
+            c.vocab_size, c.hidden_size, init_method=c.init_method(),
+            params_dtype=c.params_dtype, axis_name=c.axis_name)
+        self.transformer = ParallelTransformer(c)
+
+    def init(self, key: jax.Array) -> Dict[str, Any]:
+        c = self.config
+        ks = jax.random.split(key, 6)
+        params = {
+            "embedding": {
+                "word_embeddings": self.embedding.init(ks[0]),
+                "position_embeddings": c.init_method()(
+                    ks[1], (c.max_position_embeddings, c.hidden_size),
+                    c.params_dtype),
+                "tokentype_embeddings": c.init_method()(
+                    ks[2], (self.num_tokentypes, c.hidden_size),
+                    c.params_dtype),
+            },
+            "transformer": self.transformer.init(ks[3]),
+            # BertLMHead: dense + layernorm before the tied projection
+            "lm_head": {
+                "dense": {
+                    "weight": c.init_method()(
+                        ks[4], (c.hidden_size, c.hidden_size), c.params_dtype),
+                    "bias": jnp.zeros((c.hidden_size,), c.params_dtype),
+                },
+                "layernorm": _ln_params(c.hidden_size, c.params_dtype),
+            },
+        }
+        if self.add_binary_head:
+            params["binary_head"] = {
+                "pooler": {
+                    "weight": c.init_method()(
+                        ks[5], (c.hidden_size, c.hidden_size), c.params_dtype),
+                    "bias": jnp.zeros((c.hidden_size,), c.params_dtype),
+                },
+                "classifier": {
+                    "weight": jnp.zeros((2, c.hidden_size), c.params_dtype),
+                    "bias": jnp.zeros((2,), c.params_dtype),
+                },
+            }
+        return params
+
+    def spec(self) -> Dict[str, Any]:
+        dense_spec = {"weight": PartitionSpec(), "bias": PartitionSpec()}
+        spec = {
+            "embedding": {
+                "word_embeddings": self.embedding.spec(),
+                "position_embeddings": PartitionSpec(),
+                "tokentype_embeddings": PartitionSpec(),
+            },
+            "transformer": self.transformer.spec(),
+            "lm_head": {"dense": dict(dense_spec), "layernorm": _ln_spec()},
+        }
+        if self.add_binary_head:
+            spec["binary_head"] = {"pooler": dict(dense_spec),
+                                   "classifier": dict(dense_spec)}
+        return spec
+
+    @staticmethod
+    def build_attention_mask(padding_mask: jax.Array) -> jax.Array:
+        """[b, s] bool (True = valid token) -> [b, 1, s, s] bool mask where
+        True = masked out, the reference's extended attention mask
+        (``standalone_bert.py`` ``bert_extended_attention_mask``)."""
+        m = padding_mask.astype(bool)
+        att = m[:, None, None, :] & m[:, None, :, None]
+        return ~att
+
+    def apply(
+        self,
+        params: Dict[str, Any],
+        tokens: jax.Array,
+        padding_mask: Optional[jax.Array] = None,
+        tokentype_ids: Optional[jax.Array] = None,
+        lm_labels: Optional[jax.Array] = None,
+        *,
+        rng: Optional[jax.Array] = None,
+        deterministic: bool = True,
+    ):
+        """tokens/padding_mask/tokentype_ids/lm_labels: ``[batch, seq]``.
+
+        Returns ``(lm_loss_or_logits, binary_logits_or_None)`` mirroring the
+        reference BertModel.forward output pair.
+        """
+        c = self.config
+        rngs = (None, None) if rng is None else tuple(jax.random.split(rng))
+        hidden = embed_tokens(
+            self.embedding, params["embedding"], tokens, c,
+            tokentype_params=params["embedding"]["tokentype_embeddings"],
+            tokentype_ids=tokentype_ids, rng=rngs[0],
+            deterministic=deterministic)
+        mask = (self.build_attention_mask(padding_mask)
+                if padding_mask is not None else None)
+        hidden = self.transformer.apply(
+            params["transformer"], hidden, attention_mask=mask,
+            rng=rngs[1], deterministic=deterministic)
+        if c.sequence_parallel:
+            # heads (pooler/dense/layernorm) run on the full sequence; the
+            # gather's backward scatters grads back to the shards
+            hidden = gather_from_sequence_parallel_region(
+                hidden, False, c.axis_name)
+
+        binary_logits = None
+        if self.add_binary_head:
+            # pooler over the first token's hidden state ([CLS]); under SP
+            # token 0 lives on rank 0's shard — gather happens in the LM head
+            # matmul, so take it from the (possibly sharded) dim-0 start.
+            pooled = jnp.tanh(
+                hidden[0].astype(jnp.float32)
+                @ params["binary_head"]["pooler"]["weight"].T.astype(jnp.float32)
+                + params["binary_head"]["pooler"]["bias"])
+            binary_logits = (
+                pooled @ params["binary_head"]["classifier"]["weight"].T
+                + params["binary_head"]["classifier"]["bias"])
+
+        h = hidden.astype(jnp.float32)
+        h = h @ params["lm_head"]["dense"]["weight"].T.astype(jnp.float32) \
+            + params["lm_head"]["dense"]["bias"]
+        h = jax.nn.gelu(h, approximate=True)
+        h = _ln(params["lm_head"]["layernorm"], h, c.layernorm_epsilon)
+        logits = linear_with_grad_accumulation_and_async_allreduce(
+            h,
+            params["embedding"]["word_embeddings"]["weight"].astype(
+                jnp.float32),
+            None,
+            sequence_parallel_enabled=False,  # already gathered above
+            axis_name=c.axis_name)
+        if lm_labels is None:
+            return logits, binary_logits
+        losses = vocab_parallel_cross_entropy(
+            logits, lm_labels.transpose(1, 0), axis_name=c.axis_name)
+        if padding_mask is not None:
+            m = padding_mask.transpose(1, 0).astype(losses.dtype)
+            lm_loss = jnp.sum(losses * m) / jnp.maximum(jnp.sum(m), 1.0)
+        else:
+            lm_loss = jnp.mean(losses)
+        return lm_loss, binary_logits

@@ -1,0 +1,116 @@
+"""Standalone GPT — the flagship causal LM.
+
+Capability counterpart of the reference's test-fixture GPT
+(``apex/transformer/testing/standalone_gpt.py:~40-111`` on top of
+``standalone_transformer_lm.py``: ``TransformerLanguageModel`` ~:1390-1550,
+``post_language_model_processing`` lm-head + vocab-parallel loss): vocab- and
+tensor-sharded embedding, learned positions, parallel transformer stack,
+weight-tied vocab-parallel LM head, vocab-parallel cross entropy.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec
+
+from apex_tpu.models.transformer import (
+    ParallelTransformer,
+    TransformerConfig,
+    embed_tokens,
+)
+from apex_tpu.transformer.tensor_parallel.cross_entropy import (
+    vocab_parallel_cross_entropy,
+)
+from apex_tpu.transformer.tensor_parallel.layers import (
+    VocabParallelEmbedding,
+    linear_with_grad_accumulation_and_async_allreduce,
+)
+__all__ = ["GPTModel"]
+
+
+@dataclass
+class GPTModel:
+    """GPT: embeddings -> ParallelTransformer (causal) -> tied LM head."""
+
+    config: TransformerConfig
+
+    def __post_init__(self):
+        c = self.config
+        self.embedding = VocabParallelEmbedding(
+            c.vocab_size, c.hidden_size, init_method=c.init_method(),
+            params_dtype=c.params_dtype, axis_name=c.axis_name)
+        self.transformer = ParallelTransformer(c)
+
+    def init(self, key: jax.Array) -> Dict[str, Any]:
+        c = self.config
+        k_emb, k_pos, k_tr = jax.random.split(key, 3)
+        return {
+            "embedding": {
+                "word_embeddings": self.embedding.init(k_emb),
+                "position_embeddings": c.init_method()(
+                    k_pos, (c.max_position_embeddings, c.hidden_size),
+                    c.params_dtype),
+            },
+            "transformer": self.transformer.init(k_tr),
+        }
+
+    def spec(self) -> Dict[str, Any]:
+        return {
+            "embedding": {
+                "word_embeddings": self.embedding.spec(),
+                "position_embeddings": PartitionSpec(),
+            },
+            "transformer": self.transformer.spec(),
+        }
+
+    def _embed(self, params, tokens, rng, deterministic):
+        """tokens [b, s] -> hidden [s(, shard), b, h] (Megatron layout)."""
+        return embed_tokens(self.embedding, params["embedding"], tokens,
+                            self.config, rng=rng, deterministic=deterministic)
+
+    def apply(
+        self,
+        params: Dict[str, Any],
+        tokens: jax.Array,
+        labels: Optional[jax.Array] = None,
+        *,
+        loss_mask: Optional[jax.Array] = None,
+        rng: Optional[jax.Array] = None,
+        deterministic: bool = True,
+    ):
+        """tokens/labels/loss_mask: ``[batch, seq]``.
+
+        With ``labels`` returns the scalar masked-mean LM loss (the
+        reference's loss path through ``vocab_parallel_cross_entropy``);
+        otherwise returns vocab-parallel logits ``[s, b, vocab/tp]``.
+        """
+        c = self.config
+        rngs = (None, None) if rng is None else tuple(jax.random.split(rng))
+        hidden = self._embed(params, tokens, rngs[0], deterministic)
+        hidden = self.transformer.apply(
+            params["transformer"], hidden, rng=rngs[1],
+            deterministic=deterministic)
+        # weight-tied LM head: a ColumnParallelLinear forward with the vocab-
+        # sharded embedding matrix (standalone_transformer_lm.py
+        # post_language_model_processing); under SP this all-gathers the
+        # sequence shards back into the matmul.
+        logits = linear_with_grad_accumulation_and_async_allreduce(
+            hidden.astype(jnp.float32),
+            params["embedding"]["word_embeddings"]["weight"].astype(
+                jnp.float32),
+            None,
+            sequence_parallel_enabled=c.sequence_parallel,
+            axis_name=c.axis_name)                         # [s, b, V/tp]
+        if labels is None:
+            return logits
+        labels_sb = labels.transpose(1, 0)                  # [s, b]
+        losses = vocab_parallel_cross_entropy(logits, labels_sb,
+                                              axis_name=c.axis_name)
+        if loss_mask is None:
+            return jnp.mean(losses)
+        mask_sb = loss_mask.transpose(1, 0).astype(losses.dtype)
+        return jnp.sum(losses * mask_sb) / jnp.maximum(jnp.sum(mask_sb), 1.0)

@@ -1,0 +1,385 @@
+"""Megatron-style parallel transformer blocks, TPU-native.
+
+Capability counterpart of the reference's Megatron LM building blocks
+(``apex/transformer/testing/standalone_transformer_lm.py``: ``ParallelMLP``
+~:610-672, ``ParallelAttention`` ~:675-884, ``ParallelTransformerLayer``
+~:1033-1148, ``ParallelTransformer`` ~:1151-1380), built on the
+tensor/sequence-parallel layers of :mod:`apex_tpu.transformer.tensor_parallel`.
+
+Design (not a port):
+
+- modules are functional: ``init(key) -> params`` (global shapes),
+  ``spec() -> PartitionSpec`` pytree, ``apply(params, ...)`` written against
+  the local-shard view inside ``shard_map`` (identical code runs unsharded).
+- layout is Megatron's ``[seq, batch, hidden]``; under sequence parallelism
+  dim 0 holds the local sequence shard between matmul regions.
+- core attention is the Pallas flash kernel (``apex_tpu.ops.flash_attention``)
+  when the mask is causal/lengths-shaped and attention dropout is off;
+  otherwise the :class:`FusedScaleMaskSoftmax` path with dropout, matching
+  the reference's kernel-availability dispatch
+  (``functional/fused_softmax.py:222-248``).
+- layer stacking is ``lax.scan`` over stacked per-layer params — one trace,
+  one compile, regardless of depth; optional ``jax.checkpoint`` per layer is
+  the activation-recompute story (reference
+  ``tensor_parallel/random.py:~240-311``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec
+
+from apex_tpu.ops import flash_attention, fused_layer_norm_affine
+from apex_tpu.transformer.enums import AttnMaskType
+from apex_tpu.transformer.functional import FusedScaleMaskSoftmax
+from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+from apex_tpu.transformer.tensor_parallel.layers import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+)
+from apex_tpu.transformer.tensor_parallel.mappings import (
+    mark_sequence_parallel_parameter,
+)
+from apex_tpu.transformer.tensor_parallel.random import model_parallel_rng_key
+from apex_tpu.transformer.tensor_parallel.utils import divide
+
+__all__ = [
+    "TransformerConfig",
+    "ParallelMLP",
+    "ParallelAttention",
+    "ParallelTransformerLayer",
+    "ParallelTransformer",
+]
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    """Hyperparameters (subset of the reference's Megatron global args,
+    ``apex/transformer/testing/arguments.py``, that shape the model)."""
+
+    num_layers: int
+    hidden_size: int
+    num_attention_heads: int
+    ffn_hidden_size: Optional[int] = None
+    vocab_size: int = 32000
+    max_position_embeddings: int = 2048
+    hidden_dropout: float = 0.1
+    attention_dropout: float = 0.1
+    layernorm_epsilon: float = 1e-5
+    attn_mask_type: AttnMaskType = AttnMaskType.causal
+    sequence_parallel: bool = False
+    recompute: bool = False          # full-layer activation recompute
+    params_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.float32  # activations cast at block entry
+    init_method_std: float = 0.02
+    axis_name: str = TENSOR_AXIS
+
+    @property
+    def ffn_size(self) -> int:
+        return self.ffn_hidden_size or 4 * self.hidden_size
+
+    @property
+    def head_dim(self) -> int:
+        return divide(self.hidden_size, self.num_attention_heads)
+
+    def init_method(self) -> Callable:
+        std = self.init_method_std
+        return jax.nn.initializers.normal(stddev=std)
+
+    def output_init_method(self) -> Callable:
+        # Megatron scales residual-output layer init by 1/sqrt(2*L)
+        # (standalone_transformer_lm.py `scaled_init_method_normal`).
+        std = self.init_method_std / (2.0 * self.num_layers) ** 0.5
+        return jax.nn.initializers.normal(stddev=std)
+
+
+def _dropout(x, rate, key, deterministic, model_parallel_region, axis_name):
+    """Dropout with Megatron RNG semantics: inside model-parallel regions
+    each TP rank draws a distinct mask (reference
+    ``tensor_parallel/random.py:90-240``); in replicated regions all ranks
+    draw the same mask."""
+    if deterministic or rate == 0.0 or key is None:
+        return x
+    if model_parallel_region:
+        key = model_parallel_rng_key(key, axis_name)
+    keep = jax.random.bernoulli(key, 1.0 - rate, x.shape)
+    return jnp.where(keep, x / (1.0 - rate), jnp.zeros_like(x))
+
+
+def embed_tokens(embedding, emb_params, tokens, config, *, tokentype_params=None,
+                 tokentype_ids=None, rng=None, deterministic=True):
+    """Shared embedding pipeline: word + position (+ tokentype) lookups,
+    [b,s,h] -> [s,b,h] transpose, SP scatter, embedding dropout (reference
+    ``standalone_transformer_lm.py`` ``Embedding.forward``)."""
+    c = config
+    emb = embedding.apply(emb_params["word_embeddings"], tokens)
+    pos = emb_params["position_embeddings"][: tokens.shape[1]]
+    emb = emb + pos[None, :, :]
+    if tokentype_ids is not None:
+        emb = emb + jnp.take(tokentype_params, tokentype_ids, axis=0)
+    hidden = emb.transpose(1, 0, 2).astype(c.compute_dtype)
+    if c.sequence_parallel:
+        from apex_tpu.transformer.tensor_parallel.mappings import (
+            scatter_to_sequence_parallel_region,
+        )
+        hidden = scatter_to_sequence_parallel_region(hidden, c.axis_name)
+    return _dropout(hidden, c.hidden_dropout, rng, deterministic,
+                    model_parallel_region=c.sequence_parallel,
+                    axis_name=c.axis_name)
+
+
+def _ln_params(hidden_size, dtype):
+    return {"weight": jnp.ones((hidden_size,), dtype),
+            "bias": jnp.zeros((hidden_size,), dtype)}
+
+
+def _ln_spec():
+    return {"weight": PartitionSpec(), "bias": PartitionSpec()}
+
+
+def _ln(params, x, eps, sequence_parallel=False, axis_name=TENSOR_AXIS):
+    w, b = params["weight"], params["bias"]
+    if sequence_parallel:
+        # norm runs on sequence shards; psum the param grads (reference
+        # layer_norm.py:26-99 ``sequence_parallel_enabled`` marking)
+        w = mark_sequence_parallel_parameter(w, axis_name)
+        b = mark_sequence_parallel_parameter(b, axis_name)
+    return fused_layer_norm_affine(x, w, b, (x.shape[-1],), eps)
+
+
+@dataclass
+class ParallelMLP:
+    """h -> 4h (column) -> gelu -> h (row).
+
+    Reference: ``standalone_transformer_lm.py`` ``ParallelMLP`` (~:610-672):
+    ColumnParallelLinear with ``gather_output=False``, fused bias-gelu,
+    RowParallelLinear with ``input_is_parallel=True``.
+    """
+
+    config: TransformerConfig
+
+    def __post_init__(self):
+        c = self.config
+        self.dense_h_to_4h = ColumnParallelLinear(
+            c.hidden_size, c.ffn_size, gather_output=False,
+            init_method=c.init_method(),
+            sequence_parallel_enabled=c.sequence_parallel,
+            params_dtype=c.params_dtype, axis_name=c.axis_name)
+        self.dense_4h_to_h = RowParallelLinear(
+            c.ffn_size, c.hidden_size, input_is_parallel=True,
+            init_method=c.output_init_method(),
+            sequence_parallel_enabled=c.sequence_parallel,
+            params_dtype=c.params_dtype, axis_name=c.axis_name)
+
+    def init(self, key):
+        k1, k2 = jax.random.split(key)
+        return {"dense_h_to_4h": self.dense_h_to_4h.init(k1),
+                "dense_4h_to_h": self.dense_4h_to_h.init(k2)}
+
+    def spec(self):
+        return {"dense_h_to_4h": self.dense_h_to_4h.spec(),
+                "dense_4h_to_h": self.dense_4h_to_h.spec()}
+
+    def apply(self, params, hidden):
+        x = self.dense_h_to_4h.apply(params["dense_h_to_4h"], hidden)
+        x = jax.nn.gelu(x, approximate=True)
+        return self.dense_4h_to_h.apply(params["dense_4h_to_h"], x)
+
+
+@dataclass
+class ParallelAttention:
+    """Self-attention with TP-sharded heads.
+
+    Reference: ``standalone_transformer_lm.py`` ``ParallelAttention``
+    (~:675-884): fused QKV ColumnParallelLinear (``gather_output=False``),
+    per-rank head slice, core attention (fused softmax + dropout + BMMs or
+    flash), RowParallelLinear output projection.
+    """
+
+    config: TransformerConfig
+
+    def __post_init__(self):
+        c = self.config
+        self.query_key_value = ColumnParallelLinear(
+            c.hidden_size, 3 * c.hidden_size, gather_output=False,
+            init_method=c.init_method(),
+            sequence_parallel_enabled=c.sequence_parallel,
+            params_dtype=c.params_dtype, axis_name=c.axis_name)
+        self.dense = RowParallelLinear(
+            c.hidden_size, c.hidden_size, input_is_parallel=True,
+            init_method=c.output_init_method(),
+            sequence_parallel_enabled=c.sequence_parallel,
+            params_dtype=c.params_dtype, axis_name=c.axis_name)
+        self.scale_mask_softmax = FusedScaleMaskSoftmax(
+            attn_mask_type=c.attn_mask_type,
+            scaled_masked_softmax_fusion=True,
+            softmax_in_fp32=True)
+
+    def init(self, key):
+        k1, k2 = jax.random.split(key)
+        return {"query_key_value": self.query_key_value.init(k1),
+                "dense": self.dense.init(k2)}
+
+    def spec(self):
+        return {"query_key_value": self.query_key_value.spec(),
+                "dense": self.dense.spec()}
+
+    def _core_attention(self, q, k, v, attention_mask, kv_lengths,
+                        rng, deterministic):
+        """q/k/v: [b, local_heads, s, dh]."""
+        c = self.config
+        causal = c.attn_mask_type == AttnMaskType.causal
+        use_flash = attention_mask is None and (
+            deterministic or c.attention_dropout == 0.0)
+        if use_flash:
+            return flash_attention(q, k, v, causal=causal,
+                                   kv_lengths=kv_lengths)
+        if kv_lengths is not None:
+            # fold varlen lengths into the boolean mask (True = masked out)
+            # so the unfused path matches flash semantics
+            invalid = jnp.arange(k.shape[2])[None, None, None, :] >= \
+                kv_lengths[:, None, None, None]
+            attention_mask = invalid if attention_mask is None else (
+                jnp.logical_or(attention_mask, invalid))
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(
+            jnp.asarray(c.head_dim, jnp.float32)).astype(q.dtype)
+        probs = self.scale_mask_softmax(scores, attention_mask)
+        probs = _dropout(probs, c.attention_dropout, rng, deterministic,
+                         model_parallel_region=True, axis_name=c.axis_name)
+        return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
+
+    def apply(self, params, hidden, *, attention_mask=None, kv_lengths=None,
+              rng=None, deterministic=True):
+        """hidden: [s(, shard), b, h] -> [s(, shard), b, h]."""
+        c = self.config
+        qkv = self.query_key_value.apply(params["query_key_value"], hidden)
+        s, b = qkv.shape[0], qkv.shape[1]
+        dh = c.head_dim
+        local_heads = qkv.shape[-1] // (3 * dh)
+        qkv = qkv.reshape(s, b, local_heads, 3 * dh)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        # [s, b, hl, dh] -> [b, hl, s, dh]
+        q, k, v = (t.transpose(1, 2, 0, 3) for t in (q, k, v))
+        ctx = self._core_attention(q, k, v, attention_mask, kv_lengths,
+                                   rng, deterministic)
+        ctx = ctx.transpose(2, 0, 1, 3).reshape(s, b, local_heads * dh)
+        return self.dense.apply(params["dense"], ctx)
+
+
+@dataclass
+class ParallelTransformerLayer:
+    """Pre-LN block: ln -> attn -> add -> ln -> mlp -> add.
+
+    Reference: ``standalone_transformer_lm.py`` ``ParallelTransformerLayer``
+    (~:1033-1148). Under sequence parallelism the norms and dropouts run on
+    sequence shards (``transformer/layers/layer_norm.py:26-99`` marks those
+    params ``sequence_parallel_enabled`` for grad sync; here that sync is the
+    train step's psum of replicated-param grads).
+    """
+
+    config: TransformerConfig
+
+    def __post_init__(self):
+        c = self.config
+        self.attention = ParallelAttention(c)
+        self.mlp = ParallelMLP(c)
+
+    def init(self, key):
+        c = self.config
+        k1, k2 = jax.random.split(key)
+        return {
+            "input_layernorm": _ln_params(c.hidden_size, c.params_dtype),
+            "self_attention": self.attention.init(k1),
+            "post_attention_layernorm": _ln_params(c.hidden_size, c.params_dtype),
+            "mlp": self.mlp.init(k2),
+        }
+
+    def spec(self):
+        return {
+            "input_layernorm": _ln_spec(),
+            "self_attention": self.attention.spec(),
+            "post_attention_layernorm": _ln_spec(),
+            "mlp": self.mlp.spec(),
+        }
+
+    def apply(self, params, hidden, *, attention_mask=None, kv_lengths=None,
+              rng=None, deterministic=True):
+        c = self.config
+        rngs = ((None,) * 3 if rng is None
+                else tuple(jax.random.split(rng, 3)))
+        x = _ln(params["input_layernorm"], hidden, c.layernorm_epsilon,
+                c.sequence_parallel, c.axis_name)
+        attn_out = self.attention.apply(
+            params["self_attention"], x.astype(c.compute_dtype),
+            attention_mask=attention_mask, kv_lengths=kv_lengths,
+            rng=rngs[2], deterministic=deterministic)
+        attn_out = _dropout(attn_out, c.hidden_dropout, rngs[0], deterministic,
+                            model_parallel_region=c.sequence_parallel,
+                            axis_name=c.axis_name)
+        hidden = hidden + attn_out
+        x = _ln(params["post_attention_layernorm"], hidden,
+                c.layernorm_epsilon, c.sequence_parallel, c.axis_name)
+        mlp_out = self.mlp.apply(params["mlp"], x.astype(c.compute_dtype))
+        mlp_out = _dropout(mlp_out, c.hidden_dropout, rngs[1], deterministic,
+                           model_parallel_region=c.sequence_parallel,
+                           axis_name=c.axis_name)
+        return hidden + mlp_out
+
+
+@dataclass
+class ParallelTransformer:
+    """Stack of :class:`ParallelTransformerLayer` via ``lax.scan``.
+
+    Reference: ``standalone_transformer_lm.py`` ``ParallelTransformer``
+    (~:1151-1380). ``num_layers`` here is the *local* (per-pipeline-stage)
+    depth; pipeline schedules stack these per stage.
+    """
+
+    config: TransformerConfig
+
+    def __post_init__(self):
+        self.layer = ParallelTransformerLayer(self.config)
+
+    def init(self, key):
+        keys = jax.random.split(key, self.config.num_layers)
+        stacked = jax.vmap(self.layer.init)(keys)
+        return {"layers": stacked,
+                "final_layernorm": _ln_params(self.config.hidden_size,
+                                              self.config.params_dtype)}
+
+    def spec(self):
+        layer_spec = self.layer.spec()
+        stacked = jax.tree.map(
+            lambda s: PartitionSpec(None, *s), layer_spec,
+            is_leaf=lambda x: isinstance(x, PartitionSpec))
+        return {"layers": stacked, "final_layernorm": _ln_spec()}
+
+    def apply(self, params, hidden, *, attention_mask=None, kv_lengths=None,
+              rng=None, deterministic=True, final_norm=True):
+        c = self.config
+
+        def one_layer(carry, xs):
+            h, idx = carry
+            layer_params = xs
+            layer_rng = None if rng is None else jax.random.fold_in(rng, idx)
+
+            def run(h):
+                return self.layer.apply(
+                    layer_params, h, attention_mask=attention_mask,
+                    kv_lengths=kv_lengths, rng=layer_rng,
+                    deterministic=deterministic)
+
+            h = jax.checkpoint(run)(h) if c.recompute else run(h)
+            return (h, idx + 1), None
+
+        (hidden, _), _ = lax.scan(one_layer, (hidden, 0), params["layers"])
+        if final_norm:
+            hidden = _ln(params["final_layernorm"], hidden,
+                         c.layernorm_epsilon, c.sequence_parallel,
+                         c.axis_name)
+        return hidden

@@ -102,6 +102,32 @@ class FusedOptimizer:
             new_state["master"] = new_p32
         return like(new_p32, params), new_state
 
+    def state_spec(self, params, param_spec):
+        """PartitionSpec pytree for ``init(params)``'s state, derived from the
+        params' spec: param-shaped slot leaves inherit the param's spec,
+        per-tensor scalars (e.g. NovoGrad's second moments) and the step
+        counter are replicated. Used to shard optimizer state under pjit/
+        shard_map (and, over the data axis, for the ZeRO-sharded variants).
+        """
+        from jax.sharding import PartitionSpec
+
+        shapes = jax.eval_shape(self.init, params)
+
+        def sub(shape_tree):
+            if shape_tree is None:
+                return None
+            return tree_map(
+                lambda sh, sp: sp if sh.ndim > 0 else PartitionSpec(),
+                shape_tree, param_spec)
+
+        spec = {
+            "step": PartitionSpec(),
+            "slots": {k: sub(v) for k, v in shapes["slots"].items()},
+        }
+        if self.master_weights:
+            spec["master"] = param_spec
+        return spec
+
     # -- optax interop ----------------------------------------------------
     def as_gradient_transformation(self):
         """Expose as an ``optax.GradientTransformation`` (updates = new - old)."""

@@ -77,8 +77,13 @@ class FusedScaleMaskSoftmax:
                             sq: int, sk: int) -> bool:
         """Reference predicate (``fused_softmax.py:222-248``) — the CUDA
         limits (sk <= 16384, fp16/bf16 only, sq % 4 == 0) don't apply to the
-        Pallas kernels; only the fusion flag gates the fused path."""
-        return bool(self.scaled_masked_softmax_fusion)
+        Pallas kernels; the fused causal kernel still requires square scores
+        (same gate as the reference's ``sq == sk`` check)."""
+        if not self.scaled_masked_softmax_fusion:
+            return False
+        if self.attn_mask_type == AttnMaskType.causal and sq != sk:
+            return False
+        return True
 
     def __call__(self, x: jax.Array, mask: Optional[jax.Array]) -> jax.Array:
         assert x.ndim == 4  # (b, np, sq, sk), reference `forward` assertion

@@ -47,6 +47,7 @@ from apex_tpu.transformer.parallel_state import TENSOR_AXIS
 from apex_tpu.transformer.tensor_parallel.mappings import (
     axis_bound,
     copy_to_tensor_model_parallel_region,
+    mark_sequence_parallel_parameter,
     gather_from_sequence_parallel_region,
     gather_from_tensor_model_parallel_region,
     reduce_from_tensor_model_parallel_region,
@@ -97,9 +98,11 @@ def linear_with_grad_accumulation_and_async_allreduce(
             x, True, axis_name)
     else:
         total_input = copy_to_tensor_model_parallel_region(x, axis_name)
-    out = jnp.matmul(total_input, weight.T)
+    # compute in the activation dtype (amp O2 semantics: bf16 compute against
+    # fp32 master params; the cast's transpose keeps param grads fp32)
+    out = jnp.matmul(total_input, weight.T.astype(x.dtype))
     if bias is not None:
-        out = out + bias
+        out = out + bias.astype(out.dtype)
     return out
 
 
@@ -201,7 +204,7 @@ class RowParallelLinear:
         """Forward (reference ``layers.py:777-813``)."""
         if not self.input_is_parallel:
             x = scatter_to_tensor_model_parallel_region(x, self.axis_name)
-        partial_out = jnp.matmul(x, params["weight"].T)
+        partial_out = jnp.matmul(x, params["weight"].T.astype(x.dtype))
         if self.sequence_parallel_enabled:
             out = reduce_scatter_to_sequence_parallel_region(
                 partial_out, self.axis_name)
@@ -209,10 +212,15 @@ class RowParallelLinear:
             out = reduce_from_tensor_model_parallel_region(
                 partial_out, self.axis_name)
         bias = params.get("bias")
+        if bias is not None and self.sequence_parallel_enabled:
+            # bias meets sequence-sharded output: per-rank bias grads are
+            # partial sums (reference marks the bias
+            # ``sequence_parallel_enabled``, layers.py:758-775)
+            bias = mark_sequence_parallel_parameter(bias, self.axis_name)
         if self.skip_bias_add:
             return out, bias
         if bias is not None:
-            out = out + bias
+            out = out + bias.astype(out.dtype)
         return out
 
 

@@ -43,6 +43,7 @@ __all__ = [
     "scatter_to_sequence_parallel_region",
     "gather_from_sequence_parallel_region",
     "reduce_scatter_to_sequence_parallel_region",
+    "mark_sequence_parallel_parameter",
 ]
 
 
@@ -232,3 +233,33 @@ def _sp_rs_bwd(axis_name, _, g):
 
 
 reduce_scatter_to_sequence_parallel_region.defvjp(_sp_rs_fwd, _sp_rs_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def mark_sequence_parallel_parameter(p, axis_name=TENSOR_AXIS):
+    """Identity forward; backward psums the parameter cotangent over the
+    tensor axis.
+
+    Counterpart of the reference's ``sequence_parallel_enabled`` attribute on
+    layer-norm / row-linear-bias params (``transformer/layers/layer_norm.py:
+    26-99``, ``tensor_parallel/layers.py:758-775``) plus the trainer-side
+    grad all-reduce: under sequence parallelism those params consume
+    *sequence-sharded* activations, so per-rank grads are partial sums. Here
+    the sync is part of the parameter's use site instead of trainer
+    bookkeeping — wrap the param where it meets the sharded activation and
+    autodiff produces fully-reduced grads on every rank.
+    """
+    return p
+
+
+def _mark_sp_fwd(p, axis_name):
+    return p, None
+
+
+def _mark_sp_bwd(axis_name, _, g):
+    if axis_bound(axis_name):
+        g = lax.psum(g, axis_name)
+    return (g,)
+
+
+mark_sequence_parallel_parameter.defvjp(_mark_sp_fwd, _mark_sp_bwd)

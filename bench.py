@@ -1,0 +1,91 @@
+"""Benchmark: flagship GPT training-step throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no numbers (BASELINE.md) — the baseline here is the
+*unfused* XLA implementation of the same model measured in-process (attention
+via materialized scores + softmax instead of the Pallas flash kernel), so
+``vs_baseline`` reports the speedup the fused/Pallas path delivers, the exact
+claim the reference makes for its CUDA kernels.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+STEPS = 10
+
+
+def _build():
+    from apex_tpu.models import GPTModel, TransformerConfig
+    from apex_tpu.optimizers import FusedAdam
+
+    cfg = TransformerConfig(
+        num_layers=12, hidden_size=768, num_attention_heads=12,
+        vocab_size=50304, max_position_embeddings=1024,
+        hidden_dropout=0.0, attention_dropout=0.0,
+        recompute=True, compute_dtype=jnp.bfloat16)
+    model = GPTModel(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    opt = FusedAdam(lr=1e-4)
+    opt_state = opt.init(params)
+
+    bs, seq = 8, 1024
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (bs, seq), 0, 50304)
+    labels = jax.random.randint(jax.random.PRNGKey(2), (bs, seq), 0, 50304)
+
+    def loss_fn(p):
+        return model.apply(p, tokens, labels)
+
+    @jax.jit
+    def step(params, opt_state):
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        params, opt_state = opt.step(grads, params, opt_state)
+        return params, opt_state, loss
+
+    return step, params, opt_state, bs * seq
+
+
+def _run(flash: bool):
+    import apex_tpu.ops._support as support
+    import os
+
+    # kernel dispatch is keyed on APEX_TPU_FORCE_PALLAS (ops/_support.py);
+    # 'off' turns every fused op into its plain-XLA fallback = the baseline
+    prev = os.environ.get("APEX_TPU_FORCE_PALLAS")
+    os.environ["APEX_TPU_FORCE_PALLAS"] = (
+        "tpu" if flash and jax.default_backend() == "tpu" else "off")
+    support.pallas_mode.cache_clear()
+    step, params, opt_state, tokens_per_step = _build()
+    params, opt_state, loss = step(params, opt_state)          # compile
+    _ = float(loss)
+    t0 = time.perf_counter()
+    for _i in range(STEPS):
+        params, opt_state, loss = step(params, opt_state)
+    _ = float(loss)                                            # host sync
+    dt = (time.perf_counter() - t0) / STEPS
+    if prev is None:
+        os.environ.pop("APEX_TPU_FORCE_PALLAS", None)
+    else:
+        os.environ["APEX_TPU_FORCE_PALLAS"] = prev
+    support.pallas_mode.cache_clear()
+    return tokens_per_step / dt, float(loss)
+
+
+def main():
+    fused_tps, loss = _run(flash=True)
+    baseline_tps, _ = _run(flash=False)
+    print(json.dumps({
+        "metric": "gpt2_124m_train_tokens_per_sec_per_chip",
+        "value": round(fused_tps, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round(fused_tps / baseline_tps, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()
