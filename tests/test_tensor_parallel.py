@@ -239,10 +239,18 @@ class TestColumnRowSequenceParallel:
         rp = row.init(jax.random.PRNGKey(1))
         x = jax.random.normal(jax.random.PRNGKey(2), (8, 2, 8))
 
-        def loss_sh(cparams, rparams):
-            f = shmap(lambda c, r, v: row.apply(r, col.apply(c, v)),
-                      tp8_mesh, (col.spec(), row.spec(), P(TENSOR)), P(TENSOR))
-            return (f(cparams, rparams, x) ** 2).sum()
+        # Canonical usage (see mappings.py docstring): per-rank autodiff
+        # *inside* shard_map — the global loss is the sum of per-rank local
+        # losses over the sequence shards, and the region backwards
+        # (all-gather / psum) assemble full grads on every rank.
+        def per_rank(cparams, rparams, v):
+            def local_loss(c, r):
+                return (row.apply(r, col.apply(c, v)) ** 2).sum()
+            return jax.grad(local_loss, argnums=(0, 1))(cparams, rparams)
+
+        g_sh = shmap(per_rank, tp8_mesh,
+                     (col.spec(), row.spec(), P(TENSOR)),
+                     (col.spec(), row.spec()))(cp, rp, x)
 
         col_ref = ColumnParallelLinear(8, 32, gather_output=False)
         row_ref = RowParallelLinear(32, 8, input_is_parallel=True)
@@ -250,7 +258,6 @@ class TestColumnRowSequenceParallel:
         def loss_ref(cparams, rparams):
             return (row_ref.apply(rparams, col_ref.apply(cparams, x)) ** 2).sum()
 
-        g_sh = jax.grad(loss_sh, argnums=(0, 1))(cp, rp)
         g_ref = jax.grad(loss_ref, argnums=(0, 1))(cp, rp)
         for a, b in zip(jax.tree.leaves(g_sh), jax.tree.leaves(g_ref)):
             np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
