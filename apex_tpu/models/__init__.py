@@ -16,6 +16,7 @@ from apex_tpu.models.transformer import (
 )
 from apex_tpu.models.gpt import GPTModel
 from apex_tpu.models.bert import BertModel
+from apex_tpu.models.pipelined import PipelinedGPT
 
 __all__ = [
     "TransformerConfig",
@@ -25,4 +26,5 @@ __all__ = [
     "ParallelTransformer",
     "GPTModel",
     "BertModel",
+    "PipelinedGPT",
 ]

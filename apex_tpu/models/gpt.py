@@ -29,7 +29,36 @@ from apex_tpu.transformer.tensor_parallel.layers import (
     VocabParallelEmbedding,
     linear_with_grad_accumulation_and_async_allreduce,
 )
-__all__ = ["GPTModel"]
+__all__ = ["GPTModel", "lm_head_loss"]
+
+
+def lm_head_loss(embedding_weight, hidden, labels, loss_mask, config):
+    """Weight-tied LM head + vocab-parallel loss tail shared by
+    :class:`GPTModel` and :class:`~apex_tpu.models.pipelined.PipelinedGPT`.
+
+    Reference: ``standalone_transformer_lm.py`` ``post_language_model_
+    processing`` — ColumnParallelLinear forward with the vocab-sharded
+    embedding matrix (under SP this all-gathers the sequence shards back into
+    the matmul), then ``vocab_parallel_cross_entropy``. Returns vocab-parallel
+    logits ``[s, b, V/tp]`` when ``labels`` is None, else the scalar
+    (optionally loss-masked) mean loss.
+    """
+    c = config
+    logits = linear_with_grad_accumulation_and_async_allreduce(
+        hidden.astype(jnp.float32),
+        embedding_weight.astype(jnp.float32),
+        None,
+        sequence_parallel_enabled=c.sequence_parallel,
+        axis_name=c.axis_name)                              # [s, b, V/tp]
+    if labels is None:
+        return logits
+    labels_sb = labels.transpose(1, 0)                      # [s, b]
+    losses = vocab_parallel_cross_entropy(logits, labels_sb,
+                                          axis_name=c.axis_name)
+    if loss_mask is None:
+        return jnp.mean(losses)
+    mask_sb = loss_mask.transpose(1, 0).astype(losses.dtype)
+    return jnp.sum(losses * mask_sb) / jnp.maximum(jnp.sum(mask_sb), 1.0)
 
 
 @dataclass
@@ -88,29 +117,11 @@ class GPTModel:
         reference's loss path through ``vocab_parallel_cross_entropy``);
         otherwise returns vocab-parallel logits ``[s, b, vocab/tp]``.
         """
-        c = self.config
         rngs = (None, None) if rng is None else tuple(jax.random.split(rng))
         hidden = self._embed(params, tokens, rngs[0], deterministic)
         hidden = self.transformer.apply(
             params["transformer"], hidden, rng=rngs[1],
             deterministic=deterministic)
-        # weight-tied LM head: a ColumnParallelLinear forward with the vocab-
-        # sharded embedding matrix (standalone_transformer_lm.py
-        # post_language_model_processing); under SP this all-gathers the
-        # sequence shards back into the matmul.
-        logits = linear_with_grad_accumulation_and_async_allreduce(
-            hidden.astype(jnp.float32),
-            params["embedding"]["word_embeddings"]["weight"].astype(
-                jnp.float32),
-            None,
-            sequence_parallel_enabled=c.sequence_parallel,
-            axis_name=c.axis_name)                         # [s, b, V/tp]
-        if labels is None:
-            return logits
-        labels_sb = labels.transpose(1, 0)                  # [s, b]
-        losses = vocab_parallel_cross_entropy(logits, labels_sb,
-                                              axis_name=c.axis_name)
-        if loss_mask is None:
-            return jnp.mean(losses)
-        mask_sb = loss_mask.transpose(1, 0).astype(losses.dtype)
-        return jnp.sum(losses * mask_sb) / jnp.maximum(jnp.sum(mask_sb), 1.0)
+        return lm_head_loss(
+            params["embedding"]["word_embeddings"]["weight"], hidden,
+            labels, loss_mask, self.config)

@@ -1,0 +1,186 @@
+"""Pipeline-parallel GPT.
+
+The pipelined flagship: embedding + tied head replicated across pipeline
+stages (grads psum-synced, the analog of the reference's embedding-group
+all-reduce, ``parallel_state.py:347-407``), transformer layers stacked and
+sharded over the ``pipeline`` mesh axis, driven by the ``ppermute`` schedules
+in :mod:`apex_tpu.transformer.pipeline_parallel.schedules`.
+
+Capability counterpart of the reference's pipelined GPT test fixture
+(``apex/transformer/testing/standalone_gpt.py`` under
+``test_pipeline_parallel_fwd_bwd.py``): same TP/SP layers inside each stage,
+1F1B or interleaved schedule outside, vocab-parallel loss on the last stage.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec
+
+from apex_tpu.models.transformer import (
+    ParallelTransformerLayer,
+    TransformerConfig,
+    embed_tokens,
+)
+from apex_tpu.models.transformer import _ln, _ln_params, _ln_spec
+from apex_tpu.transformer.pipeline_parallel.schedules.common import (
+    arrange_layers_for_pipeline,
+    mark_pipeline_replicated,
+    pipeline_stage_spec,
+)
+from apex_tpu.transformer.pipeline_parallel.schedules import (
+    make_interleaved_pipelined_loss_fn,
+    make_pipelined_loss_fn,
+)
+from apex_tpu.models.gpt import lm_head_loss
+from apex_tpu.transformer.tensor_parallel.layers import VocabParallelEmbedding
+
+__all__ = ["PipelinedGPT"]
+
+
+@dataclass
+class PipelinedGPT:
+    """GPT with its layer stack split over the pipeline mesh axis.
+
+    ``num_microbatches`` sizes the schedule scan; ``virtual_pipeline_size``
+    switches to the interleaved schedule. The loss fn returned by
+    :meth:`make_loss_fn` runs per-rank inside ``shard_map`` (compose with
+    ``apex_tpu.training.make_train_step``).
+    """
+
+    config: TransformerConfig
+    pipeline_size: int
+    num_microbatches: int
+    virtual_pipeline_size: Optional[int] = None
+
+    def __post_init__(self):
+        c = self.config
+        self.embedding = VocabParallelEmbedding(
+            c.vocab_size, c.hidden_size, init_method=c.init_method(),
+            params_dtype=c.params_dtype, axis_name=c.axis_name)
+        self.layer = ParallelTransformerLayer(c)
+        V = self.pipeline_size * (self.virtual_pipeline_size or 1)
+        if c.num_layers % V:
+            raise ValueError(
+                f"num_layers ({c.num_layers}) must divide evenly into "
+                f"{V} (virtual) pipeline stages")
+        self.layers_per_chunk = c.num_layers // V
+
+    # -- parameters ---------------------------------------------------------
+
+    def init(self, key: jax.Array) -> Dict[str, Any]:
+        c = self.config
+        k_emb, k_pos, k_tr = jax.random.split(key, 3)
+        keys = jax.random.split(k_tr, c.num_layers)
+        stacked = jax.vmap(self.layer.init)(keys)
+        stages = arrange_layers_for_pipeline(
+            stacked, self.pipeline_size, self.virtual_pipeline_size)
+        return {
+            "embedding": {
+                "word_embeddings": self.embedding.init(k_emb),
+                "position_embeddings": c.init_method()(
+                    k_pos, (c.max_position_embeddings, c.hidden_size),
+                    c.params_dtype),
+            },
+            "stages": stages,
+            "final_layernorm": _ln_params(c.hidden_size, c.params_dtype),
+        }
+
+    def spec(self) -> Dict[str, Any]:
+        return {
+            "embedding": {
+                "word_embeddings": self.embedding.spec(),
+                "position_embeddings": PartitionSpec(),
+            },
+            "stages": pipeline_stage_spec(self.layer.spec(),
+                                          self.virtual_pipeline_size),
+            "final_layernorm": _ln_spec(),
+        }
+
+    # -- stage functions ----------------------------------------------------
+
+    def _run_chunk(self, chunk_params, hidden, rng):
+        deterministic = rng is None
+
+        def one_layer(carry, layer_params):
+            h, idx = carry
+            layer_rng = None if rng is None else jax.random.fold_in(rng, idx)
+            h = self.layer.apply(layer_params, h, rng=layer_rng,
+                                 deterministic=deterministic)
+            return (h, idx + 1), None
+
+        (hidden, _), _ = lax.scan(one_layer, (hidden, 0), chunk_params)
+        return hidden
+
+    def _stage_rng(self, rng, tick):
+        """Per-tick dropout stream, decorrelated across pipeline stages (the
+        Megatron RNG-tracker role, ``tensor_parallel/random.py:90-240``)."""
+        if rng is None:
+            return None
+        from apex_tpu.transformer.parallel_state import (
+            get_pipeline_model_parallel_rank,
+        )
+        rng = jax.random.fold_in(rng, tick)
+        return jax.random.fold_in(rng, get_pipeline_model_parallel_rank())
+
+    def _postprocess(self, params, hidden, mb):
+        c = self.config
+        emb = mark_pipeline_replicated(params["embedding"])
+        fln = mark_pipeline_replicated(params["final_layernorm"])
+        hidden = _ln(fln, hidden, c.layernorm_epsilon,
+                     c.sequence_parallel, c.axis_name)
+        return lm_head_loss(emb["word_embeddings"]["weight"], hidden,
+                            mb["labels"], mb.get("loss_mask"), c)
+
+    # -- schedule -----------------------------------------------------------
+
+    def make_loss_fn(self, *, remat: bool = True):
+        """Build ``loss_fn(params, microbatched_batch, rng=None) -> scalar``.
+
+        Batch leaves are ``[M, micro_b, ...]`` (see
+        ``split_batch_into_microbatches``). ``rng`` enables dropout with
+        per-microbatch embedding streams and per-tick/stage layer streams.
+        """
+        M = self.num_microbatches
+
+        def loss_fn(params, batch, rng=None):
+            deterministic = rng is None
+
+            def preprocess(p, mb):
+                emb = mark_pipeline_replicated(p["embedding"])
+                r = (None if deterministic
+                     else jax.random.fold_in(rng, mb["_mb"]))
+                return embed_tokens(self.embedding, emb, mb["tokens"],
+                                    self.config, rng=r,
+                                    deterministic=deterministic)
+
+            def stage(p, h, tick):
+                local = jax.tree.map(lambda x: x[0], p["stages"])
+                return self._run_chunk(local, h, self._stage_rng(rng, tick))
+
+            def stage_interleaved(p, h, chunk, tick):
+                local = jax.tree.map(
+                    lambda x: lax.dynamic_index_in_dim(x[0], chunk, 0,
+                                                       keepdims=False),
+                    p["stages"])
+                r = self._stage_rng(rng, tick)
+                r = None if r is None else jax.random.fold_in(r, chunk)
+                return self._run_chunk(local, h, r)
+
+            batch = dict(batch)
+            batch["_mb"] = jnp.arange(M)
+            if self.virtual_pipeline_size is not None:
+                inner = make_interleaved_pipelined_loss_fn(
+                    preprocess, stage_interleaved, self._postprocess,
+                    M, self.virtual_pipeline_size, remat=remat)
+            else:
+                inner = make_pipelined_loss_fn(
+                    preprocess, stage, self._postprocess, M, remat=remat)
+            return inner(params, batch)
+
+        return loss_fn

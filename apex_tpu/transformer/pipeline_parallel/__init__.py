@@ -1,0 +1,32 @@
+"""Pipeline parallelism.
+
+TPU-native counterpart of ``apex/transformer/pipeline_parallel/``: microbatch
+calculators, the three fwd/bwd schedules (no-pipelining, 1F1B non-interleaved,
+interleaved/virtual), p2p communication, and training utilities.
+
+Where the reference drives an eager 1F1B state machine with NCCL
+``batch_isend_irecv`` (``p2p_communication.py:48-690``) and explicit
+``forward_step``/``backward_step`` calls per microbatch
+(``schedules/fwd_bwd_pipelining_without_interleaving.py:241-597``), the TPU
+design expresses the *forward* pipeline as a ``lax.scan`` over schedule ticks
+with a ``ppermute`` ring shift per tick, and obtains the *backward* pipeline
+from autodiff: the VJP of ``ppermute`` is the reverse ring permute, so
+``jax.grad`` of the scanned forward is itself a reverse-order pipelined
+schedule, compiled and overlap-scheduled by XLA.
+"""
+
+from apex_tpu.transformer.pipeline_parallel.microbatches import (
+    ConstantNumMicroBatches,
+    RampupBatchsizeNumMicroBatches,
+    build_num_microbatches_calculator,
+)
+from apex_tpu.transformer.pipeline_parallel.schedules import (
+    get_forward_backward_func,
+)
+
+__all__ = [
+    "ConstantNumMicroBatches",
+    "RampupBatchsizeNumMicroBatches",
+    "build_num_microbatches_calculator",
+    "get_forward_backward_func",
+]

@@ -1,0 +1,86 @@
+"""Device-synchronized named timers.
+
+Reference: ``apex/transformer/pipeline_parallel/_timers.py:6-83`` — named
+timers that ``cuda.synchronize()`` around ``time.time()``. The TPU analog
+synchronizes by blocking on outstanding device work
+(``jax.block_until_ready`` has no global variant, so we block on a trivial
+device op, the documented JAX idiom for a device fence).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["Timers"]
+
+
+def _device_sync():
+    jnp.zeros(()).block_until_ready()
+
+
+class _Timer:
+    """Reference ``_timers.py:6-48``."""
+
+    def __init__(self, name: str):
+        self.name_ = name
+        self.elapsed_ = 0.0
+        self.started_ = False
+        self.start_time = time.time()
+
+    def start(self):
+        assert not self.started_, "timer has already been started"
+        _device_sync()
+        self.start_time = time.time()
+        self.started_ = True
+
+    def stop(self):
+        assert self.started_, "timer is not started"
+        _device_sync()
+        self.elapsed_ += time.time() - self.start_time
+        self.started_ = False
+
+    def reset(self):
+        self.elapsed_ = 0.0
+        self.started_ = False
+
+    def elapsed(self, reset: bool = True) -> float:
+        started_ = self.started_
+        if self.started_:
+            self.stop()
+        elapsed_ = self.elapsed_
+        if reset:
+            self.reset()
+        if started_:
+            self.start()
+        return elapsed_
+
+
+class Timers:
+    """Group of timers (reference ``_timers.py:51-83``)."""
+
+    def __init__(self):
+        self.timers: Dict[str, _Timer] = {}
+
+    def __call__(self, name: str) -> _Timer:
+        if name not in self.timers:
+            self.timers[name] = _Timer(name)
+        return self.timers[name]
+
+    def write(self, names, writer, iteration, normalizer=1.0, reset=False):
+        assert normalizer > 0.0
+        for name in names:
+            value = self.timers[name].elapsed(reset=reset) / normalizer
+            writer.add_scalar(f"{name}-time", value, iteration)
+
+    def log(self, names, normalizer=1.0, reset=True):
+        assert normalizer > 0.0
+        string = "time (ms)"
+        for name in names:
+            elapsed_time = (
+                self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer)
+            string += f" | {name}: {elapsed_time:.2f}"
+        print(string, flush=True)

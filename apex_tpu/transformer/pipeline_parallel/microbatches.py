@@ -1,0 +1,158 @@
+"""Microbatch calculators.
+
+Parity with ``apex/transformer/microbatches.py:26-195``: a calculator exposes
+``get() -> num_micro_batches`` and ``get_current_global_batch_size()``, and
+``update(consumed_samples, consistency_check)`` advances ramp-up state.
+These are host-side bookkeeping (they size the scan over microbatches), so
+pure Python is the right implementation on TPU too.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import List, Optional
+
+__all__ = [
+    "build_num_microbatches_calculator",
+    "NumMicroBatchesCalculator",
+    "ConstantNumMicroBatches",
+    "RampupBatchsizeNumMicroBatches",
+]
+
+
+def build_num_microbatches_calculator(
+    rank: int,
+    rampup_batch_size: Optional[List[int]],
+    global_batch_size: int,
+    micro_batch_size: int,
+    data_parallel_size: int,
+):
+    """Reference: ``microbatches.py:26-75``."""
+    if rampup_batch_size is None:
+        calculator = ConstantNumMicroBatches(
+            global_batch_size, micro_batch_size, data_parallel_size)
+        if rank == 0:
+            print(
+                "setting number of micro-batches to constant "
+                f"{calculator.get()}", flush=True)
+    else:
+        if len(rampup_batch_size) != 3:
+            raise ValueError(
+                "expected the following format: --rampup-batch-size <start "
+                "batch size> <batch size increment> <ramp-up samples>")
+        start_batch_size = int(rampup_batch_size[0])
+        batch_size_increment = int(rampup_batch_size[1])
+        ramup_samples = int(rampup_batch_size[2])
+        if rank == 0:
+            print(
+                "will use batch size rampup starting from global batch size "
+                f"{start_batch_size} to global batch size "
+                f"{global_batch_size} with batch size increments "
+                f"{batch_size_increment} over {ramup_samples} samples.",
+                flush=True)
+        calculator = RampupBatchsizeNumMicroBatches(
+            start_batch_size, batch_size_increment, ramup_samples,
+            global_batch_size, micro_batch_size, data_parallel_size)
+    return calculator
+
+
+class NumMicroBatchesCalculator(ABC):
+    """Reference ABC at ``microbatches.py:61-75``."""
+
+    def __init__(self):
+        self.num_micro_batches: Optional[int] = None
+        self.current_global_batch_size: Optional[int] = None
+
+    def get(self) -> int:
+        return self.num_micro_batches
+
+    def get_current_global_batch_size(self) -> int:
+        return self.current_global_batch_size
+
+    @abstractmethod
+    def update(self, consumed_samples, consistency_check) -> None:
+        ...
+
+
+class ConstantNumMicroBatches(NumMicroBatchesCalculator):
+    """Reference: ``microbatches.py:77-97``."""
+
+    def __init__(self, global_batch_size, micro_batch_size, data_parallel_size):
+        super().__init__()
+        micro_batch_times_data_parallel = micro_batch_size * data_parallel_size
+        if global_batch_size % micro_batch_times_data_parallel != 0:
+            raise ValueError(
+                f"global batch size ({global_batch_size}) is not divisible by "
+                f"micro batch size ({micro_batch_size}) times data parallel "
+                f"size ({data_parallel_size})")
+        self.num_micro_batches = (
+            global_batch_size // micro_batch_times_data_parallel)
+        if self.num_micro_batches < 1:
+            raise ValueError("number of microbatches must be at least 1")
+        self.current_global_batch_size = global_batch_size
+        self.micro_batch_size = micro_batch_size
+
+    def update(self, consumed_samples, consistency_check) -> None:
+        pass
+
+
+class RampupBatchsizeNumMicroBatches(NumMicroBatchesCalculator):
+    """Batch-size rampup, reference ``microbatches.py:100-195``.
+
+    Global batch size grows from ``start_batch_size`` by
+    ``batch_size_increment`` per step over ``ramup_samples`` consumed samples,
+    then stays at ``global_batch_size``.
+    """
+
+    def __init__(self, start_batch_size, batch_size_increment, ramup_samples,
+                 global_batch_size, micro_batch_size, data_parallel_size):
+        super().__init__()
+        self.micro_batch_size = micro_batch_size
+        self.data_parallel_size = data_parallel_size
+        self.micro_batch_times_data_parallel_size = (
+            micro_batch_size * data_parallel_size)
+        if self.micro_batch_times_data_parallel_size <= 0:
+            raise ValueError("micro * dp size must be positive")
+        self.start_batch_size = start_batch_size
+        self.batch_size_increment = batch_size_increment
+        self.ramup_samples = ramup_samples
+        self.global_batch_size = global_batch_size
+
+        diff_batch_size = self.global_batch_size - self.start_batch_size
+        if diff_batch_size < 0:
+            raise ValueError(
+                "expected global batch size to be at least equal to start "
+                "batch size")
+        if diff_batch_size % batch_size_increment != 0:
+            raise ValueError(
+                f"expected global batch size interval ({diff_batch_size}) to "
+                "be divisible by global batch size increment "
+                f"({batch_size_increment})")
+
+        num_increments = diff_batch_size // self.batch_size_increment
+        self.rampup_samples_per_increment = (
+            self.ramup_samples / num_increments if num_increments > 0 else 0)
+
+        self.update(0, False)
+
+    def update(self, consumed_samples, consistency_check) -> None:
+        if (consumed_samples > self.ramup_samples
+                or self.rampup_samples_per_increment == 0):
+            self.current_global_batch_size = self.global_batch_size
+        else:
+            steps = int(consumed_samples / self.rampup_samples_per_increment)
+            self.current_global_batch_size = (
+                self.start_batch_size + steps * self.batch_size_increment)
+            self.current_global_batch_size = min(
+                self.current_global_batch_size, self.global_batch_size)
+        if consistency_check and (
+                self.current_global_batch_size
+                % self.micro_batch_times_data_parallel_size != 0):
+            raise RuntimeError(
+                f"current global batch size ({self.current_global_batch_size}) "
+                "is not divisible by micro-batch-size "
+                f"({self.micro_batch_size}) times data parallel size "
+                f"({self.data_parallel_size})")
+        self.num_micro_batches = (
+            self.current_global_batch_size
+            // self.micro_batch_times_data_parallel_size)

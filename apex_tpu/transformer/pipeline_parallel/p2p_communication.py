@@ -1,0 +1,86 @@
+"""Pipeline p2p communication.
+
+TPU-native counterpart of ``apex/transformer/pipeline_parallel/
+p2p_communication.py:48-690``. The reference batches NCCL isend/irecv pairs
+between adjacent pipeline stages (``_run_p2pops``, ``:48-160``) and offers the
+fused ``send_forward_recv_backward``-style calls the 1F1B schedule needs.
+
+On TPU every adjacent-stage exchange is a single ``lax.ppermute`` over the
+``pipeline`` mesh axis: all stages shift their tensor one hop around the ICI
+ring simultaneously (exactly the communication pattern 1F1B produces when
+every stage sends in lock-step), and XLA lowers it to collective-permute.
+Usable only inside ``shard_map``; outside (world size 1) they are identity,
+mirroring the reference's no-op at pipeline world size 1.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax import lax
+
+from apex_tpu.transformer.parallel_state import PIPELINE_AXIS
+from apex_tpu.transformer.tensor_parallel.mappings import axis_bound
+
+__all__ = [
+    "send_forward",
+    "send_backward",
+    "send_forward_recv_backward",
+    "send_backward_recv_forward",
+    "ring_shift",
+]
+
+
+def _perm_next(size: int):
+    return [(i, (i + 1) % size) for i in range(size)]
+
+
+def _perm_prev(size: int):
+    return [(i, (i - 1) % size) for i in range(size)]
+
+
+def ring_shift(x: Any, *, reverse: bool = False,
+               axis_name: str = PIPELINE_AXIS) -> Any:
+    """Shift a pytree one hop along the pipeline ring.
+
+    ``reverse=False``: each stage receives from the previous stage (the
+    forward-activation direction, reference ``send_forward``/``recv_forward``
+    at ``p2p_communication.py:385-460``); ``reverse=True``: from the next
+    stage (the gradient direction, ``send_backward``/``recv_backward``).
+    """
+    if not axis_bound(axis_name):
+        return x
+    size = lax.axis_size(axis_name)
+    if size == 1:
+        return x
+    perm = _perm_prev(size) if reverse else _perm_next(size)
+    return jax.tree.map(lambda t: lax.ppermute(t, axis_name, perm), x)
+
+
+def send_forward(output_tensor: Any, *, axis_name: str = PIPELINE_AXIS) -> Any:
+    """Send activations to the next stage; returns what this stage receives
+    from its previous stage (reference ``p2p_communication.py:~385-420``; the
+    ring wraps, so the first stage receives the last stage's tensor — callers
+    mask it, as the schedules do)."""
+    return ring_shift(output_tensor, reverse=False, axis_name=axis_name)
+
+
+def send_backward(input_grad: Any, *, axis_name: str = PIPELINE_AXIS) -> Any:
+    """Send gradients to the previous stage; returns what this stage receives
+    from its next stage (reference ``:~422-460``)."""
+    return ring_shift(input_grad, reverse=True, axis_name=axis_name)
+
+
+def send_forward_recv_backward(output_tensor: Any, input_grad: Any, *,
+                               axis_name: str = PIPELINE_AXIS):
+    """Fused variant (reference ``:~462-520``): both directions in one step."""
+    return (ring_shift(output_tensor, reverse=False, axis_name=axis_name),
+            ring_shift(input_grad, reverse=True, axis_name=axis_name))
+
+
+def send_backward_recv_forward(input_grad: Any, output_tensor: Any, *,
+                               axis_name: str = PIPELINE_AXIS):
+    """Fused variant (reference ``:~522-580``)."""
+    return (ring_shift(input_grad, reverse=True, axis_name=axis_name),
+            ring_shift(output_tensor, reverse=False, axis_name=axis_name))

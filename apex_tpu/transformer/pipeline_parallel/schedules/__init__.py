@@ -1,0 +1,43 @@
+"""Schedule selector.
+
+Reference: ``apex/transformer/pipeline_parallel/schedules/__init__.py:22-35``
+picks among no-pipelining / 1F1B / interleaved based on the pipeline world
+size and virtual-pipeline setting. Same selection logic here.
+"""
+
+from __future__ import annotations
+
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.pipeline_parallel.schedules.fwd_bwd_no_pipelining import (
+    forward_backward_no_pipelining,
+)
+from apex_tpu.transformer.pipeline_parallel.schedules.fwd_bwd_pipelining_without_interleaving import (
+    forward_backward_pipelining_without_interleaving,
+    make_pipelined_loss_fn,
+)
+from apex_tpu.transformer.pipeline_parallel.schedules.fwd_bwd_pipelining_with_interleaving import (
+    forward_backward_pipelining_with_interleaving,
+    make_interleaved_pipelined_loss_fn,
+)
+
+__all__ = [
+    "get_forward_backward_func",
+    "forward_backward_no_pipelining",
+    "forward_backward_pipelining_without_interleaving",
+    "forward_backward_pipelining_with_interleaving",
+    "make_pipelined_loss_fn",
+    "make_interleaved_pipelined_loss_fn",
+]
+
+
+def get_forward_backward_func(virtual_pipeline_model_parallel_size=None,
+                              pipeline_model_parallel_size=None):
+    """Reference: ``schedules/__init__.py:22-35``."""
+    if pipeline_model_parallel_size is None:
+        pipeline_model_parallel_size = (
+            parallel_state.get_pipeline_model_parallel_world_size())
+    if pipeline_model_parallel_size > 1:
+        if virtual_pipeline_model_parallel_size is not None:
+            return forward_backward_pipelining_with_interleaving
+        return forward_backward_pipelining_without_interleaving
+    return forward_backward_no_pipelining

@@ -1,0 +1,115 @@
+"""Stage construction helpers.
+
+Reference: ``apex/transformer/pipeline_parallel/schedules/common.py:30-150``
+(``build_model``): instantiates the rank's model chunk(s) — a list of
+``vpp`` chunks under interleaving — and optionally wraps them in DDP.
+
+TPU analog: parameters for all layers are initialized **globally** as one
+stacked ``[L, ...]`` pytree (rank-consistent init by construction) and then
+*arranged* so that sharding the leading dim over the ``pipeline`` mesh axis
+gives each rank exactly the layers its (virtual) stages own. DDP wrapping has
+no analog — the data axis pmean in the train step covers it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+from jax.sharding import PartitionSpec
+
+from apex_tpu.transformer.parallel_state import PIPELINE_AXIS
+from apex_tpu.transformer.tensor_parallel.mappings import (
+    mark_sequence_parallel_parameter as _mark_psum_grad,
+)
+
+__all__ = [
+    "arrange_layers_for_pipeline",
+    "pipeline_stage_spec",
+    "mark_pipeline_replicated",
+    "build_model",
+]
+
+
+def arrange_layers_for_pipeline(
+    stacked_params: Any,
+    pipeline_size: int,
+    virtual_pipeline_size: Optional[int] = None,
+) -> Any:
+    """Rearrange a ``[L, ...]``-stacked layer pytree for pipeline sharding.
+
+    Without interleaving: ``[L, ...] -> [S, L/S, ...]`` — rank ``i`` owns
+    layers ``[i*L/S, (i+1)*L/S)`` (the reference's contiguous layer split in
+    ``build_model``).
+
+    With interleaving: ``[L, ...] -> [S, vpp, L/V, ...]`` where position
+    ``[i, c]`` holds virtual stage ``v = c*S + i`` — the reference's
+    round-robin chunk assignment (``fwd_bwd_pipelining_with_interleaving.py``
+    model-chunk indexing).
+    """
+    S = pipeline_size
+
+    def one(x):
+        L = x.shape[0]
+        if virtual_pipeline_size is None:
+            if L % S:
+                raise ValueError(f"num layers ({L}) not divisible by "
+                                 f"pipeline size ({S})")
+            return x.reshape(S, L // S, *x.shape[1:])
+        vpp = virtual_pipeline_size
+        V = S * vpp
+        if L % V:
+            raise ValueError(f"num layers ({L}) not divisible by pipeline "
+                             f"size x virtual size ({V})")
+        Lc = L // V
+        # [L] -> [V, Lc] -> [vpp, S, Lc] -> [S, vpp, Lc]
+        return (x.reshape(vpp, S, Lc, *x.shape[1:])
+                 .transpose(1, 0, *range(2, x.ndim + 2)))
+
+    return jax.tree.map(one, stacked_params)
+
+
+def pipeline_stage_spec(layer_spec: Any,
+                        virtual_pipeline_size: Optional[int] = None,
+                        axis_name: str = PIPELINE_AXIS) -> Any:
+    """PartitionSpec pytree for :func:`arrange_layers_for_pipeline` output:
+    pipeline axis on dim 0, then (chunk dim,) layer dim, then the per-layer
+    spec."""
+    extra = (None,) if virtual_pipeline_size is not None else ()
+
+    def one(s):
+        return PartitionSpec(axis_name, *extra, None, *s)
+
+    return jax.tree.map(
+        one, layer_spec, is_leaf=lambda x: isinstance(x, PartitionSpec))
+
+
+def mark_pipeline_replicated(params: Any,
+                             axis_name: str = PIPELINE_AXIS) -> Any:
+    """Mark parameters replicated across pipeline stages (embedding, final
+    norm, tied head) so their per-stage partial grads are psum-reduced — the
+    analog of the reference's embedding-grad all-reduce between first and last
+    stages (``parallel_state.py:347-407`` embedding groups). Identity forward,
+    ``psum`` over the pipeline axis on the backward."""
+    return jax.tree.map(lambda p: _mark_psum_grad(p, axis_name), params)
+
+
+def build_model(model_provider_func, wrap_with_ddp: bool = True,
+                virtual_pipeline_model_parallel_size: Optional[int] = None,
+                *args, **kwargs):
+    """Reference-shaped ``build_model`` (``schedules/common.py:30-150``).
+
+    Calls ``model_provider_func(*args, pre_process=..., post_process=...,
+    **kwargs)`` once per virtual chunk and returns the list. On TPU the
+    provider should return a functional module (init/spec/apply); DDP
+    wrapping is a no-op (``wrap_with_ddp`` accepted for signature parity —
+    the data-axis pmean in the train step is DDP).
+    """
+    vpp = virtual_pipeline_model_parallel_size
+    n_chunks = vpp if vpp is not None else 1
+    models = []
+    for c in range(n_chunks):
+        models.append(model_provider_func(
+            *args, pre_process=(c == 0), post_process=(c == n_chunks - 1),
+            **kwargs))
+    return models

@@ -1,0 +1,76 @@
+"""No-pipelining schedule: sequential microbatches with grad accumulation.
+
+Reference: ``apex/transformer/pipeline_parallel/schedules/
+fwd_bwd_no_pipelining.py:23-124`` — runs every microbatch's forward+backward
+under ``no_sync`` (deferring the DP grad allreduce), then the last microbatch
+with sync on. On TPU the deferral is structural: grads are accumulated inside
+a ``lax.scan`` and the data-parallel ``pmean`` happens once, in the train
+step, after this function returns.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["forward_backward_no_pipelining"]
+
+
+def forward_backward_no_pipelining(
+    forward_step_func: Callable,
+    batch: Any,
+    params: Any,
+    *,
+    num_microbatches: int,
+    forward_only: bool = False,
+    grad_scaler: Optional[Callable] = None,
+):
+    """Run ``num_microbatches`` sequential fwd(+bwd) steps, accumulating.
+
+    Args:
+      forward_step_func: ``(params, microbatch) -> scalar loss`` — the analog
+        of the reference's ``forward_step_func(batch, model)`` returning
+        ``(output, loss_func)`` (``schedules/common.py:253-309``); here the
+        loss reduction is folded in.
+      batch: pytree whose leaves have leading dim ``num_microbatches``
+        (microbatch-major; build with
+        :func:`apex_tpu.transformer.pipeline_parallel.utils.split_batch_into_microbatches`).
+      params: parameter pytree.
+      grad_scaler: optional fn applied to each microbatch loss before
+        differentiation (the reference scales on the last stage,
+        ``schedules/common.py:378-379``).
+
+    Returns:
+      ``(mean_loss, grads)`` with grads averaged over microbatches, or
+      ``(mean_loss, None)`` when ``forward_only``.
+    """
+
+    def scaled_loss(p, mb):
+        loss = forward_step_func(p, mb)
+        scaled = grad_scaler(loss) if grad_scaler is not None else loss
+        return scaled, loss  # differentiate scaled, report unscaled
+
+    if forward_only:
+        def fwd_body(acc, mb):
+            return acc + forward_step_func(params, mb), None
+
+        total, _ = lax.scan(fwd_body, jnp.zeros(()), batch)
+        return total / num_microbatches, None
+
+    grad_fn = jax.value_and_grad(scaled_loss, has_aux=True)
+
+    def body(carry, mb):
+        loss_acc, grad_acc = carry
+        (_, loss), grads = grad_fn(params, mb)
+        grad_acc = jax.tree.map(jnp.add, grad_acc, grads)
+        return (loss_acc + loss, grad_acc), None
+
+    zero_grads = jax.tree.map(jnp.zeros_like, params)
+    (loss_sum, grad_sum), _ = lax.scan(
+        body, (jnp.zeros(()), zero_grads), batch)
+    inv = 1.0 / num_microbatches
+    grads = jax.tree.map(lambda g: g * jnp.asarray(inv, g.dtype), grad_sum)
+    return loss_sum * inv, grads

@@ -1,0 +1,164 @@
+"""Interleaved (virtual-pipeline) schedule.
+
+Reference: ``apex/transformer/pipeline_parallel/schedules/
+fwd_bwd_pipelining_with_interleaving.py:27-744`` — each rank hosts
+``vpp`` model chunks; microbatches traverse the rank ring ``vpp`` times, so
+the pipeline has ``V = S * vpp`` virtual stages and the warmup bubble per
+chunk shrinks by ``vpp``.
+
+TPU design (circular pipeline): each rank carries a ``[vpp, ...]`` activation
+buffer — slot ``c`` holds the microbatch currently at this rank's chunk ``c``
+(virtual stage ``v = c * S + rank``). Per tick every rank computes **all**
+its chunks (each on a different in-flight microbatch), then one ``ppermute``
+moves the whole buffer to the next rank; the wrap-around at rank 0 shifts the
+chunk dimension by one (stage ``c*S + S-1`` feeds stage ``(c+1)*S``), rank 0
+slot 0 takes the next injected microbatch, and rank ``S-1`` slot ``vpp-1``
+emits finished microbatches. Ticks: ``M + V - 1``. Backward comes from
+autodiff, as in the non-interleaved schedule.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.transformer.parallel_state import PIPELINE_AXIS
+from apex_tpu.transformer.pipeline_parallel.p2p_communication import ring_shift
+from apex_tpu.transformer.pipeline_parallel.schedules.fwd_bwd_pipelining_without_interleaving import (
+    _broadcast_last_stage_loss,
+    _index_microbatch,
+)
+from apex_tpu.transformer.tensor_parallel.mappings import axis_bound
+
+__all__ = [
+    "make_interleaved_pipelined_loss_fn",
+    "forward_backward_pipelining_with_interleaving",
+]
+
+
+def make_interleaved_pipelined_loss_fn(
+    preprocess_fn: Callable,
+    stage_fn: Callable,
+    postprocess_fn: Callable,
+    num_microbatches: int,
+    virtual_pipeline_size: int,
+    *,
+    axis_name: str = PIPELINE_AXIS,
+    remat: bool = True,
+) -> Callable:
+    """Build ``loss_fn(params, batch) -> scalar`` for the circular pipeline.
+
+    ``stage_fn(params, hidden, chunk, tick) -> hidden`` applies this rank's
+    layer chunk ``chunk`` (``0..vpp-1``); chunk ``c`` of rank ``i`` is virtual
+    stage ``c * S + i``, matching the reference's chunk-to-rank assignment
+    (``parallel_state.py:675-696`` virtual rank state). Other arguments as in
+    :func:`...fwd_bwd_pipelining_without_interleaving.make_pipelined_loss_fn`.
+    """
+    M = num_microbatches
+    vpp = virtual_pipeline_size
+
+    def loss_fn(params, batch):
+        staged = jax.checkpoint(stage_fn) if remat else stage_fn
+
+        pipelined = axis_bound(axis_name)
+        S = lax.axis_size(axis_name) if pipelined else 1
+        i = lax.axis_index(axis_name) if pipelined else 0
+        V = S * vpp
+
+        injected = jax.vmap(lambda mb: preprocess_fn(params, mb))(batch)
+        hidden0 = jax.tree.map(lambda x: jnp.zeros_like(x[0]), injected)
+        # [vpp, ...] in-flight buffer; slot c = this rank's chunk c.
+        state0 = jax.tree.map(
+            lambda x: jnp.broadcast_to(x, (vpp,) + x.shape), hidden0)
+        outbuf0 = jax.tree.map(jnp.zeros_like, injected)
+        chunk_ids = jnp.arange(vpp)
+
+        def tick(carry, t):
+            state, outbuf = carry
+            m_in = jnp.clip(t, 0, M - 1)
+            inj = _index_microbatch(injected, m_in)
+            # rank 0 slot 0 <- injected microbatch
+            state = jax.tree.map(
+                lambda s, x: jnp.where(
+                    (i == 0)
+                    & (jnp.arange(vpp) == 0).reshape(
+                        (vpp,) + (1,) * x.ndim),
+                    x[None], s),
+                state, inj)
+            # compute every chunk (each a different in-flight microbatch)
+            y = lax.map(
+                lambda args: staged(params, args[0], args[1], t),
+                (state, chunk_ids))
+            # rank S-1 chunk vpp-1 output = finished microbatch t - (V-1)
+            m_out = jnp.clip(t - (V - 1), 0, M - 1)
+            outbuf = jax.tree.map(
+                lambda buf, leaf: lax.dynamic_update_index_in_dim(
+                    buf, leaf[vpp - 1], m_out, 0), outbuf, y)
+            # one ring hop for the whole buffer; the wrap into rank 0 climbs
+            # one chunk (virtual stage c*S + S-1 -> (c+1)*S)
+            arrived = ring_shift(y, axis_name=axis_name) if pipelined else y
+            shifted = jax.tree.map(lambda a: jnp.roll(a, 1, axis=0), arrived)
+            state = jax.tree.map(
+                lambda sh, ar: jnp.where(i == 0, sh, ar), shifted, arrived)
+            return (state, outbuf), None
+
+        (_, outbuf), _ = lax.scan(
+            tick, (state0, outbuf0), jnp.arange(M + V - 1))
+
+        losses = jax.vmap(
+            lambda y, mb: postprocess_fn(params, y, mb))(outbuf, batch)
+        local = jnp.mean(losses)
+        if not pipelined:
+            return local
+        return _broadcast_last_stage_loss(
+            jnp.where(i == S - 1, local, 0.0), axis_name)
+
+    return loss_fn
+
+
+def forward_backward_pipelining_with_interleaving(
+    forward_step_func: Any,
+    batch: Any,
+    params: Any,
+    *,
+    num_microbatches: int,
+    virtual_pipeline_size: Optional[int] = None,
+    forward_only: bool = False,
+    grad_scaler: Optional[Callable] = None,
+    axis_name: str = PIPELINE_AXIS,
+    remat: bool = True,
+):
+    """Reference-shaped driver; see the non-interleaved counterpart.
+
+    ``virtual_pipeline_size`` defaults to the registered virtual world size
+    (``parallel_state.set_virtual_pipeline_model_parallel_world_size`` /
+    ``initialize_model_parallel(virtual_pipeline_model_parallel_size=...)``),
+    keeping this callable signature-compatible with the other schedules the
+    selector can return.
+    """
+    if virtual_pipeline_size is None:
+        from apex_tpu.transformer import parallel_state
+        virtual_pipeline_size = (
+            parallel_state.get_virtual_pipeline_model_parallel_world_size())
+        if virtual_pipeline_size is None:
+            raise ValueError(
+                "virtual_pipeline_size not given and no virtual pipeline "
+                "world size is registered in parallel_state")
+    preprocess_fn, stage_fn, postprocess_fn = forward_step_func
+    loss_fn = make_interleaved_pipelined_loss_fn(
+        preprocess_fn, stage_fn, postprocess_fn, num_microbatches,
+        virtual_pipeline_size, axis_name=axis_name, remat=remat)
+    if forward_only:
+        return loss_fn(params, batch), None
+    if grad_scaler is None:
+        return jax.value_and_grad(loss_fn)(params, batch)
+
+    def scaled(p, b):
+        loss = loss_fn(p, b)
+        return grad_scaler(loss), loss  # differentiate scaled, report unscaled
+
+    (_, loss), grads = jax.value_and_grad(scaled, has_aux=True)(params, batch)
+    return loss, grads

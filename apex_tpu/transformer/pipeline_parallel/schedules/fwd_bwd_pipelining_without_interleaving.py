@@ -1,0 +1,188 @@
+"""Non-interleaved pipelined schedule (the 1F1B capability).
+
+Reference: ``apex/transformer/pipeline_parallel/schedules/
+fwd_bwd_pipelining_without_interleaving.py:241-597`` — warmup forwards,
+steady-state 1F1B with fused ``send_forward_recv_backward``, cooldown
+backwards, all driven eagerly per-rank with NCCL p2p.
+
+TPU design: the forward pipeline is a ``lax.scan`` over ``M + S - 1`` ticks.
+Per tick every stage applies its layer chunk to the activation it holds, then
+the whole ring does one ``ppermute`` shift (exactly the lock-step p2p pattern
+of the reference's steady state). Stage 0 injects microbatch ``t`` at tick
+``t``; stage ``S-1``'s output at tick ``t`` is microbatch ``t - (S-1)`` and is
+collected into an output buffer. The loss is computed once, batched over all
+collected microbatch outputs, masked to the last stage, and ``psum``-reduced.
+
+The backward schedule is **derived, not written**: ``jax.grad`` through the
+scan produces the reverse pipeline (the VJP of ``ppermute`` is the opposite
+ring shift), with per-tick stage recompute under ``jax.checkpoint`` bounding
+live activations — the role 1F1B's in-flight-microbatch cap plays in the
+reference.
+
+Stages run redundant compute during bubble ticks (zeros flow through); that is
+the pipeline bubble made explicit — the same ``(S-1)/M`` overhead the
+reference pays in idle waits.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.transformer.parallel_state import PIPELINE_AXIS
+from apex_tpu.transformer.pipeline_parallel.p2p_communication import ring_shift
+from apex_tpu.transformer.tensor_parallel.mappings import axis_bound
+
+__all__ = [
+    "make_pipelined_loss_fn",
+    "forward_backward_pipelining_without_interleaving",
+]
+
+
+def _index_microbatch(batch: Any, m) -> Any:
+    return jax.tree.map(
+        lambda x: lax.dynamic_index_in_dim(x, m, 0, keepdims=False), batch)
+
+
+from functools import partial
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _broadcast_last_stage_loss(x, axis_name: str):
+    """psum in the forward (replicating the last stage's masked loss to every
+    rank), identity in the backward.
+
+    A plain ``psum`` here would S-fold the gradients: per-rank autodiff seeds
+    a cotangent of 1.0 on *every* rank's (identical) output and psum's
+    transpose sums them. The last-stage mask already routes the single real
+    cotangent, so the broadcast must be gradient-transparent."""
+    return lax.psum(x, axis_name)
+
+
+def _bcast_fwd(x, axis_name):
+    return lax.psum(x, axis_name), None
+
+
+def _bcast_bwd(axis_name, _, g):
+    return (g,)
+
+
+_broadcast_last_stage_loss.defvjp(_bcast_fwd, _bcast_bwd)
+
+
+def make_pipelined_loss_fn(
+    preprocess_fn: Callable,
+    stage_fn: Callable,
+    postprocess_fn: Callable,
+    num_microbatches: int,
+    *,
+    axis_name: str = PIPELINE_AXIS,
+    remat: bool = True,
+) -> Callable:
+    """Build ``loss_fn(params, batch) -> scalar`` running the pipeline.
+
+    Args:
+      preprocess_fn: ``(params, microbatch) -> hidden`` — the first-stage
+        input transform (embedding). Evaluated batched over all microbatches
+        up front; only stage 0's copy feeds the pipeline (other stages'
+        results carry zero gradient through the injection select).
+      stage_fn: ``(params, hidden, tick) -> hidden`` — applies this rank's
+        layer chunk. Must be shape-preserving (homogeneous stages, the same
+        constraint the reference's ``tensor_shape`` argument encodes).
+      postprocess_fn: ``(params, hidden, microbatch) -> scalar`` — final norm
+        + head + loss for one microbatch. Evaluated batched after the loop;
+        only the last stage's value survives the mask.
+      num_microbatches: M. Must be known statically (it sizes the scan).
+      remat: wrap ``stage_fn`` in ``jax.checkpoint`` so the backward pipeline
+        recomputes stage activations instead of storing every tick's
+        intermediates (the activation-recompute story of
+        ``tensor_parallel/random.py:~240-311``).
+
+    The returned function must run inside ``shard_map`` with ``axis_name``
+    bound (at world size 1 it degrades to sequential microbatching).
+    """
+    M = num_microbatches
+
+    def loss_fn(params, batch):
+        staged = jax.checkpoint(stage_fn) if remat else stage_fn
+
+        pipelined = axis_bound(axis_name)
+        S = lax.axis_size(axis_name) if pipelined else 1
+        i = lax.axis_index(axis_name) if pipelined else 0
+
+        # Embed all microbatches batched (one big MXU-friendly gather) rather
+        # than per tick.
+        injected = jax.vmap(lambda mb: preprocess_fn(params, mb))(batch)
+        state0 = jax.tree.map(lambda x: jnp.zeros_like(x[0]), injected)
+        outbuf0 = jax.tree.map(jnp.zeros_like, injected)
+
+        def tick(carry, t):
+            state, outbuf = carry
+            m_in = jnp.clip(t, 0, M - 1)
+            inj = _index_microbatch(injected, m_in)
+            h = (jax.tree.map(lambda a, b: jnp.where(i == 0, a, b), inj, state)
+                 if pipelined else inj)
+            y = staged(params, h, t)
+            # stage S-1's tick-t output is microbatch t-(S-1); bubble ticks
+            # (m_out < 0) write garbage into slot 0, overwritten at t = S-1.
+            m_out = jnp.clip(t - (S - 1), 0, M - 1)
+            outbuf = jax.tree.map(
+                lambda buf, leaf: lax.dynamic_update_index_in_dim(
+                    buf, leaf, m_out, 0), outbuf, y)
+            state = ring_shift(y, axis_name=axis_name) if pipelined else y
+            return (state, outbuf), None
+
+        (_, outbuf), _ = lax.scan(
+            tick, (state0, outbuf0), jnp.arange(M + S - 1))
+
+        losses = jax.vmap(
+            lambda y, mb: postprocess_fn(params, y, mb))(outbuf, batch)
+        local = jnp.mean(losses)
+        if not pipelined:
+            return local
+        # only the last stage holds real outputs; broadcast the masked value
+        # so every rank returns the same scalar (reference: losses live on
+        # the last stage only, ``:597``, then are broadcast by the caller).
+        return _broadcast_last_stage_loss(
+            jnp.where(i == S - 1, local, 0.0), axis_name)
+
+    return loss_fn
+
+
+def forward_backward_pipelining_without_interleaving(
+    forward_step_func: Any,
+    batch: Any,
+    params: Any,
+    *,
+    num_microbatches: int,
+    forward_only: bool = False,
+    grad_scaler: Optional[Callable] = None,
+    axis_name: str = PIPELINE_AXIS,
+    remat: bool = True,
+):
+    """Reference-shaped driver (``fwd_bwd_pipelining_without_interleaving.py:
+    241``): returns ``(loss, grads)`` (grads ``None`` when ``forward_only``).
+
+    ``forward_step_func`` here is the triple ``(preprocess_fn, stage_fn,
+    postprocess_fn)`` — the stage decomposition the reference gets implicitly
+    from which ``nn.Module`` chunk lives on each rank (``build_model``,
+    ``schedules/common.py:30-150``).
+    """
+    preprocess_fn, stage_fn, postprocess_fn = forward_step_func
+    loss_fn = make_pipelined_loss_fn(
+        preprocess_fn, stage_fn, postprocess_fn, num_microbatches,
+        axis_name=axis_name, remat=remat)
+    if forward_only:
+        return loss_fn(params, batch), None
+    if grad_scaler is None:
+        return jax.value_and_grad(loss_fn)(params, batch)
+
+    def scaled(p, b):
+        loss = loss_fn(p, b)
+        return grad_scaler(loss), loss  # differentiate scaled, report unscaled
+
+    (_, loss), grads = jax.value_and_grad(scaled, has_aux=True)(params, batch)
+    return loss, grads

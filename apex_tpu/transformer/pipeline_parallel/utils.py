@@ -1,0 +1,216 @@
+"""Pipeline-parallel training utilities.
+
+Reference: ``apex/transformer/pipeline_parallel/utils.py:58-357`` — the
+microbatch-calculator singleton, batch slicing, loss averaging over the DP
+group, TP-aware parameter norms, ltor mask construction, memory reporting.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.transformer.parallel_state import DATA_AXIS
+from apex_tpu.transformer.pipeline_parallel._timers import Timers
+from apex_tpu.transformer.pipeline_parallel.microbatches import (
+    build_num_microbatches_calculator,
+)
+from apex_tpu.transformer.tensor_parallel.mappings import axis_bound
+
+__all__ = [
+    "setup_microbatch_calculator",
+    "get_micro_batch_size",
+    "get_num_microbatches",
+    "get_current_global_batch_size",
+    "update_num_microbatches",
+    "get_timers",
+    "split_batch_into_microbatches",
+    "get_kth_microbatch",
+    "average_losses_across_data_parallel_group",
+    "calc_params_l2_norm",
+    "get_ltor_masks_and_position_ids",
+    "report_memory",
+    "print_rank_0",
+]
+
+_GLOBAL_NUM_MICROBATCHES_CALCULATOR = None
+_GLOBAL_TIMERS: Optional[Timers] = None
+_GLOBAL_AUTORESUME = None
+
+
+def setup_microbatch_calculator(rank: int, rampup_batch_size: Optional[List[int]],
+                                global_batch_size: int, micro_batch_size: int,
+                                data_parallel_size: int) -> None:
+    """Reference: ``pipeline_parallel/utils.py:58-78`` (singleton guard)."""
+    global _GLOBAL_NUM_MICROBATCHES_CALCULATOR
+    if _GLOBAL_NUM_MICROBATCHES_CALCULATOR is not None:
+        raise RuntimeError("num microbatches calculator is already initialized.")
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR = build_num_microbatches_calculator(
+        rank, rampup_batch_size, global_batch_size, micro_batch_size,
+        data_parallel_size)
+
+
+def _destroy_microbatch_calculator() -> None:
+    global _GLOBAL_NUM_MICROBATCHES_CALCULATOR
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR = None
+
+
+def get_num_microbatches() -> int:
+    return _GLOBAL_NUM_MICROBATCHES_CALCULATOR.get()
+
+
+def get_micro_batch_size() -> int:
+    return _GLOBAL_NUM_MICROBATCHES_CALCULATOR.micro_batch_size
+
+
+def get_current_global_batch_size() -> int:
+    return _GLOBAL_NUM_MICROBATCHES_CALCULATOR.get_current_global_batch_size()
+
+
+def update_num_microbatches(consumed_samples, consistency_check=True) -> None:
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR.update(
+        consumed_samples, consistency_check)
+
+
+def get_timers() -> Timers:
+    """Reference: ``utils.py:146-157``."""
+    global _GLOBAL_TIMERS
+    if _GLOBAL_TIMERS is None:
+        _GLOBAL_TIMERS = Timers()
+    return _GLOBAL_TIMERS
+
+
+def get_autoresume():
+    """Reference: ``utils.py:142-144`` (ADLR AutoResume hook; None here —
+    elastic/autoresume integration is environment-specific)."""
+    return _GLOBAL_AUTORESUME
+
+
+def split_batch_into_microbatches(batch: Any, num_microbatches: int) -> Any:
+    """Reshape each leaf ``[B, ...] -> [M, B/M, ...]`` (microbatch-major),
+    the layout the schedules scan over. Analog of the reference's repeated
+    ``get_kth_microbatch`` slicing (``utils.py:196-208``)."""
+
+    def one(x):
+        B = x.shape[0]
+        if B % num_microbatches:
+            raise ValueError(
+                f"batch dim ({B}) not divisible by num_microbatches "
+                f"({num_microbatches})")
+        return x.reshape(num_microbatches, B // num_microbatches,
+                         *x.shape[1:])
+
+    return jax.tree.map(one, batch)
+
+
+def get_kth_microbatch(batch: Optional[Any], k: int) -> Any:
+    """Reference: ``utils.py:196-208`` — slice microbatch ``k`` out of a
+    batch whose leaves are ``[B, ...]`` with implicit microbatch-major order."""
+    if batch is None:
+        return None
+    return jax.tree.map(lambda x: x[k], batch)
+
+
+def average_losses_across_data_parallel_group(losses,
+                                              axis_name: str = DATA_AXIS):
+    """Reference: ``utils.py:242-250`` — allreduce/mean losses over DP."""
+    averaged = jnp.stack([jnp.asarray(l).reshape(()) for l in losses])
+    if axis_bound(axis_name):
+        averaged = lax.pmean(averaged, axis_name)
+    return averaged
+
+
+def calc_params_l2_norm(params: Any, *, tensor_axis: str = "tensor",
+                        shared_specs: Any = None) -> jax.Array:
+    """Global L2 norm of parameters (reference ``utils.py:~220-240``
+    ``calc_params_l2_norm``; the reference skips TP-duplicated params on
+    non-owner ranks so each parameter is counted once).
+
+    ``shared_specs``: optional PartitionSpec pytree matching ``params``.
+    Inside ``shard_map``, leaves whose spec does NOT mention ``tensor_axis``
+    are replicated across it — their identical per-rank contribution is
+    divided by the axis size so the closing ``psum`` counts them once.
+    Without ``shared_specs`` every leaf is assumed axis-sharded.
+    """
+    if not axis_bound(tensor_axis):
+        leaves = jax.tree.leaves(params)
+        sq = sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves)
+        return jnp.sqrt(sq)
+
+    size = lax.axis_size(tensor_axis)
+    if shared_specs is None:
+        shared_flags = jax.tree.map(lambda _: False, params)
+    else:
+        shared_flags = jax.tree.map(
+            lambda s: tensor_axis not in jax.tree.leaves(tuple(s)),
+            shared_specs,
+            is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec))
+    sq = jnp.zeros((), jnp.float32)
+    for leaf, replicated in zip(jax.tree.leaves(params),
+                                jax.tree.leaves(shared_flags)):
+        contrib = jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+        sq = sq + (contrib / size if replicated else contrib)
+    return jnp.sqrt(lax.psum(sq, tensor_axis))
+
+
+def get_ltor_masks_and_position_ids(data: jax.Array,
+                                    eod_token: int,
+                                    reset_position_ids: bool = False,
+                                    reset_attention_mask: bool = False,
+                                    eod_mask_loss: bool = False):
+    """Left-to-right masks + position ids (reference ``utils.py:265-357``).
+
+    Returns ``(attention_mask [b,1,s,s] bool — True = masked out,
+    loss_mask [b,s] f32, position_ids [b,s] i32)``. The document-reset
+    variants rebuild positions after each EOD token.
+    """
+    b, s = data.shape
+    causal = jnp.triu(jnp.ones((s, s), jnp.bool_), k=1)
+    attention_mask = jnp.broadcast_to(causal, (b, 1, s, s))
+
+    loss_mask = jnp.ones((b, s), jnp.float32)
+    if eod_mask_loss:
+        loss_mask = jnp.where(data == eod_token, 0.0, loss_mask)
+
+    position_ids = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+    if reset_position_ids or reset_attention_mask:
+        # segment id = number of EODs strictly before each position
+        is_eod = (data == eod_token).astype(jnp.int32)
+        segments = jnp.cumsum(is_eod, axis=1) - is_eod
+        if reset_position_ids:
+            # position within segment: global pos minus pos of segment start
+            seg_change = jnp.concatenate(
+                [jnp.zeros((b, 1), jnp.bool_), segments[:, 1:] != segments[:, :-1]],
+                axis=1)
+            start_pos = jnp.where(seg_change, position_ids, 0)
+            start_of_segment = lax.associative_scan(
+                jnp.maximum, start_pos, axis=1)
+            position_ids = position_ids - start_of_segment
+        if reset_attention_mask:
+            cross_doc = segments[:, :, None] != segments[:, None, :]
+            attention_mask = attention_mask | cross_doc[:, None, :, :]
+    return attention_mask, loss_mask, position_ids
+
+
+def report_memory(name: str) -> None:
+    """Reference: ``utils.py:253-263`` — print device memory stats."""
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+    except Exception:
+        stats = {}
+    giga = 1024 ** 3
+    used = stats.get("bytes_in_use", 0) / giga
+    peak = stats.get("peak_bytes_in_use", 0) / giga
+    limit = stats.get("bytes_limit", 0) / giga
+    print(f"[{name}] memory (GB) | in use: {used:.2f} | peak: {peak:.2f} "
+          f"| limit: {limit:.2f}", flush=True)
+
+
+def print_rank_0(message: str) -> None:
+    """Reference: ``utils.py:159-168`` — JAX is single-controller per host;
+    print on process index 0."""
+    if jax.process_index() == 0:
+        print(message, flush=True)
