@@ -1,0 +1,113 @@
+"""Distributed checkpoint / resume.
+
+The reference leaves model checkpointing to user scripts
+(``examples/imagenet/main_amp.py:254-260`` uses ``torch.save``) and layers
+three pieces on top (SURVEY.md §5):
+
+- amp scaler state round-trip (``apex/amp/frontend.py:365-404``, recommended
+  flow ``README.md:63-103``),
+- fp32 master groups in ``FP16_Optimizer.state_dict``
+  (``apex/fp16_utils/fp16_optimizer.py:212-273``),
+- sharded optimizer state gather/scatter in ``DistributedFusedAdam``.
+
+On TPU all three collapse into one capability: **save and restore an
+arbitrarily-sharded JAX pytree without gathering it to one host**, provided
+here on orbax — each host writes exactly the array shards it owns (the
+analog of the reference's shard-aware gather/scatter, minus the gather).
+Loss-scaler state, fp32 masters, and ZeRO shards are ordinary pytree leaves,
+so the whole train state round-trips through one call pair.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+
+__all__ = [
+    "save_checkpoint",
+    "load_checkpoint",
+    "CheckpointManager",
+]
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+    return ocp.StandardCheckpointer()
+
+
+def _as_restore_target(template: Any) -> Any:
+    """Template pytree -> ShapeDtypeStruct pytree carrying shardings, so each
+    leaf is restored with the layout the training state expects."""
+    return jax.tree.map(
+        lambda x: (x if isinstance(x, jax.ShapeDtypeStruct)
+                   else jax.ShapeDtypeStruct(
+                       x.shape, x.dtype,
+                       sharding=getattr(x, "sharding", None))),
+        template)
+
+
+def save_checkpoint(path: str, state: Any, *, force: bool = True) -> None:
+    """Write ``state`` (any pytree of jax.Arrays, sharded or not) to
+    ``path``. Sharded leaves are written distributed: every host persists its
+    own shards (no host gather — contrast the reference's
+    ``DistributedFusedAdam.state_dict`` gather)."""
+    ckptr = _checkpointer()
+    ckptr.save(os.path.abspath(os.fspath(path)), state, force=force)
+    ckptr.wait_until_finished()
+
+
+def load_checkpoint(path: str, template: Optional[Any] = None) -> Any:
+    """Restore a checkpoint. ``template`` (a pytree of arrays or
+    ``jax.ShapeDtypeStruct``, possibly carrying shardings) restores each leaf
+    with the requested sharding/dtype; without it, arrays come back
+    replicated on the default device."""
+    ckptr = _checkpointer()
+    path = os.path.abspath(os.fspath(path))
+    if template is None:
+        return ckptr.restore(path)
+    return ckptr.restore(path, _as_restore_target(template))
+
+
+class CheckpointManager:
+    """Rotating step-indexed checkpoints with resume — the role the
+    reference's AutoResume hook + user save scripts play
+    (``pipeline_parallel/utils.py:142-144``, ``examples/imagenet``).
+
+    ``save(step, state)`` / ``restore(template) -> (step, state) | None``;
+    keeps the newest ``max_to_keep``.
+    """
+
+    def __init__(self, directory: str, *, max_to_keep: int = 3,
+                 save_interval_steps: int = 1):
+        import orbax.checkpoint as ocp
+        self._mgr = ocp.CheckpointManager(
+            os.path.abspath(os.fspath(directory)),
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                save_interval_steps=save_interval_steps),
+        )
+
+    def save(self, step: int, state: Any) -> bool:
+        import orbax.checkpoint as ocp
+        saved = self._mgr.save(step, args=ocp.args.StandardSave(state))
+        return saved
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(self, template: Any):
+        import orbax.checkpoint as ocp
+        step = self._mgr.latest_step()
+        if step is None:
+            return None
+        state = self._mgr.restore(
+            step, args=ocp.args.StandardRestore(_as_restore_target(template)))
+        return step, state
+
+    def wait_until_finished(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()

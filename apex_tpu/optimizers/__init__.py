@@ -7,8 +7,10 @@ from apex_tpu.optimizers.fused_sgd import FusedSGD
 from apex_tpu.optimizers.fused_novograd import FusedNovoGrad
 from apex_tpu.optimizers.fused_adagrad import FusedAdagrad
 from apex_tpu.optimizers.fused_mixed_precision_lamb import FusedMixedPrecisionLamb
+from apex_tpu.optimizers.distributed_fused_adam import DistributedFusedAdam
 
 __all__ = [
+    "DistributedFusedAdam",
     "FusedOptimizer",
     "FusedAdam",
     "FusedAdamW",

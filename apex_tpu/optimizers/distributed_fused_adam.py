@@ -1,0 +1,296 @@
+"""ZeRO-2 sharded Adam.
+
+TPU-native counterpart of ``apex/contrib/optimizers/distributed_fused_adam.py``
+(``DistributedFusedAdam``, class at ``:272``, docstring ``:273-287``:
+"distributes ... optimizer state ... sharded"): gradients are reduce-scattered
+across the data-parallel group, each rank updates only its shard of the fp32
+master params and Adam moments, and updated params are all-gathered back —
+overlapping comm with backward is XLA's latency-hiding scheduler's job rather
+than the reference's per-param grad hooks (``:811-885``).
+
+Design: each rank's parameter pytree is flattened into ONE padded fp32 buffer
+(the same move as the reference's bucket views over ``apex_C`` flattening,
+``parallel/distributed.py:15-35``), split ``[dp, chunk]`` over the data axis:
+
+- ``step`` (per-rank, inside ``shard_map``): flat grads ->
+  ``lax.psum_scatter`` (mean) over the data axis -> local ``[chunk]`` shard ->
+  Adam update against local master/moment shards -> tiled ``lax.all_gather``
+  of the new params -> unflatten, cast back to param dtypes. The
+  reduce-scatter IS the data-parallel gradient sync (``handles_grad_sync``),
+  so the train step skips its grad ``pmean``.
+- state is globally ``[dp, *model_axes, chunk]`` sharded over every mesh
+  axis: the data axis carries the ZeRO shards; the model axes (pipeline/
+  context/tensor) exist because TP/PP-sharded layers give each model-parallel
+  rank a *different* local parameter set, each with its own ZeRO shards —
+  the mesh-wide statement of the reference's "one optimizer instance per
+  model-parallel rank, sharded over its DP group". Optimizer memory per
+  device is ``3 * N_local/dp * 4`` bytes, the ZeRO-2 figure.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec
+
+from apex_tpu.optimizers.fused_adam import FusedAdam
+from apex_tpu.transformer.parallel_state import (
+    CONTEXT_AXIS,
+    DATA_AXIS,
+    PIPELINE_AXIS,
+    TENSOR_AXIS,
+)
+from apex_tpu.transformer.tensor_parallel.mappings import axis_bound
+
+__all__ = ["DistributedFusedAdam"]
+
+_MODEL_AXES = (PIPELINE_AXIS, CONTEXT_AXIS, TENSOR_AXIS)
+
+
+def _spec_axes(entry) -> Tuple[str, ...]:
+    """Mesh axis names a PartitionSpec entry binds to one array dim."""
+    if entry is None:
+        return ()
+    if isinstance(entry, (tuple, list)):
+        return tuple(entry)
+    return (entry,)
+
+
+def _dim_factor_offset(entry, coords: dict):
+    """(shard count, shard index) one PartitionSpec entry induces on a dim
+    under the model-parallel coordinate ``coords`` (axis name -> (rank, size))."""
+    factor, offset_units = 1, 0
+    for ax in _spec_axes(entry):
+        if ax not in coords:
+            continue
+        rank, size = coords[ax]
+        factor *= size
+        offset_units = offset_units * size + rank
+    return factor, offset_units
+
+
+def _local_leaf(leaf, spec, coords: dict):
+    """Slice one globally-shaped leaf (numpy or jax) down to the local shard
+    owned by the model-parallel coordinate ``coords``."""
+    if spec is None:
+        return leaf
+    out = leaf
+    for dim, entry in enumerate(tuple(spec)):
+        factor, offset_units = _dim_factor_offset(entry, coords)
+        if factor == 1:
+            continue
+        if out.shape[dim] % factor:
+            raise ValueError(
+                f"dim {dim} of shape {leaf.shape} not divisible by mesh "
+                f"axes {entry} (size {factor})")
+        block = out.shape[dim] // factor
+        idx = [slice(None)] * out.ndim
+        idx[dim] = slice(offset_units * block, (offset_units + 1) * block)
+        out = out[tuple(idx)]
+    return out
+
+
+def _local_numel(shape, spec, axis_sizes: dict) -> int:
+    """Element count of one model-parallel rank's shard of a leaf."""
+    n = int(np.prod(shape, dtype=np.int64))
+    if spec is None:
+        return n
+    for dim, entry in enumerate(tuple(spec)):
+        for ax in _spec_axes(entry):
+            if ax in axis_sizes:
+                n //= axis_sizes[ax]
+    return n
+
+
+class DistributedFusedAdam(FusedAdam):
+    """Adam with data-parallel-sharded state (ZeRO-2).
+
+    Args mirror :class:`FusedAdam`. fp32 master weights are always kept
+    (sharded) — that is the point of the exercise, matching the reference
+    which materializes fp32 state shards regardless of param dtype.
+
+    ``init`` wants ``param_spec`` whenever the model itself is mesh-sharded
+    (TP/PP); without it params are assumed replicated across model axes.
+    """
+
+    handles_grad_sync = True
+
+    def __init__(self, lr: float = 1e-3, *, num_shards: Optional[int] = None,
+                 axis_name: str = DATA_AXIS, **adam_kw):
+        adam_kw.pop("master_weights", None)
+        super().__init__(lr=lr, master_weights=True, **adam_kw)
+        if num_shards is None:
+            from apex_tpu.transformer import parallel_state
+            num_shards = (parallel_state.get_data_parallel_world_size()
+                          if parallel_state.model_parallel_is_initialized()
+                          else 1)
+        self.num_shards = num_shards
+        self.axis_name = axis_name
+
+    # -- flat buffer layout --------------------------------------------------
+
+    def _model_axis_sizes(self):
+        from apex_tpu.transformer import parallel_state
+        if not parallel_state.model_parallel_is_initialized():
+            return {}
+        mesh = parallel_state.get_mesh()
+        return {a: mesh.shape[a] for a in _MODEL_AXES if a in mesh.shape}
+
+    def _chunk_size(self, local_numel: int) -> int:
+        return -(-local_numel // self.num_shards)  # ceil
+
+    def _flatten_local(self, tree) -> jax.Array:
+        leaves = jax.tree_util.tree_leaves(tree)
+        flat = jnp.concatenate(
+            [l.astype(jnp.float32).reshape(-1) for l in leaves])
+        chunk = self._chunk_size(flat.shape[0])
+        return jnp.pad(flat, (0, chunk * self.num_shards - flat.shape[0]))
+
+    def _unflatten_local(self, flat: jax.Array, params) -> Any:
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        out, off = [], 0
+        for l in leaves:
+            n = int(np.prod(l.shape, dtype=np.int64))
+            out.append(flat[off:off + n].reshape(l.shape).astype(l.dtype))
+            off += n
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    # -- public API ----------------------------------------------------------
+
+    def init(self, params, param_spec=None) -> dict:
+        """Build the globally-shaped sharded state from global params.
+
+        State shape is ``[dp, *model_axes, chunk]``: position ``[d, *coord]``
+        holds segment ``d`` of the flattened local params of model-parallel
+        rank ``coord``. Shards are materialized directly on their owning
+        devices via ``jax.make_array_from_callback`` — no full fp32 copy of
+        the state is ever resident on one device (the distributed-init analog
+        of the reference initializing each rank's shard in place)."""
+        axes = self._model_axis_sizes()
+        names, sizes = list(axes.keys()), list(axes.values())
+        dp = self.num_shards
+
+        if not names:
+            master = self._flatten_local(params).reshape(dp, -1)
+            return {
+                "step": jnp.zeros((), jnp.int32),
+                "master": master,
+                "exp_avg": jnp.zeros_like(master),
+                "exp_avg_sq": jnp.zeros_like(master),
+            }
+
+        from apex_tpu.transformer import parallel_state
+        from jax.sharding import NamedSharding
+
+        mesh = parallel_state.get_mesh()
+        if mesh.shape[DATA_AXIS] != dp:
+            raise ValueError(
+                f"num_shards ({dp}) must equal the mesh data-axis size "
+                f"({mesh.shape[DATA_AXIS]}) — construct the optimizer after "
+                "initialize_model_parallel() or pass num_shards explicitly")
+        leaves = jax.tree_util.tree_leaves(params)
+        if param_spec is None:
+            spec_leaves = [None] * len(leaves)
+        else:
+            spec_leaves = jax.tree_util.tree_structure(params).flatten_up_to(
+                param_spec)
+        local_numel = sum(
+            _local_numel(l.shape, s, axes)
+            for l, s in zip(leaves, spec_leaves))
+        chunk = self._chunk_size(local_numel)
+        shape = (dp, *sizes, chunk)
+        sharding = NamedSharding(mesh, PartitionSpec(DATA_AXIS, *names, None))
+        host_leaves = [np.asarray(l, dtype=np.float32) for l in leaves]
+        shard_cache: dict = {}
+
+        def _coord_flat(coord):
+            if coord not in shard_cache:
+                coords = {n: (r, s) for n, r, s in zip(names, coord, sizes)}
+                flat = np.concatenate([
+                    _local_leaf(l, s, coords).reshape(-1)
+                    for l, s in zip(host_leaves, spec_leaves)])
+                shard_cache[coord] = np.pad(
+                    flat, (0, chunk * dp - flat.shape[0]))
+            return shard_cache[coord]
+
+        def cb(index):
+            d = index[0].start or 0
+            coord = tuple((sl.start or 0) for sl in index[1:-1])
+            seg = _coord_flat(coord)[d * chunk:(d + 1) * chunk]
+            return seg.reshape((1,) + (1,) * len(sizes) + (chunk,))
+
+        master = jax.make_array_from_callback(shape, sharding, cb)
+        return {
+            "step": jnp.zeros((), jnp.int32),
+            "master": master,
+            "exp_avg": jnp.zeros_like(master),      # sharding-preserving
+            "exp_avg_sq": jnp.zeros_like(master),
+        }
+
+    def state_spec(self, params, param_spec=None):
+        names = list(self._model_axis_sizes().keys())
+        p = PartitionSpec(self.axis_name, *names, None)
+        return {"step": PartitionSpec(), "master": p, "exp_avg": p,
+                "exp_avg_sq": p}
+
+    def step(self, grads, params, state, *, lr: Optional[Any] = None,
+             grad_scale: Optional[jax.Array] = None,
+             found_inf: Optional[jax.Array] = None) -> Tuple[Any, dict]:
+        """Per-rank view inside ``shard_map``: ``grads``/``params`` are this
+        rank's local pytrees, state leaves are ``[1, 1..., chunk]`` shards.
+        Outside ``shard_map`` (world size 1) it degrades to FusedAdam on the
+        flat buffer."""
+        lr = self.lr if lr is None else lr
+        if axis_bound(self.axis_name):
+            axis_size = lax.axis_size(self.axis_name)  # static at trace time
+            if axis_size != self.num_shards:
+                raise ValueError(
+                    f"DistributedFusedAdam was built with num_shards="
+                    f"{self.num_shards} but the bound '{self.axis_name}' "
+                    f"axis has size {axis_size}; gradients would silently "
+                    "desynchronize. Construct the optimizer after "
+                    "initialize_model_parallel() (or pass num_shards).")
+        sharded = axis_bound(self.axis_name) and self.num_shards > 1
+
+        g_flat = self._flatten_local(grads)
+        if grad_scale is not None:
+            g_flat = g_flat * (1.0 / grad_scale)
+        if sharded:
+            # reduce-scatter = grad sync + shard selection in one collective
+            # (reference grad-sync pipeline, distributed_fused_adam.py:811-885)
+            g_local = lax.psum_scatter(g_flat, self.axis_name,
+                                       scatter_dimension=0, tiled=True)
+            g_local = g_local / self.num_shards
+        else:
+            g_local = g_flat
+
+        shard_shape = state["master"].shape
+        p_local = state["master"].reshape(-1)
+        slots = {"exp_avg": state["exp_avg"].reshape(-1),
+                 "exp_avg_sq": state["exp_avg_sq"].reshape(-1)}
+        step = state["step"] + 1
+        new_p, new_slots = self._update(g_local, p_local, slots, step, lr)
+        if found_inf is not None:
+            new_p = jnp.where(found_inf, p_local, new_p)
+            new_slots = jax.tree.map(
+                lambda n, o: jnp.where(found_inf, o, n), new_slots, slots)
+            step = jnp.where(found_inf, state["step"], step)
+
+        if sharded:
+            # params come back via all-gather (reference: all-gather params
+            # after the sharded step)
+            full = lax.all_gather(new_p, self.axis_name, tiled=True)
+        else:
+            full = new_p
+        new_params = self._unflatten_local(full, params)
+        new_state = {
+            "step": step,
+            "master": new_p.reshape(shard_shape),
+            "exp_avg": new_slots["exp_avg"].reshape(shard_shape),
+            "exp_avg_sq": new_slots["exp_avg_sq"].reshape(shard_shape),
+        }
+        return new_params, new_state

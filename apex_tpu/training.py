@@ -76,6 +76,16 @@ def make_train_step(
                 "need opt_state_spec or params_template to derive it")
         opt_state_spec = optimizer.state_spec(params_template, param_spec)
 
+    # A ZeRO-style optimizer syncs grads itself, but only over its own axis
+    # (its reduce-scatter IS the DP allreduce on that axis — reference
+    # DistributedFusedAdam grad pipeline); any other data axes still need
+    # the pmean here.
+    if getattr(optimizer, "handles_grad_sync", False):
+        opt_axis = getattr(optimizer, "axis_name", None)
+        grad_sync_axes = tuple(a for a in data_axes if a != opt_axis)
+    else:
+        grad_sync_axes = tuple(data_axes)
+
     def per_rank(params, opt_state, batch, rng):
         if rng is not None:
             # independent dropout streams per data shard (DDP's per-rank RNG);
@@ -86,7 +96,7 @@ def make_train_step(
                 except NameError:
                     pass
         loss, grads = jax.value_and_grad(loss_fn)(params, batch, rng)
-        grads = sync_data_parallel_grads(grads, data_axes)
+        grads = sync_data_parallel_grads(grads, grad_sync_axes)
         loss = sync_data_parallel_grads(loss, data_axes)
         new_params, new_state = optimizer.step(grads, params, opt_state)
         return new_params, new_state, loss

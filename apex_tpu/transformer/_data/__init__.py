@@ -1,0 +1,6 @@
+from apex_tpu.transformer._data._batchsampler import (
+    MegatronPretrainingSampler,
+    MegatronPretrainingRandomSampler,
+)
+
+__all__ = ["MegatronPretrainingSampler", "MegatronPretrainingRandomSampler"]

@@ -1,0 +1,132 @@
+"""Resumable, data-parallel-sharded batch samplers.
+
+Parity with ``apex/transformer/_data/_batchsampler.py:~1-180``
+(``MegatronPretrainingSampler``, ``MegatronPretrainingRandomSampler``): both
+yield lists of dataset indices for **this data-parallel rank's** microbatch,
+starting from ``consumed_samples`` so a resumed run continues the exact data
+order (the checkpoint/resume story of SURVEY.md §5).
+
+Host-side index generation is rank-agnostic JAX-wise — these feed whatever
+input pipeline stages batches onto the mesh.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["MegatronPretrainingSampler", "MegatronPretrainingRandomSampler"]
+
+
+class _Base:
+    def __init__(self, total_samples: int, consumed_samples: int,
+                 micro_batch_size: int, data_parallel_rank: int,
+                 data_parallel_size: int):
+        self.total_samples = total_samples
+        self.consumed_samples = consumed_samples
+        self.micro_batch_size = micro_batch_size
+        self.data_parallel_rank = data_parallel_rank
+        self.data_parallel_size = data_parallel_size
+        self.micro_batch_times_data_parallel_size = (
+            micro_batch_size * data_parallel_size)
+
+        if total_samples <= 0:
+            raise RuntimeError(
+                f"no sample to consume: {total_samples}")
+        if micro_batch_size <= 0:
+            raise RuntimeError(
+                f"micro_batch_size size must be greater than 0, but "
+                f"{micro_batch_size}")
+        if data_parallel_size <= 0:
+            raise RuntimeError(
+                f"data parallel size must be greater than 0, but "
+                f"{data_parallel_size}")
+        if data_parallel_rank >= data_parallel_size:
+            raise RuntimeError(
+                f"data_parallel_rank should be smaller than data size: "
+                f"{data_parallel_rank}, {data_parallel_size}")
+
+
+class MegatronPretrainingSampler(_Base):
+    """Sequential sampler (reference class of the same name): rank ``r``
+    takes the ``r``-th ``micro_batch_size`` slice of each global batch."""
+
+    def __init__(self, total_samples, consumed_samples, micro_batch_size,
+                 data_parallel_rank, data_parallel_size,
+                 drop_last: bool = True):
+        super().__init__(total_samples, consumed_samples, micro_batch_size,
+                         data_parallel_rank, data_parallel_size)
+        # single-pass sampler: exhausted data is an error here, while the
+        # random sampler below wraps into later epochs (reference puts this
+        # check only on the sequential variant)
+        if consumed_samples >= total_samples:
+            raise RuntimeError(
+                f"no samples left to consume: {consumed_samples}, "
+                f"{total_samples}")
+        self.drop_last = drop_last
+
+    def __len__(self):
+        return self.total_samples
+
+    def get_start_end_idx(self):
+        start_idx = self.data_parallel_rank * self.micro_batch_size
+        end_idx = start_idx + self.micro_batch_size
+        return start_idx, end_idx
+
+    def __iter__(self):
+        batch = []
+        # data sharding: [DP rank 0 mbs, DP rank 1 mbs, ..., DP rank n mbs]
+        for idx in range(self.consumed_samples, self.total_samples):
+            batch.append(idx)
+            if len(batch) == self.micro_batch_times_data_parallel_size:
+                start_idx, end_idx = self.get_start_end_idx()
+                yield batch[start_idx:end_idx]
+                batch = []
+        if len(batch) > 0 and not self.drop_last:
+            start_idx, end_idx = self.get_start_end_idx()
+            yield batch[start_idx:end_idx]
+
+
+class MegatronPretrainingRandomSampler(_Base):
+    """Shuffled sampler, resumable mid-epoch: the permutation is seeded by
+    the epoch so every rank regenerates the same order, and
+    ``consumed_samples`` fast-forwards into it (reference logic: bucket
+    offset from ``current_epoch_samples``)."""
+
+    def __len__(self):
+        return self.total_samples
+
+    def __iter__(self):
+        # the tail that doesn't fill a global batch is dropped each epoch, so
+        # epoch accounting runs on the active sample count (reference:
+        # active_total_samples = total_samples - last_batch_size)
+        last_batch_size = (
+            self.total_samples % self.micro_batch_times_data_parallel_size)
+        active_total_samples = self.total_samples - last_batch_size
+        if active_total_samples <= 0:
+            raise RuntimeError(
+                "total_samples smaller than one global batch: "
+                f"{self.total_samples} < "
+                f"{self.micro_batch_times_data_parallel_size}")
+        self.epoch = self.consumed_samples // active_total_samples
+        current_epoch_samples = self.consumed_samples % active_total_samples
+        assert (current_epoch_samples
+                % self.micro_batch_times_data_parallel_size == 0)
+
+        # data sharding: interleaved buckets, one per DP rank
+        bucket_size = (self.total_samples
+                       // self.micro_batch_times_data_parallel_size
+                       ) * self.micro_batch_size
+        bucket_offset = current_epoch_samples // self.data_parallel_size
+        start_idx = self.data_parallel_rank * bucket_size
+
+        g = np.random.default_rng(self.epoch)
+        random_idx = g.permutation(bucket_size).tolist()
+        idx_range = [start_idx + x for x in random_idx[bucket_offset:]]
+
+        batch = []
+        for idx in idx_range:
+            batch.append(idx)
+            if len(batch) == self.micro_batch_size:
+                self.consumed_samples += self.micro_batch_times_data_parallel_size
+                yield batch
+                batch = []
