@@ -32,9 +32,17 @@ __all__ = [
 ]
 
 
+_CKPTR = None
+
+
 def _checkpointer():
-    import orbax.checkpoint as ocp
-    return ocp.StandardCheckpointer()
+    # one long-lived checkpointer: orbax spins up async-IO resources per
+    # instance, so per-call construction leaks in long training loops
+    global _CKPTR
+    if _CKPTR is None:
+        import orbax.checkpoint as ocp
+        _CKPTR = ocp.StandardCheckpointer()
+    return _CKPTR
 
 
 def _as_restore_target(template: Any) -> Any:

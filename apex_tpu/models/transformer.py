@@ -37,7 +37,7 @@ from jax.sharding import PartitionSpec
 from apex_tpu.ops import flash_attention, fused_layer_norm_affine
 from apex_tpu.transformer.enums import AttnMaskType
 from apex_tpu.transformer.functional import FusedScaleMaskSoftmax
-from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+from apex_tpu.transformer.parallel_state import CONTEXT_AXIS, TENSOR_AXIS
 from apex_tpu.transformer.tensor_parallel.layers import (
     ColumnParallelLinear,
     RowParallelLinear,
@@ -73,6 +73,10 @@ class TransformerConfig:
     layernorm_epsilon: float = 1e-5
     attn_mask_type: AttnMaskType = AttnMaskType.causal
     sequence_parallel: bool = False
+    # context parallelism (long-context; the reference has none, SURVEY.md §5):
+    # None | "ring" (ppermute KV rotation) | "ulysses" (all-to-all head swap)
+    context_parallel_method: Optional[str] = None
+    context_axis: str = CONTEXT_AXIS
     recompute: bool = False          # full-layer activation recompute
     params_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.float32  # activations cast at block entry
@@ -117,8 +121,26 @@ def embed_tokens(embedding, emb_params, tokens, config, *, tokentype_params=None
     [b,s,h] -> [s,b,h] transpose, SP scatter, embedding dropout (reference
     ``standalone_transformer_lm.py`` ``Embedding.forward``)."""
     c = config
+    from apex_tpu.transformer.tensor_parallel.mappings import axis_bound
+
     emb = embedding.apply(emb_params["word_embeddings"], tokens)
-    pos = emb_params["position_embeddings"][: tokens.shape[1]]
+    s_local = tokens.shape[1]
+    if c.context_parallel_method and axis_bound(c.context_axis):
+        # tokens are this context rank's contiguous sequence chunk: position
+        # ids start at rank * s_local. dynamic_slice clamps out-of-range
+        # starts, so overlong sequences must be rejected loudly here (the
+        # unsharded path fails with a shape error instead).
+        cp = lax.axis_size(c.context_axis)
+        if cp * s_local > c.max_position_embeddings:
+            raise ValueError(
+                f"global sequence length ({cp} context shards x {s_local}) "
+                f"exceeds max_position_embeddings "
+                f"({c.max_position_embeddings})")
+        offset = lax.axis_index(c.context_axis) * s_local
+        pos = lax.dynamic_slice_in_dim(
+            emb_params["position_embeddings"], offset, s_local, axis=0)
+    else:
+        pos = emb_params["position_embeddings"][:s_local]
     emb = emb + pos[None, :, :]
     if tokentype_ids is not None:
         emb = emb + jnp.take(tokentype_params, tokentype_ids, axis=0)
@@ -234,6 +256,26 @@ class ParallelAttention:
         """q/k/v: [b, local_heads, s, dh]."""
         c = self.config
         causal = c.attn_mask_type == AttnMaskType.causal
+        if c.context_parallel_method:
+            from apex_tpu.ops.ring_attention import (
+                ring_attention,
+                ulysses_attention,
+            )
+            if attention_mask is not None or (
+                    not deterministic and c.attention_dropout > 0.0):
+                raise NotImplementedError(
+                    "context parallelism supports causal/full attention "
+                    "without attention dropout or explicit masks")
+            fn = {"ring": ring_attention,
+                  "ulysses": ulysses_attention}[c.context_parallel_method]
+            kw = {"kv_lengths": kv_lengths} if (
+                c.context_parallel_method == "ulysses"
+                and kv_lengths is not None) else {}
+            if c.context_parallel_method == "ring" and kv_lengths is not None:
+                raise NotImplementedError(
+                    "ring attention does not take kv_lengths; pad-free "
+                    "varlen rides the ulysses path")
+            return fn(q, k, v, causal=causal, axis_name=c.context_axis, **kw)
         use_flash = attention_mask is None and (
             deterministic or c.attention_dropout == 0.0)
         if use_flash:

@@ -17,6 +17,7 @@ from apex_tpu.ops.cross_entropy import (
     SoftmaxCrossEntropyLoss,
 )
 from apex_tpu.ops.attention import flash_attention
+from apex_tpu.ops.ring_attention import ring_attention, ulysses_attention
 from apex_tpu.ops.rope import (
     fused_rope,
     fused_rope_cached,
@@ -40,4 +41,6 @@ __all__ = [
     "fused_rope_thd",
     "fused_rope_2d",
     "flash_attention",
+    "ring_attention",
+    "ulysses_attention",
 ]

@@ -1,0 +1,156 @@
+"""Context-parallel attention: ring + Ulysses (all-to-all).
+
+The reference has **no** context parallelism — its only long-context
+mechanism is Megatron SP (sequence sharded between TP ranks outside matmuls,
+SURVEY.md §5) and its attention kernels cap at 16k tokens
+(``csrc/megatron/scaled_masked_softmax.h:460``). These two ops are the
+TPU-native long-context story that closes that gap:
+
+- :func:`ring_attention` — blockwise attention with online-softmax
+  accumulation: every rank keeps its query chunk, K/V chunks rotate around
+  the ``context`` mesh axis one ``ppermute`` hop per step (ICI-neighbor
+  traffic only), log-sum-exp state merges chunk by chunk. Peak memory per
+  rank is O(s_local^2) logits for one chunk pair; no rank ever materializes
+  the full sequence.
+- :func:`ulysses_attention` — DeepSpeed-Ulysses-style all-to-all: exchange
+  sequence sharding for head sharding, run the fused flash kernel on the
+  full sequence with ``heads/cp`` local heads, all-to-all back. Two
+  collectives total; better for moderate sequence lengths where the full-seq
+  flash kernel wins.
+
+Both degrade to plain :func:`flash_attention` outside ``shard_map`` (context
+world size 1). Backward comes from autodiff: the VJP of the ``ppermute``
+ring is the reverse rotation, giving the standard ring-attention backward
+(dK/dV accumulate as the cotangents counter-rotate).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from apex_tpu.ops.attention import flash_attention
+from apex_tpu.transformer.parallel_state import CONTEXT_AXIS
+from apex_tpu.transformer.tensor_parallel.mappings import axis_bound
+
+__all__ = ["ring_attention", "ulysses_attention"]
+
+_NEG_INF = -1e30
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    softmax_scale: Optional[float] = None,
+    axis_name: str = CONTEXT_AXIS,
+) -> jax.Array:
+    """Exact attention over a context-sharded sequence.
+
+    Args:
+      q, k, v: ``[batch, heads, s_local, head_dim]`` — this rank's contiguous
+        sequence chunk; global sequence is the rank-order concatenation over
+        ``axis_name``.
+      causal: global causal mask (rank ``i``'s queries see chunks ``j < i``
+        fully, chunk ``i`` triangularly, chunks ``j > i`` not at all — the
+        skipped work is real: fully-masked chunks cost one masked matmul,
+        and XLA's scheduler overlaps the ppermute with compute).
+    """
+    if not axis_bound(axis_name):
+        return flash_attention(q, k, v, causal=causal,
+                               softmax_scale=softmax_scale)
+    cp = lax.axis_size(axis_name)
+    if cp == 1:
+        return flash_attention(q, k, v, causal=causal,
+                               softmax_scale=softmax_scale)
+    rank = lax.axis_index(axis_name)
+    scale = float(softmax_scale if softmax_scale is not None
+                  else 1.0 / np.sqrt(q.shape[-1]))
+    b, h, sc, d = q.shape
+    q32 = q.astype(jnp.float32)
+    perm = [(r, (r + 1) % cp) for r in range(cp)]
+
+    rows = jnp.arange(sc)
+
+    def accumulate(m, l, acc, kc, vc, j):
+        """Fold chunk ``j`` (owner rank of the currently-held K/V) into the
+        running online-softmax state."""
+        s = jnp.einsum("bhqd,bhkd->bhqk", q32, kc.astype(jnp.float32)) * scale
+        if causal:
+            allowed = jnp.where(
+                rank == j, rows[:, None] >= rows[None, :],
+                jnp.broadcast_to(rank > j, (sc, sc)))
+            s = jnp.where(allowed[None, None], s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1)
+        acc = acc * corr[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, vc.astype(jnp.float32))
+        return m_new, l, acc
+
+    def step(carry, t):
+        # rotate first, then fold: cp-1 ppermute pairs total (the own chunk
+        # is folded before the scan, so no discarded final rotation)
+        kc, vc, m, l, acc = carry
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        m, l, acc = accumulate(m, l, acc, kc, vc, (rank - t) % cp)
+        return (kc, vc, m, l, acc), None
+
+    m0 = jnp.full((b, h, sc), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, sc), jnp.float32)
+    acc0 = jnp.zeros((b, h, sc, d), jnp.float32)
+    m0, l0, acc0 = jax.checkpoint(accumulate)(m0, l0, acc0, k, v, rank)
+    (_, _, _, l, acc), _ = lax.scan(
+        jax.checkpoint(step), (k, v, m0, l0, acc0), jnp.arange(1, cp))
+    return (acc / l[..., None]).astype(q.dtype)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    softmax_scale: Optional[float] = None,
+    kv_lengths: Optional[jax.Array] = None,
+    axis_name: str = CONTEXT_AXIS,
+) -> jax.Array:
+    """All-to-all sequence parallelism: trade the sequence shard for a head
+    shard, run flash attention over the full sequence, trade back.
+
+    Requires ``heads % cp == 0``. Layouts as :func:`ring_attention`.
+    """
+    if not axis_bound(axis_name):
+        return flash_attention(q, k, v, causal=causal,
+                               softmax_scale=softmax_scale,
+                               kv_lengths=kv_lengths)
+    cp = lax.axis_size(axis_name)
+    if cp == 1:
+        return flash_attention(q, k, v, causal=causal,
+                               softmax_scale=softmax_scale,
+                               kv_lengths=kv_lengths)
+    if q.shape[1] % cp:
+        raise ValueError(
+            f"ulysses_attention needs heads ({q.shape[1]}) divisible by the "
+            f"context-parallel size ({cp}); use ring_attention otherwise")
+
+    def seq_to_heads(x):
+        # [b, h, s/cp, d] -> [b, h/cp, s, d]; concat order over ranks is
+        # rank-major, preserving the global sequence order
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    out = flash_attention(qh, kh, vh, causal=causal,
+                          softmax_scale=softmax_scale, kv_lengths=kv_lengths)
+    # [b, h/cp, s, d] -> [b, h, s/cp, d]
+    return lax.all_to_all(out, axis_name, split_axis=2, concat_axis=1,
+                          tiled=True)

@@ -204,15 +204,18 @@ class DistributedFusedAdam(FusedAdam):
         chunk = self._chunk_size(local_numel)
         shape = (dp, *sizes, chunk)
         sharding = NamedSharding(mesh, PartitionSpec(DATA_AXIS, *names, None))
-        host_leaves = [np.asarray(l, dtype=np.float32) for l in leaves]
         shard_cache: dict = {}
 
         def _coord_flat(coord):
+            # slice only this model-parallel rank's param shards (no full
+            # host gather — on multi-host meshes the callback is invoked for
+            # addressable shards only, whose param slices are host-local)
             if coord not in shard_cache:
                 coords = {n: (r, s) for n, r, s in zip(names, coord, sizes)}
                 flat = np.concatenate([
-                    _local_leaf(l, s, coords).reshape(-1)
-                    for l, s in zip(host_leaves, spec_leaves)])
+                    np.asarray(_local_leaf(l, s, coords),
+                               dtype=np.float32).reshape(-1)
+                    for l, s in zip(leaves, spec_leaves)])
                 shard_cache[coord] = np.pad(
                     flat, (0, chunk * dp - flat.shape[0]))
             return shard_cache[coord]

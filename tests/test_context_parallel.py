@@ -1,0 +1,166 @@
+"""Context-parallel (long-context) suite.
+
+The reference has no CP (SURVEY.md §5: attention kernels cap at 16k and the
+only sequence mechanism is Megatron SP), so the ground truth here is the
+single-device flash/reference attention: ring and Ulysses attention over a
+sharded sequence must reproduce it — forward and gradients — and the GPT
+model must train identically with the sequence split over the ``context``
+mesh axis.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+os.environ.setdefault("APEX_TPU_FORCE_PALLAS", "interpret")
+
+from apex_tpu.models import GPTModel, TransformerConfig  # noqa: E402
+from apex_tpu.ops import flash_attention, ring_attention, ulysses_attention  # noqa: E402
+from apex_tpu.transformer import parallel_state  # noqa: E402
+
+
+def _qkv(b=2, h=4, s=32, d=16, key=0):
+    ks = jax.random.split(jax.random.PRNGKey(key), 3)
+    shape = (b, h, s, d)
+    return tuple(jax.random.normal(k, shape, jnp.float32) for k in ks)
+
+
+def _weights(shape, s_offset, s_total):
+    """Position-dependent weights consistent between global and sharded
+    layouts: w[b,h,s,d] = flat index in the GLOBAL [b,h,s_total,d] array."""
+    b, h, sc, d = shape
+    bi = jnp.arange(b).reshape(b, 1, 1, 1)
+    hi = jnp.arange(h).reshape(1, h, 1, 1)
+    si = jnp.arange(sc).reshape(1, 1, sc, 1) + s_offset
+    di = jnp.arange(d).reshape(1, 1, 1, d)
+    return (((bi * h + hi) * s_total + si) * d + di).astype(jnp.float32)
+
+
+def _run_cp(fn, q, k, v, cp, causal):
+    parallel_state.destroy_model_parallel()
+    mesh = parallel_state.initialize_model_parallel(context_parallel_size=cp)
+    s_total = q.shape[2]
+
+    def attn_loss(q, k, v):
+        o = fn(q, k, v, causal=causal)
+        sc = o.shape[2]
+        w = _weights(o.shape, jax.lax.axis_index("context") * sc, s_total)
+        # pmean: per-rank autodiff seeds one cotangent per rank, so the mean
+        # yields exactly the global-sum gradients; value is ref/cp
+        return jax.lax.pmean(jnp.sum(o * w), "context")
+
+    grads = jax.jit(jax.shard_map(
+        jax.value_and_grad(attn_loss, argnums=(0, 1, 2)), mesh=mesh,
+        in_specs=(P(None, None, "context"),) * 3,
+        out_specs=(P(), (P(None, None, "context"),) * 3),
+        check_vma=False))
+    loss, (dq, dk, dv) = grads(q, k, v)
+    out = jax.jit(jax.shard_map(
+        lambda q, k, v: fn(q, k, v, causal=causal), mesh=mesh,
+        in_specs=(P(None, None, "context"),) * 3,
+        out_specs=P(None, None, "context"),
+        check_vma=False))(q, k, v)
+    parallel_state.destroy_model_parallel()
+    return out, loss, (dq, dk, dv)
+
+
+def _reference(q, k, v, causal):
+    def attn_loss(q, k, v):
+        o = flash_attention(q, k, v, causal=causal)
+        w = _weights(o.shape, 0, o.shape[2])
+        return jnp.sum(o * w)
+
+    out = flash_attention(q, k, v, causal=causal)
+    loss, grads = jax.value_and_grad(attn_loss, argnums=(0, 1, 2))(q, k, v)
+    return out, loss, grads
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_reference(self, causal):
+        q, k, v = _qkv()
+        out, loss, grads = _run_cp(ring_attention, q, k, v, cp=4,
+                                   causal=causal)
+        ref_out, ref_loss, ref_grads = _reference(q, k, v, causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out),
+                                   rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(float(loss) * 4, float(ref_loss),
+                                   rtol=1e-5)
+        for g, rg in zip(grads, ref_grads):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(rg),
+                                       rtol=1e-3, atol=1e-3)
+
+    def test_degrades_to_flash_unsharded(self):
+        q, k, v = _qkv()
+        out = ring_attention(q, k, v, causal=True)
+        ref = flash_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestUlyssesAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_reference(self, causal):
+        q, k, v = _qkv()
+        out, loss, grads = _run_cp(ulysses_attention, q, k, v, cp=4,
+                                   causal=causal)
+        ref_out, ref_loss, ref_grads = _reference(q, k, v, causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out),
+                                   rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(float(loss) * 4, float(ref_loss),
+                                   rtol=1e-5)
+        for g, rg in zip(grads, ref_grads):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(rg),
+                                       rtol=1e-3, atol=1e-3)
+
+    def test_head_divisibility_check(self):
+        parallel_state.destroy_model_parallel()
+        mesh = parallel_state.initialize_model_parallel(
+            context_parallel_size=4)
+        q, k, v = _qkv(h=2)  # 2 heads, cp=4 -> invalid
+        with pytest.raises(ValueError, match="divisible"):
+            jax.jit(jax.shard_map(
+                lambda q, k, v: ulysses_attention(q, k, v), mesh=mesh,
+                in_specs=(P(None, None, "context"),) * 3,
+                out_specs=P(None, None, "context"),
+                check_vma=False))(q, k, v)
+        parallel_state.destroy_model_parallel()
+
+
+class TestGPTContextParallel:
+    @pytest.mark.parametrize("method", ["ring", "ulysses"])
+    def test_loss_matches_unsharded(self, method):
+        parallel_state.destroy_model_parallel()
+        mesh = parallel_state.initialize_model_parallel(
+            context_parallel_size=2)
+        cfg = dict(num_layers=2, hidden_size=64, num_attention_heads=4,
+                   vocab_size=128, max_position_embeddings=32,
+                   hidden_dropout=0.0, attention_dropout=0.0)
+        ref_model = GPTModel(TransformerConfig(**cfg))
+        cp_model = GPTModel(TransformerConfig(
+            **cfg, context_parallel_method=method))
+        params = ref_model.init(jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, 128)
+        labels = jax.random.randint(jax.random.PRNGKey(2), (4, 32), 0, 128)
+
+        ref_loss = ref_model.apply(params, tokens, labels)
+
+        def per_rank(p, tokens, labels):
+            # local loss is the mean over this rank's positions; global mean
+            # = pmean over equal-size shards
+            loss = cp_model.apply(p, tokens, labels)
+            return jax.lax.pmean(loss, "context")
+
+        loss = jax.jit(jax.shard_map(
+            per_rank, mesh=mesh,
+            in_specs=(ref_model.spec(), P(None, "context"),
+                      P(None, "context")),
+            out_specs=P(),
+            check_vma=False))(params, tokens, labels)
+        np.testing.assert_allclose(float(loss), float(ref_loss),
+                                   rtol=2e-5, atol=2e-5)
+        parallel_state.destroy_model_parallel()
