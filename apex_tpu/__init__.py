@@ -18,12 +18,16 @@ fallbacks; where the reference speaks NCCL through ``torch.distributed``
 """
 
 from apex_tpu import amp
+from apex_tpu import checkpoint
 from apex_tpu import fp16_utils
+from apex_tpu import fused_dense
+from apex_tpu import mlp
 from apex_tpu import multi_tensor_apply
 from apex_tpu import normalization
 from apex_tpu import ops
 from apex_tpu import optimizers
 from apex_tpu import parallel
+from apex_tpu import rnn
 from apex_tpu import transformer
 from apex_tpu.utils.logging import get_logger, RankInfoFormatter
 from apex_tpu.utils.deprecation import deprecated_warning
@@ -32,12 +36,16 @@ __version__ = "0.1.0"
 
 __all__ = [
     "amp",
+    "checkpoint",
     "fp16_utils",
+    "fused_dense",
+    "mlp",
     "multi_tensor_apply",
     "normalization",
     "ops",
     "optimizers",
     "parallel",
+    "rnn",
     "transformer",
     "get_logger",
     "RankInfoFormatter",

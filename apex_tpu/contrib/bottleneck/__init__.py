@@ -1,0 +1,8 @@
+from apex_tpu.contrib.bottleneck.bottleneck import Bottleneck, SpatialBottleneck
+from apex_tpu.contrib.bottleneck.halo_exchangers import (
+    HaloExchanger,
+    halo_exchange_1d,
+)
+
+__all__ = ["Bottleneck", "SpatialBottleneck", "HaloExchanger",
+           "halo_exchange_1d"]

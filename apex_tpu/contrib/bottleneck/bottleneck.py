@@ -1,0 +1,111 @@
+"""Fused ResNet bottleneck + spatial (H-split) parallel variant.
+
+Counterpart of ``apex/contrib/bottleneck/bottleneck.py`` (``Bottleneck``
+:134, ``SpatialBottleneck`` :265-749; 4k LoC of cuDNN-frontend fused conv
+graphs in ``bottleneck.cpp``): the 1x1-3x3-1x1 residual block with
+norm+ReLU epilogues, and a variant whose activations are sharded over the
+image H dimension across devices, exchanging one-row halos for the 3x3 conv.
+
+TPU design: convs are ``lax.conv_general_dilated`` in NHWC (the TPU-native
+conv layout the reference's "channels_last" fights torch to get), epilogue
+fusion is XLA's, and the halo exchange is the ``ppermute`` pair in
+:mod:`.halo_exchangers`. Norms are frozen scale/bias folded next to each
+conv (the reference's inference-style ``FrozenBatchNorm``
+scale/bias arguments); training-time stats ride
+:class:`apex_tpu.contrib.groupbn.BatchNorm2d_NHWC` when needed.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec
+
+from apex_tpu.contrib.bottleneck.halo_exchangers import halo_exchange_1d
+from apex_tpu.utils.conv import conv_nhwc as _conv_nhwc, he_init as _he_init
+
+__all__ = ["Bottleneck", "SpatialBottleneck"]
+
+
+@dataclass
+class Bottleneck:
+    """1x1 (reduce) -> 3x3 -> 1x1 (expand) with residual, per-conv frozen
+    scale/bias + ReLU (reference ``Bottleneck``, ``bottleneck.py:134-262``).
+    """
+
+    in_channels: int
+    bottleneck_channels: int
+    out_channels: int
+    stride: int = 1
+    use_cudnn: bool = True   # accepted for parity; ignored
+
+    @property
+    def has_downsample(self) -> bool:
+        return self.stride != 1 or self.in_channels != self.out_channels
+
+    def init(self, key: jax.Array) -> Dict[str, jax.Array]:
+        cin, cb, cout = (self.in_channels, self.bottleneck_channels,
+                         self.out_channels)
+        keys = jax.random.split(key, 4)
+        p = {
+            "conv1": _he_init(keys[0], (1, 1, cin, cb)),
+            "conv2": _he_init(keys[1], (3, 3, cb, cb)),
+            "conv3": _he_init(keys[2], (1, 1, cb, cout)),
+        }
+        for i, c in (("1", cb), ("2", cb), ("3", cout)):
+            p[f"scale{i}"] = jnp.ones((c,))
+            p[f"bias{i}"] = jnp.zeros((c,))
+        if self.has_downsample:
+            p["conv4"] = _he_init(keys[3], (1, 1, cin, cout))
+            p["scale4"] = jnp.ones((cout,))
+            p["bias4"] = jnp.zeros((cout,))
+        return p
+
+    def spec(self):
+        shapes = jax.eval_shape(self.init, jax.random.PRNGKey(0))
+        return {k: PartitionSpec() for k in shapes}
+
+    def _conv2(self, params, x):
+        return _conv_nhwc(x, params["conv2"], stride=self.stride,
+                          padding="SAME")
+
+    def apply(self, params: Dict[str, jax.Array], x: jax.Array) -> jax.Array:
+        """x: [N, H, W, C_in] NHWC."""
+        out = _conv_nhwc(x, params["conv1"])
+        out = jax.nn.relu(out * params["scale1"] + params["bias1"])
+        out = self._conv2(params, out)
+        out = jax.nn.relu(out * params["scale2"] + params["bias2"])
+        out = _conv_nhwc(out, params["conv3"])
+        out = out * params["scale3"] + params["bias3"]
+        if self.has_downsample:
+            residual = _conv_nhwc(x, params["conv4"], stride=self.stride)
+            residual = residual * params["scale4"] + params["bias4"]
+        else:
+            residual = x
+        return jax.nn.relu(out + residual)
+
+
+@dataclass
+class SpatialBottleneck(Bottleneck):
+    """H-split spatial parallelism (reference ``SpatialBottleneck``,
+    ``bottleneck.py:265-749``): activations sharded ``[N, H/ranks, W, C]``
+    over ``spatial_axis``; only the 3x3 conv needs neighbor rows, fetched by
+    a one-row halo exchange, then the padded conv runs with VALID height
+    padding so results match the unsharded block exactly."""
+
+    spatial_axis: str = "context"
+
+    def _conv2(self, params, x):
+        if self.stride != 1:
+            raise NotImplementedError(
+                "spatial H-split with strided 3x3 requires stride-aligned "
+                "shards; shard the stride-1 stages (reference restriction)")
+        padded = halo_exchange_1d(x, 1, dim=1, axis_name=self.spatial_axis)
+        return lax.conv_general_dilated(
+            padded, params["conv2"], window_strides=(1, 1),
+            padding=((0, 0), (1, 1)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))

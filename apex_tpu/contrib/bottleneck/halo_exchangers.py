@@ -1,0 +1,70 @@
+"""Halo exchange for spatial (H-split) convolution parallelism.
+
+Counterpart of ``apex/contrib/bottleneck/halo_exchangers.py:11-...`` which
+ships THREE transports (``HaloExchangerAllGather``, ``HaloExchangerSendRecv``
+over raw NCCL p2p, ``HaloExchangerPeer`` over CUDA-IPC peer memory) because
+NCCL neighbor exchange is slow enough to warrant hand-rolled alternatives.
+On TPU every variant collapses onto a pair of ``lax.ppermute`` neighbor
+shifts riding the ICI ring — the topology the hardware was built around —
+so one implementation covers all three (and ``contrib/peer_memory``'s
+``PeerHaloExchanger1d`` + ``contrib/csrc/nccl_p2p``'s
+``left_right_halo_exchange``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.transformer.tensor_parallel.mappings import axis_bound
+
+__all__ = ["halo_exchange_1d", "HaloExchanger"]
+
+
+def halo_exchange_1d(x: jax.Array, halo: int, *, dim: int = 1,
+                     axis_name: str = "context",
+                     wrap: bool = False) -> jax.Array:
+    """Pad ``x`` along ``dim`` with ``halo`` rows from each ring neighbor.
+
+    Returns ``x`` extended to ``size + 2*halo`` along ``dim``: the leading
+    halo comes from the previous rank's trailing rows, the trailing halo
+    from the next rank's leading rows (reference
+    ``left_right_halo_exchange``, ``nccl_p2p.cpp:20-24``). Edge ranks get
+    zeros unless ``wrap`` (matching the zero-padding a non-distributed conv
+    would see).
+    """
+    if not axis_bound(axis_name):
+        zeros = jnp.zeros_like(lax.slice_in_dim(x, 0, halo, axis=dim))
+        return jnp.concatenate([zeros, x, zeros], axis=dim)
+    size = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    top = lax.slice_in_dim(x, 0, halo, axis=dim)
+    bottom = lax.slice_in_dim(x, x.shape[dim] - halo, x.shape[dim], axis=dim)
+    fwd = [(r, (r + 1) % size) for r in range(size)]
+    bwd = [(r, (r - 1) % size) for r in range(size)]
+    from_prev = lax.ppermute(bottom, axis_name, fwd)  # prev rank's bottom
+    from_next = lax.ppermute(top, axis_name, bwd)     # next rank's top
+    if not wrap:
+        from_prev = jnp.where(rank == 0, jnp.zeros_like(from_prev),
+                              from_prev)
+        from_next = jnp.where(rank == size - 1, jnp.zeros_like(from_next),
+                              from_next)
+    return jnp.concatenate([from_prev, x, from_next], axis=dim)
+
+
+@dataclass
+class HaloExchanger:
+    """Object form mirroring the reference exchanger classes; the transport
+    distinction (AllGather / SendRecv / Peer) is meaningless on TPU, so one
+    class with the reference's call shape."""
+
+    axis_name: str = "context"
+    wrap: bool = False
+
+    def __call__(self, x: jax.Array, halo: int, dim: int = 1) -> jax.Array:
+        return halo_exchange_1d(x, halo, dim=dim, axis_name=self.axis_name,
+                                wrap=self.wrap)

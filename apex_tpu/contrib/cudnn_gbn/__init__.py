@@ -1,0 +1,11 @@
+"""Group (cross-device) batch norm (reference ``apex/contrib/cudnn_gbn``).
+
+``GroupBatchNorm2d`` (``cudnn_gbn/batch_norm.py:44``) is the cuDNN-graph
+flavor of the groupbn capability; on TPU both are the psum-synced NHWC
+batchnorm, so this re-exports :class:`apex_tpu.contrib.groupbn.
+BatchNorm2d_NHWC` under the reference name.
+"""
+
+from apex_tpu.contrib.groupbn import BatchNorm2d_NHWC as GroupBatchNorm2d
+
+__all__ = ["GroupBatchNorm2d"]

@@ -1,0 +1,3 @@
+from apex_tpu.contrib.fmha.fmha import FMHA
+
+__all__ = ["FMHA"]

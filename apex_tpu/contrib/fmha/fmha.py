@@ -1,0 +1,52 @@
+"""FMHA — BERT-style fused multi-head attention over variable-length batches.
+
+Counterpart of ``apex/contrib/fmha/fmha.py:33-90`` (+ ~6k LoC of sm80/90
+kernels under ``contrib/csrc/fmha`` capped at seq 512): packed-QKV attention
+where padding tokens are skipped via ``cu_seqlens`` offsets.
+
+TPU semantics: XLA wants static shapes, so the packed ``[total, 3, h, d]`` +
+``cu_seqlens`` interface becomes a padded ``[B, S, 3, h, d]`` + per-batch
+``seqlens`` — the flash kernel's ``kv_lengths`` masking gives the identical
+math (padded key positions contribute zero probability; padded query rows
+are zeroed on output), with no 512 cap.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops import flash_attention
+
+__all__ = ["FMHA"]
+
+
+@dataclass
+class FMHA:
+    """``config`` needs ``num_attention_heads``, ``hidden_size``, and
+    ``attention_probs_dropout_prob`` (reference ``fmha.py:62-70``; dropout
+    inside the kernel is not ported — compose dropout outside)."""
+
+    num_attention_heads: int
+    hidden_size: int
+    attention_probs_dropout_prob: float = 0.0
+
+    def __post_init__(self):
+        self.h = self.num_attention_heads
+        self.d = self.hidden_size // self.h
+        if self.d * self.h != self.hidden_size:
+            raise AssertionError("Invalid hidden size/num_heads")
+
+    def __call__(self, qkv: jax.Array, seqlens: jax.Array,
+                 is_training: bool = True) -> jax.Array:
+        """qkv: ``[B, S, 3*hidden]`` (or ``[B, S, 3, h, d]``), seqlens:
+        int32 ``[B]``. Returns ``[B, S, hidden]`` with padded rows zeroed."""
+        B, S = qkv.shape[0], qkv.shape[1]
+        qkv = qkv.reshape(B, S, 3, self.h, self.d)
+        q, k, v = (qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3))
+        ctx = flash_attention(q, k, v, kv_lengths=seqlens)
+        out = ctx.transpose(0, 2, 1, 3).reshape(B, S, self.hidden_size)
+        valid = jnp.arange(S)[None, :] < seqlens[:, None]
+        return out * valid[..., None].astype(out.dtype)

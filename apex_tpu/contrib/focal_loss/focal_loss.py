@@ -1,0 +1,52 @@
+"""Fused sigmoid focal loss.
+
+Counterpart of ``apex/contrib/focal_loss/focal_loss.py:6-60`` +
+``focal_loss_cuda_kernel.cu`` (label-smoothing constants at ``:33-38``):
+sigmoid focal loss (Lin et al.) over one-hot class targets, summed and
+normalized by ``num_positives_sum``. The CUDA kernel exists to fuse the
+one-hot materialization, BCE, modulating factor, and normalization into one
+pass with a stashed partial gradient; XLA fuses the same chain, and autodiff
+recomputes instead of stashing (cheaper than the HBM round-trip on TPU).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["focal_loss"]
+
+
+def focal_loss(
+    cls_output: jax.Array,
+    cls_targets_at_level: jax.Array,
+    num_positives_sum: jax.Array,
+    num_real_classes: int,
+    alpha: float,
+    gamma: float,
+    label_smoothing: float = 0.0,
+) -> jax.Array:
+    """Args mirror the reference function (``focal_loss.py:42-60``).
+
+    cls_output: ``[..., K_padded]`` logits (K_padded >= num_real_classes;
+    padded classes are ignored, matching the kernel's ``num_real_classes``
+    argument). cls_targets_at_level: integer class ids, ``-1``/out-of-range
+    = background (all-zero one-hot). Returns the scalar sum loss divided by
+    ``num_positives_sum``.
+    """
+    K = num_real_classes
+    x = cls_output[..., :K].astype(jnp.float32)
+    t = jax.nn.one_hot(cls_targets_at_level, K, dtype=jnp.float32)
+    if label_smoothing > 0.0:
+        # smoothed target (kernel constants focal_loss_cuda_kernel.cu:33-38)
+        t = t * (1.0 - label_smoothing) + label_smoothing / K
+
+    p = jax.nn.sigmoid(x)
+    # numerically-stable BCE with logits
+    ce = jnp.maximum(x, 0.0) - x * t + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    p_t = p * t + (1.0 - p) * (1.0 - t)
+    loss = ce * (1.0 - p_t) ** gamma
+    if alpha >= 0:
+        alpha_t = alpha * t + (1.0 - alpha) * (1.0 - t)
+        loss = alpha_t * loss
+    return jnp.sum(loss) / num_positives_sum

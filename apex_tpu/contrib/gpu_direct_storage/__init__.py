@@ -1,0 +1,35 @@
+"""Direct-storage tensor IO (reference ``apex/contrib/gpu_direct_storage``).
+
+The reference wraps cuFile (``gds.cpp``) for NVMe<->GPU DMA. TPUs have no
+user-visible DMA path — the distributed, host-bypassing persistence story on
+TPU is the orbax-backed sharded checkpointing in :mod:`apex_tpu.checkpoint`.
+``GDSFile`` here provides the reference's load/save file API over numpy
+memmap for raw-array interchange.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["GDSFile"]
+
+
+class GDSFile:
+    """Minimal ``GDSFile(name, mode)`` with ``load_data``/``save_data``
+    (reference ``contrib/gpu_direct_storage/__init__.py``)."""
+
+    def __init__(self, name: str, mode: str = "r"):
+        if mode not in ("r", "w"):
+            raise ValueError("mode must be 'r' or 'w'")
+        self.name, self.mode = name, mode
+
+    def save_data(self, array) -> None:
+        if self.mode != "w":
+            raise RuntimeError("file not opened for writing")
+        np.save(self.name, np.asarray(array), allow_pickle=False)
+
+    def load_data(self):
+        if self.mode != "r":
+            raise RuntimeError("file not opened for reading")
+        return np.load(self.name if self.name.endswith(".npy")
+                       else self.name + ".npy", mmap_mode="r")

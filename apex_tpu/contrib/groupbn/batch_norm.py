@@ -1,0 +1,99 @@
+"""NHWC BatchNorm with fused add+ReLU and cross-device groups.
+
+Counterpart of ``apex/contrib/groupbn/batch_norm.py:101-...`` ("group BN"):
+persistent NHWC batchnorm with optional fused residual-add + ReLU, and
+``bn_group > 1`` syncing statistics across a small cluster of devices. The
+reference does the sync with raw CUDA-IPC peer memory and hand-rolled
+handle exchange (``:150-180``); on TPU the same statistics sync is one
+``lax.psum`` over a mesh axis — the ``cudnn_gbn.GroupBatchNorm2d``
+capability collapses onto this module too.
+
+Functional state: ``apply`` returns ``(y, new_state)`` with updated running
+stats when ``training`` (torch mutates module buffers instead).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec
+
+from apex_tpu.transformer.tensor_parallel.mappings import axis_bound
+
+__all__ = ["BatchNorm2d_NHWC"]
+
+
+@dataclass
+class BatchNorm2d_NHWC:
+    """x: ``[N, H, W, C]``. ``bn_group_axis`` names the mesh axis whose
+    ranks share statistics (the reference's ``bn_group`` peer set)."""
+
+    num_features: int
+    fuse_relu: bool = False
+    bn_group: int = 1
+    bn_group_axis: Optional[str] = None
+    eps: float = 1e-5
+    momentum: float = 0.1
+
+    def init(self, key: Optional[jax.Array] = None) -> Dict[str, jax.Array]:
+        c = self.num_features
+        return {"weight": jnp.ones((c,)), "bias": jnp.zeros((c,))}
+
+    def init_state(self) -> Dict[str, jax.Array]:
+        c = self.num_features
+        return {"running_mean": jnp.zeros((c,)),
+                "running_var": jnp.ones((c,)),
+                "num_batches_tracked": jnp.zeros((), jnp.int32)}
+
+    def spec(self):
+        return {"weight": PartitionSpec(), "bias": PartitionSpec()}
+
+    def apply(self, params, state, x, z: Optional[jax.Array] = None,
+              *, training: bool = True) -> Tuple[jax.Array, Dict]:
+        """``z``: optional residual added before the (optional) ReLU — the
+        fused add+relu path (reference ``bn_addrelu_*`` kernels)."""
+        xdtype = x.dtype
+        x32 = x.astype(jnp.float32)
+        if training:
+            mean = jnp.mean(x32, axis=(0, 1, 2))
+            var = jnp.mean(jnp.square(x32 - mean), axis=(0, 1, 2))
+            group = 1
+            if self.bn_group > 1 and self.bn_group_axis and axis_bound(
+                    self.bn_group_axis):
+                # sync Welford-style stats across the group (reference IPC
+                # peer reduction -> one psum over the axis)
+                group = lax.axis_size(self.bn_group_axis)
+                if group != self.bn_group:
+                    raise ValueError(
+                        f"bn_group={self.bn_group} but mesh axis "
+                        f"'{self.bn_group_axis}' has {group} ranks; shape "
+                        f"the mesh so the axis matches the requested group")
+                sq = var + mean * mean
+                mean = lax.pmean(mean, self.bn_group_axis)
+                sq = lax.pmean(sq, self.bn_group_axis)
+                var = sq - mean * mean
+            # unbiased correction over the element count that actually
+            # contributed to `var` (local only unless the sync ran)
+            n = x32.shape[0] * x32.shape[1] * x32.shape[2] * group
+            unbiased = var * n / max(n - 1, 1)
+            new_state = {
+                "running_mean": (1 - self.momentum) * state["running_mean"]
+                + self.momentum * mean,
+                "running_var": (1 - self.momentum) * state["running_var"]
+                + self.momentum * unbiased,
+                "num_batches_tracked": state["num_batches_tracked"] + 1,
+            }
+        else:
+            mean, var = state["running_mean"], state["running_var"]
+            new_state = state
+        y = (x32 - mean) * lax.rsqrt(var + self.eps)
+        y = y * params["weight"] + params["bias"]
+        if z is not None:
+            y = y + z.astype(jnp.float32)
+        if self.fuse_relu:
+            y = jax.nn.relu(y)
+        return y.astype(xdtype), new_state

@@ -1,0 +1,27 @@
+"""Fused gather-multiply.
+
+Counterpart of ``apex/contrib/index_mul_2d/index_mul_2d.py:5-60`` +
+``index_mul_2d_cuda``: ``out = in1[idx1] * in2`` with the backward's
+scatter-add into ``d_in1``. One XLA gather fused with the multiply on TPU;
+the scatter-add backward falls out of autodiff (the transpose of gather).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["index_mul_2d"]
+
+
+def index_mul_2d(in1: jax.Array, in2: jax.Array,
+                 idx1: jax.Array) -> jax.Array:
+    """``out[i, :] = in1[idx1[i], :] * in2[i, :]`` (reference constraints:
+    2-D operands, index over dim 0, ``in2.shape[0] == idx1.shape[0]``)."""
+    if in1.ndim != 2 or in2.ndim != 2:
+        raise RuntimeError("in1 and in2 must be 2-dimension tensor.")
+    if idx1.ndim != 1:
+        raise RuntimeError("idx1 must be 1-dimension tensor.")
+    if in2.shape[0] != idx1.shape[0]:
+        raise RuntimeError("in2.shape[0] must equal idx1.shape[0]")
+    return jnp.take(in1, idx1, axis=0) * in2

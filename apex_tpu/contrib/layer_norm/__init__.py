@@ -1,0 +1,11 @@
+"""Fast layer norm (reference ``apex/contrib/layer_norm``).
+
+``FastLayerNorm`` (``contrib/layer_norm/layer_norm.py:43``) is the tuned
+hidden-size<=65k variant of the csrc fused LayerNorm; on TPU both map to the
+same Pallas kernel, so this is the reference import path over
+:class:`apex_tpu.normalization.FusedLayerNorm`.
+"""
+
+from apex_tpu.normalization import FusedLayerNorm as FastLayerNorm
+
+__all__ = ["FastLayerNorm"]

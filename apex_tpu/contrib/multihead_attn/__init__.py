@@ -1,0 +1,6 @@
+from apex_tpu.contrib.multihead_attn.attn import (
+    EncdecMultiheadAttn,
+    SelfMultiheadAttn,
+)
+
+__all__ = ["SelfMultiheadAttn", "EncdecMultiheadAttn"]

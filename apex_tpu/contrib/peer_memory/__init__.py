@@ -1,0 +1,17 @@
+"""Peer-memory halo exchange (reference ``apex/contrib/peer_memory``).
+
+The reference allocates CUDA-IPC peer memory pools (``peer_memory.py:5``,
+``peer_memory_cuda.cu``) so ``PeerHaloExchanger1d`` can write halos directly
+into a neighbor's buffer. XLA owns all TPU buffers — there is no user-level
+peer memory — and the capability (neighbor halo exchange) is the
+``ppermute`` implementation in :mod:`apex_tpu.contrib.bottleneck.
+halo_exchangers`, re-exported here. ``PeerMemoryPool`` has intentionally no
+TPU analog.
+"""
+
+from apex_tpu.contrib.bottleneck.halo_exchangers import (
+    HaloExchanger as PeerHaloExchanger1d,
+    halo_exchange_1d,
+)
+
+__all__ = ["PeerHaloExchanger1d", "halo_exchange_1d"]

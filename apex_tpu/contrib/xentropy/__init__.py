@@ -1,0 +1,14 @@
+"""Fused softmax cross entropy (reference ``apex/contrib/xentropy``).
+
+The kernel (``xentropy_kernel.cu``, 718 LoC) exists to avoid materializing
+softmax probabilities; the Pallas/XLA implementation lives in
+:mod:`apex_tpu.ops.cross_entropy` and is re-exported here at the reference's
+import path (``apex/contrib/xentropy/softmax_xentropy.py:6-30``).
+"""
+
+from apex_tpu.ops.cross_entropy import (
+    SoftmaxCrossEntropyLoss,
+    softmax_cross_entropy_loss,
+)
+
+__all__ = ["SoftmaxCrossEntropyLoss", "softmax_cross_entropy_loss"]

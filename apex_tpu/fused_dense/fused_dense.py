@@ -1,0 +1,107 @@
+"""Fused dense layers.
+
+Capability counterpart of ``apex/fused_dense/fused_dense.py:7-97`` +
+``csrc/fused_dense_cuda.cu:173-260``: Linear+bias and
+Linear+bias+GELU+Linear fused via cuBLASLt epilogues
+(``CUBLASLT_EPILOGUE_{BIAS,GELU_AUX,BGRADB}``). XLA performs the same
+epilogue fusion on TPU (bias add and GELU fuse into the matmul), so these
+are thin functional modules with the reference's API and init semantics;
+GELU uses the tanh approximation, matching the cuBLASLt epilogue.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec
+
+__all__ = [
+    "FusedDense",
+    "FusedDenseGeluDense",
+    "fused_dense_function",
+    "fused_dense_gelu_dense_function",
+]
+
+
+def fused_dense_function(x: jax.Array, weight: jax.Array,
+                         bias: Optional[jax.Array] = None) -> jax.Array:
+    """Reference ``_fused_dense``/``_dense_no_bias`` (``fused_dense.py:49-56``)."""
+    out = x @ weight.T.astype(x.dtype)
+    if bias is not None:
+        out = out + bias.astype(out.dtype)
+    return out
+
+
+def fused_dense_gelu_dense_function(x, weight1, bias1, weight2, bias2):
+    """Reference ``_fused_dense_gelu_dense`` (``fused_dense.py:59-61``)."""
+    h = fused_dense_function(x, weight1, bias1)
+    h = jax.nn.gelu(h, approximate=True)
+    return fused_dense_function(h, weight2, bias2)
+
+
+def _linear_init(key, out_features, in_features):
+    # nn.Linear-style kaiming-uniform bound (reference modules allocate
+    # empty params and reset like torch Linear)
+    bound = 1.0 / (in_features ** 0.5)
+    kw, kb = jax.random.split(key)
+    w = jax.random.uniform(kw, (out_features, in_features),
+                           minval=-bound, maxval=bound)
+    b = jax.random.uniform(kb, (out_features,), minval=-bound, maxval=bound)
+    return w, b
+
+
+@dataclass
+class FusedDense:
+    """Reference ``FusedDense`` (``fused_dense.py:64-80``)."""
+
+    in_features: int
+    out_features: int
+    bias: bool = True
+
+    def init(self, key: jax.Array) -> Dict[str, jax.Array]:
+        w, b = _linear_init(key, self.out_features, self.in_features)
+        return {"weight": w, "bias": b} if self.bias else {"weight": w}
+
+    def spec(self) -> Dict[str, PartitionSpec]:
+        s = {"weight": PartitionSpec()}
+        if self.bias:
+            s["bias"] = PartitionSpec()
+        return s
+
+    def apply(self, params: Dict[str, jax.Array], x: jax.Array) -> jax.Array:
+        return fused_dense_function(x, params["weight"], params.get("bias"))
+
+
+@dataclass
+class FusedDenseGeluDense:
+    """Reference ``FusedDenseGeluDense`` (``fused_dense.py:82-95``)."""
+
+    in_features: int
+    intermediate_features: int
+    out_features: int
+    bias: bool = True
+
+    def __post_init__(self):
+        if not self.bias:
+            # reference asserts bias=True (fused_dense.py:85-86)
+            raise AssertionError(
+                "DenseGeluDense module without bias is currently not supported")
+
+    def init(self, key: jax.Array) -> Dict[str, jax.Array]:
+        k1, k2 = jax.random.split(key)
+        w1, b1 = _linear_init(k1, self.intermediate_features, self.in_features)
+        w2, b2 = _linear_init(k2, self.out_features,
+                              self.intermediate_features)
+        return {"weight1": w1, "bias1": b1, "weight2": w2, "bias2": b2}
+
+    def spec(self) -> Dict[str, PartitionSpec]:
+        return {k: PartitionSpec()
+                for k in ("weight1", "bias1", "weight2", "bias2")}
+
+    def apply(self, params: Dict[str, jax.Array], x: jax.Array) -> jax.Array:
+        return fused_dense_gelu_dense_function(
+            x, params["weight1"], params["bias1"], params["weight2"],
+            params["bias2"])

@@ -1,0 +1,4 @@
+from apex_tpu.rnn.models import GRU, LSTM, ReLU, Tanh, mLSTM
+from apex_tpu.rnn.backend import RNNModel
+
+__all__ = ["LSTM", "GRU", "ReLU", "Tanh", "mLSTM", "RNNModel"]

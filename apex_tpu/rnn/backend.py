@@ -1,0 +1,152 @@
+"""RNN backend: time scan, layer stacking, bidirectionality.
+
+Counterpart of ``apex/RNN/RNNBackend.py`` (``bidirectionalRNN`` :25,
+``stackedRNN`` :90, ``RNNCell`` :232): where the reference drives a Python
+loop over timesteps with stateful hidden attributes, the TPU version is a
+``lax.scan`` over the time axis (one compile regardless of length) with
+hidden state threaded functionally; layers are a Python loop (heterogeneous
+input sizes), and bidirectionality runs a reversed scan and concatenates
+features — the same composition the reference builds from module wrappers.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec
+
+__all__ = ["RNNModel"]
+
+
+def _cell_param_shapes(gate_multiplier, input_size, hidden_size, output_size,
+                       bias, multiplicative):
+    gate_size = gate_multiplier * hidden_size
+    shapes = {"w_ih": (gate_size, input_size),
+              "w_hh": (gate_size, output_size)}
+    if output_size != hidden_size:
+        # recurrent projection (reference RNNCell w_ho, RNNBackend.py:253-255)
+        shapes["w_ho"] = (output_size, hidden_size)
+    if bias:
+        shapes["b_ih"] = (gate_size,)
+        shapes["b_hh"] = (gate_size,)
+    if multiplicative:
+        shapes["w_mih"] = (output_size, input_size)
+        shapes["w_mhh"] = (output_size, output_size)
+    return shapes
+
+
+@dataclass
+class RNNModel:
+    """A stacked (optionally bidirectional) recurrent model.
+
+    Built by the factory functions in :mod:`apex_tpu.rnn.models` (the
+    reference's ``toRNNBackend``, ``models.py:9-18``). Input layout is
+    time-major ``[T, B, input_size]`` unless ``batch_first``.
+    """
+
+    cell: Callable
+    gate_multiplier: int
+    n_hidden_states: int
+    input_size: int
+    hidden_size: int
+    num_layers: int
+    bias: bool = True
+    batch_first: bool = False
+    dropout: float = 0.0
+    bidirectional: bool = False
+    output_size: Optional[int] = None
+    multiplicative: bool = False
+
+    def __post_init__(self):
+        if self.output_size is None:
+            self.output_size = self.hidden_size
+
+    # -- parameters ---------------------------------------------------------
+
+    def _layer_shapes(self, layer: int) -> Dict[str, Tuple[int, ...]]:
+        directions = 2 if self.bidirectional else 1
+        in_size = (self.input_size if layer == 0
+                   else self.output_size * directions)
+        return _cell_param_shapes(self.gate_multiplier, in_size,
+                                  self.hidden_size, self.output_size,
+                                  self.bias, self.multiplicative)
+
+    def init(self, key: jax.Array) -> List:
+        """Uniform(-1/sqrt(hidden), 1/sqrt(hidden)) like the reference
+        (``RNNBackend.py:271-276``). Returns a list of per-layer dicts (pairs
+        of dicts when bidirectional)."""
+        stdev = 1.0 / self.hidden_size ** 0.5
+        directions = 2 if self.bidirectional else 1
+        params = []
+        for layer in range(self.num_layers):
+            shapes = self._layer_shapes(layer)
+            per_dir = []
+            for d in range(directions):
+                key, sub = jax.random.split(key)
+                keys = jax.random.split(sub, len(shapes))
+                per_dir.append({
+                    name: jax.random.uniform(k, shape, minval=-stdev,
+                                             maxval=stdev)
+                    for k, (name, shape) in zip(keys, sorted(shapes.items()))
+                })
+            params.append(per_dir if self.bidirectional else per_dir[0])
+        return params
+
+    def spec(self):
+        shapes = jax.eval_shape(self.init, jax.random.PRNGKey(0))
+        return jax.tree.map(lambda _: PartitionSpec(), shapes)
+
+    # -- forward ------------------------------------------------------------
+
+    def _zero_hidden(self, bsz, dtype):
+        h = jnp.zeros((bsz, self.output_size), dtype)
+        if self.n_hidden_states == 1:
+            return (h,)
+        return (h, jnp.zeros((bsz, self.hidden_size), dtype))
+
+    def _run_layer(self, p, x, h0, reverse):
+        def step(hidden, xt):
+            new = self.cell(xt, hidden, p)
+            out = new[0]
+            if "w_ho" in p:
+                out = out @ p["w_ho"].T
+                new = (out,) + tuple(new[1:])
+            return new, out
+
+        hT, outs = lax.scan(step, h0, x, reverse=reverse)
+        return outs, hT
+
+    def apply(self, params, x, hidden=None, *, rng=None,
+              deterministic: bool = True):
+        """Returns ``(output [T,B,out*dirs], final_hiddens)`` where
+        ``final_hiddens`` is a list (per layer) of hidden tuples (pairs of
+        tuples when bidirectional)."""
+        if self.batch_first:
+            x = x.transpose(1, 0, 2)
+        bsz = x.shape[1]
+        finals = []
+        for layer, p in enumerate(params):
+            dirs = p if self.bidirectional else [p]
+            h0s = (hidden[layer] if hidden is not None
+                   else [self._zero_hidden(bsz, x.dtype) for _ in dirs])
+            if not self.bidirectional and hidden is not None:
+                h0s = [hidden[layer]]
+            outs, hTs = [], []
+            for d, pd in enumerate(dirs):
+                o, hT = self._run_layer(pd, x, h0s[d], reverse=(d == 1))
+                outs.append(o)
+                hTs.append(hT)
+            x = jnp.concatenate(outs, axis=-1) if self.bidirectional else outs[0]
+            finals.append(hTs if self.bidirectional else hTs[0])
+            if (self.dropout > 0.0 and not deterministic and rng is not None
+                    and layer < self.num_layers - 1):
+                rng, sub = jax.random.split(rng)
+                keep = jax.random.bernoulli(sub, 1.0 - self.dropout, x.shape)
+                x = jnp.where(keep, x / (1.0 - self.dropout), 0.0)
+        if self.batch_first:
+            x = x.transpose(1, 0, 2)
+        return x, finals

@@ -1,0 +1,72 @@
+"""RNN cell functions.
+
+Pure-function counterparts of the torch fused cells the reference stacks
+(``apex/RNN/models.py:1-55`` imports ``LSTMCell/RNNReLUCell/RNNTanhCell/
+GRUCell`` from torch; ``apex/RNN/cells.py:56-...`` defines ``mLSTMCell``).
+Each takes ``(x [B,in], hidden, params)`` and returns the new hidden tuple;
+gate chunk order matches torch (i, f, g, o for LSTM; r, z, n for GRU) so
+parity tests can copy weights straight across.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["rnn_relu_cell", "rnn_tanh_cell", "lstm_cell", "gru_cell",
+           "mlstm_cell"]
+
+
+def _linear(x, w, b=None):
+    out = x @ w.T
+    return out if b is None else out + b
+
+
+def rnn_relu_cell(x, hidden, p):
+    (h,) = hidden
+    return (jax.nn.relu(_linear(x, p["w_ih"], p.get("b_ih"))
+                        + _linear(h, p["w_hh"], p.get("b_hh"))),)
+
+
+def rnn_tanh_cell(x, hidden, p):
+    (h,) = hidden
+    return (jnp.tanh(_linear(x, p["w_ih"], p.get("b_ih"))
+                     + _linear(h, p["w_hh"], p.get("b_hh"))),)
+
+
+def _lstm_gates(gates, c):
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    c_new = f * c + i * g
+    h_new = o * jnp.tanh(c_new)
+    return h_new, c_new
+
+
+def lstm_cell(x, hidden, p):
+    h, c = hidden
+    gates = (_linear(x, p["w_ih"], p.get("b_ih"))
+             + _linear(h, p["w_hh"], p.get("b_hh")))
+    return _lstm_gates(gates, c)
+
+
+def gru_cell(x, hidden, p):
+    (h,) = hidden
+    gi = _linear(x, p["w_ih"], p.get("b_ih"))
+    gh = _linear(h, p["w_hh"], p.get("b_hh"))
+    i_r, i_z, i_n = jnp.split(gi, 3, axis=-1)
+    h_r, h_z, h_n = jnp.split(gh, 3, axis=-1)
+    r = jax.nn.sigmoid(i_r + h_r)
+    z = jax.nn.sigmoid(i_z + h_z)
+    n = jnp.tanh(i_n + r * h_n)
+    return ((1.0 - z) * n + z * h,)
+
+
+def mlstm_cell(x, hidden, p):
+    """Multiplicative LSTM (reference ``cells.py:56-...``): the hidden state
+    is modulated by an input-dependent factor before the gate matmul."""
+    h, c = hidden
+    m = _linear(x, p["w_mih"]) * _linear(h, p["w_mhh"])
+    gates = (_linear(x, p["w_ih"], p.get("b_ih"))
+             + _linear(m, p["w_hh"], p.get("b_hh")))
+    return _lstm_gates(gates, c)

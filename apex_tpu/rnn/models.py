@@ -1,0 +1,76 @@
+"""RNN model factories.
+
+Parity with ``apex/RNN/models.py:21-55``: ``LSTM``, ``GRU``, ``ReLU``,
+``Tanh``, ``mLSTM`` — each returns a functional :class:`RNNModel` with the
+reference's gate multipliers and hidden-state counts.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from apex_tpu.rnn.backend import RNNModel
+from apex_tpu.rnn.cells import (
+    gru_cell,
+    lstm_cell,
+    mlstm_cell,
+    rnn_relu_cell,
+    rnn_tanh_cell,
+)
+
+__all__ = ["LSTM", "GRU", "ReLU", "Tanh", "mLSTM"]
+
+
+def _build(cell, gate_multiplier, n_hidden, input_size, hidden_size,
+           num_layers, bias, batch_first, dropout, bidirectional,
+           output_size, multiplicative=False):
+    return RNNModel(
+        cell=cell, gate_multiplier=gate_multiplier,
+        n_hidden_states=n_hidden, input_size=input_size,
+        hidden_size=hidden_size, num_layers=num_layers, bias=bias,
+        batch_first=batch_first, dropout=dropout,
+        bidirectional=bidirectional, output_size=output_size,
+        multiplicative=multiplicative)
+
+
+def LSTM(input_size, hidden_size, num_layers, bias=True, batch_first=False,
+         dropout=0.0, bidirectional=False, output_size: Optional[int] = None):
+    """Reference ``models.py:21-26`` (gate_multiplier=4, 2 hidden states)."""
+    return _build(lstm_cell, 4, 2, input_size, hidden_size, num_layers, bias,
+                  batch_first, dropout, bidirectional, output_size)
+
+
+def GRU(input_size, hidden_size, num_layers, bias=True, batch_first=False,
+        dropout=0.0, bidirectional=False, output_size: Optional[int] = None):
+    """Reference ``models.py:28-33`` (gate_multiplier=3, 1 hidden state)."""
+    if output_size is not None and output_size != hidden_size:
+        # GRU's update-gate mix (1-z)*n + z*h needs h in gate space; a
+        # recurrent projection would make the shapes incompatible (torch's
+        # GRUCell, which the reference stacks, has the same constraint)
+        raise ValueError(
+            "GRU does not support a recurrent projection "
+            f"(output_size={output_size} != hidden_size={hidden_size})")
+    return _build(gru_cell, 3, 1, input_size, hidden_size, num_layers, bias,
+                  batch_first, dropout, bidirectional, output_size)
+
+
+def ReLU(input_size, hidden_size, num_layers, bias=True, batch_first=False,
+         dropout=0.0, bidirectional=False, output_size: Optional[int] = None):
+    """Reference ``models.py:35-40``."""
+    return _build(rnn_relu_cell, 1, 1, input_size, hidden_size, num_layers,
+                  bias, batch_first, dropout, bidirectional, output_size)
+
+
+def Tanh(input_size, hidden_size, num_layers, bias=True, batch_first=False,
+         dropout=0.0, bidirectional=False, output_size: Optional[int] = None):
+    """Reference ``models.py:42-47``."""
+    return _build(rnn_tanh_cell, 1, 1, input_size, hidden_size, num_layers,
+                  bias, batch_first, dropout, bidirectional, output_size)
+
+
+def mLSTM(input_size, hidden_size, num_layers, bias=True, batch_first=False,
+          dropout=0.0, bidirectional=False, output_size: Optional[int] = None):
+    """Reference ``models.py:49-55`` + ``cells.py:12-53``."""
+    return _build(mlstm_cell, 4, 2, input_size, hidden_size, num_layers,
+                  bias, batch_first, dropout, bidirectional, output_size,
+                  multiplicative=True)

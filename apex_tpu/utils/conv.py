@@ -1,0 +1,27 @@
+"""Shared NHWC convolution helpers for the vision stack.
+
+NHWC + HWIO is the TPU-native layout (what the reference's channels-last /
+NHWC contrib kernels emulate on GPU); every conv in the framework routes
+through here so layout and initializer conventions stay in one place.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["conv_nhwc", "he_init"]
+
+
+def conv_nhwc(x, w, stride: int = 1, padding="SAME"):
+    """``x``: [N, H, W, Cin]; ``w``: [kh, kw, Cin, Cout]."""
+    return lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride), padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def he_init(key, shape, dtype=jnp.float32):
+    """Kaiming-normal for HWIO conv weights (fan_in = kh*kw*Cin)."""
+    fan_in = shape[0] * shape[1] * shape[2]
+    return jax.random.normal(key, shape, dtype) * (2.0 / fan_in) ** 0.5
