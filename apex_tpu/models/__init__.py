@@ -17,8 +17,34 @@ from apex_tpu.models.transformer import (
 from apex_tpu.models.gpt import GPTModel
 from apex_tpu.models.bert import BertModel
 from apex_tpu.models.pipelined import PipelinedGPT
+from apex_tpu.models.resnet import (
+    ResNet,
+    ResNetConfig,
+    resnet18,
+    resnet34,
+    resnet50,
+    resnet101,
+    resnet152,
+)
+from apex_tpu.models.dcgan import DCGANConfig, Discriminator, Generator
+from apex_tpu.models.vit import ViTConfig, ViTModel, vit_b16, vit_l16, vit_h14
 
 __all__ = [
+    "ResNet",
+    "ResNetConfig",
+    "resnet18",
+    "resnet34",
+    "resnet50",
+    "resnet101",
+    "resnet152",
+    "DCGANConfig",
+    "Generator",
+    "Discriminator",
+    "ViTConfig",
+    "ViTModel",
+    "vit_b16",
+    "vit_l16",
+    "vit_h14",
     "TransformerConfig",
     "ParallelMLP",
     "ParallelAttention",

@@ -1,0 +1,55 @@
+"""Shared functional batch-norm primitives for the vision models.
+
+Used by :mod:`apex_tpu.models.resnet` and :mod:`apex_tpu.models.dcgan`.
+Statistics are always fp32 regardless of activation dtype (the reference's
+``keep_batchnorm_fp32`` amp rule, ``fp16_utils/fp16util.py:60``), and the
+training-mode reduction optionally ``psum``s over a named mesh axis — the
+SyncBN merge of ``apex/parallel/optimized_sync_batchnorm_kernel.py:7-120``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["bn_init", "bn_apply"]
+
+
+def bn_init(c: int):
+    """Returns ``(params, state)`` for a ``c``-channel batch norm."""
+    return ({"scale": jnp.ones((c,), jnp.float32),
+             "bias": jnp.zeros((c,), jnp.float32)},
+            {"mean": jnp.zeros((c,), jnp.float32),
+             "var": jnp.ones((c,), jnp.float32)})
+
+
+def bn_apply(p, s, x, *, train: bool, momentum: float, eps: float,
+             axis_name: Optional[str]):
+    """NHWC batch norm; returns ``(y, new_state)``. With ``axis_name`` bound
+    the batch statistics are synchronized across that mesh axis."""
+    x32 = x.astype(jnp.float32)
+    if train:
+        n = jnp.asarray(x32.shape[0] * x32.shape[1] * x32.shape[2],
+                        jnp.float32)
+        total = jnp.sum(x32, axis=(0, 1, 2))
+        if axis_name is not None:
+            total = lax.psum(total, axis_name)
+            n = lax.psum(n, axis_name)
+        mean = total / n
+        sq = jnp.sum(jnp.square(x32 - mean), axis=(0, 1, 2))
+        if axis_name is not None:
+            sq = lax.psum(sq, axis_name)
+        var = sq / n
+        new_s = {
+            "mean": (1 - momentum) * s["mean"] + momentum * mean,
+            # running var uses the unbiased estimate, torch BN semantics
+            "var": (1 - momentum) * s["var"]
+                   + momentum * var * n / jnp.maximum(n - 1, 1.0),
+        }
+    else:
+        mean, var, new_s = s["mean"], s["var"], s
+    inv = lax.rsqrt(var + eps)
+    y = (x32 - mean) * (inv * p["scale"]) + p["bias"]
+    return y.astype(x.dtype), new_s
