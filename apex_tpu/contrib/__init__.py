@@ -4,9 +4,8 @@ Submodules (reference build flags in ``setup.py:110-860``):
 ``clip_grad``, ``focal_loss``, ``index_mul_2d``, ``group_norm``, ``groupbn``,
 ``cudnn_gbn``, ``multihead_attn``, ``fmha``, ``transducer``, ``bottleneck``
 (+ ``peer_memory`` halo exchange), ``sparsity`` (ASP 2:4), ``xentropy``,
-``layer_norm``, ``gpu_direct_storage``. The reference's ``openfold_triton``
-is Triton-specific acceleration whose constituent ops (fused LayerNorm, MHA,
-fused Adam+SWA) exist here as the general kernels in ``apex_tpu.ops`` /
-``apex_tpu.optimizers``; ``nccl_p2p``/``nccl_allocator`` are NCCL plumbing
+``layer_norm``, ``conv_bias_relu``, ``gpu_direct_storage``, ``openfold``
+(the reference's ``openfold_triton``: Pallas LayerNorm/MHA re-exports +
+``FusedAdamSWA``). ``nccl_p2p``/``nccl_allocator`` are NCCL plumbing
 with no TPU analog (XLA owns collectives and buffers).
 """

@@ -1,0 +1,52 @@
+"""Fused conv epilogues.
+
+Counterpart of ``apex/contrib/conv_bias_relu/conv_bias_relu.py:12-78``
+(cuDNN-frontend fused graphs in ``contrib/csrc/conv_bias_relu/conv_bias_relu
+.cpp``, 2 153 LoC): conv + bias (+ mask) (+ ReLU) and the frozen-BN
+scale/bias variant. On TPU these are single jitted expressions — XLA fuses
+the elementwise epilogue into the convolution's output tiles, which is the
+entire reason the CUDA versions exist — so each "module" is a function.
+
+Layout is NHWC (the reference requires channels_last memory format).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.utils.conv import conv_nhwc
+
+__all__ = ["ConvBiasReLU", "ConvBias", "ConvBiasMaskReLU",
+           "ConvFrozenScaleBiasReLU"]
+
+
+def ConvBias(x: jax.Array, weight: jax.Array, bias: jax.Array,
+             stride: int = 1, padding="SAME") -> jax.Array:
+    """``conv(x, w) + b`` (reference ``ConvBias_``, epilogue
+    ``CUDNN_POINTWISE_ADD``)."""
+    return conv_nhwc(x, weight, stride, padding) + bias
+
+
+def ConvBiasReLU(x: jax.Array, weight: jax.Array, bias: jax.Array,
+                 stride: int = 1, padding="SAME") -> jax.Array:
+    """``relu(conv(x, w) + b)`` (reference ``ConvBiasReLU_``)."""
+    return jax.nn.relu(ConvBias(x, weight, bias, stride, padding))
+
+
+def ConvBiasMaskReLU(x: jax.Array, weight: jax.Array, bias: jax.Array,
+                     mask: jax.Array, stride: int = 1,
+                     padding="SAME") -> jax.Array:
+    """``relu((conv(x, w) + b) * mask)`` (reference ``ConvBiasMaskReLU_`` —
+    the mask is the dropout/attention byte mask fused into the epilogue)."""
+    return jax.nn.relu(ConvBias(x, weight, bias, stride, padding) * mask)
+
+
+def ConvFrozenScaleBiasReLU(x: jax.Array, weight: jax.Array,
+                            scale: jax.Array, bias: jax.Array,
+                            stride: int = 1, padding="SAME") -> jax.Array:
+    """``relu(conv(x, w) * scale + bias)`` — frozen-BN folding (reference
+    ``ConvFrozenScaleBiasReLU_``)."""
+    return jax.nn.relu(conv_nhwc(x, weight, stride, padding) * scale + bias)

@@ -1,0 +1,84 @@
+"""OpenFold acceleration tier.
+
+Counterpart of ``apex/contrib/openfold_triton`` (the reference's only
+non-CUDA kernels — Triton LayerNorm fwd/bwd, MHA, and a fused Adam+SWA
+optimizer, ``contrib/openfold_triton/__init__.py:41-97``). On TPU the
+LayerNorm and MHA kernels are the framework's own Pallas ops (re-exported
+here so OpenFold-style callers find them under one roof), and the Triton
+autotune-cache broadcast (``sync_triton_auto_tune_cache_across_gpus``) has
+no analog — XLA's compilation cache is process-global — so it is a no-op
+kept for API parity.
+
+:class:`FusedAdamSWA` is the real capability: one fused update doing the
+Adam math and the stochastic-weight-averaging EMA in a single pass
+(reference ``fused_adam_swa.py:102-199``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops import (  # re-exports for OpenFold-style callers
+    flash_attention as mha,
+    fused_layer_norm_affine as layer_norm,
+)
+from apex_tpu.optimizers.fused_adam import FusedAdam
+
+__all__ = ["FusedAdamSWA", "layer_norm", "mha",
+           "sync_triton_auto_tune_cache_across_gpus"]
+
+
+def sync_triton_auto_tune_cache_across_gpus(*_args, **_kw) -> None:
+    """No-op: XLA's compile cache is shared process-wide (parity with
+    ``openfold_triton.sync_triton_auto_tune_cache_across_gpus``)."""
+
+
+class FusedAdamSWA(FusedAdam):
+    """Adam + stochastic weight averaging in one fused step.
+
+    Semantics of the reference's ``_swa_math`` (``fused_adam_swa.py:102-112``):
+    the first averaged step copies params into the SWA buffer; later steps do
+    ``swa += (1 - decay) * (p - swa)``. State carries ``swa_params`` and
+    ``n_averaged`` alongside the Adam slots.
+    """
+
+    def __init__(self, lr: float = 1e-3, *, swa_decay_rate: float = 0.9,
+                 **adam_kw):
+        super().__init__(lr=lr, **adam_kw)
+        self.swa_decay_rate = swa_decay_rate
+
+    def init(self, params) -> dict:
+        state = super().init(params)
+        # forced copy: donating params + state together must never alias
+        state["swa_params"] = jax.tree.map(
+            lambda p: jnp.array(p, jnp.float32, copy=True), params)
+        state["n_averaged"] = jnp.zeros((), jnp.int32)
+        return state
+
+    def step(self, grads, params, state, *, lr: Optional[Any] = None,
+             grad_scale: Optional[jax.Array] = None,
+             found_inf: Optional[jax.Array] = None) -> Tuple[Any, dict]:
+        swa_old = state["swa_params"]
+        n_avg = state["n_averaged"]
+        new_params, new_state = super().step(
+            grads, params, state, lr=lr, grad_scale=grad_scale,
+            found_inf=found_inf)
+        decay = self.swa_decay_rate
+
+        def swa_upd(swa, p):
+            p32 = p.astype(jnp.float32)
+            return jnp.where(n_avg == 0, p32,
+                             swa + (1.0 - decay) * (p32 - swa))
+
+        new_swa = jax.tree.map(swa_upd, swa_old, new_params)
+        stepped = jnp.asarray(True)
+        if found_inf is not None:
+            stepped = jnp.logical_not(found_inf)
+            new_swa = jax.tree.map(
+                lambda n, o: jnp.where(stepped, n, o), new_swa, swa_old)
+        new_state["swa_params"] = new_swa
+        new_state["n_averaged"] = n_avg + stepped.astype(jnp.int32)
+        return new_params, new_state

@@ -191,9 +191,7 @@ class ResNet:
             params["stem"]["bn"], state["stem"]["bn"], out, train)
         out = jax.nn.relu(out)
         out = lax.reduce_window(
-            out, -jnp.inf if out.dtype == jnp.float32 else
-            jnp.finfo(out.dtype).min.astype(out.dtype),
-            lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME")
+            out, -jnp.inf, lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME")
         for si, nblocks in enumerate(cfg.stage_blocks):
             stage_p = params[f"layer{si + 1}"]
             stage_s = state[f"layer{si + 1}"]

@@ -8,9 +8,11 @@ from apex_tpu.optimizers.fused_novograd import FusedNovoGrad
 from apex_tpu.optimizers.fused_adagrad import FusedAdagrad
 from apex_tpu.optimizers.fused_mixed_precision_lamb import FusedMixedPrecisionLamb
 from apex_tpu.optimizers.distributed_fused_adam import DistributedFusedAdam
+from apex_tpu.optimizers.distributed_fused_lamb import DistributedFusedLAMB
 
 __all__ = [
     "DistributedFusedAdam",
+    "DistributedFusedLAMB",
     "FusedOptimizer",
     "FusedAdam",
     "FusedAdamW",

@@ -80,7 +80,11 @@ class FusedOptimizer:
             "slots": self._init_slots(p32),
         }
         if self.master_weights:
-            state["master"] = p32
+            # force a distinct buffer even when params are already fp32
+            # (f32() is a no-op then) so donating params + opt state together
+            # never aliases the same buffer twice
+            state["master"] = tree_map(
+                lambda p: jnp.array(p, jnp.float32, copy=True), params)
         return state
 
     def step(self, grads, params, state, *, lr: Optional[Any] = None,

@@ -240,19 +240,15 @@ class DistributedFusedAdam(FusedAdam):
         return {"step": PartitionSpec(), "master": p, "exp_avg": p,
                 "exp_avg_sq": p}
 
-    def step(self, grads, params, state, *, lr: Optional[Any] = None,
-             grad_scale: Optional[jax.Array] = None,
-             found_inf: Optional[jax.Array] = None) -> Tuple[Any, dict]:
-        """Per-rank view inside ``shard_map``: ``grads``/``params`` are this
-        rank's local pytrees, state leaves are ``[1, 1..., chunk]`` shards.
-        Outside ``shard_map`` (world size 1) it degrades to FusedAdam on the
-        flat buffer."""
-        lr = self.lr if lr is None else lr
+    def _sync_grads(self, grads, grad_scale) -> Tuple[jax.Array, bool]:
+        """Shared sharded-gradient prologue: validate the bound axis,
+        flatten + unscale local grads, reduce-scatter (mean) to this rank's
+        shard. Returns ``(g_local, sharded)``."""
         if axis_bound(self.axis_name):
             axis_size = lax.axis_size(self.axis_name)  # static at trace time
             if axis_size != self.num_shards:
                 raise ValueError(
-                    f"DistributedFusedAdam was built with num_shards="
+                    f"{type(self).__name__} was built with num_shards="
                     f"{self.num_shards} but the bound '{self.axis_name}' "
                     f"axis has size {axis_size}; gradients would silently "
                     "desynchronize. Construct the optimizer after "
@@ -265,11 +261,20 @@ class DistributedFusedAdam(FusedAdam):
         if sharded:
             # reduce-scatter = grad sync + shard selection in one collective
             # (reference grad-sync pipeline, distributed_fused_adam.py:811-885)
-            g_local = lax.psum_scatter(g_flat, self.axis_name,
-                                       scatter_dimension=0, tiled=True)
-            g_local = g_local / self.num_shards
-        else:
-            g_local = g_flat
+            g_flat = lax.psum_scatter(g_flat, self.axis_name,
+                                      scatter_dimension=0, tiled=True)
+            g_flat = g_flat / self.num_shards
+        return g_flat, sharded
+
+    def step(self, grads, params, state, *, lr: Optional[Any] = None,
+             grad_scale: Optional[jax.Array] = None,
+             found_inf: Optional[jax.Array] = None) -> Tuple[Any, dict]:
+        """Per-rank view inside ``shard_map``: ``grads``/``params`` are this
+        rank's local pytrees, state leaves are ``[1, 1..., chunk]`` shards.
+        Outside ``shard_map`` (world size 1) it degrades to FusedAdam on the
+        flat buffer."""
+        lr = self.lr if lr is None else lr
+        g_local, sharded = self._sync_grads(grads, grad_scale)
 
         shard_shape = state["master"].shape
         p_local = state["master"].reshape(-1)

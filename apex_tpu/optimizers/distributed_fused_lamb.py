@@ -1,0 +1,164 @@
+"""ZeRO-sharded LAMB.
+
+TPU-native counterpart of ``apex/contrib/optimizers/distributed_fused_lamb.py``
+(``DistributedFusedLAMB`` at ``:24-108``): NVLAMB with reduce-scattered
+gradients, sharded fp32 master/moment state, and an all-gather of updated
+params — the reference's reduce-scatter+all-reduce NCCL pipeline and e5m2
+compressed all-gather collapse onto one ``psum_scatter`` / ``all_gather``
+pair over the data mesh axis (compression is XLA's transfer-layer concern).
+
+What makes sharded LAMB harder than sharded Adam: the trust ratio needs
+*per-parameter-tensor* norms ``||p|| / ||update||``, but each rank holds only
+a slice of the flat buffer, and leaf boundaries do not align with shard
+boundaries. Solution: a static segment-id map over the flat layout
+(``jax.ops.segment_sum`` of the local partial sums of squares, one ``psum``
+over the data axis), mirroring how the reference's
+``multi_tensor_distopt_lamb`` kernels accumulate per-tensor partials across
+chunks before the global reduction.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from apex_tpu.optimizers.distributed_fused_adam import DistributedFusedAdam
+from apex_tpu.transformer.parallel_state import DATA_AXIS
+
+__all__ = ["DistributedFusedLAMB"]
+
+
+class DistributedFusedLAMB(DistributedFusedAdam):
+    """LAMB with data-parallel-sharded state.
+
+    Hyperparameters mirror :class:`apex_tpu.optimizers.FusedLAMB` (NVLAMB:
+    global grad-norm clip factor, Adam moments, per-tensor trust ratio);
+    state layout, ``init`` and ``state_spec`` are inherited from
+    :class:`DistributedFusedAdam` (same three fp32 slots).
+    """
+
+    def __init__(self, lr: float = 1e-3, *, num_shards: Optional[int] = None,
+                 axis_name: str = DATA_AXIS, bias_correction: bool = True,
+                 betas: Tuple[float, float] = (0.9, 0.999), eps: float = 1e-6,
+                 weight_decay: float = 0.01, adam_w_mode: bool = True,
+                 grad_averaging: bool = True, max_grad_norm: float = 1.0,
+                 trust_clip: bool = False, always_adapt: bool = False):
+        super().__init__(lr=lr, num_shards=num_shards, axis_name=axis_name,
+                         bias_correction=bias_correction, betas=betas,
+                         eps=eps, adam_w_mode=adam_w_mode,
+                         weight_decay=weight_decay)
+        self.grad_averaging = grad_averaging
+        self.max_grad_norm = max_grad_norm
+        self.trust_clip = trust_clip
+        self.always_adapt = always_adapt
+        self._segment_cache: dict = {}
+
+    # -- segment map ---------------------------------------------------------
+
+    def _segment_ids(self, params) -> Tuple[jax.Array, int]:
+        """int32 ``[num_shards * chunk]`` mapping each flat-buffer slot to its
+        leaf index; padding maps to a dead segment ``n_leaves``."""
+        leaves = jax.tree_util.tree_leaves(params)
+        sizes = tuple(int(np.prod(l.shape, dtype=np.int64)) for l in leaves)
+        if sizes not in self._segment_cache:
+            total = sum(sizes)
+            chunk = self._chunk_size(total)
+            padded = chunk * self.num_shards
+            ids = np.full((padded,), len(sizes), dtype=np.int32)
+            off = 0
+            for i, n in enumerate(sizes):
+                ids[off:off + n] = i
+                off += n
+            self._segment_cache[sizes] = ids      # numpy: safe across traces
+        return jnp.asarray(self._segment_cache[sizes]), len(sizes)
+
+    # -- step ----------------------------------------------------------------
+
+    def step(self, grads, params, state, *, lr: Optional[Any] = None,
+             grad_scale: Optional[jax.Array] = None,
+             found_inf: Optional[jax.Array] = None) -> Tuple[Any, dict]:
+        lr = self.lr if lr is None else lr
+        g, sharded = self._sync_grads(grads, grad_scale)
+        chunk = g.shape[0]
+
+        # phase 1: global grad norm of the synced grad -> clip factor
+        # (reference two-phase NVLAMB, fused_lamb.py:167-185)
+        gsumsq = jnp.sum(g * g)
+        if sharded:
+            gsumsq = lax.psum(gsumsq, self.axis_name)
+        gnorm = jnp.sqrt(gsumsq)
+        clip = jnp.where(
+            (self.max_grad_norm > 0.0) & (gnorm > self.max_grad_norm),
+            gnorm / self.max_grad_norm, 1.0)
+        g = g / clip
+
+        ids_full, n_leaves = self._segment_ids(params)
+        if sharded:
+            ids = lax.dynamic_slice(
+                ids_full, (lax.axis_index(self.axis_name) * chunk,), (chunk,))
+        else:
+            ids = ids_full
+
+        # phase 2: Adam moments + per-tensor trust-ratio step on the shard
+        b1, b2 = self.betas
+        step_c = state["step"] + 1
+        t = step_c.astype(jnp.float32)
+        bc1 = 1.0 - b1 ** t if self.bias_correction else 1.0
+        bc2 = 1.0 - b2 ** t if self.bias_correction else 1.0
+        beta3 = 1.0 - b1 if self.grad_averaging else 1.0
+        wd = self.weight_decay
+
+        shard_shape = state["master"].shape
+        p = state["master"].reshape(-1)
+        m = state["exp_avg"].reshape(-1)
+        v = state["exp_avg_sq"].reshape(-1)
+
+        if not self.adam_w_mode and wd != 0.0:
+            g = g + wd * p
+        m = b1 * m + beta3 * g
+        v = b2 * v + (1.0 - b2) * g * g
+        update = (m / bc1) / (jnp.sqrt(v / bc2) + self.eps)
+        if self.adam_w_mode and wd != 0.0:
+            update = update + wd * p
+
+        if wd != 0.0 or self.always_adapt:
+            nseg = n_leaves + 1          # +1 dead segment for padding
+            w_sumsq = jax.ops.segment_sum(p * p, ids, num_segments=nseg)
+            u_sumsq = jax.ops.segment_sum(update * update, ids,
+                                          num_segments=nseg)
+            if sharded:
+                w_sumsq = lax.psum(w_sumsq, self.axis_name)
+                u_sumsq = lax.psum(u_sumsq, self.axis_name)
+            w_norm = jnp.sqrt(w_sumsq)
+            u_norm = jnp.sqrt(u_sumsq)
+            ratio = jnp.where((w_norm > 0) & (u_norm > 0),
+                              w_norm / jnp.maximum(u_norm, 1e-30), 1.0)
+            if self.trust_clip:
+                ratio = jnp.minimum(ratio, 1.0)
+            scale_e = ratio[ids]
+        else:
+            scale_e = 1.0
+
+        new_p = p - lr * scale_e * update
+        new_m, new_v = m, v
+        if found_inf is not None:
+            new_p = jnp.where(found_inf, p, new_p)
+            new_m = jnp.where(found_inf, state["exp_avg"].reshape(-1), new_m)
+            new_v = jnp.where(found_inf, state["exp_avg_sq"].reshape(-1),
+                              new_v)
+            step_c = jnp.where(found_inf, state["step"], step_c)
+
+        full = (lax.all_gather(new_p, self.axis_name, tiled=True)
+                if sharded else new_p)
+        new_params = self._unflatten_local(full, params)
+        new_state = {
+            "step": step_c,
+            "master": new_p.reshape(shard_shape),
+            "exp_avg": new_m.reshape(shard_shape),
+            "exp_avg_sq": new_v.reshape(shard_shape),
+        }
+        return new_params, new_state

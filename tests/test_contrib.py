@@ -361,3 +361,124 @@ class TestFMHA:
                                        np.asarray(sub[0]),
                                        rtol=1e-4, atol=1e-5)
             np.testing.assert_allclose(np.asarray(out[b, L:]), 0.0)
+
+
+class TestConvBiasReLU:
+    """apex/contrib/conv_bias_relu parity: epilogue math vs unfused ops."""
+
+    def _data(self):
+        k = jax.random.PRNGKey(0)
+        k1, k2, k3 = jax.random.split(k, 3)
+        x = jax.random.normal(k1, (2, 8, 8, 4))
+        w = jax.random.normal(k2, (3, 3, 4, 6)) * 0.1
+        b = jax.random.normal(k3, (6,))
+        return x, w, b
+
+    def test_conv_bias_relu(self):
+        from apex_tpu.contrib.conv_bias_relu import ConvBias, ConvBiasReLU
+        from apex_tpu.utils.conv import conv_nhwc
+
+        x, w, b = self._data()
+        ref = conv_nhwc(x, w) + b
+        np.testing.assert_allclose(np.asarray(ConvBias(x, w, b)),
+                                   np.asarray(ref), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(ConvBiasReLU(x, w, b)),
+                                   np.maximum(np.asarray(ref), 0), rtol=1e-6)
+
+    def test_conv_bias_mask_relu(self):
+        from apex_tpu.contrib.conv_bias_relu import ConvBiasMaskReLU
+        from apex_tpu.utils.conv import conv_nhwc
+
+        x, w, b = self._data()
+        mask = (jax.random.uniform(jax.random.PRNGKey(7),
+                                   (2, 8, 8, 6)) > 0.5).astype(x.dtype)
+        ref = np.maximum(np.asarray((conv_nhwc(x, w) + b) * mask), 0)
+        np.testing.assert_allclose(
+            np.asarray(ConvBiasMaskReLU(x, w, b, mask)), ref, rtol=1e-6)
+
+    def test_frozen_scale_bias(self):
+        from apex_tpu.contrib.conv_bias_relu import ConvFrozenScaleBiasReLU
+        from apex_tpu.utils.conv import conv_nhwc
+
+        x, w, _ = self._data()
+        scale = jnp.full((6,), 1.5)
+        bias = jnp.full((6,), -0.25)
+        ref = np.maximum(np.asarray(conv_nhwc(x, w) * scale + bias), 0)
+        np.testing.assert_allclose(
+            np.asarray(ConvFrozenScaleBiasReLU(x, w, scale, bias)), ref,
+            rtol=1e-6)
+
+    def test_grad_flows(self):
+        from apex_tpu.contrib.conv_bias_relu import ConvBiasReLU
+
+        x, w, b = self._data()
+        g = jax.grad(lambda w: jnp.sum(ConvBiasReLU(x, w, b)))(w)
+        assert np.isfinite(np.asarray(g)).all()
+
+
+class TestFusedAdamSWA:
+    """apex/contrib/openfold_triton FusedAdamSWA semantics
+    (fused_adam_swa.py:102-112)."""
+
+    def _setup(self):
+        params = {"w": jnp.ones((4, 4)), "b": jnp.zeros((4,))}
+        grads = {"w": jnp.full((4, 4), 0.1), "b": jnp.full((4,), 0.2)}
+        return params, grads
+
+    def test_first_step_copies_params(self):
+        from apex_tpu.contrib.openfold import FusedAdamSWA
+
+        params, grads = self._setup()
+        opt = FusedAdamSWA(lr=1e-2, swa_decay_rate=0.9)
+        state = opt.init(params)
+        new_p, new_s = opt.step(grads, params, state)
+        assert int(new_s["n_averaged"]) == 1
+        # n_averaged was 0 -> SWA buffer = stepped params exactly
+        jax.tree.map(lambda s, p: np.testing.assert_allclose(s, p),
+                     new_s["swa_params"], new_p)
+
+    def test_ema_after_first(self):
+        from apex_tpu.contrib.openfold import FusedAdamSWA
+
+        params, grads = self._setup()
+        decay = 0.8
+        opt = FusedAdamSWA(lr=1e-2, swa_decay_rate=decay)
+        state = opt.init(params)
+        p1, s1 = opt.step(grads, params, state)
+        p2, s2 = opt.step(grads, p1, s1)
+        expect = jax.tree.map(
+            lambda swa, p: swa + (1 - decay) * (p - swa),
+            s1["swa_params"], p2)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6),
+            s2["swa_params"], expect)
+        assert int(s2["n_averaged"]) == 2
+
+    def test_adam_math_matches_fused_adam(self):
+        from apex_tpu.contrib.openfold import FusedAdamSWA
+        from apex_tpu.optimizers import FusedAdam
+
+        params, grads = self._setup()
+        swa = FusedAdamSWA(lr=1e-2, swa_decay_rate=0.9, weight_decay=0.01)
+        ref = FusedAdam(lr=1e-2, weight_decay=0.01)
+        ps, ss = params, swa.init(params)
+        pr, sr = params, ref.init(params)
+        for _ in range(3):
+            ps, ss = swa.step(grads, ps, ss)
+            pr, sr = ref.step(grads, pr, sr)
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6),
+                     ps, pr)
+
+    def test_found_inf_freezes_swa(self):
+        from apex_tpu.contrib.openfold import FusedAdamSWA
+
+        params, grads = self._setup()
+        opt = FusedAdamSWA(lr=1e-2)
+        state = opt.init(params)
+        new_p, new_s = opt.step(grads, params, state,
+                                found_inf=jnp.asarray(True))
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b),
+                     new_p, params)
+        assert int(new_s["n_averaged"]) == 0
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b),
+                     new_s["swa_params"], state["swa_params"])

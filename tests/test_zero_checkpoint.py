@@ -243,3 +243,70 @@ class TestBatchSamplers:
                 data_parallel_size=2))
             assert len(resumed) == (32 - consumed % 32) // 4
             assert all(len(b) == 2 for b in resumed)
+
+
+class TestDistributedFusedLAMB:
+    """ZeRO LAMB: trust ratios computed from cross-shard segment norms must
+    reproduce the unsharded FusedLAMB exactly (reference
+    ``apex/contrib/test/optimizers/test_dist_lamb.py`` strategy)."""
+
+    def test_matches_fused_lamb_unsharded(self):
+        from apex_tpu.optimizers import DistributedFusedLAMB, FusedLAMB
+
+        parallel_state.destroy_model_parallel()
+        params = _params()
+        grads = _grads()
+        ref = FusedLAMB(lr=1e-2, weight_decay=0.01)
+        dist = DistributedFusedLAMB(lr=1e-2, weight_decay=0.01, num_shards=1)
+        rstate, dstate = ref.init(params), dist.init(params)
+        p_ref, p_dist = params, params
+        for _ in range(3):
+            p_ref, rstate = ref.step(grads, p_ref, rstate)
+            p_dist, dstate = dist.step(grads, p_dist, dstate)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5,
+                                                    atol=1e-6),
+            p_ref, p_dist)
+
+    def test_zero_lamb_matches_replicated(self):
+        from apex_tpu.optimizers import DistributedFusedLAMB, FusedLAMB
+
+        harness = TestDistributedFusedAdamSharded()
+        ref_losses, ref_p, _ = harness._train(FusedLAMB(lr=1e-2))
+        z_losses, z_p, z_s = harness._train(
+            DistributedFusedLAMB(lr=1e-2, num_shards=8))
+        np.testing.assert_allclose(z_losses, ref_losses, rtol=1e-5)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4,
+                                                    atol=1e-6),
+            z_p, ref_p)
+        assert z_s["master"].shape[0] == 8
+
+    def test_no_decay_no_adapt_matches_adam_shape(self):
+        from apex_tpu.optimizers import DistributedFusedLAMB
+
+        parallel_state.destroy_model_parallel()
+        params = _params()
+        grads = _grads()
+        opt = DistributedFusedLAMB(lr=1e-2, weight_decay=0.0, num_shards=1)
+        state = opt.init(params)
+        new_p, new_state = opt.step(grads, params, state)
+        assert int(new_state["step"]) == 1
+        changed = jax.tree.map(
+            lambda a, b: bool(np.any(np.asarray(a) != np.asarray(b))),
+            new_p, params)
+        assert all(jax.tree.leaves(changed))
+
+    def test_found_inf_skips(self):
+        from apex_tpu.optimizers import DistributedFusedLAMB
+
+        parallel_state.destroy_model_parallel()
+        params = _params()
+        grads = _grads()
+        opt = DistributedFusedLAMB(lr=1e-2, num_shards=1)
+        state = opt.init(params)
+        new_p, new_state = opt.step(grads, params, state,
+                                    found_inf=jnp.asarray(True))
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b),
+                     new_p, params)
+        assert int(new_state["step"]) == 0
