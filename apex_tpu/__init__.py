@@ -10,6 +10,8 @@ A from-scratch reimplementation of the *capabilities* of NVIDIA Apex
 - ``apex_tpu.transformer``    — Megatron-style tensor/sequence/pipeline/context parallelism
 - ``apex_tpu.ops``            — Pallas TPU kernels (norms, softmax, rope, attention, xentropy)
 - ``apex_tpu.contrib``        — optional extensions (focal loss, group norm, transducer, …)
+- ``apex_tpu.native``         — C++ host runtime (flatten/bucketing/staging pool/queues)
+- ``apex_tpu.data``           — prefetching host→device pipeline on the native queue
 
 Where the reference dispatches CUDA kernels through pybind11 extensions
 (``setup.py:110-860``), this package dispatches Pallas TPU kernels with pure-XLA
@@ -19,10 +21,12 @@ fallbacks; where the reference speaks NCCL through ``torch.distributed``
 
 from apex_tpu import amp
 from apex_tpu import checkpoint
+from apex_tpu import data
 from apex_tpu import fp16_utils
 from apex_tpu import fused_dense
 from apex_tpu import mlp
 from apex_tpu import multi_tensor_apply
+from apex_tpu import native
 from apex_tpu import normalization
 from apex_tpu import ops
 from apex_tpu import optimizers
@@ -37,6 +41,8 @@ __version__ = "0.1.0"
 __all__ = [
     "amp",
     "checkpoint",
+    "data",
+    "native",
     "fp16_utils",
     "fused_dense",
     "mlp",

@@ -1,0 +1,3 @@
+from apex_tpu.data.loader import PrefetchLoader
+
+__all__ = ["PrefetchLoader"]

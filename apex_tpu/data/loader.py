@@ -1,0 +1,113 @@
+"""Prefetching host->device data pipeline.
+
+Role of the reference examples' input pipelines (``examples/imagenet/
+main_amp.py`` leans on DALI/torch DataLoader worker processes + pinned-memory
+prefetch): keep the accelerator fed by overlapping host batch preparation
+with device compute. TPU-native shape: worker threads pull from the user's
+iterable, stage each batch, and a bounded C++ token queue
+(:class:`apex_tpu.native.TokenQueue` — blocking condvar ring, no GIL churn
+while waiting) hands them to the training loop, which issues
+``jax.device_put`` (async on TPU) one batch ahead.
+
+Python threads suffice for the worker pool: the heavy lifting inside a
+typical batch fn (numpy slicing/augmentation, file reads) drops the GIL, and
+the queue blocking happens in C++.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+from apex_tpu.native import TokenQueue
+
+__all__ = ["PrefetchLoader"]
+
+
+class PrefetchLoader:
+    """Wrap an iterable of host batches with background prefetch.
+
+    Args:
+      batches: iterable (or callable returning an iterator) of pytrees of
+        numpy arrays.
+      prefetch: queue depth (batches staged ahead).
+      num_workers: worker threads pulling from ``batches``. With >1 worker
+        the source iterator is shared behind a lock (order is then
+        arrival-order, as with torch DataLoader workers).
+      device_put: optional function applied to each batch on the consumer
+        side (e.g. ``jax.device_put`` / a sharded put); done one batch ahead
+        so the transfer overlaps the previous step.
+    """
+
+    def __init__(self, batches: Iterable[Any] | Callable[[], Iterator[Any]],
+                 *, prefetch: int = 2, num_workers: int = 1,
+                 device_put: Optional[Callable[[Any], Any]] = None):
+        self._make_iter = (batches if callable(batches)
+                           else lambda: iter(batches))
+        self.prefetch = max(1, prefetch)
+        self.num_workers = max(1, num_workers)
+        self.device_put = device_put
+
+    def __iter__(self) -> Iterator[Any]:
+        queue = TokenQueue(self.prefetch)
+        slots: dict[int, Any] = {}
+        counter = itertools.count()
+        src = self._make_iter()
+        src_lock = threading.Lock()
+        done = threading.Event()
+        live_workers = [self.num_workers]
+        workers_lock = threading.Lock()
+        errors: list[BaseException] = []
+
+        def worker():
+            try:
+                while not done.is_set():
+                    with src_lock:
+                        try:
+                            batch = next(src)
+                        except StopIteration:
+                            break
+                    tok = next(counter)
+                    slots[tok] = batch
+                    if not queue.put(tok):   # queue closed under us
+                        slots.pop(tok, None)
+                        break
+            except BaseException as e:       # surface in the consumer,
+                errors.append(e)             # torch-DataLoader style
+            finally:
+                with workers_lock:
+                    live_workers[0] -= 1
+                    if live_workers[0] == 0:
+                        queue.close()
+
+        def consume():
+            # threads start lazily on first next(): an iterator that is
+            # created but never consumed must not leak workers
+            threads = [threading.Thread(target=worker, daemon=True)
+                       for _ in range(self.num_workers)]
+            for t in threads:
+                t.start()
+            staged = None
+            try:
+                while True:
+                    tok = queue.get()
+                    if tok is None:           # closed + drained
+                        break
+                    batch = slots.pop(tok)
+                    if self.device_put is not None:
+                        batch = self.device_put(batch)   # async transfer
+                    if staged is not None:
+                        yield staged
+                    staged = batch
+                if errors:
+                    raise errors[0]
+                if staged is not None:
+                    yield staged
+            finally:
+                done.set()
+                queue.close()
+                for t in threads:
+                    t.join(timeout=5)
+
+        return consume()

@@ -1,0 +1,287 @@
+"""ctypes bindings for the C++ host runtime (``apex_tpu/csrc``).
+
+The reference ships its host plumbing as pybind11 C++ extensions (``apex_C``
+flatten/unflatten, bucket bookkeeping inside DDP, allocator plumbing in
+``contrib/csrc/nccl_allocator``). Here the native library is built once from
+``csrc/host_runtime.cpp`` with the system ``g++`` (no pybind11 in the image —
+plain C ABI + ctypes) and cached next to the source; a pure-numpy fallback
+keeps every API functional when no compiler is available.
+
+Public surface:
+
+- :func:`flatten` / :func:`unflatten` — tensor-list <-> one contiguous
+  numpy buffer (multithreaded memcpy in C++),
+- :func:`bucket_plan` — apex-DDP-style arrival-order bucket assignment,
+- :class:`StagingPool` stats / trim — aligned host staging-buffer pool,
+- :class:`TokenQueue` — blocking MPMC queue backing
+  :mod:`apex_tpu.data`'s prefetch loader.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+import weakref
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["available", "flatten", "unflatten", "bucket_plan", "TokenQueue",
+           "staging_buffer", "staging_stats", "staging_trim"]
+
+_SRC = os.path.join(os.path.dirname(__file__), os.pardir, "csrc",
+                    "host_runtime.cpp")
+_BUILD_DIR = os.path.join(os.path.dirname(__file__), "_build")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build_and_load() -> Optional[ctypes.CDLL]:
+    src = os.path.abspath(_SRC)
+    if not os.path.exists(src):
+        return None
+    with open(src, "rb") as f:
+        tag = hashlib.sha256(f.read()).hexdigest()[:16]
+    so = os.path.join(_BUILD_DIR, f"libapex_host_{tag}.so")
+    if not os.path.exists(so):
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        tmp = so + f".tmp{os.getpid()}"
+        cmd = ["g++", "-O3", "-fPIC", "-shared", "-std=c++17", "-pthread",
+               src, "-o", tmp]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=300)
+            os.replace(tmp, so)
+        except (OSError, subprocess.SubprocessError):
+            return None
+    try:
+        lib = ctypes.CDLL(so)
+    except OSError:
+        return None
+    lib.apex_flatten.argtypes = [ctypes.POINTER(ctypes.c_void_p),
+                                 ctypes.POINTER(ctypes.c_int64),
+                                 ctypes.c_int, ctypes.c_void_p]
+    lib.apex_unflatten.argtypes = [ctypes.c_void_p,
+                                   ctypes.POINTER(ctypes.c_int64),
+                                   ctypes.c_int,
+                                   ctypes.POINTER(ctypes.c_void_p)]
+    lib.apex_bucket_plan.argtypes = [ctypes.POINTER(ctypes.c_int64),
+                                     ctypes.c_int, ctypes.c_int64,
+                                     ctypes.POINTER(ctypes.c_int32)]
+    lib.apex_bucket_plan.restype = ctypes.c_int
+    lib.apex_queue_create.argtypes = [ctypes.c_int64]
+    lib.apex_queue_create.restype = ctypes.c_void_p
+    lib.apex_queue_destroy.argtypes = [ctypes.c_void_p]
+    lib.apex_queue_put.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.apex_queue_put.restype = ctypes.c_int
+    lib.apex_queue_get.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                   ctypes.POINTER(ctypes.c_int64)]
+    lib.apex_queue_get.restype = ctypes.c_int
+    lib.apex_queue_close.argtypes = [ctypes.c_void_p]
+    lib.apex_queue_size.argtypes = [ctypes.c_void_p]
+    lib.apex_queue_size.restype = ctypes.c_int64
+    lib.apex_staging_stats.argtypes = [ctypes.POINTER(ctypes.c_int64),
+                                       ctypes.POINTER(ctypes.c_int64)]
+    lib.apex_staging_trim.argtypes = []
+    lib.apex_staging_alloc.argtypes = [ctypes.c_int64]
+    lib.apex_staging_alloc.restype = ctypes.c_void_p
+    lib.apex_staging_free.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    return lib
+
+
+def _get_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _lib is None and not _tried:
+        with _lock:
+            if _lib is None and not _tried:
+                _lib = _build_and_load()
+                _tried = True
+    return _lib
+
+
+def available() -> bool:
+    """True when the C++ runtime built and loaded."""
+    return _get_lib() is not None
+
+
+def _as_arrays(arrays: Sequence[np.ndarray]) -> List[np.ndarray]:
+    return [np.ascontiguousarray(a) for a in arrays]
+
+
+def staging_buffer(nbytes: int) -> np.ndarray:
+    """A uint8 array backed by the C++ aligned staging pool; the buffer
+    returns to the pool when the array (and its views) are collected. Falls
+    back to a plain numpy allocation without the native library."""
+    lib = _get_lib()
+    if lib is None or nbytes == 0:
+        return np.empty(nbytes, np.uint8)
+    ptr = lib.apex_staging_alloc(int(nbytes))
+    if not ptr:
+        return np.empty(nbytes, np.uint8)
+    mem = (ctypes.c_uint8 * nbytes).from_address(ptr)
+    arr = np.frombuffer(mem, dtype=np.uint8, count=nbytes)
+    # finalize `mem`, NOT `arr`: numpy collapses base chains, so any view of
+    # `arr` bases directly on `mem` — attaching the free there guarantees the
+    # buffer outlives every view
+    weakref.finalize(mem, lib.apex_staging_free, ptr, int(nbytes))
+    return arr
+
+
+def flatten(arrays: Sequence[np.ndarray]) -> np.ndarray:
+    """Concatenate arbitrary-dtype host arrays into one uint8 buffer
+    (``apex_C.flatten`` role, reference ``csrc/flatten_unflatten.cpp:15``)."""
+    arrays = _as_arrays(arrays)
+    sizes = [a.nbytes for a in arrays]
+    out = staging_buffer(sum(sizes))
+    lib = _get_lib()
+    if lib is None or not arrays:
+        off = 0
+        for a, n in zip(arrays, sizes):
+            out[off:off + n] = a.view(np.uint8).reshape(-1)
+            off += n
+        return out
+    n = len(arrays)
+    srcs = (ctypes.c_void_p * n)(*[a.ctypes.data for a in arrays])
+    nbytes = (ctypes.c_int64 * n)(*sizes)
+    lib.apex_flatten(srcs, nbytes, n, out.ctypes.data)
+    return out
+
+
+def unflatten(flat: np.ndarray, like: Sequence[np.ndarray]) -> List[np.ndarray]:
+    """Split a flat uint8 buffer back into arrays shaped/typed like ``like``
+    (``apex_C.unflatten`` role)."""
+    flat = np.ascontiguousarray(flat.view(np.uint8).reshape(-1))
+    outs = [np.empty(a.shape, a.dtype) for a in like]
+    sizes = [o.nbytes for o in outs]
+    if sum(sizes) != flat.nbytes:
+        raise ValueError(f"flat buffer has {flat.nbytes} bytes; templates "
+                         f"need {sum(sizes)}")
+    lib = _get_lib()
+    if lib is None or not outs:
+        off = 0
+        for o, n in zip(outs, sizes):
+            o.view(np.uint8).reshape(-1)[:] = flat[off:off + n]
+            off += n
+        return outs
+    n = len(outs)
+    dsts = (ctypes.c_void_p * n)(*[o.ctypes.data for o in outs])
+    nbytes = (ctypes.c_int64 * n)(*sizes)
+    lib.apex_unflatten(flat.ctypes.data, nbytes, n, dsts)
+    return outs
+
+
+def bucket_plan(nbytes: Sequence[int], cap_bytes: int) -> np.ndarray:
+    """Arrival-order bucket ids capped at ``cap_bytes`` per bucket (apex DDP
+    bucket learning, reference ``parallel/distributed.py:366-390``)."""
+    n = len(nbytes)
+    ids = np.zeros(n, dtype=np.int32)
+    lib = _get_lib()
+    if lib is None:
+        bucket, fill = 0, 0
+        for i, nb in enumerate(nbytes):
+            if fill > 0 and fill + nb > cap_bytes:
+                bucket, fill = bucket + 1, 0
+            ids[i] = bucket
+            fill += nb
+            if fill >= cap_bytes:
+                bucket, fill = bucket + 1, 0
+        return ids
+    arr = (ctypes.c_int64 * n)(*[int(x) for x in nbytes])
+    lib.apex_bucket_plan(arr, n, int(cap_bytes),
+                         ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    return ids
+
+
+def staging_stats():
+    """(outstanding allocations, pooled free bytes) of the C++ staging pool."""
+    lib = _get_lib()
+    if lib is None:
+        return (0, 0)
+    a, b = ctypes.c_int64(), ctypes.c_int64()
+    lib.apex_staging_stats(ctypes.byref(a), ctypes.byref(b))
+    return (a.value, b.value)
+
+
+def staging_trim() -> None:
+    lib = _get_lib()
+    if lib is not None:
+        lib.apex_staging_trim()
+
+
+class TokenQueue:
+    """Blocking bounded MPMC queue over the C++ condvar ring; falls back to
+    ``queue.Queue`` when the native library is unavailable."""
+
+    def __init__(self, capacity: int):
+        self._lib = _get_lib()
+        if self._lib is not None:
+            self._q = self._lib.apex_queue_create(capacity)
+            self._py = None
+        else:
+            import queue
+            self._q = None
+            self._py = queue.Queue(maxsize=capacity)
+            self._closed_ev = threading.Event()
+
+    def put(self, token: int) -> bool:
+        """Blocks while full. False once the queue is closed."""
+        if self._py is not None:
+            import queue as _qm
+            while not self._closed_ev.is_set():
+                try:
+                    # poll in slices so close() is observed mid-block
+                    self._py.put(int(token), timeout=0.1)
+                    return True
+                except _qm.Full:
+                    continue
+            return False
+        return self._lib.apex_queue_put(self._q, int(token)) == 0
+
+    def get(self, timeout_ms: int = -1) -> Optional[int]:
+        """Blocks while empty. None at end-of-stream (closed + drained);
+        raises TimeoutError on timeout."""
+        if self._py is not None:
+            import queue as _qm
+            while True:
+                try:
+                    # poll in slices so close() is observed even with an
+                    # infinite timeout
+                    return self._py.get(
+                        timeout=0.1 if timeout_ms < 0 else timeout_ms / 1e3)
+                except _qm.Empty:
+                    if self._closed_ev.is_set() and self._py.empty():
+                        return None
+                    if timeout_ms >= 0:
+                        raise TimeoutError("queue.get timed out")
+        tok = ctypes.c_int64()
+        rc = self._lib.apex_queue_get(self._q, int(timeout_ms),
+                                      ctypes.byref(tok))
+        if rc == 0:
+            return tok.value
+        if rc == -1:
+            return None
+        raise TimeoutError("queue.get timed out")
+
+    def close(self) -> None:
+        if self._py is not None:
+            self._closed_ev.set()
+            return
+        if self._q is not None:
+            self._lib.apex_queue_close(self._q)
+
+    def __len__(self) -> int:
+        if self._py is not None:
+            return self._py.qsize()
+        return int(self._lib.apex_queue_size(self._q))
+
+    def __del__(self):
+        try:
+            if self._py is None and self._q is not None:
+                self._lib.apex_queue_close(self._q)
+                self._lib.apex_queue_destroy(self._q)
+                self._q = None
+        except Exception:
+            pass
