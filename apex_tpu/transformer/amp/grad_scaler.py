@@ -1,0 +1,62 @@
+"""Model-parallel-aware loss scaler.
+
+Counterpart of ``apex/transformer/amp/grad_scaler.py:21-125``: the
+reference's ``GradScaler`` subclass all-reduces ``found_inf`` across the
+model-parallel group in ``_maybe_opt_step`` and ``update``, because under
+TP/PP each rank only sees its shard's gradients — one rank's overflow must
+skip the step (and shrink the scale) on *every* rank or parameters
+desynchronize.
+
+Here :class:`GradScaler` extends :class:`apex_tpu.amp.LossScaler`: inside
+``shard_map`` the ``unscale`` overflow flag is OR-reduced (``psum`` of the
+0/1 flag) over whichever of the configured mesh axes are bound; outside any
+mesh it degrades to the plain scaler.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.amp.scaler import LossScaler, LossScalerState
+from apex_tpu.transformer.parallel_state import (
+    CONTEXT_AXIS,
+    PIPELINE_AXIS,
+    TENSOR_AXIS,
+)
+from apex_tpu.transformer.tensor_parallel.mappings import axis_bound
+
+__all__ = ["GradScaler"]
+
+
+class GradScaler(LossScaler):
+    """LossScaler whose overflow flag is agreed across model-parallel ranks.
+
+    Args match :class:`LossScaler` plus ``model_parallel_axes`` (default:
+    tensor, pipeline and context — the reference's "model-parallel group").
+    """
+
+    def __init__(self, *args,
+                 model_parallel_axes: Sequence[str] = (
+                     TENSOR_AXIS, PIPELINE_AXIS, CONTEXT_AXIS),
+                 **kw):
+        super().__init__(*args, **kw)
+        self.model_parallel_axes = tuple(model_parallel_axes)
+
+    def _sync_found_inf(self, found_inf: jax.Array) -> jax.Array:
+        """OR ``found_inf`` over every bound model-parallel axis (the
+        reference's ``torch.distributed.all_reduce(found_inf, MAX, model
+        parallel group)``)."""
+        flag = found_inf.astype(jnp.float32)
+        for axis in self.model_parallel_axes:
+            if axis_bound(axis):
+                flag = lax.psum(flag, axis)
+        return flag > 0
+
+    def unscale(self, grads: Any,
+                state: LossScalerState) -> Tuple[Any, jax.Array]:
+        grads, found_inf = super().unscale(grads, state)
+        return grads, self._sync_found_inf(found_inf)

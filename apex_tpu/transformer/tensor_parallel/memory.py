@@ -1,0 +1,100 @@
+"""Activation-memory arenas.
+
+Counterpart of ``apex/transformer/tensor_parallel/memory.py`` (``MemoryBuffer``
+/ ``RingMemBuffer``): the reference preallocates one large device tensor and
+hands out zero-copy views so activation-checkpoint regions never hit the CUDA
+allocator. On TPU, XLA owns device memory — buffers are program-allocated,
+donation recycles them, and there is no runtime allocator to bypass — so the
+arena here is a *functional* scratch: one flat array, trace-time slicing into
+requested shapes, explicit reset. It exists for API parity and for host-side
+staging composition with :mod:`apex_tpu.native`'s pooled buffers, and it
+enforces the same invariants the reference does (no over-allocation, dtype
+match).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["MemoryBuffer", "RingMemBuffer", "allocate_mem_buff"]
+
+
+class MemoryBuffer:
+    """Flat arena of ``numel`` elements handing out shaped slices
+    (reference ``MemoryBuffer.get``)."""
+
+    def __init__(self, name: str, numel: int, dtype=jnp.bfloat16,
+                 track_usage: bool = False):
+        self.name = name
+        self.numel = int(numel)
+        self.dtype = dtype
+        self.track_usage = track_usage
+        self.data = jnp.zeros((self.numel,), dtype)
+        self._start = 0
+        self.in_use_value = 0
+        self.total_value = 0
+
+    def reset(self) -> None:
+        # usage accounting per fill cycle: elements handed out vs capacity
+        if self.track_usage:
+            self.in_use_value += self._start
+            self.total_value += self.numel
+        self._start = 0
+
+    def is_in_use(self) -> bool:
+        return self._start > 0
+
+    def numel_in_use(self) -> int:
+        return self._start
+
+    def get(self, shape: Sequence[int], dtype=None) -> jax.Array:
+        """Carve the next ``prod(shape)`` elements as a view of the arena."""
+        dtype = dtype or self.dtype
+        if dtype != self.dtype:
+            raise ValueError(
+                f"arena {self.name} holds {self.dtype}, asked for {dtype}")
+        n = int(np.prod(shape, dtype=np.int64))
+        end = self._start + n
+        if end > self.numel:
+            raise MemoryError(
+                f"arena {self.name}: requested {n} elements at offset "
+                f"{self._start}, capacity {self.numel}")
+        out = jax.lax.dynamic_slice(self.data, (self._start,), (n,))
+        self._start = end
+        return out.reshape(tuple(shape))
+
+    def print_average_usage(self) -> None:
+        if self.track_usage and self.total_value:
+            print(f"arena {self.name}: average usage "
+                  f"{100.0 * self.in_use_value / max(self.total_value, 1):.1f}%")
+
+
+class RingMemBuffer:
+    """Round-robin ring of ``num_buffers`` arenas (reference
+    ``RingMemBuffer``): consecutive ``get_next`` calls rotate arenas so a
+    double-buffered pipeline stage never overwrites live activations."""
+
+    def __init__(self, name: str, num_buffers: int, numel: int,
+                 dtype=jnp.bfloat16, track_usage: bool = False):
+        self.num_buffers = num_buffers
+        self.buffers = [
+            MemoryBuffer(f"{name}-{i}", numel, dtype, track_usage)
+            for i in range(num_buffers)
+        ]
+        self._index = -1
+
+    def get_next_buffer(self) -> MemoryBuffer:
+        self._index = (self._index + 1) % self.num_buffers
+        buf = self.buffers[self._index]
+        buf.reset()
+        return buf
+
+
+def allocate_mem_buff(name: str, numel: int, dtype=jnp.bfloat16,
+                      track_usage: bool = False) -> MemoryBuffer:
+    """Factory matching the reference's module-level allocator."""
+    return MemoryBuffer(name, numel, dtype, track_usage)

@@ -1,0 +1,40 @@
+"""Test-harness toolkit (counterpart of ``apex/transformer/testing``).
+
+The reference ships a Megatron-style global-args system (``arguments.py``,
+``global_vars.py``), toy-model helpers (``commons.py``), a multi-process
+distributed test base (``distributed_test_base.py``), and standalone GPT/BERT
+fixtures. Here the standalone models are first-class
+(:mod:`apex_tpu.models`); this package provides the args system, the
+commons helpers, and the virtual-mesh test base that stands in for
+``MultiProcessTestCase`` on a single host (SURVEY.md §4 implication).
+"""
+
+from apex_tpu.models import BertModel as StandaloneBertModel
+from apex_tpu.models import GPTModel as StandaloneGPTModel
+from apex_tpu.transformer.testing.arguments import parse_args
+from apex_tpu.transformer.testing.commons import (
+    IdentityLayer,
+    initialize_distributed,
+    print_separator,
+    set_random_seed,
+)
+from apex_tpu.transformer.testing.distributed_test_base import (
+    DistributedTestBase,
+)
+from apex_tpu.transformer.testing.global_vars import (
+    get_args,
+    set_global_variables,
+)
+
+__all__ = [
+    "parse_args",
+    "get_args",
+    "set_global_variables",
+    "IdentityLayer",
+    "set_random_seed",
+    "initialize_distributed",
+    "print_separator",
+    "DistributedTestBase",
+    "StandaloneGPTModel",
+    "StandaloneBertModel",
+]

@@ -1,0 +1,146 @@
+"""Megatron-style global arguments.
+
+Counterpart of ``apex/transformer/testing/arguments.py`` (977 LoC of
+Megatron argparse): the subset of flags that shape models, parallel layout,
+precision, and training schedule in this framework. ``parse_args`` accepts
+``extra_args_provider`` and ``defaults`` overrides and performs the same
+derived-value checks (world size divisibility, global/micro batch
+consistency) the reference does.
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Callable, Dict, Optional
+
+__all__ = ["parse_args", "core_transformer_config_from_args"]
+
+
+def parse_args(extra_args_provider: Optional[Callable] = None,
+               defaults: Optional[Dict] = None,
+               ignore_unknown_args: bool = False,
+               args=None) -> argparse.Namespace:
+    parser = argparse.ArgumentParser(
+        description="apex_tpu Megatron-style arguments",
+        allow_abbrev=False)
+
+    g = parser.add_argument_group("model")
+    g.add_argument("--num-layers", type=int, default=2)
+    g.add_argument("--hidden-size", type=int, default=128)
+    g.add_argument("--num-attention-heads", type=int, default=8)
+    g.add_argument("--ffn-hidden-size", type=int, default=None)
+    g.add_argument("--seq-length", type=int, default=128)
+    g.add_argument("--max-position-embeddings", type=int, default=128)
+    g.add_argument("--vocab-size", type=int, default=4096)
+    g.add_argument("--hidden-dropout", type=float, default=0.1)
+    g.add_argument("--attention-dropout", type=float, default=0.1)
+    g.add_argument("--layernorm-epsilon", type=float, default=1e-5)
+    g.add_argument("--init-method-std", type=float, default=0.02)
+
+    g = parser.add_argument_group("parallel")
+    g.add_argument("--tensor-model-parallel-size", type=int, default=1)
+    g.add_argument("--pipeline-model-parallel-size", type=int, default=1)
+    g.add_argument("--context-parallel-size", type=int, default=1)
+    g.add_argument("--virtual-pipeline-model-parallel-size", type=int,
+                   default=None)
+    g.add_argument("--sequence-parallel", action="store_true")
+    g.add_argument("--world-size", type=int, default=None,
+                   help="defaults to jax.device_count()")
+
+    g = parser.add_argument_group("training")
+    g.add_argument("--micro-batch-size", type=int, default=2)
+    g.add_argument("--global-batch-size", type=int, default=None)
+    g.add_argument("--rampup-batch-size", type=int, nargs=3, default=None,
+                   metavar=("START", "INCR", "SAMPLES"))
+    g.add_argument("--train-iters", type=int, default=10)
+    g.add_argument("--lr", type=float, default=1e-4)
+    g.add_argument("--weight-decay", type=float, default=0.01)
+    g.add_argument("--clip-grad", type=float, default=1.0)
+    g.add_argument("--seed", type=int, default=1234)
+
+    g = parser.add_argument_group("precision")
+    g.add_argument("--fp16", action="store_true")
+    g.add_argument("--bf16", action="store_true")
+    g.add_argument("--loss-scale", type=float, default=None,
+                   help="static loss scale (None = dynamic when fp16)")
+    g.add_argument("--initial-loss-scale", type=float, default=2.0 ** 32)
+    g.add_argument("--loss-scale-window", type=int, default=1000)
+    g.add_argument("--hysteresis", type=int, default=2)
+
+    g = parser.add_argument_group("checkpoint/misc")
+    g.add_argument("--recompute", action="store_true",
+                   help="full-layer activation recompute")
+    g.add_argument("--save", type=str, default=None)
+    g.add_argument("--load", type=str, default=None)
+    g.add_argument("--adlr-autoresume", action="store_true")
+    g.add_argument("--log-interval", type=int, default=10)
+
+    if extra_args_provider is not None:
+        parser = extra_args_provider(parser)
+
+    if ignore_unknown_args:
+        ns, _ = parser.parse_known_args(args)
+    else:
+        ns = parser.parse_args(args)
+
+    for k, v in (defaults or {}).items():
+        key = k.replace("-", "_")
+        cur = getattr(ns, key, None)
+        # identity checks: unset options (None) and un-passed store_true
+        # flags (False) take the default; explicit numeric zeros do not
+        if cur is None or cur is False:
+            setattr(ns, key, v)
+
+    # derived values + validation (reference parse_args post-processing)
+    if ns.world_size is None:
+        import jax
+        ns.world_size = jax.device_count()
+    mp = (ns.tensor_model_parallel_size * ns.pipeline_model_parallel_size
+          * ns.context_parallel_size)
+    if ns.world_size % mp:
+        raise ValueError(
+            f"world size {ns.world_size} not divisible by model-parallel "
+            f"size {mp}")
+    ns.data_parallel_size = ns.world_size // mp
+    if ns.global_batch_size is None:
+        ns.global_batch_size = ns.micro_batch_size * ns.data_parallel_size
+    if ns.global_batch_size % (ns.micro_batch_size * ns.data_parallel_size):
+        raise ValueError(
+            f"global batch {ns.global_batch_size} not divisible by "
+            f"micro-batch {ns.micro_batch_size} x dp {ns.data_parallel_size}")
+    if ns.ffn_hidden_size is None:
+        ns.ffn_hidden_size = 4 * ns.hidden_size
+    if ns.fp16 and ns.bf16:
+        raise ValueError("--fp16 and --bf16 are mutually exclusive")
+    ns.params_dtype = "float32"
+    if ns.bf16:
+        ns.params_dtype = "bfloat16"
+    return ns
+
+
+def core_transformer_config_from_args(args):
+    """Build a :class:`apex_tpu.models.TransformerConfig` from parsed args."""
+    import jax.numpy as jnp
+
+    from apex_tpu.models import TransformerConfig
+
+    compute = jnp.float32
+    if args.bf16:
+        compute = jnp.bfloat16
+    elif args.fp16:
+        compute = jnp.float16
+    return TransformerConfig(
+        num_layers=args.num_layers,
+        hidden_size=args.hidden_size,
+        num_attention_heads=args.num_attention_heads,
+        ffn_hidden_size=args.ffn_hidden_size,
+        vocab_size=args.vocab_size,
+        max_position_embeddings=args.max_position_embeddings,
+        hidden_dropout=args.hidden_dropout,
+        attention_dropout=args.attention_dropout,
+        layernorm_epsilon=args.layernorm_epsilon,
+        init_method_std=args.init_method_std,
+        sequence_parallel=args.sequence_parallel,
+        recompute=args.recompute,
+        compute_dtype=compute,
+    )

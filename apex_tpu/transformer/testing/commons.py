@@ -1,0 +1,94 @@
+"""Shared test helpers (counterpart of
+``apex/transformer/testing/commons.py:44-296``): seeded init, a trainable
+identity fixture, distributed/mesh bring-up, and toy forward-step functions
+for pipeline-schedule tests."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.transformer import parallel_state
+
+__all__ = ["set_random_seed", "IdentityLayer", "initialize_distributed",
+           "print_separator", "model_provider_func", "fwd_step_func"]
+
+
+def set_random_seed(seed: int) -> jax.Array:
+    """Seed numpy + return a JAX key (the reference seeds torch/cuda RNGs;
+    JAX's explicit keys make most of that moot, numpy covers host-side
+    shuffles)."""
+    np.random.seed(seed)
+    return jax.random.PRNGKey(seed)
+
+
+class IdentityLayer:
+    """A single trainable tensor returned as-is (reference ``IdentityLayer``):
+    the minimal "model" for exercising grad flows through collectives."""
+
+    def __init__(self, shape: Sequence[int], scale: float = 1.0, seed: int = 0):
+        self.shape = tuple(shape)
+        self.scale = scale
+        self.seed = seed
+
+    def init(self, key: Optional[jax.Array] = None):
+        key = key if key is not None else jax.random.PRNGKey(self.seed)
+        return {"weight": self.scale * jax.random.normal(key, self.shape)}
+
+    def apply(self, params):
+        return params["weight"]
+
+
+def initialize_distributed(tensor_model_parallel_size: int = 1,
+                           pipeline_model_parallel_size: int = 1,
+                           context_parallel_size: int = 1,
+                           **kw):
+    """Mesh bring-up for tests (the reference's NCCL process-group init +
+    ``parallel_state.initialize_model_parallel``)."""
+    parallel_state.destroy_model_parallel()
+    return parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size=tensor_model_parallel_size,
+        pipeline_model_parallel_size=pipeline_model_parallel_size,
+        context_parallel_size=context_parallel_size, **kw)
+
+
+def print_separator(message: str) -> None:
+    print("\n" + "-" * 31 + f" {message} " + "-" * 31, flush=True)
+
+
+def model_provider_func(hidden_size: int, seed: int = 0) -> Tuple[Any, Any]:
+    """A toy two-matmul model ``(module, params)`` for schedule tests
+    (reference ``commons.py`` ``MyModel``)."""
+    key = jax.random.PRNGKey(seed)
+    k1, k2 = jax.random.split(key)
+
+    class _Toy:
+        def init(self, key=None):
+            a, b = (k1, k2) if key is None else jax.random.split(key)
+            return {"w1": 0.02 * jax.random.normal(a, (hidden_size,
+                                                       hidden_size)),
+                    "w2": 0.02 * jax.random.normal(b, (hidden_size,
+                                                       hidden_size))}
+
+        def apply(self, params, x):
+            return jnp.tanh(x @ params["w1"]) @ params["w2"]
+
+    m = _Toy()
+    return m, m.init()
+
+
+def fwd_step_func(model) -> Callable:
+    """Forward-step closure in this framework's no-pipelining-schedule shape
+    ``(params, microbatch) -> scalar loss`` (role of the reference's
+    ``commons.py`` ``fwd_step_func``; the pipelined schedules instead take a
+    ``(preprocess, stage, postprocess)`` triple, see
+    ``schedules/fwd_bwd_pipelining_without_interleaving.py``)."""
+
+    def _step(params, microbatch):
+        out = model.apply(params, microbatch)
+        return jnp.mean(jnp.square(out))
+
+    return _step

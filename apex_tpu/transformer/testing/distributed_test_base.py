@@ -1,0 +1,59 @@
+"""Distributed test base.
+
+Counterpart of ``apex/transformer/testing/distributed_test_base.py:22-126``:
+the reference subclasses ``MultiProcessTestCase`` to spawn one process per
+GPU with NCCL/UCC file-store init. On TPU the honest single-host analog
+(SURVEY.md §4) is a virtual device mesh: N CPU devices from
+``--xla_force_host_platform_device_count`` (or the real chips), with
+``parallel_state`` meshes built/torn down per test. ``world_size`` mirrors
+the reference's "min(4, gpus)" policy but over available JAX devices.
+"""
+
+from __future__ import annotations
+
+import unittest
+from typing import Optional
+
+import jax
+
+from apex_tpu.transformer import parallel_state
+
+__all__ = ["DistributedTestBase"]
+
+
+class DistributedTestBase(unittest.TestCase):
+    """unittest base managing mesh lifecycle around each test.
+
+    Usage mirrors the reference: subclasses read ``self.world_size``, call
+    ``self.initialize_model_parallel(tp, pp, cp)`` and get automatic
+    teardown. Works under pytest as plain classes too.
+    """
+
+    #: cap matching the reference's 4-GPU default (``world_size`` property,
+    #: distributed_test_base.py:36-38); override in subclasses as needed
+    MAX_WORLD_SIZE: Optional[int] = None
+
+    @property
+    def world_size(self) -> int:
+        n = len(jax.devices())
+        if self.MAX_WORLD_SIZE is not None:
+            n = min(n, self.MAX_WORLD_SIZE)
+        return n
+
+    def setUp(self):
+        super().setUp()
+        parallel_state.destroy_model_parallel()
+
+    def tearDown(self):
+        parallel_state.destroy_model_parallel()
+        super().tearDown()
+
+    def initialize_model_parallel(self, tensor_model_parallel_size: int = 1,
+                                  pipeline_model_parallel_size: int = 1,
+                                  context_parallel_size: int = 1, **kw):
+        devs = jax.devices()[:self.world_size]
+        return parallel_state.initialize_model_parallel(
+            tensor_model_parallel_size=tensor_model_parallel_size,
+            pipeline_model_parallel_size=pipeline_model_parallel_size,
+            context_parallel_size=context_parallel_size,
+            devices=devs, **kw)

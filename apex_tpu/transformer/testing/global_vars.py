@@ -1,0 +1,44 @@
+"""Global args singleton (counterpart of
+``apex/transformer/testing/global_vars.py``): ``set_global_variables`` parses
+(or accepts) args once; ``get_args`` asserts initialization like the
+reference's ``_ensure_var_is_initialized``."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from apex_tpu.transformer.testing.arguments import parse_args
+
+_GLOBAL_ARGS = None
+
+
+def set_global_variables(args=None, *, extra_args_provider=None,
+                         defaults=None, ignore_unknown_args=False):
+    """Parse and install the global args (idempotent only via
+    :func:`destroy_global_vars`)."""
+    global _GLOBAL_ARGS
+    if _GLOBAL_ARGS is not None:
+        raise RuntimeError("global args are already initialized")
+    if args is None:
+        args = parse_args(extra_args_provider=extra_args_provider,
+                          defaults=defaults,
+                          ignore_unknown_args=ignore_unknown_args)
+    _GLOBAL_ARGS = args
+    return args
+
+
+def get_args():
+    if _GLOBAL_ARGS is None:
+        raise RuntimeError("global args are not initialized; call "
+                           "set_global_variables() first")
+    return _GLOBAL_ARGS
+
+
+def get_current_global_batch_size() -> Optional[int]:
+    args = get_args()
+    return getattr(args, "global_batch_size", None)
+
+
+def destroy_global_vars() -> None:
+    global _GLOBAL_ARGS
+    _GLOBAL_ARGS = None

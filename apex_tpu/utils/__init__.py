@@ -1,5 +1,13 @@
 from apex_tpu.utils.logging import get_logger, RankInfoFormatter
 from apex_tpu.utils.deprecation import deprecated_warning
+from apex_tpu.utils.profiling import (
+    annotate_fn,
+    device_memory_stats,
+    nvtx_range,
+    profiler_start,
+    profiler_stop,
+    trace,
+)
 from apex_tpu.utils.tree import (
     tree_cast,
     tree_size,
@@ -15,4 +23,10 @@ __all__ = [
     "tree_size",
     "tree_zeros_like",
     "global_norm",
+    "nvtx_range",
+    "annotate_fn",
+    "profiler_start",
+    "profiler_stop",
+    "trace",
+    "device_memory_stats",
 ]

@@ -1,0 +1,78 @@
+"""Tracing / profiling helpers.
+
+Counterpart of the reference's NVTX plumbing (SURVEY.md §5: DDP's ``prof``
+flag wraps hooks/comm in ``torch.cuda.nvtx`` ranges,
+``apex/parallel/distributed.py:361-364``; the imagenet example calls
+``cudaProfilerStart`` at a chosen iteration). TPU-native equivalents:
+
+- :func:`nvtx_range` — ``jax.named_scope`` context manager (the name lands
+  in XLA HLO metadata and shows up in the profiler timeline exactly like an
+  NVTX range does in Nsight);
+- :func:`profiler_start` / :func:`profiler_stop` — ``jax.profiler`` trace
+  capture to a TensorBoard-readable directory;
+- :func:`annotate_fn` — decorator form of :func:`nvtx_range`;
+- :func:`device_memory_stats` — per-device live-bytes summary (role of
+  ``report_memory``, ``pipeline_parallel/utils.py:253-263``, which also
+  re-exports this).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+from typing import Any, Callable, Dict, Optional
+
+import jax
+
+__all__ = ["nvtx_range", "annotate_fn", "profiler_start", "profiler_stop",
+           "trace", "device_memory_stats"]
+
+
+def nvtx_range(name: str):
+    """``with nvtx_range("fwd"):`` — names the enclosed computation in the
+    profiler timeline (``jax.named_scope``)."""
+    return jax.named_scope(name)
+
+
+def annotate_fn(name: Optional[str] = None) -> Callable:
+    """Decorator: run the function under a named scope."""
+
+    def deco(fn: Callable) -> Callable:
+        scope = name or fn.__name__
+
+        @functools.wraps(fn)
+        def wrapped(*a, **kw):
+            with jax.named_scope(scope):
+                return fn(*a, **kw)
+
+        return wrapped
+
+    return deco
+
+
+def profiler_start(log_dir: str) -> None:
+    """Begin a profiler trace (role of ``cudaProfilerStart`` at iteration N,
+    reference ``examples/imagenet/main_amp.py:335-339``)."""
+    jax.profiler.start_trace(log_dir)
+
+
+def profiler_stop() -> None:
+    jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Context-manager form: profile exactly the enclosed iterations."""
+    profiler_start(log_dir)
+    try:
+        yield
+    finally:
+        profiler_stop()
+
+
+def device_memory_stats(device=None) -> Dict[str, Any]:
+    """Live/peak byte counts for one device (empty dict when the backend
+    doesn't expose stats, e.g. CPU)."""
+    dev = device or jax.devices()[0]
+    stats = getattr(dev, "memory_stats", lambda: None)()
+    return dict(stats) if stats else {}

@@ -1,0 +1,242 @@
+"""Tests for the transformer testing toolkit, memory arenas, and launcher
+helper (reference: ``apex/transformer/testing/*``,
+``tensor_parallel/memory.py``, ``apex/parallel/multiproc.py``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.tensor_parallel.memory import (
+    MemoryBuffer,
+    RingMemBuffer,
+    allocate_mem_buff,
+)
+from apex_tpu.transformer.testing import (
+    DistributedTestBase,
+    IdentityLayer,
+    initialize_distributed,
+    parse_args,
+    set_random_seed,
+)
+from apex_tpu.transformer.testing import global_vars
+
+
+class TestArguments:
+    def test_defaults_and_derived(self):
+        args = parse_args(args=[])
+        assert args.ffn_hidden_size == 4 * args.hidden_size
+        assert args.data_parallel_size == args.world_size
+        assert args.global_batch_size == (args.micro_batch_size
+                                          * args.data_parallel_size)
+
+    def test_parallel_divisibility_enforced(self):
+        with pytest.raises(ValueError):
+            parse_args(args=["--tensor-model-parallel-size", "3",
+                             "--world-size", "8"])
+
+    def test_fp16_bf16_exclusive(self):
+        with pytest.raises(ValueError):
+            parse_args(args=["--fp16", "--bf16"])
+
+    def test_defaults_override(self):
+        args = parse_args(args=[], defaults={"hidden-size": 64})
+        # explicit CLI value survives, unset one takes the default
+        assert args.hidden_size == 128  # argparse default wins (set)
+        args2 = parse_args(args=[], defaults={"save": "/tmp/x"})
+        assert args2.save == "/tmp/x"
+
+    def test_config_from_args(self):
+        from apex_tpu.transformer.testing.arguments import (
+            core_transformer_config_from_args,
+        )
+
+        args = parse_args(args=["--num-layers", "3", "--bf16"])
+        cfg = core_transformer_config_from_args(args)
+        assert cfg.num_layers == 3
+        assert cfg.compute_dtype == jnp.bfloat16
+
+
+class TestGlobalVars:
+    def test_singleton_lifecycle(self):
+        global_vars.destroy_global_vars()
+        with pytest.raises(RuntimeError):
+            global_vars.get_args()
+        args = global_vars.set_global_variables(parse_args(args=[]))
+        assert global_vars.get_args() is args
+        with pytest.raises(RuntimeError):
+            global_vars.set_global_variables(args)
+        global_vars.destroy_global_vars()
+
+
+class TestCommons:
+    def test_identity_layer_grad(self):
+        layer = IdentityLayer((4, 4), scale=0.5)
+        params = layer.init()
+        g = jax.grad(lambda p: jnp.sum(layer.apply(p) ** 2))(params)
+        np.testing.assert_allclose(np.asarray(g["weight"]),
+                                   2 * np.asarray(params["weight"]),
+                                   rtol=1e-6)
+
+    def test_set_random_seed(self):
+        k1 = set_random_seed(7)
+        k2 = set_random_seed(7)
+        np.testing.assert_array_equal(np.asarray(k1), np.asarray(k2))
+
+    def test_initialize_distributed(self):
+        mesh = initialize_distributed(tensor_model_parallel_size=2)
+        assert parallel_state.get_tensor_model_parallel_world_size() == 2
+        parallel_state.destroy_model_parallel()
+
+
+class TestDistributedTestBase:
+    def test_mesh_lifecycle(self):
+        class _T(DistributedTestBase):
+            def runTest(self):
+                pass
+
+        t = _T()
+        t.setUp()
+        assert t.world_size == len(jax.devices())
+        mesh = t.initialize_model_parallel(tensor_model_parallel_size=2)
+        assert parallel_state.model_parallel_is_initialized()
+        t.tearDown()
+        assert not parallel_state.model_parallel_is_initialized()
+
+    def test_world_size_cap(self):
+        class _T(DistributedTestBase):
+            MAX_WORLD_SIZE = 2
+
+            def runTest(self):
+                pass
+
+        assert _T().world_size == 2
+
+
+class TestMemoryBuffer:
+    def test_get_and_reset(self):
+        buf = MemoryBuffer("test", 64, jnp.float32)
+        a = buf.get((4, 4))
+        b = buf.get((8,))
+        assert a.shape == (4, 4) and b.shape == (8,)
+        assert buf.numel_in_use() == 24
+        buf.reset()
+        assert not buf.is_in_use()
+
+    def test_overflow_raises(self):
+        buf = MemoryBuffer("small", 8, jnp.float32)
+        buf.get((8,))
+        with pytest.raises(MemoryError):
+            buf.get((1,))
+
+    def test_dtype_mismatch_raises(self):
+        buf = allocate_mem_buff("t", 8, jnp.bfloat16)
+        with pytest.raises(ValueError):
+            buf.get((2,), jnp.float32)
+
+    def test_ring_rotates_and_resets(self):
+        ring = RingMemBuffer("ring", 2, 16, jnp.float32)
+        b0 = ring.get_next_buffer()
+        b0.get((16,))
+        b1 = ring.get_next_buffer()
+        assert b1 is not b0
+        b0_again = ring.get_next_buffer()
+        assert b0_again is b0
+        assert not b0_again.is_in_use()   # reset on reacquisition
+
+
+class TestMultiproc:
+    def test_init_distributed_single_process(self):
+        from apex_tpu.parallel.multiproc import init_distributed
+
+        # single-process: jax.distributed init either succeeds trivially or
+        # is already initialized; either way process_count is 1 here
+        try:
+            n = init_distributed()
+        except Exception:
+            pytest.skip("jax.distributed unavailable in this environment")
+        assert n == 1
+
+
+class TestModelParallelGradScaler:
+    """transformer.amp.GradScaler: one rank's overflow must skip everywhere
+    (reference apex/transformer/amp/grad_scaler.py:21-125)."""
+
+    def test_overflow_on_one_tp_rank_seen_by_all(self):
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from apex_tpu.transformer.amp import GradScaler
+
+        mesh = initialize_distributed(tensor_model_parallel_size=8)
+        scaler = GradScaler("dynamic")
+        state = scaler.init()
+
+        # grads sharded over tensor ranks; rank 3's shard holds an inf
+        g = np.ones((8, 4), np.float32)
+        g[3, 1] = np.inf
+
+        def per_rank(g_local, state):
+            scaled = jax.tree.map(lambda x: x * state.loss_scale, g_local)
+            _, found_inf = scaler.unscale(scaled, state)
+            return found_inf.reshape(1)
+
+        found = shard_map(per_rank, mesh=mesh,
+                          in_specs=(P("tensor"), P()),
+                          out_specs=P("tensor"))(g, state)
+        # every rank agrees: all True
+        assert np.asarray(found).all()
+        parallel_state.destroy_model_parallel()
+
+    def test_no_overflow_plain(self):
+        from apex_tpu.transformer.amp import GradScaler
+
+        parallel_state.destroy_model_parallel()
+        scaler = GradScaler("dynamic")
+        state = scaler.init()
+        grads = {"w": jnp.ones((3,)) * state.loss_scale}
+        un, found = scaler.unscale(grads, state)
+        assert not bool(found)
+        np.testing.assert_allclose(np.asarray(un["w"]), np.ones(3), rtol=1e-6)
+
+
+class TestProfiling:
+    def test_nvtx_range_and_annotate(self):
+        from apex_tpu.utils import annotate_fn, nvtx_range
+
+        with nvtx_range("block"):
+            y = jnp.sum(jnp.ones(4))
+        assert float(y) == 4.0
+
+        @annotate_fn("scoped")
+        def f(x):
+            return x * 2
+
+        np.testing.assert_allclose(np.asarray(f(jnp.ones(2))), 2 * np.ones(2))
+
+    def test_named_scope_in_jit(self):
+        from apex_tpu.utils import nvtx_range
+
+        @jax.jit
+        def f(x):
+            with nvtx_range("inner"):
+                return x + 1
+
+        assert float(f(jnp.zeros(()))) == 1.0
+
+    def test_device_memory_stats_shape(self):
+        from apex_tpu.utils import device_memory_stats
+
+        stats = device_memory_stats()
+        assert isinstance(stats, dict)
+
+    def test_trace_writes_profile(self, tmp_path):
+        from apex_tpu.utils import trace
+
+        with trace(str(tmp_path)):
+            jax.block_until_ready(jnp.dot(jnp.ones((8, 8)), jnp.ones((8, 8))))
+        import os
+        found = any("trace" in f or f.endswith(".pb") or "plugins" in r
+                    for r, _, fs in os.walk(tmp_path) for f in fs + [r])
+        assert found
