@@ -44,9 +44,12 @@ def lm_head_loss(embedding_weight, hidden, labels, loss_mask, config):
     (optionally loss-masked) mean loss.
     """
     c = config
+    # LM-head matmul in compute dtype (bf16 on the MXU runs ~4x fp32 and
+    # halves the [s, b, V] logits footprint); the CE upcasts internally
+    # (vocab_parallel_cross_entropy fp32 math, Megatron kernel semantics)
     logits = linear_with_grad_accumulation_and_async_allreduce(
-        hidden.astype(jnp.float32),
-        embedding_weight.astype(jnp.float32),
+        hidden.astype(c.compute_dtype),
+        embedding_weight,     # callee casts weight to x.dtype (amp-O2 rule)
         None,
         sequence_parallel_enabled=c.sequence_parallel,
         axis_name=c.axis_name)                              # [s, b, V/tp]

@@ -1,20 +1,25 @@
 """Megatron-style model parallelism on a TPU mesh (capability of
-``apex/transformer``): tensor, sequence, pipeline, and context parallelism
-plus the mesh registry (``parallel_state``)."""
+``apex/transformer``): tensor, sequence, pipeline, context, and expert
+parallelism plus the mesh registry (``parallel_state``)."""
 
 from apex_tpu.transformer import enums
 from apex_tpu.transformer import functional
+from apex_tpu.transformer import moe
 from apex_tpu.transformer import parallel_state
 from apex_tpu.transformer import tensor_parallel
 from apex_tpu.transformer.enums import AttnMaskType, AttnType, LayerType, ModelType
+from apex_tpu.transformer.moe import MoEConfig, SwitchMLP
 
 __all__ = [
     "enums",
     "functional",
+    "moe",
     "parallel_state",
     "tensor_parallel",
     "AttnMaskType",
     "AttnType",
     "LayerType",
     "ModelType",
+    "MoEConfig",
+    "SwitchMLP",
 ]

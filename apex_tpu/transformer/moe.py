@@ -1,0 +1,198 @@
+"""Mixture-of-Experts with expert parallelism (EP).
+
+**Exceeds the reference**: apex has no MoE/expert code anywhere in the tree
+(SURVEY.md §2.2 "EP — absent"). This module completes the parallelism matrix
+(DP/TP/SP/PP/CP/EP) with the TPU-native shape of switch routing:
+
+- router: top-1 or top-2 gating with optional jitter and the standard
+  load-balancing auxiliary loss (Shazeer/Fedus switch-transformer recipe —
+  public algorithm, implemented fresh);
+- capacity-based dispatch: per-shard token buffers ``[E, C, h]`` built with
+  one-hot matmuls (MXU-friendly, no scatters), tokens over capacity dropped
+  to the residual path;
+- expert parallelism over a mesh axis (default: the ``data`` axis, the
+  standard "EP rides DP" layout): one ``lax.all_to_all`` ships each
+  expert's buffer to its owning rank, the expert FFNs run as one batched
+  einsum over the local experts, and a second ``all_to_all`` ships results
+  back. Unsharded (axis unbound) it degrades to a dense dispatch over all
+  experts locally.
+
+Layout follows the transformer stack: ``[s, b, h]`` activations, functional
+``init/apply``, works inside ``shard_map`` next to
+:class:`~apex_tpu.models.transformer.ParallelTransformerLayer`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec
+
+from apex_tpu.transformer.parallel_state import DATA_AXIS
+from apex_tpu.transformer.tensor_parallel.mappings import axis_bound
+from apex_tpu.transformer.tensor_parallel.utils import divide
+
+__all__ = ["MoEConfig", "SwitchMLP"]
+
+
+@dataclass(frozen=True)
+class MoEConfig:
+    hidden_size: int
+    ffn_hidden_size: int
+    num_experts: int
+    top_k: int = 1                      # 1 = switch, 2 = GShard-style
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 1e-2
+    router_jitter: float = 0.0          # multiplicative input jitter at train
+    expert_axis: Optional[str] = DATA_AXIS
+    params_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.float32
+    init_method_std: float = 0.02
+
+
+class SwitchMLP:
+    """Top-k routed expert FFN bank.
+
+    ``apply(params, x[s, b, h], rng, deterministic) ->
+    (y[s, b, h], aux_loss)``; ``aux_loss`` is already scaled by
+    ``config.aux_loss_weight`` — callers add it to the training objective
+    as-is.
+    """
+
+    def __init__(self, config: MoEConfig):
+        self.config = config
+
+    # -- params --------------------------------------------------------------
+
+    def init(self, key: jax.Array) -> Dict[str, Any]:
+        c = self.config
+        kr, k1, k2 = jax.random.split(key, 3)
+        std = c.init_method_std
+        dt = c.params_dtype
+        return {
+            "router": jax.random.normal(
+                kr, (c.hidden_size, c.num_experts), dt) * std,
+            "w_in": jax.random.normal(
+                k1, (c.num_experts, c.hidden_size, c.ffn_hidden_size),
+                dt) * std,
+            "b_in": jnp.zeros((c.num_experts, c.ffn_hidden_size), dt),
+            "w_out": jax.random.normal(
+                k2, (c.num_experts, c.ffn_hidden_size, c.hidden_size),
+                dt) * std,
+            "b_out": jnp.zeros((c.num_experts, c.hidden_size), dt),
+        }
+
+    def spec(self) -> Dict[str, PartitionSpec]:
+        """Experts sharded dim-0 over the expert axis; router replicated."""
+        e = self.config.expert_axis
+        return {
+            "router": PartitionSpec(),
+            "w_in": PartitionSpec(e, None, None),
+            "b_in": PartitionSpec(e, None),
+            "w_out": PartitionSpec(e, None, None),
+            "b_out": PartitionSpec(e, None),
+        }
+
+    # -- routing -------------------------------------------------------------
+
+    def _route(self, params, x2d, rng, deterministic):
+        """x2d: [T, h] -> (weights [T, k], experts [T, k], aux_loss)."""
+        c = self.config
+        inp = x2d
+        if not deterministic and c.router_jitter > 0.0 and rng is not None:
+            eps = jax.random.uniform(
+                rng, x2d.shape, x2d.dtype,
+                1.0 - c.router_jitter, 1.0 + c.router_jitter)
+            inp = x2d * eps
+        logits = inp.astype(jnp.float32) @ params["router"].astype(jnp.float32)
+        probs = jax.nn.softmax(logits, axis=-1)            # [T, E]
+        weights, experts = lax.top_k(probs, c.top_k)       # [T, k]
+        if c.top_k > 1:
+            weights = weights / jnp.sum(weights, -1, keepdims=True)
+
+        # load-balancing loss: E * sum_e fraction_e * mean_prob_e
+        # (switch-transformer aux objective)
+        top1 = experts[:, 0]
+        frac = jnp.mean(
+            jax.nn.one_hot(top1, c.num_experts, dtype=jnp.float32), axis=0)
+        mean_prob = jnp.mean(probs, axis=0)
+        aux = (c.aux_loss_weight * c.num_experts
+               * jnp.sum(frac * mean_prob))
+        return weights, experts, aux
+
+    # -- dispatch/combine ----------------------------------------------------
+
+    def _capacity(self, tokens: int) -> int:
+        c = self.config
+        cap = int(tokens * c.capacity_factor * c.top_k / c.num_experts)
+        return max(cap, 1)
+
+    def apply(self, params, x, *, rng=None,
+              deterministic: bool = True) -> Tuple[jax.Array, jax.Array]:
+        c = self.config
+        s, b, h = x.shape
+        tokens = s * b
+        x2d = x.reshape(tokens, h)
+        weights, experts, aux = self._route(params, x2d, rng, deterministic)
+        cap = self._capacity(tokens)
+
+        # position of each token within its expert's capacity buffer, one
+        # pass per k (cumsum over the one-hot assignment matrix)
+        dispatch = jnp.zeros((tokens, c.num_experts, cap), x.dtype)
+        combine = jnp.zeros((tokens, c.num_experts, cap), jnp.float32)
+        prior = jnp.zeros((c.num_experts,), jnp.int32)
+        for k in range(c.top_k):
+            onehot = jax.nn.one_hot(experts[:, k], c.num_experts,
+                                    dtype=jnp.int32)       # [T, E]
+            pos = jnp.cumsum(onehot, axis=0) - 1 + prior   # [T, E]
+            prior = prior + jnp.sum(onehot, axis=0)
+            within = jnp.take_along_axis(
+                pos, experts[:, k:k + 1], axis=1)[:, 0]    # [T]
+            keep = within < cap                            # overflow dropped
+            pos_oh = jax.nn.one_hot(jnp.where(keep, within, cap),
+                                    cap + 1, dtype=x.dtype)[:, :cap]
+            contrib = onehot.astype(x.dtype)[:, :, None] * pos_oh[:, None, :]
+            dispatch = dispatch + contrib
+            combine = combine + (contrib.astype(jnp.float32)
+                                 * weights[:, k, None, None])
+
+        # gather tokens into expert buffers: [E, C, h] (one-hot matmul — a
+        # dense MXU op instead of data-dependent scatters)
+        buffers = jnp.einsum("tec,th->ech", dispatch, x2d)
+
+        ep = (lax.axis_size(c.expert_axis)
+              if c.expert_axis and axis_bound(c.expert_axis) else 1)
+        if ep > 1:
+            divide(c.num_experts, ep)    # validate E % ep == 0
+            # ship expert buffers to their owners: split the expert dim
+            # (chunk i -> rank i), concat received chunks along capacity:
+            # [E, C, h] -> [E/ep, ep*C, h]; each rank now holds its local
+            # experts' tokens from every rank
+            buffers = lax.all_to_all(buffers, c.expert_axis, split_axis=0,
+                                     concat_axis=1, tiled=True)
+
+        cd = c.compute_dtype
+        # params inside shard_map are already the local expert shard
+        # ([E/ep, ...]) under spec(); unsharded they are the full bank
+        w_in, b_in = params["w_in"], params["b_in"]
+        w_out, b_out = params["w_out"], params["b_out"]
+        hmid = jnp.einsum("ech,ehf->ecf", buffers.astype(cd),
+                          w_in.astype(cd)) + b_in[:, None, :].astype(cd)
+        hmid = jax.nn.gelu(hmid)
+        out = jnp.einsum("ecf,efh->ech", hmid,
+                         w_out.astype(cd)) + b_out[:, None, :].astype(cd)
+
+        if ep > 1:
+            # inverse shuffle: split capacity back per source rank, concat
+            # experts back to global order: [E/ep, ep*C, h] -> [E, C, h]
+            out = lax.all_to_all(out, c.expert_axis, split_axis=1,
+                                 concat_axis=0, tiled=True)
+
+        # combine back to token order with routing weights
+        y = jnp.einsum("tec,ech->th", combine.astype(jnp.float32),
+                       out.astype(jnp.float32))
+        return y.reshape(s, b, h).astype(x.dtype), aux

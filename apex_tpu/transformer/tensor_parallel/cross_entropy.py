@@ -39,6 +39,11 @@ def _tp(axis_name):
 
 def _forward(vocab_parallel_logits, target, label_smoothing, axis_name):
     rank, size, bound = _tp(axis_name)
+    in_dtype = vocab_parallel_logits.dtype
+    # fp32 internal math regardless of logits dtype (the reference CUDA
+    # kernel upcasts half logits, xentropy_kernel.cu) — callers can feed
+    # bf16 logits straight from a bf16 LM-head matmul
+    vocab_parallel_logits = vocab_parallel_logits.astype(jnp.float32)
     local_vocab = vocab_parallel_logits.shape[-1]
     global_vocab = local_vocab * size
     start = rank * local_vocab
@@ -80,7 +85,10 @@ def _forward(vocab_parallel_logits, target, label_smoothing, axis_name):
         mean_log_probs = sum_log_probs / global_vocab
         loss = (1.0 - smoothing) * loss - smoothing * mean_log_probs
 
-    residuals = (softmax, in_range, masked_target, smoothing, global_vocab)
+    # residual kept in the caller's dtype: halves backward HBM traffic for
+    # bf16 logits (the grad is bf16 anyway — it feeds a bf16 matmul)
+    residuals = (softmax.astype(in_dtype), in_range, masked_target,
+                 smoothing, global_vocab)
     return loss, residuals
 
 
@@ -106,15 +114,15 @@ def _vjp_bwd(label_smoothing, axis_name, residuals, g):
     # Reference backward (:100-134): grad = softmax - onehot(target) on the
     # local shard, with the smoothing correction spread over the vocab.
     softmax, in_range, masked_target, smoothing, global_vocab = residuals
-    grad = softmax
+    grad = softmax.astype(jnp.float32)     # fp32 math, output in input dtype
     onehot = jax.nn.one_hot(
-        masked_target, softmax.shape[-1], dtype=softmax.dtype)
-    onehot = onehot * in_range[..., None].astype(softmax.dtype)
+        masked_target, softmax.shape[-1], dtype=jnp.float32)
+    onehot = onehot * in_range[..., None].astype(jnp.float32)
     if smoothing > 0:
         grad = grad - (1.0 - smoothing) * onehot - smoothing / global_vocab
     else:
         grad = grad - onehot
-    grad = grad * g[..., None]
+    grad = grad * g[..., None].astype(jnp.float32)
     return (grad.astype(softmax.dtype), None)
 
 
