@@ -89,17 +89,28 @@ def make_train_step(
     def per_rank(params, opt_state, batch, rng):
         if rng is not None:
             # independent dropout streams per data shard (DDP's per-rank RNG);
-            # the tensor axis is folded inside model-parallel regions only
+            # the tensor axis is folded inside model-parallel regions only.
+            # Unbound axes fold index 0 so the single-device fast path below
+            # draws the identical stream as a size-1 shard_map would.
             for a in data_axes:
                 try:
-                    rng = jax.random.fold_in(rng, lax.axis_index(a))
+                    idx = lax.axis_index(a)
                 except NameError:
-                    pass
+                    idx = 0
+                rng = jax.random.fold_in(rng, idx)
         loss, grads = jax.value_and_grad(loss_fn)(params, batch, rng)
         grads = sync_data_parallel_grads(grads, grad_sync_axes)
         loss = sync_data_parallel_grads(loss, data_axes)
         new_params, new_state = optimizer.step(grads, params, opt_state)
         return new_params, new_state, loss
+
+    if mesh.size == 1:
+        # single-device mesh: manual partitioning buys nothing and costs a
+        # lot (tunneled PJRT backends execute SPMD-partitioned programs an
+        # order of magnitude slower; measured 9x on GPT-124M) — run the
+        # per-rank body directly. Semantics match: every mesh axis has size
+        # 1, and all collective regions no-op behind axis_bound() guards.
+        return jax.jit(per_rank, donate_argnums=(0, 1) if donate else ())
 
     sharded = jax.shard_map(
         per_rank,

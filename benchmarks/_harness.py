@@ -1,0 +1,51 @@
+"""Shared benchmark harness: time a jitted train step, print ONE JSON line
+(same contract as the repo-root ``bench.py``). All configs from BASELINE.md
+live here as scripts; absolute numbers are self-measured (the reference
+publishes none — BASELINE.md)."""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Callable
+
+
+def run(metric: str, unit: str, step_fn: Callable, *state,
+        work_per_step: float, steps: int = 10, baseline_fn=None):
+    """``step_fn(*state) -> (*new_state, loss)``; prints the JSON line.
+
+    ``baseline_fn``: optional same-signature unoptimized step; when given,
+    ``vs_baseline`` reports measured speedup, else 1.0.
+    """
+    import jax
+    import numpy as _np
+
+    def _fetch(x):
+        # hard device->host fetch: through tunneled PJRT backends (axon)
+        # block_until_ready can return before execution finishes, inflating
+        # throughput ~10x; np.asarray cannot lie
+        return _np.asarray(x)
+
+    def _time(fn, state):
+        # fresh copies per timing run: a donating step consumes its input
+        # buffers, and the baseline run must reuse the same initial state
+        state = [jax.tree.map(lambda a: a.copy() if hasattr(a, "copy") else a,
+                              s) for s in state]
+        out = fn(*state)
+        _fetch(out[-1])
+        state = list(out[:-1])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = fn(*state)
+            state = list(out[:-1])
+        _fetch(out[-1])
+        return (time.perf_counter() - t0) / steps
+
+    dt = _time(step_fn, state)
+    value = work_per_step / dt
+    vs = 1.0
+    if baseline_fn is not None:
+        vs = _time(baseline_fn, state) * value / work_per_step
+    print(json.dumps({"metric": metric, "value": round(value, 1),
+                      "unit": unit, "vs_baseline": round(vs, 3)}))
+    return value

@@ -1,0 +1,47 @@
+"""BASELINE config 3: BERT-base pretrain step, FusedLAMB + Pallas LayerNorm.
+
+Measures tokens/sec/chip.
+Usage: ``PYTHONPATH=/root/repo:/root/.axon_site python benchmarks/bert_lamb.py``
+"""
+
+import sys, os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from benchmarks._harness import run
+from apex_tpu.models import BertModel, TransformerConfig
+from apex_tpu.optimizers import FusedLAMB
+from apex_tpu.transformer.enums import AttnMaskType
+
+
+def main(batch=16, seq=512):
+    cfg = TransformerConfig(
+        num_layers=12, hidden_size=768, num_attention_heads=12,
+        vocab_size=30528, max_position_embeddings=512,
+        hidden_dropout=0.0, attention_dropout=0.0,
+        attn_mask_type=AttnMaskType.padding,
+        recompute=True, compute_dtype=jnp.bfloat16)
+    model = BertModel(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    opt = FusedLAMB(lr=1e-3, weight_decay=0.01)
+    opt_state = opt.init(params)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0, 30528)
+    labels = jax.random.randint(jax.random.PRNGKey(2), (batch, seq), 0, 30528)
+
+    @jax.jit
+    def step(params, opt_state):
+        def loss_fn(p):
+            lm_loss, _ = model.apply(p, tokens, lm_labels=labels)
+            return lm_loss
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        params, opt_state = opt.step(grads, params, opt_state)
+        return params, opt_state, loss
+
+    run("bert_base_lamb_train_tokens_per_sec_per_chip", "tokens/sec",
+        step, params, opt_state, work_per_step=batch * seq)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,41 @@
+"""BASELINE config 5: ViT-L/16 + FusedAdam train step; imgs/sec/chip.
+
+Usage: ``PYTHONPATH=/root/repo:/root/.axon_site python benchmarks/vit_adam.py``
+"""
+
+import sys, os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from benchmarks._harness import run
+from apex_tpu.models import vit_l16
+from apex_tpu.optimizers import FusedAdam
+
+
+def main(batch=32, image=224):
+    model = vit_l16(image_size=image, num_classes=1000,
+                    recompute=True, compute_dtype=jnp.bfloat16)
+    params = model.init(jax.random.PRNGKey(0))
+    opt = FusedAdam(lr=3e-4, weight_decay=0.05)
+    opt_state = opt.init(params)
+    x = jax.random.normal(jax.random.PRNGKey(1), (batch, image, image, 3))
+    y = jax.random.randint(jax.random.PRNGKey(2), (batch,), 0, 1000)
+
+    @jax.jit
+    def step(params, opt_state):
+        def loss_fn(p):
+            logits = model.apply(p, x)
+            logp = jax.nn.log_softmax(logits)
+            return -jnp.mean(logp[jnp.arange(batch), y])
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        params, opt_state = opt.step(grads, params, opt_state)
+        return params, opt_state, loss
+
+    run("vit_l16_adam_train_imgs_per_sec_per_chip", "imgs/sec",
+        step, params, opt_state, work_per_step=batch)
+
+
+if __name__ == "__main__":
+    main()
