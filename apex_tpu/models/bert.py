@@ -48,6 +48,10 @@ class BertModel:
 
     def __post_init__(self):
         c = self.config
+        if c.num_moe_experts:
+            raise NotImplementedError(
+                "MoE (num_moe_experts) is currently wired into GPTModel "
+                "only; BertModel does not consume the (hidden, aux) pair")
         if c.attn_mask_type == AttnMaskType.causal:
             self.config = c = replace(c, attn_mask_type=AttnMaskType.padding)
         self.embedding = VocabParallelEmbedding(

@@ -125,6 +125,12 @@ class GPTModel:
         hidden = self.transformer.apply(
             params["transformer"], hidden, rng=rngs[1],
             deterministic=deterministic)
-        return lm_head_loss(
+        moe_aux = None
+        if self.config.num_moe_experts:
+            hidden, moe_aux = hidden
+        out = lm_head_loss(
             params["embedding"]["word_embeddings"]["weight"], hidden,
             labels, loss_mask, self.config)
+        if moe_aux is not None and labels is not None:
+            out = out + moe_aux        # load-balancing term, pre-scaled
+        return out

@@ -60,6 +60,10 @@ class PipelinedGPT:
 
     def __post_init__(self):
         c = self.config
+        if c.num_moe_experts:
+            raise NotImplementedError(
+                "MoE (num_moe_experts) is currently wired into GPTModel "
+                "only; the pipeline scan carries a bare hidden state")
         self.embedding = VocabParallelEmbedding(
             c.vocab_size, c.hidden_size, init_method=c.init_method(),
             params_dtype=c.params_dtype, axis_name=c.axis_name)

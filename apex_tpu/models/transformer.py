@@ -77,6 +77,14 @@ class TransformerConfig:
     # None | "ring" (ppermute KV rotation) | "ulysses" (all-to-all head swap)
     context_parallel_method: Optional[str] = None
     context_axis: str = CONTEXT_AXIS
+    # MoE (exceeds reference, SURVEY.md §2.2 EP: absent): when set, every
+    # layer's MLP becomes a SwitchMLP with this many experts; apply() then
+    # returns (hidden, aux_loss)
+    num_moe_experts: Optional[int] = None
+    moe_top_k: int = 1
+    moe_capacity_factor: float = 1.25
+    moe_aux_loss_weight: float = 1e-2
+    moe_expert_axis: Optional[str] = None   # e.g. "data" for EP over DP
     recompute: bool = False          # full-layer activation recompute
     params_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.float32  # activations cast at block entry
@@ -329,7 +337,21 @@ class ParallelTransformerLayer:
     def __post_init__(self):
         c = self.config
         self.attention = ParallelAttention(c)
-        self.mlp = ParallelMLP(c)
+        if c.num_moe_experts:
+            from apex_tpu.transformer.moe import MoEConfig, SwitchMLP
+            self.mlp = SwitchMLP(MoEConfig(
+                hidden_size=c.hidden_size,
+                ffn_hidden_size=c.ffn_size,
+                num_experts=c.num_moe_experts,
+                top_k=c.moe_top_k,
+                capacity_factor=c.moe_capacity_factor,
+                aux_loss_weight=c.moe_aux_loss_weight,
+                expert_axis=c.moe_expert_axis,
+                params_dtype=c.params_dtype,
+                compute_dtype=c.compute_dtype,
+                init_method_std=c.init_method_std))
+        else:
+            self.mlp = ParallelMLP(c)
 
     def init(self, key):
         c = self.config
@@ -366,11 +388,20 @@ class ParallelTransformerLayer:
         hidden = hidden + attn_out
         x = _ln(params["post_attention_layernorm"], hidden,
                 c.layernorm_epsilon, c.sequence_parallel, c.axis_name)
-        mlp_out = self.mlp.apply(params["mlp"], x.astype(c.compute_dtype))
+        if c.num_moe_experts:
+            moe_rng = (None if rngs[1] is None
+                       else jax.random.fold_in(rngs[1], 1))
+            mlp_out, aux = self.mlp.apply(
+                params["mlp"], x.astype(c.compute_dtype),
+                rng=moe_rng, deterministic=deterministic)
+        else:
+            mlp_out = self.mlp.apply(params["mlp"], x.astype(c.compute_dtype))
+            aux = None
         mlp_out = _dropout(mlp_out, c.hidden_dropout, rngs[1], deterministic,
                            model_parallel_region=c.sequence_parallel,
                            axis_name=c.axis_name)
-        return hidden + mlp_out
+        out = hidden + mlp_out
+        return (out, aux) if c.num_moe_experts else out
 
 
 @dataclass
@@ -403,25 +434,31 @@ class ParallelTransformer:
 
     def apply(self, params, hidden, *, attention_mask=None, kv_lengths=None,
               rng=None, deterministic=True, final_norm=True):
+        """Returns ``hidden`` — or ``(hidden, moe_aux_loss)`` (aux summed
+        over layers) when the config enables MoE."""
         c = self.config
+        moe = bool(c.num_moe_experts)
 
         def one_layer(carry, xs):
-            h, idx = carry
+            h, aux_sum, idx = carry
             layer_params = xs
             layer_rng = None if rng is None else jax.random.fold_in(rng, idx)
 
             def run(h):
-                return self.layer.apply(
+                out = self.layer.apply(
                     layer_params, h, attention_mask=attention_mask,
                     kv_lengths=kv_lengths, rng=layer_rng,
                     deterministic=deterministic)
+                return out if moe else (out, jnp.zeros((), jnp.float32))
 
-            h = jax.checkpoint(run)(h) if c.recompute else run(h)
-            return (h, idx + 1), None
+            h, aux = (jax.checkpoint(run)(h) if c.recompute else run(h))
+            return (h, aux_sum + aux, idx + 1), None
 
-        (hidden, _), _ = lax.scan(one_layer, (hidden, 0), params["layers"])
+        (hidden, aux_sum, _), _ = lax.scan(
+            one_layer, (hidden, jnp.zeros((), jnp.float32), 0),
+            params["layers"])
         if final_norm:
             hidden = _ln(params["final_layernorm"], hidden,
                          c.layernorm_epsilon, c.sequence_parallel,
                          c.axis_name)
-        return hidden
+        return (hidden, aux_sum) if moe else hidden

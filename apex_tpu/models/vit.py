@@ -54,6 +54,10 @@ class ViTModel:
     ``apply(params, images_nhwc) -> logits``."""
 
     def __init__(self, config: ViTConfig):
+        if config.transformer.num_moe_experts:
+            raise NotImplementedError(
+                "MoE (num_moe_experts) is currently wired into GPTModel "
+                "only; ViTModel does not consume the (hidden, aux) pair")
         self.config = config
         self.encoder = ParallelTransformer(config.transformer)
 
