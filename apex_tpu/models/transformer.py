@@ -84,6 +84,7 @@ class TransformerConfig:
     moe_top_k: int = 1
     moe_capacity_factor: float = 1.25
     moe_aux_loss_weight: float = 1e-2
+    moe_router_jitter: float = 0.0
     moe_expert_axis: Optional[str] = None   # e.g. "data" for EP over DP
     recompute: bool = False          # full-layer activation recompute
     params_dtype: Any = jnp.float32
@@ -346,6 +347,7 @@ class ParallelTransformerLayer:
                 top_k=c.moe_top_k,
                 capacity_factor=c.moe_capacity_factor,
                 aux_loss_weight=c.moe_aux_loss_weight,
+                router_jitter=c.moe_router_jitter,
                 expert_axis=c.moe_expert_axis,
                 params_dtype=c.params_dtype,
                 compute_dtype=c.compute_dtype,

@@ -1,0 +1,57 @@
+"""Minimal distributed data-parallel training.
+
+Parity with the reference's ``examples/simple/distributed/
+distributed_data_parallel.py`` (a linear model trained under apex DDP,
+launched with one process per GPU): here one process drives all devices —
+a ``data``-axis mesh, per-rank autodiff under ``shard_map``, and a gradient
+``pmean`` standing in for DDP's bucketed allreduce.
+
+Run on real chips, or on a virtual mesh:
+``XLA_FLAGS=--xla_force_host_platform_device_count=4 JAX_PLATFORMS=cpu \\
+  PYTHONPATH=/root/repo python examples/simple_distributed.py``
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu.optimizers import FusedSGD
+
+devices = jax.devices()
+mesh = Mesh(np.array(devices), ("data",))
+ndev = len(devices)
+print(f"world size: {ndev}")
+
+key = jax.random.PRNGKey(0)
+params = {"w": jax.random.normal(key, (8, 1)) * 0.1, "b": jnp.zeros((1,))}
+opt = FusedSGD(lr=0.1)
+opt_state = opt.init(params)
+
+x = jax.random.normal(jax.random.PRNGKey(1), (32 * ndev, 8))
+y = x @ jnp.arange(1.0, 9.0).reshape(8, 1) + 0.5
+
+
+def per_rank(params, opt_state, x, y):
+    def loss_fn(p):
+        return jnp.mean((x @ p["w"] + p["b"] - y) ** 2)
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    grads = jax.lax.pmean(grads, "data")     # DDP allreduce
+    loss = jax.lax.pmean(loss, "data")
+    params, opt_state = opt.step(grads, params, opt_state)
+    return params, opt_state, loss
+
+
+step = jax.jit(jax.shard_map(
+    per_rank, mesh=mesh,
+    in_specs=(P(), P(), P("data"), P("data")),
+    out_specs=(P(), P(), P()), check_vma=False))
+
+for it in range(50):
+    params, opt_state, loss = step(params, opt_state, x, y)
+    if it % 10 == 0:
+        print(f"iter {it:3d} loss {float(loss):.6f}")
+print("final loss:", float(loss))
+assert float(loss) < 1e-3, "did not converge"
+print("CONVERGED OK")

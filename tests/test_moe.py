@@ -214,7 +214,13 @@ class TestMoETransformer:
         assert logits.shape == (8, 2, 64)
 
     def test_moe_guarded_in_non_gpt_models(self):
-        from apex_tpu.models import BertModel, PipelinedGPT, TransformerConfig
+        from apex_tpu.models import (
+            BertModel,
+            PipelinedGPT,
+            TransformerConfig,
+            ViTConfig,
+            ViTModel,
+        )
 
         cfg = TransformerConfig(
             num_layers=2, hidden_size=32, num_attention_heads=4,
@@ -223,3 +229,6 @@ class TestMoETransformer:
             BertModel(cfg)
         with pytest.raises(NotImplementedError):
             PipelinedGPT(cfg, pipeline_size=2, num_microbatches=2)
+        with pytest.raises(NotImplementedError):
+            ViTModel(ViTConfig(image_size=32, patch_size=16, num_classes=4,
+                               transformer=cfg))
