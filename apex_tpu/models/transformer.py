@@ -35,7 +35,7 @@ from jax import lax
 from jax.sharding import PartitionSpec
 
 from apex_tpu.ops import flash_attention, fused_layer_norm_affine
-from apex_tpu.transformer.enums import AttnMaskType
+from apex_tpu.transformer.enums import AttnMaskType, AttnType, LayerType
 from apex_tpu.transformer.functional import FusedScaleMaskSoftmax
 from apex_tpu.transformer.parallel_state import CONTEXT_AXIS, TENSOR_AXIS
 from apex_tpu.transformer.tensor_parallel.layers import (
@@ -224,47 +224,82 @@ class ParallelMLP:
 
 @dataclass
 class ParallelAttention:
-    """Self-attention with TP-sharded heads.
+    """Self- or cross-attention with TP-sharded heads.
 
     Reference: ``standalone_transformer_lm.py`` ``ParallelAttention``
-    (~:675-884): fused QKV ColumnParallelLinear (``gather_output=False``),
-    per-rank head slice, core attention (fused softmax + dropout + BMMs or
-    flash), RowParallelLinear output projection.
+    (~:675-884): fused QKV ColumnParallelLinear (``gather_output=False``) for
+    self-attention, separate Q and fused KV projections for cross-attention
+    (``attention_type == AttnType.cross_attn`` branch), per-rank head slice,
+    core attention (fused softmax + dropout + BMMs or flash),
+    RowParallelLinear output projection.
     """
 
     config: TransformerConfig
+    attn_type: Any = AttnType.self_attn
 
     def __post_init__(self):
         c = self.config
-        self.query_key_value = ColumnParallelLinear(
-            c.hidden_size, 3 * c.hidden_size, gather_output=False,
-            init_method=c.init_method(),
-            sequence_parallel_enabled=c.sequence_parallel,
-            params_dtype=c.params_dtype, axis_name=c.axis_name)
+        if self.attn_type == AttnType.self_attn:
+            self.query_key_value = ColumnParallelLinear(
+                c.hidden_size, 3 * c.hidden_size, gather_output=False,
+                init_method=c.init_method(),
+                sequence_parallel_enabled=c.sequence_parallel,
+                params_dtype=c.params_dtype, axis_name=c.axis_name)
+        else:
+            self.query = ColumnParallelLinear(
+                c.hidden_size, c.hidden_size, gather_output=False,
+                init_method=c.init_method(),
+                sequence_parallel_enabled=c.sequence_parallel,
+                params_dtype=c.params_dtype, axis_name=c.axis_name)
+            # CONTRACT: encoder_output is the full (gathered) sequence; the
+            # KV projection runs without SP, so a sequence-sharded input
+            # would silently attend over one shard — callers under SP must
+            # gather first (see ParallelTransformerLayer.apply docstring)
+            self.key_value = ColumnParallelLinear(
+                c.hidden_size, 2 * c.hidden_size, gather_output=False,
+                init_method=c.init_method(),
+                sequence_parallel_enabled=False,
+                params_dtype=c.params_dtype, axis_name=c.axis_name)
         self.dense = RowParallelLinear(
             c.hidden_size, c.hidden_size, input_is_parallel=True,
             init_method=c.output_init_method(),
             sequence_parallel_enabled=c.sequence_parallel,
             params_dtype=c.params_dtype, axis_name=c.axis_name)
         self.scale_mask_softmax = FusedScaleMaskSoftmax(
-            attn_mask_type=c.attn_mask_type,
+            attn_mask_type=(AttnMaskType.padding
+                            if self.attn_type == AttnType.cross_attn
+                            else c.attn_mask_type),
             scaled_masked_softmax_fusion=True,
             softmax_in_fp32=True)
 
     def init(self, key):
         k1, k2 = jax.random.split(key)
-        return {"query_key_value": self.query_key_value.init(k1),
+        if self.attn_type == AttnType.self_attn:
+            return {"query_key_value": self.query_key_value.init(k1),
+                    "dense": self.dense.init(k2)}
+        k1a, k1b = jax.random.split(k1)
+        return {"query": self.query.init(k1a),
+                "key_value": self.key_value.init(k1b),
                 "dense": self.dense.init(k2)}
 
     def spec(self):
-        return {"query_key_value": self.query_key_value.spec(),
+        if self.attn_type == AttnType.self_attn:
+            return {"query_key_value": self.query_key_value.spec(),
+                    "dense": self.dense.spec()}
+        return {"query": self.query.spec(),
+                "key_value": self.key_value.spec(),
                 "dense": self.dense.spec()}
 
     def _core_attention(self, q, k, v, attention_mask, kv_lengths,
                         rng, deterministic):
         """q/k/v: [b, local_heads, s, dh]."""
         c = self.config
-        causal = c.attn_mask_type == AttnMaskType.causal
+        causal = (self.attn_type == AttnType.self_attn
+                  and c.attn_mask_type == AttnMaskType.causal)
+        if c.context_parallel_method and self.attn_type != AttnType.self_attn:
+            raise NotImplementedError(
+                "context parallelism shards the self-attention sequence; "
+                "cross-attention K/V come from the (unsharded) encoder")
         if c.context_parallel_method:
             from apex_tpu.ops.ring_attention import (
                 ring_attention,
@@ -304,16 +339,30 @@ class ParallelAttention:
                          model_parallel_region=True, axis_name=c.axis_name)
         return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
 
-    def apply(self, params, hidden, *, attention_mask=None, kv_lengths=None,
-              rng=None, deterministic=True):
-        """hidden: [s(, shard), b, h] -> [s(, shard), b, h]."""
+    def apply(self, params, hidden, *, encoder_output=None,
+              attention_mask=None, kv_lengths=None, rng=None,
+              deterministic=True):
+        """hidden: [s(, shard), b, h] -> [s(, shard), b, h]; cross-attention
+        reads K/V from ``encoder_output`` [s_enc, b, h]."""
         c = self.config
-        qkv = self.query_key_value.apply(params["query_key_value"], hidden)
-        s, b = qkv.shape[0], qkv.shape[1]
         dh = c.head_dim
-        local_heads = qkv.shape[-1] // (3 * dh)
-        qkv = qkv.reshape(s, b, local_heads, 3 * dh)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
+        if self.attn_type == AttnType.self_attn:
+            qkv = self.query_key_value.apply(params["query_key_value"],
+                                             hidden)
+            s, b = qkv.shape[0], qkv.shape[1]
+            local_heads = qkv.shape[-1] // (3 * dh)
+            qkv = qkv.reshape(s, b, local_heads, 3 * dh)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+        else:
+            if encoder_output is None:
+                raise ValueError("cross-attention needs encoder_output")
+            q = self.query.apply(params["query"], hidden)
+            kv = self.key_value.apply(params["key_value"], encoder_output)
+            s, b = q.shape[0], q.shape[1]
+            local_heads = q.shape[-1] // dh
+            q = q.reshape(s, b, local_heads, dh)
+            kv = kv.reshape(kv.shape[0], b, local_heads, 2 * dh)
+            k, v = jnp.split(kv, 2, axis=-1)
         # [s, b, hl, dh] -> [b, hl, s, dh]
         q, k, v = (t.transpose(1, 2, 0, 3) for t in (q, k, v))
         ctx = self._core_attention(q, k, v, attention_mask, kv_lengths,
@@ -334,10 +383,17 @@ class ParallelTransformerLayer:
     """
 
     config: TransformerConfig
+    layer_type: Any = LayerType.encoder
 
     def __post_init__(self):
         c = self.config
         self.attention = ParallelAttention(c)
+        if self.layer_type == LayerType.decoder:
+            # decoder blocks add cross-attention over the encoder output
+            # (reference ParallelTransformerLayer inter_attention branch,
+            # standalone_transformer_lm.py ~:1090-1115)
+            self.inter_attention = ParallelAttention(
+                c, attn_type=AttnType.cross_attn)
         if c.num_moe_experts:
             from apex_tpu.transformer.moe import MoEConfig, SwitchMLP
             self.mlp = SwitchMLP(MoEConfig(
@@ -357,27 +413,45 @@ class ParallelTransformerLayer:
 
     def init(self, key):
         c = self.config
-        k1, k2 = jax.random.split(key)
-        return {
+        k1, k2, k3 = jax.random.split(key, 3)
+        p = {
             "input_layernorm": _ln_params(c.hidden_size, c.params_dtype),
             "self_attention": self.attention.init(k1),
             "post_attention_layernorm": _ln_params(c.hidden_size, c.params_dtype),
             "mlp": self.mlp.init(k2),
         }
+        if self.layer_type == LayerType.decoder:
+            p["inter_attention"] = self.inter_attention.init(k3)
+            p["post_inter_attention_layernorm"] = _ln_params(
+                c.hidden_size, c.params_dtype)
+        return p
 
     def spec(self):
-        return {
+        s = {
             "input_layernorm": _ln_spec(),
             "self_attention": self.attention.spec(),
             "post_attention_layernorm": _ln_spec(),
             "mlp": self.mlp.spec(),
         }
+        if self.layer_type == LayerType.decoder:
+            s["inter_attention"] = self.inter_attention.spec()
+            s["post_inter_attention_layernorm"] = _ln_spec()
+        return s
 
-    def apply(self, params, hidden, *, attention_mask=None, kv_lengths=None,
+    def apply(self, params, hidden, *, encoder_output=None,
+              enc_dec_attn_mask=None, attention_mask=None, kv_lengths=None,
               rng=None, deterministic=True):
+        """``encoder_output`` (decoder layers) must be the FULL encoder
+        sequence ``[s_enc, b, h]`` — under sequence parallelism gather it
+        first (``gather_from_sequence_parallel_region``), as
+        :class:`~apex_tpu.models.bert.BertModel` does for its heads."""
         c = self.config
-        rngs = ((None,) * 3 if rng is None
-                else tuple(jax.random.split(rng, 3)))
+        decoder = self.layer_type == LayerType.decoder
+        # decoder layers draw a 4th key; encoder layers keep the historical
+        # 3-way split so fixed-seed dropout streams stay reproducible
+        n_keys = 4 if decoder else 3
+        rngs = ((None,) * n_keys if rng is None
+                else tuple(jax.random.split(rng, n_keys)))
         x = _ln(params["input_layernorm"], hidden, c.layernorm_epsilon,
                 c.sequence_parallel, c.axis_name)
         attn_out = self.attention.apply(
@@ -388,7 +462,25 @@ class ParallelTransformerLayer:
                             model_parallel_region=c.sequence_parallel,
                             axis_name=c.axis_name)
         hidden = hidden + attn_out
-        x = _ln(params["post_attention_layernorm"], hidden,
+        if decoder:
+            x = _ln(params["post_attention_layernorm"], hidden,
+                    c.layernorm_epsilon, c.sequence_parallel, c.axis_name)
+            r_attn = None if rngs[3] is None else jax.random.fold_in(rngs[3], 0)
+            r_drop = None if rngs[3] is None else jax.random.fold_in(rngs[3], 1)
+            inter_out = self.inter_attention.apply(
+                params["inter_attention"], x.astype(c.compute_dtype),
+                encoder_output=encoder_output,
+                attention_mask=enc_dec_attn_mask,
+                rng=r_attn, deterministic=deterministic)
+            inter_out = _dropout(
+                inter_out, c.hidden_dropout, r_drop, deterministic,
+                model_parallel_region=c.sequence_parallel,
+                axis_name=c.axis_name)
+            hidden = hidden + inter_out
+            norm_name = "post_inter_attention_layernorm"
+        else:
+            norm_name = "post_attention_layernorm"
+        x = _ln(params[norm_name], hidden,
                 c.layernorm_epsilon, c.sequence_parallel, c.axis_name)
         if c.num_moe_experts:
             moe_rng = (None if rngs[1] is None
@@ -416,9 +508,10 @@ class ParallelTransformer:
     """
 
     config: TransformerConfig
+    layer_type: Any = LayerType.encoder
 
     def __post_init__(self):
-        self.layer = ParallelTransformerLayer(self.config)
+        self.layer = ParallelTransformerLayer(self.config, self.layer_type)
 
     def init(self, key):
         keys = jax.random.split(key, self.config.num_layers)
@@ -434,7 +527,8 @@ class ParallelTransformer:
             is_leaf=lambda x: isinstance(x, PartitionSpec))
         return {"layers": stacked, "final_layernorm": _ln_spec()}
 
-    def apply(self, params, hidden, *, attention_mask=None, kv_lengths=None,
+    def apply(self, params, hidden, *, encoder_output=None,
+              enc_dec_attn_mask=None, attention_mask=None, kv_lengths=None,
               rng=None, deterministic=True, final_norm=True):
         """Returns ``hidden`` — or ``(hidden, moe_aux_loss)`` (aux summed
         over layers) when the config enables MoE."""
@@ -448,7 +542,9 @@ class ParallelTransformer:
 
             def run(h):
                 out = self.layer.apply(
-                    layer_params, h, attention_mask=attention_mask,
+                    layer_params, h, encoder_output=encoder_output,
+                    enc_dec_attn_mask=enc_dec_attn_mask,
+                    attention_mask=attention_mask,
                     kv_lengths=kv_lengths, rng=layer_rng,
                     deterministic=deterministic)
                 return out if moe else (out, jnp.zeros((), jnp.float32))
