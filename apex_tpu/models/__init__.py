@@ -16,6 +16,7 @@ from apex_tpu.models.transformer import (
 )
 from apex_tpu.models.gpt import GPTModel
 from apex_tpu.models.bert import BertModel
+from apex_tpu.models.encoder_decoder import EncoderDecoderModel
 from apex_tpu.models.pipelined import PipelinedGPT
 from apex_tpu.models.resnet import (
     ResNet,
@@ -52,5 +53,6 @@ __all__ = [
     "ParallelTransformer",
     "GPTModel",
     "BertModel",
+    "EncoderDecoderModel",
     "PipelinedGPT",
 ]

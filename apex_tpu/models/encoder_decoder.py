@@ -1,0 +1,132 @@
+"""Encoder-decoder LM (T5-style) on the parallel transformer toolkit.
+
+Capability counterpart of the reference's ``ModelType.encoder_and_decoder``
+path through the Megatron testing LM (``standalone_transformer_lm.py``
+builds encoder+decoder ``ParallelTransformer`` stacks with cross-attention
+decoder layers; exercised by the pipeline-parallel tests with
+encoder_and_decoder model type). Here: a bidirectional encoder stack, a
+causal decoder stack whose layers cross-attend the gathered encoder output,
+tied input embeddings, and the vocab-parallel LM loss tail.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec
+
+from apex_tpu.models.gpt import lm_head_loss
+from apex_tpu.models.transformer import (
+    ParallelTransformer,
+    TransformerConfig,
+    embed_tokens,
+)
+from apex_tpu.transformer.enums import AttnMaskType, LayerType
+from apex_tpu.transformer.tensor_parallel.layers import VocabParallelEmbedding
+from apex_tpu.transformer.tensor_parallel.mappings import (
+    gather_from_sequence_parallel_region,
+)
+
+__all__ = ["EncoderDecoderModel"]
+
+
+@dataclass
+class EncoderDecoderModel:
+    """``apply(params, enc_tokens, dec_tokens, labels=None)``.
+
+    ``config`` describes the decoder; the encoder uses the same sizes with
+    bidirectional (padding) attention and ``num_encoder_layers`` depth
+    (default: ``config.num_layers``).
+    """
+
+    config: TransformerConfig
+    num_encoder_layers: Optional[int] = None
+
+    def __post_init__(self):
+        c = self.config
+        if c.num_moe_experts:
+            raise NotImplementedError(
+                "MoE (num_moe_experts) is currently wired into GPTModel only")
+        if c.context_parallel_method:
+            raise NotImplementedError(
+                "context parallelism is decoder-self-attention only; the "
+                "cross-attended encoder output is not sequence-sharded")
+        n_enc = (c.num_layers if self.num_encoder_layers is None
+                 else self.num_encoder_layers)
+        if n_enc < 1:
+            raise ValueError(f"num_encoder_layers must be >= 1, got {n_enc}")
+        self._enc_cfg = replace(
+            c, attn_mask_type=AttnMaskType.padding, num_layers=n_enc)
+        self._dec_cfg = replace(c, attn_mask_type=AttnMaskType.causal)
+        self.embedding = VocabParallelEmbedding(
+            c.vocab_size, c.hidden_size, init_method=c.init_method(),
+            params_dtype=c.params_dtype, axis_name=c.axis_name)
+        self.encoder = ParallelTransformer(self._enc_cfg)
+        self.decoder = ParallelTransformer(self._dec_cfg, LayerType.decoder)
+
+    def init(self, key: jax.Array) -> Dict[str, Any]:
+        c = self.config
+        k_emb, k_pos, k_enc, k_dec = jax.random.split(key, 4)
+        return {
+            "embedding": {
+                "word_embeddings": self.embedding.init(k_emb),
+                "position_embeddings": c.init_method()(
+                    k_pos, (c.max_position_embeddings, c.hidden_size),
+                    c.params_dtype),
+            },
+            "encoder": self.encoder.init(k_enc),
+            "decoder": self.decoder.init(k_dec),
+        }
+
+    def spec(self) -> Dict[str, Any]:
+        return {
+            "embedding": {
+                "word_embeddings": self.embedding.spec(),
+                "position_embeddings": PartitionSpec(),
+            },
+            "encoder": self.encoder.spec(),
+            "decoder": self.decoder.spec(),
+        }
+
+    def apply(self, params, enc_tokens, dec_tokens, labels=None, *,
+              enc_padding_mask=None, enc_lengths=None, loss_mask=None,
+              rng=None, deterministic: bool = True):
+        """enc/dec tokens, labels: ``[batch, seq]``.
+
+        Right-padded batches should pass ``enc_lengths`` ([batch] valid
+        lengths) — that keeps both encoder self-attention and decoder
+        cross-attention on the Pallas varlen flash path. The general boolean
+        ``enc_padding_mask`` ([batch, s_enc], True = pad) takes the fused
+        masked-softmax fallback. Returns the scalar LM loss with ``labels``,
+        else vocab-parallel decoder logits."""
+        c = self.config
+        if enc_padding_mask is not None and enc_lengths is not None:
+            raise ValueError("pass enc_padding_mask or enc_lengths, not both")
+        rngs = ((None,) * 4 if rng is None
+                else tuple(jax.random.split(rng, 4)))
+        enc_hidden = embed_tokens(
+            self.embedding, params["embedding"], enc_tokens, self._enc_cfg,
+            rng=rngs[0], deterministic=deterministic)
+        enc_mask = (None if enc_padding_mask is None
+                    else enc_padding_mask[:, None, None, :])
+        enc_out = self.encoder.apply(
+            params["encoder"], enc_hidden, attention_mask=enc_mask,
+            kv_lengths=enc_lengths, rng=rngs[1],
+            deterministic=deterministic)
+        if c.sequence_parallel:
+            # decoder cross-attention wants the full encoder sequence
+            enc_out = gather_from_sequence_parallel_region(
+                enc_out, False, c.axis_name)
+        dec_hidden = embed_tokens(
+            self.embedding, params["embedding"], dec_tokens, self._dec_cfg,
+            rng=rngs[2], deterministic=deterministic)
+        dec_out = self.decoder.apply(
+            params["decoder"], dec_hidden, encoder_output=enc_out,
+            enc_dec_attn_mask=enc_mask, enc_kv_lengths=enc_lengths,
+            rng=rngs[3], deterministic=deterministic)
+        return lm_head_loss(
+            params["embedding"]["word_embeddings"]["weight"], dec_out,
+            labels, loss_mask, c)

@@ -439,12 +439,16 @@ class ParallelTransformerLayer:
         return s
 
     def apply(self, params, hidden, *, encoder_output=None,
-              enc_dec_attn_mask=None, attention_mask=None, kv_lengths=None,
+              enc_dec_attn_mask=None, enc_kv_lengths=None,
+              attention_mask=None, kv_lengths=None,
               rng=None, deterministic=True):
         """``encoder_output`` (decoder layers) must be the FULL encoder
         sequence ``[s_enc, b, h]`` — under sequence parallelism gather it
         first (``gather_from_sequence_parallel_region``), as
-        :class:`~apex_tpu.models.bert.BertModel` does for its heads."""
+        :class:`~apex_tpu.models.bert.BertModel` does for its heads.
+        ``enc_kv_lengths`` ([batch] valid encoder lengths) keeps padded
+        cross-attention on the varlen flash path instead of a boolean
+        ``enc_dec_attn_mask``."""
         c = self.config
         decoder = self.layer_type == LayerType.decoder
         # decoder layers draw a 4th key; encoder layers keep the historical
@@ -471,6 +475,7 @@ class ParallelTransformerLayer:
                 params["inter_attention"], x.astype(c.compute_dtype),
                 encoder_output=encoder_output,
                 attention_mask=enc_dec_attn_mask,
+                kv_lengths=enc_kv_lengths,
                 rng=r_attn, deterministic=deterministic)
             inter_out = _dropout(
                 inter_out, c.hidden_dropout, r_drop, deterministic,
@@ -528,7 +533,8 @@ class ParallelTransformer:
         return {"layers": stacked, "final_layernorm": _ln_spec()}
 
     def apply(self, params, hidden, *, encoder_output=None,
-              enc_dec_attn_mask=None, attention_mask=None, kv_lengths=None,
+              enc_dec_attn_mask=None, enc_kv_lengths=None,
+              attention_mask=None, kv_lengths=None,
               rng=None, deterministic=True, final_norm=True):
         """Returns ``hidden`` — or ``(hidden, moe_aux_loss)`` (aux summed
         over layers) when the config enables MoE."""
@@ -544,6 +550,7 @@ class ParallelTransformer:
                 out = self.layer.apply(
                     layer_params, h, encoder_output=encoder_output,
                     enc_dec_attn_mask=enc_dec_attn_mask,
+                    enc_kv_lengths=enc_kv_lengths,
                     attention_mask=attention_mask,
                     kv_lengths=kv_lengths, rng=layer_rng,
                     deterministic=deterministic)
