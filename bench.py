@@ -17,7 +17,7 @@ import time
 import jax
 import jax.numpy as jnp
 
-STEPS = 10
+STEPS = 30   # longer window: amortizes queue ramp-up through the tunnel
 
 
 def _build():

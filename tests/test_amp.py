@@ -132,3 +132,65 @@ def test_scale_skip_flow_jitted():
     params3, st3 = step(params2, st2, jnp.full((4,), jnp.inf))
     np.testing.assert_allclose(params3, params2)  # skipped
     assert float(st3.loss_scale) == 2.0 ** 3
+
+
+class TestFp16Path:
+    """True float16 (not bf16) flow — fp16 is the dtype dynamic loss scaling
+    exists for (the reference's amp O2 default). fp16's 65504 max makes
+    scaled gradients genuinely overflow, exercising backoff + recovery end
+    to end."""
+
+    def test_fp16_policy(self):
+        amp_state = amp.initialize("O2", half_dtype=jnp.float16)
+        assert amp_state.policy.param_dtype == jnp.float16
+        assert amp_state.policy.compute_dtype == jnp.float16
+
+    def test_fp16_overflow_backoff_and_recovery(self):
+        scaler = amp.LossScaler("dynamic", init_scale=2.0 ** 16,
+                                scale_window=2, hysteresis=1)
+        st = scaler.init()
+        # fp16 grads that overflow once scaled by 2^16
+        big = jnp.full((4,), 4.0, jnp.float16)       # 4 * 65536 > fp16 max
+        scaled = (big.astype(jnp.float32) * st.loss_scale).astype(jnp.float16)
+        grads, found_inf = scaler.unscale({"g": scaled}, st)
+        assert bool(found_inf)
+        st = scaler.update(st, found_inf)
+        assert float(st.loss_scale) == 2.0 ** 15     # backed off
+        # finite steps at the reduced scale grow it back after scale_window
+        ok = jnp.ones((4,), jnp.float16)
+        for _ in range(2):
+            g, fi = scaler.unscale(
+                {"g": (ok.astype(jnp.float32) * st.loss_scale / 2.0 ** 14
+                       ).astype(jnp.float16)}, st)
+            assert not bool(fi)
+            st = scaler.update(st, fi)
+        assert float(st.loss_scale) == 2.0 ** 16     # regrown
+
+    def test_fp16_train_step_converges(self):
+        from apex_tpu.optimizers import FusedSGD
+
+        amp_state = amp.initialize("O2", half_dtype=jnp.float16)
+        scaler, st = amp_state.scaler, amp_state.scaler_states[0]
+        w = {"w": jnp.ones((8,), jnp.float16) * 0.5}
+        opt = FusedSGD(lr=0.1, master_weights=True)
+        os_ = opt.init(w)
+        x = jnp.linspace(-1, 1, 8).astype(jnp.float16)
+
+        @jax.jit
+        def step(w, os_, st):
+            def loss_fn(p):
+                return jnp.mean((p["w"].astype(jnp.float32) * x.astype(
+                    jnp.float32) - x.astype(jnp.float32)) ** 2)
+
+            sloss, grads = jax.value_and_grad(
+                lambda p: scaler.scale(loss_fn(p), st))(w)
+            grads, found_inf = scaler.unscale(grads, st)
+            w2, os2 = opt.step(grads, w, os_, found_inf=found_inf)
+            return w2, os2, scaler.update(st, found_inf), sloss / st.loss_scale
+
+        losses = []
+        for _ in range(20):
+            w, os_, st, loss = step(w, os_, st)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+        assert w["w"].dtype == jnp.float16
