@@ -18,6 +18,7 @@ from apex_tpu.models.gpt import GPTModel
 from apex_tpu.models.bert import BertModel
 from apex_tpu.models.encoder_decoder import EncoderDecoderModel
 from apex_tpu.models.pipelined import PipelinedGPT
+from apex_tpu.models.generation import decode_step, generate, init_kv_caches
 from apex_tpu.models.resnet import (
     ResNet,
     ResNetConfig,
@@ -55,4 +56,7 @@ __all__ = [
     "BertModel",
     "EncoderDecoderModel",
     "PipelinedGPT",
+    "generate",
+    "decode_step",
+    "init_kv_caches",
 ]

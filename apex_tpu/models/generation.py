@@ -1,0 +1,145 @@
+"""Autoregressive generation with KV caching for :class:`GPTModel`.
+
+The reference ships no inference utilities (its `get_ltor_masks...` helper
+is training-side), so this exceeds parity: jit-compiled incremental decoding
+— one token per step, K/V written into preallocated caches, greedy or
+temperature/top-k sampling — the standard TPU decode shape (static shapes,
+``lax.scan`` over steps, no host round-trips inside the loop).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["init_kv_caches", "decode_step", "generate"]
+
+
+def init_kv_caches(model, batch_size: int, max_len: int,
+                   dtype=None) -> Tuple[jax.Array, jax.Array]:
+    """Preallocate stacked caches ``(k, v)``, each
+    ``[num_layers, batch, local_heads, max_len, head_dim]``.
+
+    Inside ``shard_map`` with a bound tensor axis the head count is the
+    TP-local slice (``heads // tp``), matching the per-rank QKV shapes.
+    """
+    from apex_tpu.transformer.tensor_parallel.mappings import axis_bound
+
+    c = model.config
+    dtype = dtype or c.compute_dtype
+    heads = c.num_attention_heads
+    if axis_bound(c.axis_name):
+        heads //= lax.axis_size(c.axis_name)
+    shape = (c.num_layers, batch_size, heads, max_len, c.head_dim)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def _gather_vocab(logits: jax.Array, axis_name: str) -> jax.Array:
+    """Vocab-parallel logits -> full vocab (argmax/categorical need global
+    token ids; shard-local winners would be garbage under TP)."""
+    from apex_tpu.transformer.tensor_parallel.mappings import axis_bound
+
+    if axis_bound(axis_name):
+        logits = lax.all_gather(logits, axis_name, axis=-1, tiled=True)
+    return logits
+
+
+def _cached_forward(model, params, caches, tokens: jax.Array, index):
+    """Run ``tokens`` [batch, s] occupying cache slots [index, index+s) ->
+    (fp32 full-vocab logits [s, batch, V], new caches)."""
+    c = model.config
+    emb_p = params["embedding"]
+    s = tokens.shape[1]
+    emb = model.embedding.apply(emb_p["word_embeddings"], tokens)  # [b,s,h]
+    pos = lax.dynamic_slice_in_dim(emb_p["position_embeddings"], index, s,
+                                   axis=0)                          # [s, h]
+    hidden = (emb + pos[None]).transpose(1, 0, 2)                   # [s,b,h]
+    hidden = hidden.astype(c.compute_dtype)
+    hidden, new_caches = model.transformer.apply(
+        params["transformer"], hidden, kv_caches=caches, cache_index=index)
+    from apex_tpu.models.gpt import lm_head_loss
+    logits = lm_head_loss(
+        emb_p["word_embeddings"]["weight"], hidden, None, None, c)
+    logits = _gather_vocab(logits, c.axis_name)
+    return logits.astype(jnp.float32), new_caches
+
+
+def decode_step(model, params, caches, tokens: jax.Array, index) -> Tuple[
+        jax.Array, Tuple[jax.Array, jax.Array]]:
+    """One incremental step: ``tokens`` [batch] at position ``index`` ->
+    (fp32 full-vocab logits [batch, V], updated caches)."""
+    logits, new_caches = _cached_forward(model, params, caches,
+                                         tokens[:, None], index)
+    return logits[0], new_caches
+
+
+def generate(model, params, prompt: jax.Array, max_new_tokens: int, *,
+             max_len: Optional[int] = None, temperature: float = 0.0,
+             top_k: Optional[int] = None,
+             rng: Optional[jax.Array] = None,
+             eos_token: Optional[int] = None) -> jax.Array:
+    """Generate ``[batch, prompt_len + max_new_tokens]`` token ids.
+
+    ``temperature == 0`` is greedy; otherwise softmax sampling (optionally
+    truncated to ``top_k`` logits) with ``rng``. ``eos_token`` freezes
+    finished rows (they keep emitting ``eos_token``). Fully jittable; decode
+    runs as one ``lax.scan``.
+    """
+    if temperature > 0.0 and rng is None:
+        raise ValueError("sampling (temperature > 0) needs rng")
+    if model.config.num_moe_experts:
+        raise NotImplementedError("generation with MoE is not supported")
+    b, prompt_len = prompt.shape
+    total = prompt_len + max_new_tokens
+    if total > model.config.max_position_embeddings:
+        raise ValueError(
+            f"prompt + new tokens ({total}) exceeds "
+            f"max_position_embeddings "
+            f"({model.config.max_position_embeddings}); the clamped "
+            "position lookup would silently repeat the last row")
+    S = max_len or total
+    if S < total:
+        raise ValueError(f"max_len {S} < prompt+new tokens {total}")
+    caches = init_kv_caches(model, b, S)
+    rng = jax.random.PRNGKey(0) if rng is None else rng
+
+    out = jnp.zeros((b, total), prompt.dtype)
+    out = out.at[:, :prompt_len].set(prompt)
+
+    def pick_next(logits, key):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(prompt.dtype)
+        logits = logits / temperature
+        if top_k is not None:
+            kth = lax.top_k(logits, top_k)[0][:, -1:]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+        return jax.random.categorical(key, logits).astype(prompt.dtype)
+
+    # batched prefill: one forward writes all prompt K/V; its last-position
+    # logits produce the first generated token
+    prefill_logits, caches = _cached_forward(model, params, caches, prompt, 0)
+    first = pick_next(prefill_logits[-1], jax.random.fold_in(rng, 0))
+    out = out.at[:, prompt_len].set(first)
+    done0 = ((first == eos_token) if eos_token is not None
+             else jnp.zeros((b,), bool))
+    if max_new_tokens == 1:
+        return out
+
+    def step(carry, i):
+        # i = absolute position of the token being fed (already written)
+        caches, out, done = carry
+        token = lax.dynamic_index_in_dim(out, i, axis=1, keepdims=False)
+        logits, caches = decode_step(model, params, caches, token, i)
+        nxt = pick_next(logits, jax.random.fold_in(rng, i))
+        if eos_token is not None:
+            nxt = jnp.where(done, eos_token, nxt)
+            done = jnp.logical_or(done, nxt == eos_token)
+        out = lax.dynamic_update_slice(out, nxt[:, None], (0, i + 1))
+        return (caches, out, done), None
+
+    (caches, out, _), _ = lax.scan(
+        step, (caches, out, done0), jnp.arange(prompt_len, total - 1))
+    return out

@@ -340,10 +340,16 @@ class ParallelAttention:
         return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
 
     def apply(self, params, hidden, *, encoder_output=None,
-              attention_mask=None, kv_lengths=None, rng=None,
-              deterministic=True):
+              attention_mask=None, kv_lengths=None, kv_cache=None,
+              cache_index=None, rng=None, deterministic=True):
         """hidden: [s(, shard), b, h] -> [s(, shard), b, h]; cross-attention
-        reads K/V from ``encoder_output`` [s_enc, b, h]."""
+        reads K/V from ``encoder_output`` [s_enc, b, h].
+
+        Incremental decoding: pass ``kv_cache=(k, v)`` (``[b, local_heads,
+        S_max, dh]`` each) and ``cache_index`` (tokens already cached); the
+        current K/V are written at that offset, attention runs over the
+        cache, and the return becomes ``(out, new_cache)``.
+        """
         c = self.config
         dh = c.head_dim
         if self.attn_type == AttnType.self_attn:
@@ -365,10 +371,33 @@ class ParallelAttention:
             k, v = jnp.split(kv, 2, axis=-1)
         # [s, b, hl, dh] -> [b, hl, s, dh]
         q, k, v = (t.transpose(1, 2, 0, 3) for t in (q, k, v))
+        new_cache = None
+        if kv_cache is not None:
+            if self.attn_type != AttnType.self_attn:
+                raise NotImplementedError(
+                    "kv_cache is for self-attention decode; cross-attention "
+                    "K/V are static — precompute them once instead")
+            ck, cv = kv_cache
+            ck = lax.dynamic_update_slice(
+                ck, k.astype(ck.dtype), (0, 0, cache_index, 0))
+            cv = lax.dynamic_update_slice(
+                cv, v.astype(cv.dtype), (0, 0, cache_index, 0))
+            k, v = ck.astype(q.dtype), cv.astype(q.dtype)
+            new_cache = (ck, cv)
+            # per-query causal+prefix mask over the padded cache: query i of
+            # the slice may see slots j <= cache_index + i (the dispatcher's
+            # offset-causal tril assumes queries sit at the cache END, which
+            # padded caches violate — so encode causality explicitly)
+            slots = jnp.arange(k.shape[2])[None, None, None, :]
+            allowed_up_to = cache_index + jnp.arange(s)[None, None, :, None]
+            invalid = slots > allowed_up_to
+            attention_mask = (invalid if attention_mask is None
+                              else jnp.logical_or(attention_mask, invalid))
         ctx = self._core_attention(q, k, v, attention_mask, kv_lengths,
                                    rng, deterministic)
         ctx = ctx.transpose(2, 0, 1, 3).reshape(s, b, local_heads * dh)
-        return self.dense.apply(params["dense"], ctx)
+        out = self.dense.apply(params["dense"], ctx)
+        return out if new_cache is None else (out, new_cache)
 
 
 @dataclass
@@ -440,15 +469,16 @@ class ParallelTransformerLayer:
 
     def apply(self, params, hidden, *, encoder_output=None,
               enc_dec_attn_mask=None, enc_kv_lengths=None,
-              attention_mask=None, kv_lengths=None,
-              rng=None, deterministic=True):
+              attention_mask=None, kv_lengths=None, kv_cache=None,
+              cache_index=None, rng=None, deterministic=True):
         """``encoder_output`` (decoder layers) must be the FULL encoder
         sequence ``[s_enc, b, h]`` — under sequence parallelism gather it
         first (``gather_from_sequence_parallel_region``), as
         :class:`~apex_tpu.models.bert.BertModel` does for its heads.
         ``enc_kv_lengths`` ([batch] valid encoder lengths) keeps padded
         cross-attention on the varlen flash path instead of a boolean
-        ``enc_dec_attn_mask``."""
+        ``enc_dec_attn_mask``. With ``kv_cache`` (incremental decoding) the
+        return becomes ``(out, new_cache)``."""
         c = self.config
         decoder = self.layer_type == LayerType.decoder
         # decoder layers draw a 4th key; encoder layers keep the historical
@@ -461,7 +491,11 @@ class ParallelTransformerLayer:
         attn_out = self.attention.apply(
             params["self_attention"], x.astype(c.compute_dtype),
             attention_mask=attention_mask, kv_lengths=kv_lengths,
+            kv_cache=kv_cache, cache_index=cache_index,
             rng=rngs[2], deterministic=deterministic)
+        new_cache = None
+        if kv_cache is not None:
+            attn_out, new_cache = attn_out
         attn_out = _dropout(attn_out, c.hidden_dropout, rngs[0], deterministic,
                             model_parallel_region=c.sequence_parallel,
                             axis_name=c.axis_name)
@@ -500,6 +534,11 @@ class ParallelTransformerLayer:
                            model_parallel_region=c.sequence_parallel,
                            axis_name=c.axis_name)
         out = hidden + mlp_out
+        if new_cache is not None:
+            if c.num_moe_experts:
+                raise NotImplementedError(
+                    "kv_cache decoding with MoE layers is not supported")
+            return out, new_cache
         return (out, aux) if c.num_moe_experts else out
 
 
@@ -534,16 +573,21 @@ class ParallelTransformer:
 
     def apply(self, params, hidden, *, encoder_output=None,
               enc_dec_attn_mask=None, enc_kv_lengths=None,
-              attention_mask=None, kv_lengths=None,
-              rng=None, deterministic=True, final_norm=True):
+              attention_mask=None, kv_lengths=None, kv_caches=None,
+              cache_index=None, rng=None, deterministic=True,
+              final_norm=True):
         """Returns ``hidden`` — or ``(hidden, moe_aux_loss)`` (aux summed
-        over layers) when the config enables MoE."""
+        over layers) when the config enables MoE, or ``(hidden, new_caches)``
+        when decoding with ``kv_caches`` (``(k, v)`` stacked ``[L, ...]``)."""
         c = self.config
         moe = bool(c.num_moe_experts)
 
         def one_layer(carry, xs):
             h, aux_sum, idx = carry
-            layer_params = xs
+            if kv_caches is not None:
+                layer_params, layer_cache = xs
+            else:
+                layer_params, layer_cache = xs, None
             layer_rng = None if rng is None else jax.random.fold_in(rng, idx)
 
             def run(h):
@@ -552,18 +596,26 @@ class ParallelTransformer:
                     enc_dec_attn_mask=enc_dec_attn_mask,
                     enc_kv_lengths=enc_kv_lengths,
                     attention_mask=attention_mask,
-                    kv_lengths=kv_lengths, rng=layer_rng,
+                    kv_lengths=kv_lengths, kv_cache=layer_cache,
+                    cache_index=cache_index, rng=layer_rng,
                     deterministic=deterministic)
+                if layer_cache is not None:
+                    return out        # (h, new_cache)
                 return out if moe else (out, jnp.zeros((), jnp.float32))
 
-            h, aux = (jax.checkpoint(run)(h) if c.recompute else run(h))
-            return (h, aux_sum + aux, idx + 1), None
+            h, extra = (jax.checkpoint(run)(h) if c.recompute else run(h))
+            if layer_cache is not None:
+                return (h, aux_sum, idx + 1), extra
+            return (h, aux_sum + extra, idx + 1), None
 
-        (hidden, aux_sum, _), _ = lax.scan(
-            one_layer, (hidden, jnp.zeros((), jnp.float32), 0),
-            params["layers"])
+        xs = (params["layers"] if kv_caches is None
+              else (params["layers"], kv_caches))
+        (hidden, aux_sum, _), new_caches = lax.scan(
+            one_layer, (hidden, jnp.zeros((), jnp.float32), 0), xs)
         if final_norm:
             hidden = _ln(params["final_layernorm"], hidden,
                          c.layernorm_epsilon, c.sequence_parallel,
                          c.axis_name)
+        if kv_caches is not None:
+            return hidden, new_caches
         return (hidden, aux_sum) if moe else hidden
