@@ -1,3 +1,16 @@
 from apex_tpu.contrib.sparsity.asp import ASP, compute_sparse_mask_2to4
+from apex_tpu.contrib.sparsity.permutation import (
+    invert_permutation,
+    mask_efficacy,
+    permute_columns,
+    search_for_good_permutation,
+)
 
-__all__ = ["ASP", "compute_sparse_mask_2to4"]
+__all__ = [
+    "ASP",
+    "compute_sparse_mask_2to4",
+    "invert_permutation",
+    "mask_efficacy",
+    "permute_columns",
+    "search_for_good_permutation",
+]

@@ -9,8 +9,9 @@ TPU reality check, stated rather than hidden: TPUs have **no sparse tensor
 cores**, so 2:4 masks buy no TPU speedup — the capability exists for
 training models destined for sparse inference elsewhere, and for accuracy
 experiments. The channel-permutation search (a CUDA kernel whose only job
-is preserving more magnitude under the mask) is approximated by its greedy
-column-swap objective in pure JAX.
+is preserving more magnitude under the mask) lives in
+:mod:`apex_tpu.contrib.sparsity.permutation` — a vectorized JAX hill-climb
+over column swaps with the reference's efficacy objective.
 
 Functional API: masks are a pytree like the params; ``apply_masks`` is the
 in-step analog of the reference's optimizer-step mask hook.

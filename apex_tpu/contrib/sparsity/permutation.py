@@ -1,0 +1,148 @@
+"""Channel-permutation search for 2:4 sparsity.
+
+Counterpart of the reference's ``apex/contrib/sparsity/permutation_lib.py``
+(1.6k LoC host logic) + ``permutation_search_kernels.cu``: find a permutation
+of a weight matrix's **input channels** (columns) that maximizes the
+magnitude preserved when the 2:4 mask is applied afterwards. The reference
+searches with CUDA-accelerated group-exhaustive swaps; this is an offline
+prep step, so here it is a vectorized JAX hill-climb — a jitted scorer
+rates candidate column swaps in batched chunks (the MXU-friendly
+formulation), apply the best, repeat until no swap helps.
+
+Efficacy metric (identical to the reference's): the sum of the ``n``
+largest ``|w|`` in every group of ``m`` consecutive columns, summed over
+rows — i.e. exactly the magnitude the 2:4 mask keeps.
+
+Cross-layer bookkeeping (the reference propagates one permutation through
+residual/conv chains) is the caller's job. With this module's gather
+convention (``permute_columns(w2, perm) == w2[:, perm]``), the upstream
+producer must have the *same* ``perm`` applied to its output rows —
+``w1[perm, :]`` — for the composed function to be unchanged
+(``w2[:, perm] @ (w1[perm, :] @ x) == w2 @ (w1 @ x)``).
+:func:`invert_permutation` is for undoing a permutation (mapping permuted
+positions back to originals), e.g. when exporting weights to a consumer
+that expects the original channel order.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "mask_efficacy",
+    "search_for_good_permutation",
+    "permute_columns",
+    "invert_permutation",
+]
+
+
+def _group_efficacy(absw: jax.Array, n: int, m: int) -> jax.Array:
+    """Magnitude kept by an n:m mask on ``absw`` [rows, cols]: per group of
+    ``m`` columns keep the ``n`` largest per row."""
+    r, c = absw.shape
+    g = absw.reshape(r, c // m, m)
+    top = jax.lax.top_k(g, n)[0]
+    return jnp.sum(top)
+
+
+def mask_efficacy(w: jax.Array, *, n: int = 2, m: int = 4) -> jax.Array:
+    """Fraction of total magnitude the n:m mask preserves on ``w``."""
+    absw = jnp.abs(w.astype(jnp.float32))
+    return _group_efficacy(absw, n, m) / jnp.maximum(jnp.sum(absw), 1e-30)
+
+
+@functools.partial(jax.jit, static_argnums=(5, 6))
+def _score_pairs(g, G, H, A, B, n, m):
+    """Gain of swapping column (G, A) with (H, B) for a chunk of candidate
+    pairs — only the two touched groups change efficacy, so each pair costs
+    one [rows, 2, m] top-k, batched over the chunk."""
+    base = jnp.sum(jax.lax.top_k(g, n)[0], axis=(0, 2))      # [ng]
+    colG = g[:, G, :]                                        # [r, P, m]
+    colH = g[:, H, :]
+    idx = jnp.arange(G.shape[0])
+    valGA = colG[:, idx, A]                                  # [r, P]
+    valHB = colH[:, idx, B]
+    swapG = colG.at[:, idx, A].set(valHB)
+    swapH = colH.at[:, idx, B].set(valGA)
+    effG = jnp.sum(jax.lax.top_k(swapG.transpose(1, 0, 2), n)[0], axis=(1, 2))
+    effH = jnp.sum(jax.lax.top_k(swapH.transpose(1, 0, 2), n)[0], axis=(1, 2))
+    return (effG + effH) - (base[G] + base[H])               # [P]
+
+
+def _best_swap(absw: np.ndarray, n: int, m: int,
+               chunk: int = 16384) -> Tuple[float, int, int]:
+    """Score every cross-group column swap (i, j); return (gain, i, j).
+
+    Candidate pairs are scored in fixed-size chunks so wide layers (C up to
+    several thousand) stay within memory: peak is O(rows * chunk * m)."""
+    r, c = absw.shape
+    ng = c // m
+    g = jnp.asarray(absw).reshape(r, ng, m)
+    G, H, A, B = np.meshgrid(np.arange(ng), np.arange(ng),
+                             np.arange(m), np.arange(m), indexing="ij")
+    sel = (G < H).reshape(-1)
+    G, H, A, B = (x.reshape(-1)[sel] for x in (G, H, A, B))
+    best_gain, best_i, best_j = -np.inf, 0, 0
+    for s in range(0, G.size, chunk):
+        e = min(s + chunk, G.size)
+        gains = np.asarray(_score_pairs(
+            g, jnp.asarray(G[s:e]), jnp.asarray(H[s:e]),
+            jnp.asarray(A[s:e]), jnp.asarray(B[s:e]), n, m))
+        k = int(np.argmax(gains))
+        if gains[k] > best_gain:
+            best_gain = float(gains[k])
+            best_i = int(G[s + k] * m + A[s + k])
+            best_j = int(H[s + k] * m + B[s + k])
+    return best_gain, best_i, best_j
+
+
+def search_for_good_permutation(
+    w: jax.Array,
+    *,
+    n: int = 2,
+    m: int = 4,
+    max_iterations: int = 100,
+    min_gain: float = 1e-6,
+) -> np.ndarray:
+    """Greedy column-swap hill-climb; returns the permutation (int array
+    ``perm`` such that ``w[:, perm]`` has maximal retained magnitude).
+
+    Matches the reference's search objective
+    (``permutation_lib.py`` / ``permutation_search_kernels.cu``); each
+    iteration applies the single best swap among all O((C/m·m)²) candidates.
+    """
+    if w.ndim != 2:
+        raise ValueError("permutation search expects a 2-D weight [out, in]")
+    if w.shape[1] % m:
+        raise ValueError(f"in-features ({w.shape[1]}) not divisible by {m}")
+    absw = np.abs(np.asarray(w, np.float32))
+    perm = np.arange(w.shape[1])
+    for _ in range(max_iterations):
+        gain, i, j = _best_swap(absw, n, m)
+        if gain <= min_gain:
+            break
+        absw[:, [i, j]] = absw[:, [j, i]]
+        perm[[i, j]] = perm[[j, i]]
+    return perm
+
+
+def permute_columns(w: jax.Array, perm) -> jax.Array:
+    """Apply a found permutation to the input-channel dim."""
+    return w[:, jnp.asarray(perm)]
+
+
+def invert_permutation(perm) -> np.ndarray:
+    """Inverse permutation: ``inv[perm] == arange``. Use it to undo a
+    permutation (e.g. restore original channel order on export). NOTE —
+    for cross-layer propagation apply ``perm`` itself, not the inverse, to
+    the upstream layer's output rows (``w1[perm, :]``); see the module
+    docstring."""
+    perm = np.asarray(perm)
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(perm.size)
+    return inv

@@ -21,18 +21,26 @@ __all__ = ["init_kv_caches", "decode_step", "generate"]
 def init_kv_caches(model, batch_size: int, max_len: int,
                    dtype=None) -> Tuple[jax.Array, jax.Array]:
     """Preallocate stacked caches ``(k, v)``, each
-    ``[num_layers, batch, local_heads, max_len, head_dim]``.
+    ``[num_layers, batch, local_kv_heads, max_len, head_dim]`` — K/V heads
+    (``config.kv_heads``), which under GQA/MQA is ``num_query_groups``, not
+    the query head count.
 
     Inside ``shard_map`` with a bound tensor axis the head count is the
-    TP-local slice (``heads // tp``), matching the per-rank QKV shapes.
+    TP-local slice (``kv_heads // tp``), matching the per-rank QKV shapes.
     """
     from apex_tpu.transformer.tensor_parallel.mappings import axis_bound
 
     c = model.config
     dtype = dtype or c.compute_dtype
-    heads = c.num_attention_heads
+    heads = c.kv_heads                     # == query heads unless GQA/MQA
     if axis_bound(c.axis_name):
-        heads //= lax.axis_size(c.axis_name)
+        tp = lax.axis_size(c.axis_name)
+        if heads % tp:
+            raise ValueError(
+                f"kv heads ({heads}) must be divisible by the "
+                f"tensor-parallel size ({tp}); with GQA/MQA keep "
+                f"num_query_groups a multiple of tp")
+        heads //= tp
     shape = (c.num_layers, batch_size, heads, max_len, c.head_dim)
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 

@@ -65,6 +65,10 @@ class TransformerConfig:
     num_layers: int
     hidden_size: int
     num_attention_heads: int
+    # GQA/MQA (exceeds reference): number of K/V head groups; None = MHA.
+    # Query heads are split evenly over the groups; the flash kernel reads
+    # shared K/V blocks per group with no HBM broadcast.
+    num_query_groups: Optional[int] = None
     ffn_hidden_size: Optional[int] = None
     vocab_size: int = 32000
     max_position_embeddings: int = 2048
@@ -99,6 +103,14 @@ class TransformerConfig:
     @property
     def head_dim(self) -> int:
         return divide(self.hidden_size, self.num_attention_heads)
+
+    @property
+    def kv_heads(self) -> int:
+        """K/V heads per replica (== query heads unless GQA/MQA)."""
+        if self.num_query_groups is None:
+            return self.num_attention_heads
+        divide(self.num_attention_heads, self.num_query_groups)  # validates
+        return self.num_query_groups
 
     def init_method(self) -> Callable:
         std = self.init_method_std
@@ -240,8 +252,13 @@ class ParallelAttention:
     def __post_init__(self):
         c = self.config
         if self.attn_type == AttnType.self_attn:
+            # fused QKV, grouped layout [g0: qpg·dh + k·dh + v·dh | g1: ...]
+            # so a TP slice holds whole K/V groups (Megatron fuses the same
+            # way for plain MHA; the grouped layout generalizes it to GQA)
+            qpg = c.num_attention_heads // c.kv_heads
+            qkv_size = c.kv_heads * (qpg + 2) * c.head_dim
             self.query_key_value = ColumnParallelLinear(
-                c.hidden_size, 3 * c.hidden_size, gather_output=False,
+                c.hidden_size, qkv_size, gather_output=False,
                 init_method=c.init_method(),
                 sequence_parallel_enabled=c.sequence_parallel,
                 params_dtype=c.params_dtype, axis_name=c.axis_name)
@@ -300,6 +317,13 @@ class ParallelAttention:
             raise NotImplementedError(
                 "context parallelism shards the self-attention sequence; "
                 "cross-attention K/V come from the (unsharded) encoder")
+        if k.shape[1] != q.shape[1] and c.context_parallel_method:
+            # GQA under context parallelism: materialize the head broadcast
+            # (ring/ulysses shard over heads); the flash and grouped-einsum
+            # paths below read shared K/V natively instead
+            rep = q.shape[1] // k.shape[1]
+            k = jnp.repeat(k, rep, axis=1)
+            v = jnp.repeat(v, rep, axis=1)
         if c.context_parallel_method:
             from apex_tpu.ops.ring_attention import (
                 ring_attention,
@@ -332,8 +356,22 @@ class ParallelAttention:
                 kv_lengths[:, None, None, None]
             attention_mask = invalid if attention_mask is None else (
                 jnp.logical_or(attention_mask, invalid))
-        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(
+        inv_scale = jnp.sqrt(
             jnp.asarray(c.head_dim, jnp.float32)).astype(q.dtype)
+        if k.shape[1] != q.shape[1]:
+            # grouped einsum: q heads fold into [kv_heads, group] so K/V are
+            # contracted once per group with no HBM broadcast copy
+            g = q.shape[1] // k.shape[1]
+            qg = q.reshape(q.shape[0], k.shape[1], g, *q.shape[2:])
+            scores = jnp.einsum("bhgqd,bhkd->bhgqk", qg, k) / inv_scale
+            scores = scores.reshape(q.shape[0], q.shape[1], *scores.shape[3:])
+            probs = self.scale_mask_softmax(scores, attention_mask)
+            probs = _dropout(probs, c.attention_dropout, rng, deterministic,
+                             model_parallel_region=True, axis_name=c.axis_name)
+            pg = probs.reshape(q.shape[0], k.shape[1], g, *probs.shape[2:])
+            ctx = jnp.einsum("bhgqk,bhkd->bhgqd", pg.astype(v.dtype), v)
+            return ctx.reshape(q.shape[0], q.shape[1], *ctx.shape[3:])
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / inv_scale
         probs = self.scale_mask_softmax(scores, attention_mask)
         probs = _dropout(probs, c.attention_dropout, rng, deterministic,
                          model_parallel_region=True, axis_name=c.axis_name)
@@ -345,8 +383,9 @@ class ParallelAttention:
         """hidden: [s(, shard), b, h] -> [s(, shard), b, h]; cross-attention
         reads K/V from ``encoder_output`` [s_enc, b, h].
 
-        Incremental decoding: pass ``kv_cache=(k, v)`` (``[b, local_heads,
-        S_max, dh]`` each) and ``cache_index`` (tokens already cached); the
+        Incremental decoding: pass ``kv_cache=(k, v)`` (``[b, local_kv_heads,
+        S_max, dh]`` each — K/V heads, i.e. ``num_query_groups`` under
+        GQA/MQA) and ``cache_index`` (tokens already cached); the
         current K/V are written at that offset, attention runs over the
         cache, and the return becomes ``(out, new_cache)``.
         """
@@ -356,9 +395,20 @@ class ParallelAttention:
             qkv = self.query_key_value.apply(params["query_key_value"],
                                              hidden)
             s, b = qkv.shape[0], qkv.shape[1]
-            local_heads = qkv.shape[-1] // (3 * dh)
-            qkv = qkv.reshape(s, b, local_heads, 3 * dh)
-            q, k, v = jnp.split(qkv, 3, axis=-1)
+            qpg = c.num_attention_heads // c.kv_heads
+            block = (qpg + 2) * dh
+            if qkv.shape[-1] % block:
+                raise ValueError(
+                    f"tensor-parallel slice of the fused QKV projection "
+                    f"({qkv.shape[-1]}) cuts through a K/V group (group "
+                    f"block = {block}); num_query_groups ({c.kv_heads}) "
+                    f"must be divisible by the tensor-parallel size")
+            local_groups = qkv.shape[-1] // block
+            qkv = qkv.reshape(s, b, local_groups, qpg + 2, dh)
+            q = qkv[:, :, :, :qpg].reshape(s, b, local_groups * qpg, dh)
+            k = qkv[:, :, :, qpg]
+            v = qkv[:, :, :, qpg + 1]
+            local_heads = local_groups * qpg
         else:
             if encoder_output is None:
                 raise ValueError("cross-attention needs encoder_output")

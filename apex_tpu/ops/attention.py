@@ -115,8 +115,11 @@ def _fwd_kernel(kvl_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0, 0] = jnp.broadcast_to(lse.T, lse_ref.shape[2:])
 
 
-def _run_fwd(q, k, v, kv_lengths, scale, causal, sq, sk, bq, bk):
-    """q/k/v padded to block multiples; returns padded (o, lse)."""
+def _run_fwd(q, k, v, kv_lengths, scale, causal, sq, sk, bq, bk, group=1):
+    """q/k/v padded to block multiples; returns padded (o, lse). ``group``
+    q heads share each K/V head (GQA/MQA): the K/V index maps divide the
+    head coordinate, so grouped heads reread the same blocks instead of the
+    caller materializing a broadcast copy in HBM."""
     batch, heads, sqp, dp = q.shape
     skp = k.shape[2]
     nq, nk = sqp // bq, skp // bk
@@ -135,8 +138,10 @@ def _run_fwd(q, k, v, kv_lengths, scale, causal, sq, sk, bq, bk):
         grid=grid,
         in_specs=kvl_spec + [
             pl.BlockSpec((1, 1, bq, dp), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, bk, dp), lambda b, h, i, j: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, bk, dp), lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, bk, dp),
+                         lambda b, h, i, j: (b, h // group, j, 0)),
+            pl.BlockSpec((1, 1, bk, dp),
+                         lambda b, h, i, j: (b, h // group, j, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, bq, dp), lambda b, h, i, j: (b, h, i, 0)),
@@ -201,10 +206,14 @@ def _dq_kernel(kvl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _dkv_kernel(kvl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_scr, dv_scr,
-                *, scale, bq, bk, nq, sq, sk, causal):
-    b, j, i = pl.program_id(0), pl.program_id(2), pl.program_id(3)
+                *, scale, bq, bk, nq, sq, sk, causal, group=1):
+    # grid: (batch, kv_heads, nk, group * nq) — the trailing dim walks every
+    # (q head in group, q block) pair so dk/dv accumulate over the whole
+    # query group in one scratch pass (GQA/MQA backward)
+    b, j, t = pl.program_id(0), pl.program_id(2), pl.program_id(3)
+    i = t % nq
 
-    @pl.when(i == 0)
+    @pl.when(t == 0)
     def _init():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
@@ -236,16 +245,16 @@ def _dkv_kernel(kvl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     else:
         _step()
 
-    @pl.when(i == nq - 1)
+    @pl.when(t == group * nq - 1)
     def _finish():
         dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
 
 
 def _run_bwd(q, k, v, do, lse, delta, kv_lengths, scale, causal,
-             sq, sk, bq, bk):
+             sq, sk, bq, bk, group=1):
     batch, heads, sqp, dp = q.shape
-    skp = k.shape[2]
+    kv_heads, skp = k.shape[1], k.shape[2]
     nq, nk = sqp // bq, skp // bk
     kvl_spec, args = [], []
     if kv_lengths is not None:
@@ -259,8 +268,10 @@ def _run_bwd(q, k, v, do, lse, delta, kv_lengths, scale, causal,
 
     row_specs = [
         pl.BlockSpec((1, 1, bq, dp), lambda b, h, i, j: (b, h, i, 0)),   # q
-        pl.BlockSpec((1, 1, bk, dp), lambda b, h, i, j: (b, h, j, 0)),   # k
-        pl.BlockSpec((1, 1, bk, dp), lambda b, h, i, j: (b, h, j, 0)),   # v
+        pl.BlockSpec((1, 1, bk, dp),
+                     lambda b, h, i, j: (b, h // group, j, 0)),          # k
+        pl.BlockSpec((1, 1, bk, dp),
+                     lambda b, h, i, j: (b, h // group, j, 0)),          # v
         pl.BlockSpec((1, 1, bq, dp), lambda b, h, i, j: (b, h, i, 0)),   # do
         pl.BlockSpec((1, 1, 1, bq), lambda b, h, i, j: (b, h, 0, i)),    # lse
         pl.BlockSpec((1, 1, 1, bq), lambda b, h, i, j: (b, h, 0, i)),    # delta
@@ -279,18 +290,23 @@ def _run_bwd(q, k, v, do, lse, delta, kv_lengths, scale, causal,
         interpret=pallas_interpret(),
     )(*args, q, k, v, do, lse, delta)
 
+    # trailing grid dim walks (q head in group, q block) pairs: t = g*nq + i
     col_specs = [
-        pl.BlockSpec((1, 1, bq, dp), lambda b, h, j, i: (b, h, i, 0)),   # q
-        pl.BlockSpec((1, 1, bk, dp), lambda b, h, j, i: (b, h, j, 0)),   # k
-        pl.BlockSpec((1, 1, bk, dp), lambda b, h, j, i: (b, h, j, 0)),   # v
-        pl.BlockSpec((1, 1, bq, dp), lambda b, h, j, i: (b, h, i, 0)),   # do
-        pl.BlockSpec((1, 1, 1, bq), lambda b, h, j, i: (b, h, 0, i)),    # lse
-        pl.BlockSpec((1, 1, 1, bq), lambda b, h, j, i: (b, h, 0, i)),    # delta
+        pl.BlockSpec((1, 1, bq, dp),
+                     lambda b, h, j, t: (b, h * group + t // nq, t % nq, 0)),
+        pl.BlockSpec((1, 1, bk, dp), lambda b, h, j, t: (b, h, j, 0)),   # k
+        pl.BlockSpec((1, 1, bk, dp), lambda b, h, j, t: (b, h, j, 0)),   # v
+        pl.BlockSpec((1, 1, bq, dp),
+                     lambda b, h, j, t: (b, h * group + t // nq, t % nq, 0)),
+        pl.BlockSpec((1, 1, 1, bq),
+                     lambda b, h, j, t: (b, h * group + t // nq, 0, t % nq)),
+        pl.BlockSpec((1, 1, 1, bq),
+                     lambda b, h, j, t: (b, h * group + t // nq, 0, t % nq)),
     ]
     dk, dv = pl.pallas_call(
         wrap(_dkv_kernel, scale=scale, bq=bq, bk=bk, nq=nq, sq=sq, sk=sk,
-             causal=causal),
-        grid=(batch, heads, nk, nq),
+             causal=causal, group=group),
+        grid=(batch, kv_heads, nk, group * nq),
         in_specs=kvl_spec + col_specs,
         out_specs=[
             pl.BlockSpec((1, 1, bk, dp), lambda b, h, j, i: (b, h, j, 0)),
@@ -334,8 +350,10 @@ def _flash(q, k, v, kv_lengths, scale, causal, bq, bk):
 def _flash_fwd_impl(q, k, v, kv_lengths, scale, causal, bq, bk):
     sq, d = q.shape[2], q.shape[3]
     sk = k.shape[2]
+    group = q.shape[1] // k.shape[1]
     qp, kp, vp = _pad_qkv(q, k, v, bq, bk)
-    o, lse = _run_fwd(qp, kp, vp, kv_lengths, scale, causal, sq, sk, bq, bk)
+    o, lse = _run_fwd(qp, kp, vp, kv_lengths, scale, causal, sq, sk, bq, bk,
+                      group=group)
     return o[:, :, :sq, :d], lse[:, :, :sq]
 
 
@@ -359,7 +377,7 @@ def _flash_vjp_bwd(scale, causal, bq, bk, res, do):
     # reshape row-vectors to (B, H, 1, sqp) for the (1,1,1,bq) block specs
     dq, dk, dv = _run_bwd(qp, kp, vp, dop, lsep[:, :, None, :],
                           delta[:, :, None, :], kv_lengths, scale, causal,
-                          sq, sk, bq, bk)
+                          sq, sk, bq, bk, group=q.shape[1] // k.shape[1])
     dq = dq[:, :, :sq, :d]
     dk = dk[:, :, :sk, :d]
     dv = dv[:, :, :sk, :d]
@@ -379,6 +397,10 @@ _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 def _mha_reference(q, k, v, kv_lengths, scale, causal):
     sq, sk = q.shape[2], k.shape[2]
+    if k.shape[1] != q.shape[1]:     # GQA/MQA: broadcast the K/V heads
+        group = q.shape[1] // k.shape[1]
+        k = jnp.repeat(k, group, axis=1)
+        v = jnp.repeat(v, group, axis=1)
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
     col = jnp.arange(sk)[None, None, None, :]
@@ -412,8 +434,11 @@ def flash_attention(
 
     Args:
       q: ``[batch, heads, seq_q, head_dim]``.
-      k, v: ``[batch, heads, seq_k, head_dim]`` (``heads`` must match; do any
-        GQA/MQA head broadcast before calling).
+      k, v: ``[batch, kv_heads, seq_k, head_dim]`` — ``kv_heads`` may divide
+        ``heads`` (GQA; ``kv_heads == 1`` is MQA): grouped query heads read
+        the same K/V blocks inside the kernel, so no broadcast copy of K/V
+        ever lands in HBM, and dK/dV accumulate over the group in one
+        scratch pass.
       causal: upper-triangular mask with the standard ``seq_k - seq_q`` offset
         (reference ``scaled_upper_triang_masked_softmax`` semantics).
       softmax_scale: defaults to ``1/sqrt(head_dim)``.
@@ -422,6 +447,10 @@ def flash_attention(
     """
     if q.ndim != 4 or k.ndim != 4 or v.ndim != 4:
         raise ValueError("flash_attention expects [batch, heads, seq, dim]")
+    if k.shape[1] != v.shape[1] or q.shape[1] % k.shape[1]:
+        raise ValueError(
+            f"kv_heads ({k.shape[1]}) must divide query heads "
+            f"({q.shape[1]}) for GQA/MQA")
     scale = float(softmax_scale if softmax_scale is not None
                   else 1.0 / np.sqrt(q.shape[-1]))
     if not use_pallas():

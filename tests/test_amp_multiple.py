@@ -1,0 +1,166 @@
+"""Multiple models / optimizers / losses under one amp state.
+
+Mirrors the reference's ``tests/L0/run_amp/test_multiple_models_optimizers_
+losses.py`` (762 LoC): ``amp.initialize(num_losses=N)`` creates independent
+loss scalers; an overflow in one loss's backward must back off only that
+scaler and skip only the optimizers stepped under it, while the other
+model/optimizer pair keeps training and its scaler keeps growing. Also the
+DCGAN-shaped scenario (two models, two optimizers, three losses) the
+reference exercises in ``examples/dcgan/main_amp.py``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu import amp
+from apex_tpu.optimizers import FusedAdam, FusedSGD
+
+
+def _linear_loss(p, x, target):
+    pred = x @ p["w"] + p["b"]
+    return jnp.mean((pred - target) ** 2)
+
+
+def test_num_losses_independent_states():
+    st = amp.initialize("O2", num_losses=3)
+    assert len(st.scaler_states) == 3
+    # states are independent values, not aliases
+    s0 = st.scaler.update(st.scaler_states[0], jnp.asarray(True))
+    assert float(s0.loss_scale) < float(st.scaler_states[1].loss_scale)
+
+
+def test_state_dict_roundtrip_multi_loss():
+    st = amp.initialize("O1", num_losses=3)
+    # push scaler 1 through an overflow so the three diverge
+    states = list(st.scaler_states)
+    states[1] = st.scaler.update(states[1], jnp.asarray(True))
+    st.scaler_states[:] = states
+    d = amp.state_dict(st)
+    assert set(d) == {"loss_scaler0", "loss_scaler1", "loss_scaler2"}
+    st2 = amp.initialize("O1", num_losses=3)
+    st2 = amp.load_state_dict(st2, d)
+    for a, b in zip(st.scaler_states, st2.scaler_states):
+        assert float(a.loss_scale) == float(b.loss_scale)
+
+
+def test_overflow_isolated_per_loss():
+    """Loss 0 overflows; optimizer 0 skips + scaler 0 backs off; loss 1's
+    model steps normally and scaler 1 is untouched."""
+    sc = amp.LossScaler("dynamic", init_scale=2.0 ** 8)
+    st0, st1 = sc.init(), sc.init()
+
+    p0 = {"w": jnp.ones((4, 2)), "b": jnp.zeros((2,))}
+    p1 = {"w": jnp.ones((4, 2)), "b": jnp.zeros((2,))}
+    opt0, opt1 = FusedSGD(lr=0.1), FusedAdam(lr=0.1)
+    os0, os1 = opt0.init(p0), opt1.init(p1)
+    x = jnp.ones((3, 4))
+    y = jnp.zeros((3, 2))
+
+    @jax.jit
+    def step(p0, os0, st0, p1, os1, st1, poison):
+        g0 = jax.grad(lambda p: sc.scale(_linear_loss(p, x, y), st0))(p0)
+        # inject an overflow into model 0's grads only
+        g0 = jax.tree.map(lambda g: g + poison, g0)
+        g0, inf0 = sc.unscale(g0, st0)
+        p0, os0 = opt0.step(g0, p0, os0, found_inf=inf0)  # on-device skip
+        st0 = sc.update(st0, inf0)
+
+        g1 = jax.grad(lambda p: sc.scale(_linear_loss(p, x, y), st1))(p1)
+        g1, inf1 = sc.unscale(g1, st1)
+        p1, os1 = opt1.step(g1, p1, os1, found_inf=inf1)
+        st1 = sc.update(st1, inf1)
+        return p0, os0, st0, p1, os1, st1
+
+    p0b, os0b, st0b, p1b, os1b, st1b = step(
+        p0, os0, st0, p1, os1, st1, jnp.asarray(jnp.inf))
+    # model 0: skipped, scaler backed off
+    np.testing.assert_allclose(p0b["w"], p0["w"])
+    assert float(st0b.loss_scale) == 2.0 ** 7
+    # model 1: stepped, scaler intact
+    assert not np.allclose(p1b["w"], p1["w"])
+    assert float(st1b.loss_scale) == 2.0 ** 8
+
+
+def test_shared_model_two_losses_sequential_backward():
+    """Reference scenario: the same model backed through two losses with
+    per-loss scalers (amp.scale_loss(loss, opt, loss_id=i)); gradients
+    accumulate across the two backwards before one optimizer step."""
+    sc = amp.LossScaler(2.0 ** 4)   # static
+    st0, st1 = sc.init(), sc.init()
+    p = {"w": jnp.ones((4, 2)), "b": jnp.zeros((2,))}
+    opt = FusedSGD(lr=0.05)
+    os_ = opt.init(p)
+    x = jnp.ones((3, 4))
+    y0, y1 = jnp.zeros((3, 2)), jnp.ones((3, 2))
+
+    @jax.jit
+    def step(p, os_):
+        g0 = jax.grad(lambda q: sc.scale(_linear_loss(q, x, y0), st0))(p)
+        g0, i0 = sc.unscale(g0, st0)
+        g1 = jax.grad(lambda q: sc.scale(_linear_loss(q, x, y1), st1))(p)
+        g1, i1 = sc.unscale(g1, st1)
+        g = jax.tree.map(jnp.add, g0, g1)
+        inf = jnp.logical_or(i0, i1)
+        return opt.step(g, p, os_, found_inf=inf)
+
+    # reference: grads of (loss0 + loss1) == accumulated per-loss grads
+    g_ref = jax.grad(lambda q: _linear_loss(q, x, y0)
+                     + _linear_loss(q, x, y1))(p)
+    p_ref, _ = opt.step(g_ref, p, opt.init(p))
+    p_new, _ = step(p, os_)
+    np.testing.assert_allclose(p_new["w"], p_ref["w"], rtol=1e-5)
+
+
+def test_dcgan_shaped_three_scalers():
+    """Two models (G, D), two optimizers, three losses (errD_real,
+    errD_fake, errG) each with its own scaler — the examples/dcgan_amp.py
+    topology — trains without NaN and decreases both losses."""
+    key = jax.random.PRNGKey(0)
+    amp_state = amp.initialize("O1", num_losses=3, loss_scale="dynamic")
+    sc = amp_state.scaler
+    s = list(amp_state.scaler_states)
+
+    kG, kD, kz = jax.random.split(key, 3)
+    G = {"w": jax.random.normal(kG, (8, 16)) * 0.1}
+    D = {"w": jax.random.normal(kD, (16, 1)) * 0.1}
+    optG, optD = FusedAdam(lr=2e-3), FusedAdam(lr=2e-3)
+    osG, osD = optG.init(G), optD.init(D)
+    real = jax.random.normal(kz, (32, 16))
+
+    def d_out(D, h):
+        return jax.nn.sigmoid(h @ D["w"])
+
+    def bce(p, label):
+        eps = 1e-6
+        return -jnp.mean(label * jnp.log(p + eps)
+                         + (1 - label) * jnp.log(1 - p + eps))
+
+    @jax.jit
+    def step(G, D, osG, osD, s0, s1, s2, z):
+        # D on real (loss 0) + D on fake (loss 1), accumulated
+        fake = z @ G["w"]
+        gr = jax.grad(lambda d: sc.scale(bce(d_out(d, real), 1.0), s0))(D)
+        gr, i0 = sc.unscale(gr, s0)
+        gf = jax.grad(lambda d: sc.scale(bce(d_out(d, fake), 0.0), s1))(D)
+        gf, i1 = sc.unscale(gf, s1)
+        gD = jax.tree.map(jnp.add, gr, gf)
+        D, osD = optD.step(gD, D, osD, found_inf=jnp.logical_or(i0, i1))
+        s0, s1 = sc.update(s0, i0), sc.update(s1, i1)
+        # G (loss 2)
+        gG = jax.grad(
+            lambda g: sc.scale(bce(d_out(D, z @ g["w"]), 1.0), s2))(G)
+        gG, i2 = sc.unscale(gG, s2)
+        G, osG = optG.step(gG, G, osG, found_inf=i2)
+        s2 = sc.update(s2, i2)
+        errD = bce(d_out(D, real), 1.0) + bce(d_out(D, fake), 0.0)
+        return G, D, osG, osD, s0, s1, s2, errD
+
+    errs = []
+    for i in range(20):
+        z = jax.random.normal(jax.random.fold_in(kz, i), (32, 8))
+        G, D, osG, osD, s[0], s[1], s[2], errD = step(
+            G, D, osG, osD, s[0], s[1], s[2], z)
+        errs.append(float(errD))
+    assert np.isfinite(errs).all()
+    assert errs[-1] < errs[0]

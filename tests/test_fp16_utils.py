@@ -1,0 +1,138 @@
+"""fp16_utils tier — mirrors the reference's ``tests/L0/run_fp16util``
+(``test_fp16util.py``: prep_param_lists / master↔model copies) plus
+``FP16_Optimizer`` step/overflow flow from ``run_deprecated``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.fp16_utils import (
+    DynamicLossScaler,
+    FP16_Optimizer,
+    LossScaler,
+    convert_network,
+    master_params_to_model_params,
+    model_grads_to_master_grads,
+    network_to_half,
+    prep_param_lists,
+)
+from apex_tpu.optimizers import FusedAdam, FusedSGD
+
+
+def _params():
+    return {
+        "dense": {"kernel": jnp.ones((4, 3)), "bias": jnp.zeros((3,))},
+        "batchnorm": {"scale": jnp.ones((3,)), "bias": jnp.zeros((3,))},
+        "step": jnp.asarray(0, jnp.int32),          # non-float leaf
+    }
+
+
+class TestConvertNetwork:
+    def test_network_to_half_casts_all_floats(self):
+        half = network_to_half(_params(), jnp.bfloat16)
+        assert half["dense"]["kernel"].dtype == jnp.bfloat16
+        assert half["batchnorm"]["scale"].dtype == jnp.bfloat16
+
+    def test_convert_network_keeps_norms_fp32(self):
+        """BN_convert_float capability (fp16util.py:60-71): norm-named
+        leaves stay fp32, everything else halves, ints untouched."""
+        half = convert_network(_params(), jnp.bfloat16)
+        assert half["dense"]["kernel"].dtype == jnp.bfloat16
+        assert half["batchnorm"]["scale"].dtype == jnp.float32
+        assert half["batchnorm"]["bias"].dtype == jnp.float32
+        assert half["step"].dtype == jnp.int32
+
+    def test_convert_network_custom_predicate(self):
+        half = convert_network(_params(), jnp.bfloat16, keep_fp32=None)
+        assert half["batchnorm"]["scale"].dtype == jnp.bfloat16
+
+
+class TestMasterModelCopies:
+    def test_prep_param_lists(self):
+        model = network_to_half(_params(), jnp.bfloat16)
+        model_out, master = prep_param_lists(model)
+        assert model_out is model
+        assert master["dense"]["kernel"].dtype == jnp.float32
+
+    def test_grads_to_master_and_back(self):
+        model = network_to_half({"w": jnp.ones((4,))}, jnp.bfloat16)
+        grads = jax.tree.map(lambda p: jnp.full_like(p, 0.5), model)
+        master_grads = model_grads_to_master_grads(grads)
+        assert master_grads["w"].dtype == jnp.float32
+        # master update then copy back preserves model dtype
+        _, master = prep_param_lists(model)
+        master = jax.tree.map(lambda m, g: m - 0.1 * g, master, master_grads)
+        model2 = master_params_to_model_params(master, model)
+        assert model2["w"].dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(model2["w"], np.float32), 0.95, rtol=1e-2)
+
+
+class TestFP16Optimizer:
+    def test_step_matches_inner_on_fp32(self):
+        """With scale 1.0 and fp32 params the wrapper must reproduce the
+        inner optimizer exactly (fp16_optimizer.py step path)."""
+        p = {"w": jnp.linspace(0.1, 1.0, 8)}
+        g = {"w": jnp.full((8,), 0.25)}
+        inner = FusedSGD(lr=0.1, momentum=0.9)
+        wrapped = FP16_Optimizer(FusedSGD(lr=0.1, momentum=0.9))
+        st = wrapped.init(p)
+        p_ref, _ = inner.step(g, p, inner.init(p))
+        p_new, _ = wrapped.step(g, st, p)
+        np.testing.assert_allclose(p_new["w"], p_ref["w"], rtol=1e-6)
+
+    def test_half_params_master_flow(self):
+        p = network_to_half({"w": jnp.ones((8,))}, jnp.bfloat16)
+        opt = FP16_Optimizer(FusedAdam(lr=0.01), static_loss_scale=128.0)
+        st = opt.init(p)
+        loss_scale = opt.scale_loss(jnp.asarray(1.0), st)
+        assert float(loss_scale) == 128.0
+        grads = {"w": (jnp.ones((8,)) * 128.0).astype(jnp.bfloat16)}  # scaled
+        p2, st2 = opt.step(grads, st, p)
+        assert p2["w"].dtype == jnp.bfloat16
+        # master moved by ~lr in the right direction (unscaled grad == 1)
+        assert float(st2.master_params["w"][0]) < 1.0
+
+    def test_dynamic_overflow_skips_and_backs_off(self):
+        p = {"w": jnp.ones((4,), jnp.bfloat16)}
+        opt = FP16_Optimizer(FusedSGD(lr=0.5), dynamic_loss_scale=True,
+                             dynamic_loss_args={"init_scale": 2.0 ** 10})
+        st = opt.init(p)
+        bad = {"w": jnp.full((4,), jnp.inf, jnp.bfloat16)}
+        p2, st2 = opt.step(bad, st, p)
+        np.testing.assert_allclose(np.asarray(p2["w"], np.float32), 1.0)
+        assert float(st2.scaler_state.loss_scale) == 2.0 ** 9
+
+    def test_jittable(self):
+        p = {"w": jnp.ones((4,), jnp.bfloat16)}
+        opt = FP16_Optimizer(FusedSGD(lr=0.1), dynamic_loss_scale=True)
+        st = opt.init(p)
+
+        @jax.jit
+        def step(g, st, p):
+            return opt.step(g, st, p)
+
+        # step() expects *scaled* grads (unscaled grad == 0.5 here)
+        scale = float(st.scaler_state.loss_scale)
+        g = {"w": jnp.full((4,), 0.5 * scale, jnp.bfloat16)}
+        p2, st2 = step(g, st, p)
+        assert not np.allclose(np.asarray(p2["w"], np.float32), 1.0)
+
+
+class TestLegacyScalers:
+    def test_static_alias(self):
+        sc = LossScaler(64.0)
+        st = sc.init()
+        assert float(st.loss_scale) == 64.0
+        st2 = sc.update(st, jnp.asarray(True))
+        assert float(st2.loss_scale) == 64.0     # static: never changes
+
+    def test_dynamic_alias_window(self):
+        sc = DynamicLossScaler(init_scale=2.0 ** 8, scale_window=2)
+        st = sc.init()
+        for _ in range(2):
+            st = sc.update(st, jnp.asarray(False))
+        assert float(st.loss_scale) == 2.0 ** 9   # grew after window
+        st = sc.update(st, jnp.asarray(True))
+        assert float(st.loss_scale) == 2.0 ** 8   # backed off

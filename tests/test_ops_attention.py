@@ -94,3 +94,58 @@ def test_jit_and_scale():
     ref = _mha_reference(q, k, v, None, 0.5, False)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
+
+
+class TestGQA:
+    """Grouped-query / multi-query attention (kv_heads divides heads): the
+    kernel reads shared K/V blocks per group — parity vs the broadcast
+    reference, forward and backward."""
+
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("kv_heads", [1, 2])   # MQA and GQA
+    def test_forward(self, causal, kv_heads):
+        q = _rand((2, 4, 96, 64), seed=11)
+        k = _rand((2, kv_heads, 160, 64), seed=12)
+        v = _rand((2, kv_heads, 160, 64), seed=13)
+        out = flash_attention(q, k, v, causal=causal)
+        ref = _mha_reference(q, k, v, None, 1.0 / np.sqrt(64), causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_backward(self, causal):
+        q = _rand((2, 4, 64, 64), seed=14)
+        k = _rand((2, 2, 128, 64), seed=15)
+        v = _rand((2, 2, 128, 64), seed=16)
+
+        def loss(fn):
+            def inner(q, k, v):
+                o = fn(q, k, v)
+                return jnp.sum(o * jnp.sin(o))
+            return inner
+
+        g = jax.grad(loss(lambda q, k, v: flash_attention(
+            q, k, v, causal=causal)), argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss(lambda q, k, v: _mha_reference(
+            q, k, v, None, 1.0 / np.sqrt(64), causal)),
+            argnums=(0, 1, 2))(q, k, v)
+        assert g[1].shape == k.shape     # dk has kv_heads, not heads
+        for a, b in zip(g, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-5, rtol=5e-5)
+
+    def test_varlen_gqa(self):
+        q = _rand((2, 4, 64, 64), seed=17)
+        k = _rand((2, 1, 200, 64), seed=18)
+        v = _rand((2, 1, 200, 64), seed=19)
+        lens = jnp.asarray([200, 23], jnp.int32)
+        out = flash_attention(q, k, v, kv_lengths=lens)
+        ref = _mha_reference(q, k, v, lens, 1.0 / np.sqrt(64), False)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_indivisible_heads_rejected(self):
+        q = _rand((1, 3, 32, 64))
+        k = _rand((1, 2, 32, 64))
+        with pytest.raises(ValueError, match="divide"):
+            flash_attention(q, k, k)

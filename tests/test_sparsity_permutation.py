@@ -1,0 +1,80 @@
+"""Channel-permutation search for 2:4 sparsity
+(``apex/contrib/sparsity/permutation_lib.py`` capability)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.contrib.sparsity import (
+    compute_sparse_mask_2to4,
+    invert_permutation,
+    mask_efficacy,
+    permute_columns,
+    search_for_good_permutation,
+)
+
+
+def test_efficacy_bounds():
+    w = jax.random.normal(jax.random.PRNGKey(0), (16, 16))
+    e = float(mask_efficacy(w))
+    assert 0.5 < e <= 1.0   # 2-of-4 keeps at least half the magnitude
+
+
+def test_search_improves_adversarial_layout():
+    """Columns arranged so big weights collide inside groups; the search
+    must spread them and strictly raise efficacy."""
+    rng = np.random.RandomState(1)
+    w = rng.randn(32, 16).astype(np.float32) * 0.01
+    w[:, :4] += rng.randn(32, 4) * 10.0    # 4 dominant columns in one group
+    w = jnp.asarray(w)
+    before = float(mask_efficacy(w))
+    perm = search_for_good_permutation(w)
+    after = float(mask_efficacy(permute_columns(w, perm)))
+    assert after > before + 0.05
+    assert sorted(perm.tolist()) == list(range(16))   # is a permutation
+
+
+def test_identity_when_already_optimal():
+    # one dominant column per group: nothing to gain
+    w = np.full((8, 8), 0.01, np.float32)
+    w[:, [0, 4]] = 5.0
+    perm = search_for_good_permutation(jnp.asarray(w))
+    np.testing.assert_array_equal(perm, np.arange(8))
+
+
+def test_inverse_permutation_roundtrip():
+    perm = search_for_good_permutation(
+        jax.random.normal(jax.random.PRNGKey(2), (8, 12)))
+    inv = invert_permutation(perm)
+    np.testing.assert_array_equal(perm[inv], np.arange(12))
+    np.testing.assert_array_equal(inv[perm], np.arange(12))
+
+
+def test_composed_network_unchanged():
+    """Permuting layer2's input channels + the same perm on layer1's output
+    rows leaves the composed function identical (cross-layer propagation
+    contract)."""
+    k1, k2, kx = jax.random.split(jax.random.PRNGKey(3), 3)
+    w1 = jax.random.normal(k1, (12, 6))    # [out=12, in=6]
+    w2 = jax.random.normal(k2, (5, 12))    # [out=5, in=12]
+    x = jax.random.normal(kx, (6,))
+    perm = search_for_good_permutation(w2)
+    w2p = permute_columns(w2, perm)
+    w1p = w1[jnp.asarray(perm), :]         # permute producer's output rows
+    y_ref = w2 @ (w1 @ x)
+    y_new = w2p @ (w1p @ x)
+    np.testing.assert_allclose(np.asarray(y_new), np.asarray(y_ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_mask_after_permutation_keeps_more():
+    rng = np.random.RandomState(4)
+    w = rng.randn(64, 32).astype(np.float32)
+    w[:, ::4] *= 8.0
+    w[:, 1::4] *= 8.0
+    w = jnp.asarray(w)   # two dominant columns per group: 2:4 already ideal
+    perm = search_for_good_permutation(w)
+    masked = permute_columns(w, perm) * compute_sparse_mask_2to4(
+        permute_columns(w, perm))
+    kept = float(jnp.sum(jnp.abs(masked)) / jnp.sum(jnp.abs(w)))
+    assert kept >= float(mask_efficacy(w)) - 1e-6
