@@ -63,11 +63,17 @@ def _run(flash: bool):
     step, params, opt_state, tokens_per_step = _build()
     params, opt_state, loss = step(params, opt_state)          # compile
     _ = float(loss)
-    t0 = time.perf_counter()
-    for _i in range(STEPS):
-        params, opt_state, loss = step(params, opt_state)
-    _ = float(loss)                                            # host sync
-    dt = (time.perf_counter() - t0) / STEPS
+    # best-of-3 windows: the tunneled backend has multi-second transient
+    # stalls (remote compile cache, connection ramp) that a single window
+    # folds into the mean; min-of-windows reports steady-state throughput
+    best = float("inf")
+    for _w in range(3):
+        t0 = time.perf_counter()
+        for _i in range(STEPS):
+            params, opt_state, loss = step(params, opt_state)
+        _ = float(loss)                                        # host sync
+        best = min(best, (time.perf_counter() - t0) / STEPS)
+    dt = best
     if prev is None:
         os.environ.pop("APEX_TPU_FORCE_PALLAS", None)
     else:
