@@ -13,9 +13,14 @@ scaling with overflow skip-step (``apex/amp/scaler.py:33-217``,
 
 from apex_tpu.amp.policy import (
     Policy,
+    disable_casts,
     half_function,
     float_function,
+    master_params,
     promote_function,
+    register_float_function,
+    register_half_function,
+    register_promote_function,
 )
 from apex_tpu.amp.scaler import LossScaler, LossScalerState, all_finite
 from apex_tpu.amp.frontend import (
@@ -30,9 +35,14 @@ from apex_tpu.amp.handle import scale_loss, unscale_and_update, apply_if_finite
 
 __all__ = [
     "Policy",
+    "disable_casts",
     "half_function",
     "float_function",
+    "master_params",
     "promote_function",
+    "register_float_function",
+    "register_half_function",
+    "register_promote_function",
     "LossScaler",
     "LossScalerState",
     "all_finite",

@@ -9,6 +9,8 @@ API (``apex/amp/amp.py:30-48``) as plain function wrappers.
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import dataclasses
 import functools
 from typing import Any, Callable, Optional
@@ -17,6 +19,35 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.utils.tree import tree_cast
+
+# amp.disable_casts flips this (reference ``handle.py:164-167`` turns the
+# handle inactive); wrappers built by this module check it per call. A
+# ContextVar so a disable in one thread/async context never leaks into a
+# concurrently training one.
+_casts_enabled = contextvars.ContextVar(
+    "apex_tpu_amp_casts_enabled", default=True)
+
+
+@contextlib.contextmanager
+def disable_casts():
+    """Context manager suspending all policy/decorator casts
+    (reference ``amp.disable_casts``, ``apex/amp/handle.py:164``).
+
+    TRACE-TIME SEMANTICS: under ``jax.jit`` the flag is read when the
+    function is *traced*, and cached traces are reused — entering this
+    context around an already-warm jitted function does NOT retrace it.
+    Apply it where the policy boundary lives: around the first (tracing)
+    call, or keep separate jitted variants for cast-on / cast-off paths::
+
+        eval_fn = jax.jit(fn)                     # casts baked in
+        with amp.disable_casts():
+            debug_fn = jax.jit(lambda *a: fn(*a))  # fresh traces, no casts
+    """
+    token = _casts_enabled.set(False)
+    try:
+        yield
+    finally:
+        _casts_enabled.reset(token)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -45,6 +76,8 @@ class Policy:
 
         @functools.wraps(fn)
         def wrapped(*args, **kwargs):
+            if not _casts_enabled.get():
+                return fn(*args, **kwargs)
             args = self.cast_to_compute(args)
             kwargs = self.cast_to_compute(kwargs)
             out = fn(*args, **kwargs)
@@ -77,6 +110,8 @@ class Policy:
 def _cast_fn(fn: Callable, dtype) -> Callable:
     @functools.wraps(fn)
     def wrapped(*args, **kwargs):
+        if not _casts_enabled.get():
+            return fn(*args, **kwargs)
         args = tree_cast(args, dtype)
         kwargs = tree_cast(kwargs, dtype)
         return fn(*args, **kwargs)
@@ -100,6 +135,8 @@ def promote_function(fn: Callable) -> Callable:
 
     @functools.wraps(fn)
     def wrapped(*args, **kwargs):
+        if not _casts_enabled.get():
+            return fn(*args, **kwargs)
         leaves = jax.tree_util.tree_leaves((args, kwargs))
         f_dtypes = [x.dtype for x in leaves
                     if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)]
@@ -111,3 +148,41 @@ def promote_function(fn: Callable) -> Callable:
         return fn(*args, **kwargs)
 
     return wrapped
+
+
+def register_half_function(module, name: str, dtype=jnp.bfloat16) -> None:
+    """Rebind ``module.name`` to its half-cast wrapper in place
+    (reference: ``amp.register_half_function``, ``apex/amp/amp.py:52``) —
+    the one deliberate monkey-patch kept from the reference's design, for
+    third-party functions you can't decorate at definition site."""
+    setattr(module, name, half_function(getattr(module, name), dtype))
+
+
+def register_float_function(module, name: str) -> None:
+    """Reference: ``amp.register_float_function`` (``amp/amp.py:59``)."""
+    setattr(module, name, float_function(getattr(module, name)))
+
+
+def register_promote_function(module, name: str) -> None:
+    """Reference: ``amp.register_promote_function`` (``amp/amp.py:66``)."""
+    setattr(module, name, promote_function(getattr(module, name)))
+
+
+def master_params(opt_state) -> list:
+    """The fp32 master storage held by an optimizer state, when the
+    optimizer keeps it (``master_weights=True`` / O2), else ``[]``
+    (reference: ``amp.master_params``, ``apex/amp/_amp_state.py:50-58``,
+    which yields whatever the optimizer's param groups own).
+
+    Shape caveat, same as the reference: leaves mirror the optimizer's own
+    storage layout. ``FusedAdam(master_weights=True)`` & co. keep one fp32
+    leaf per parameter; ZeRO-sharded optimizers
+    (``DistributedFusedAdam/LAMB``) keep a single zero-padded
+    ``[dp, ..., chunk]`` flat buffer — for per-parameter views of those, use
+    the optimizer's ``state_dict``."""
+    if isinstance(opt_state, dict) and "master" in opt_state:
+        return jax.tree_util.tree_leaves(opt_state["master"])
+    master = getattr(opt_state, "master_params", None)   # FP16OptimizerState
+    if master is not None:
+        return jax.tree_util.tree_leaves(master)
+    return []

@@ -1,0 +1,56 @@
+"""Text generation with KV caches — greedy and sampled decoding.
+
+The reference ships no inference utilities; this demonstrates the
+exceeds-parity generation stack (``apex_tpu.models.generation``): one
+batched prefill, then a jitted ``lax.scan`` decode loop, with a GQA model
+(grouped K/V heads -> grouped caches) to show the memory win.
+
+Run (from the repo root): PYTHONPATH=. python examples/generate.py
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.models import GPTModel, TransformerConfig, generate
+
+
+def main():
+    cfg = TransformerConfig(
+        num_layers=4, hidden_size=256, num_attention_heads=8,
+        num_query_groups=2,               # GQA: caches hold 2 heads, not 8
+        vocab_size=512, max_position_embeddings=256,
+        hidden_dropout=0.0, attention_dropout=0.0,
+        compute_dtype=jnp.bfloat16)
+    model = GPTModel(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (4, 8), 0, 512)
+
+    t0 = time.perf_counter()
+    greedy = generate(model, params, prompt, max_new_tokens=48)
+    greedy.block_until_ready()
+    t1 = time.perf_counter()
+    sampled = generate(model, params, prompt, max_new_tokens=48,
+                       temperature=0.8, top_k=40,
+                       rng=jax.random.PRNGKey(7))
+    sampled.block_until_ready()
+    t2 = time.perf_counter()
+
+    n_new = 4 * 48
+    print(f"greedy : {greedy.shape} in {t1-t0:.2f}s "
+          f"({n_new/(t1-t0):,.0f} tok/s incl. compile)")
+    print(f"sampled: {sampled.shape} in {t2-t1:.2f}s "
+          f"({n_new/(t2-t1):,.0f} tok/s)")
+    same = bool(jnp.all(greedy == sampled))
+    print(f"greedy == sampled: {same} (expected False for temperature>0)")
+    kv_heads = cfg.kv_heads
+    print(f"KV cache heads per layer: {kv_heads} "
+          f"(vs {cfg.num_attention_heads} query heads — "
+          f"{cfg.num_attention_heads // kv_heads}x smaller cache)")
+    print("GENERATE OK")
+
+
+if __name__ == "__main__":
+    main()

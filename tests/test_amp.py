@@ -194,3 +194,66 @@ class TestFp16Path:
             losses.append(float(loss))
         assert losses[-1] < losses[0]
         assert w["w"].dtype == jnp.float16
+
+
+class TestRegistrationAndDisable:
+    """The remaining apex.amp public surface: register_* module patching
+    (amp/amp.py:52-72), disable_casts (handle.py:164), master_params
+    (_amp_state.py:50)."""
+
+    def test_register_half_function(self):
+        import types
+
+        mod = types.SimpleNamespace(op=lambda x: x.dtype)
+        amp.register_half_function(mod, "op")
+        assert mod.op(jnp.ones((2,), jnp.float32)) == jnp.bfloat16
+
+    def test_register_float_function(self):
+        import types
+
+        mod = types.SimpleNamespace(op=lambda x: x.dtype)
+        amp.register_float_function(mod, "op")
+        assert mod.op(jnp.ones((2,), jnp.bfloat16)) == jnp.float32
+
+    def test_register_promote_function(self):
+        import types
+
+        mod = types.SimpleNamespace(op=lambda x, y: (x.dtype, y.dtype))
+        amp.register_promote_function(mod, "op")
+        a = jnp.ones((2,), jnp.bfloat16)
+        b = jnp.ones((2,), jnp.float32)
+        assert mod.op(a, b) == (jnp.float32, jnp.float32)
+
+    def test_disable_casts_suspends_wrappers(self):
+        fn = amp.half_function(lambda x: x.dtype)
+        x32 = jnp.ones((2,), jnp.float32)
+        assert fn(x32) == jnp.bfloat16
+        with amp.disable_casts():
+            assert fn(x32) == jnp.float32
+        assert fn(x32) == jnp.bfloat16          # restored
+
+    def test_disable_casts_suspends_policy_wrap(self):
+        pol = amp.Policy(jnp.float32, jnp.bfloat16, jnp.float32)
+        seen = {}
+
+        def probe(x):
+            seen["dt"] = x.dtype
+            return x
+
+        wrapped = pol.wrap(probe)
+        wrapped(jnp.ones((2,), jnp.float32))
+        assert seen["dt"] == jnp.bfloat16
+        seen.clear()
+        with amp.disable_casts():
+            wrapped(jnp.ones((2,), jnp.float32))
+        assert seen["dt"] == jnp.float32
+
+    def test_master_params_from_optimizer_state(self):
+        from apex_tpu.optimizers import FusedAdam
+
+        p = {"w": jnp.ones((3,), jnp.bfloat16)}
+        opt = FusedAdam(lr=1e-3, master_weights=True)
+        st = opt.init(p)
+        masters = amp.master_params(st)
+        assert len(masters) == 1 and masters[0].dtype == jnp.float32
+        assert amp.master_params(FusedAdam(lr=1e-3).init(p)) == []
