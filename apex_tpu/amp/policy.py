@@ -34,14 +34,17 @@ def disable_casts():
     (reference ``amp.disable_casts``, ``apex/amp/handle.py:164``).
 
     TRACE-TIME SEMANTICS: under ``jax.jit`` the flag is read when the
-    function is *traced*, and cached traces are reused — entering this
-    context around an already-warm jitted function does NOT retrace it.
-    Apply it where the policy boundary lives: around the first (tracing)
-    call, or keep separate jitted variants for cast-on / cast-off paths::
+    function is *traced* — which happens at the first CALL, not at
+    ``jax.jit(...)`` construction — and cached traces are reused, so
+    entering this context around an already-warm jitted function does NOT
+    retrace it. Keep separate jitted variants and make each one's first
+    (tracing) call inside the right context::
 
-        eval_fn = jax.jit(fn)                     # casts baked in
+        eval_fn = jax.jit(fn)                      # casts baked in
+        debug_fn = jax.jit(lambda *a: fn(*a))      # distinct cache
         with amp.disable_casts():
-            debug_fn = jax.jit(lambda *a: fn(*a))  # fresh traces, no casts
+            debug_fn(example_args)                 # traces NOW, casts off
+        debug_fn(real_args)                        # reuses the no-cast trace
     """
     token = _casts_enabled.set(False)
     try:

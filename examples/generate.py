@@ -42,7 +42,8 @@ def main():
     print(f"greedy : {greedy.shape} in {t1-t0:.2f}s "
           f"({n_new/(t1-t0):,.0f} tok/s incl. compile)")
     print(f"sampled: {sampled.shape} in {t2-t1:.2f}s "
-          f"({n_new/(t2-t1):,.0f} tok/s)")
+          f"({n_new/(t2-t1):,.0f} tok/s incl. compile — the sampling "
+          f"branch retraces)")
     same = bool(jnp.all(greedy == sampled))
     print(f"greedy == sampled: {same} (expected False for temperature>0)")
     kv_heads = cfg.kv_heads
