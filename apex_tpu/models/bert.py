@@ -21,6 +21,8 @@ from apex_tpu.models.transformer import (
     _ln_params,
     _ln_spec,
     embed_tokens,
+    position_table_params,
+    position_table_spec,
 )
 from apex_tpu.transformer.enums import AttnMaskType
 from apex_tpu.transformer.tensor_parallel.cross_entropy import (
@@ -65,9 +67,7 @@ class BertModel:
         params = {
             "embedding": {
                 "word_embeddings": self.embedding.init(ks[0]),
-                "position_embeddings": c.init_method()(
-                    ks[1], (c.max_position_embeddings, c.hidden_size),
-                    c.params_dtype),
+                **position_table_params(c, ks[1]),
                 "tokentype_embeddings": c.init_method()(
                     ks[2], (self.num_tokentypes, c.hidden_size),
                     c.params_dtype),
@@ -102,7 +102,7 @@ class BertModel:
         spec = {
             "embedding": {
                 "word_embeddings": self.embedding.spec(),
-                "position_embeddings": PartitionSpec(),
+                **position_table_spec(self.config),
                 "tokentype_embeddings": PartitionSpec(),
             },
             "transformer": self.transformer.spec(),

@@ -23,6 +23,8 @@ from apex_tpu.models.transformer import (
     ParallelTransformer,
     TransformerConfig,
     embed_tokens,
+    position_table_params,
+    position_table_spec,
 )
 from apex_tpu.transformer.enums import AttnMaskType, LayerType
 from apex_tpu.transformer.tensor_parallel.layers import VocabParallelEmbedding
@@ -73,9 +75,7 @@ class EncoderDecoderModel:
         return {
             "embedding": {
                 "word_embeddings": self.embedding.init(k_emb),
-                "position_embeddings": c.init_method()(
-                    k_pos, (c.max_position_embeddings, c.hidden_size),
-                    c.params_dtype),
+                **position_table_params(c, k_pos),
             },
             "encoder": self.encoder.init(k_enc),
             "decoder": self.decoder.init(k_dec),
@@ -85,7 +85,7 @@ class EncoderDecoderModel:
         return {
             "embedding": {
                 "word_embeddings": self.embedding.spec(),
-                "position_embeddings": PartitionSpec(),
+                **position_table_spec(self.config),
             },
             "encoder": self.encoder.spec(),
             "decoder": self.decoder.spec(),

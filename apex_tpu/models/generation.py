@@ -62,9 +62,12 @@ def _cached_forward(model, params, caches, tokens: jax.Array, index):
     emb_p = params["embedding"]
     s = tokens.shape[1]
     emb = model.embedding.apply(emb_p["word_embeddings"], tokens)  # [b,s,h]
-    pos = lax.dynamic_slice_in_dim(emb_p["position_embeddings"], index, s,
-                                   axis=0)                          # [s, h]
-    hidden = (emb + pos[None]).transpose(1, 0, 2)                   # [s,b,h]
+    if c.position_embedding_type == "learned":
+        pos = lax.dynamic_slice_in_dim(emb_p["position_embeddings"], index,
+                                       s, axis=0)                   # [s, h]
+        emb = emb + pos[None]
+    # (rope rotates q/k inside attention at offset ``index``; nothing to add)
+    hidden = emb.transpose(1, 0, 2)                                 # [s,b,h]
     hidden = hidden.astype(c.compute_dtype)
     hidden, new_caches = model.transformer.apply(
         params["transformer"], hidden, kv_caches=caches, cache_index=index)
@@ -102,7 +105,8 @@ def generate(model, params, prompt: jax.Array, max_new_tokens: int, *,
         raise NotImplementedError("generation with MoE is not supported")
     b, prompt_len = prompt.shape
     total = prompt_len + max_new_tokens
-    if total > model.config.max_position_embeddings:
+    if (model.config.position_embedding_type == "learned"
+            and total > model.config.max_position_embeddings):
         raise ValueError(
             f"prompt + new tokens ({total}) exceeds "
             f"max_position_embeddings "

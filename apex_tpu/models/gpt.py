@@ -21,6 +21,8 @@ from apex_tpu.models.transformer import (
     ParallelTransformer,
     TransformerConfig,
     embed_tokens,
+    position_table_params,
+    position_table_spec,
 )
 from apex_tpu.transformer.tensor_parallel.cross_entropy import (
     vocab_parallel_cross_entropy,
@@ -83,9 +85,7 @@ class GPTModel:
         return {
             "embedding": {
                 "word_embeddings": self.embedding.init(k_emb),
-                "position_embeddings": c.init_method()(
-                    k_pos, (c.max_position_embeddings, c.hidden_size),
-                    c.params_dtype),
+                **position_table_params(c, k_pos),
             },
             "transformer": self.transformer.init(k_tr),
         }
@@ -94,7 +94,7 @@ class GPTModel:
         return {
             "embedding": {
                 "word_embeddings": self.embedding.spec(),
-                "position_embeddings": PartitionSpec(),
+                **position_table_spec(self.config),
             },
             "transformer": self.transformer.spec(),
         }

@@ -26,6 +26,8 @@ from apex_tpu.models.transformer import (
     ParallelTransformerLayer,
     TransformerConfig,
     embed_tokens,
+    position_table_params,
+    position_table_spec,
 )
 from apex_tpu.models.transformer import _ln, _ln_params, _ln_spec
 from apex_tpu.transformer.pipeline_parallel.schedules.common import (
@@ -87,9 +89,7 @@ class PipelinedGPT:
         return {
             "embedding": {
                 "word_embeddings": self.embedding.init(k_emb),
-                "position_embeddings": c.init_method()(
-                    k_pos, (c.max_position_embeddings, c.hidden_size),
-                    c.params_dtype),
+                **position_table_params(c, k_pos),
             },
             "stages": stages,
             "final_layernorm": _ln_params(c.hidden_size, c.params_dtype),
@@ -99,7 +99,7 @@ class PipelinedGPT:
         return {
             "embedding": {
                 "word_embeddings": self.embedding.spec(),
-                "position_embeddings": PartitionSpec(),
+                **position_table_spec(self.config),
             },
             "stages": pipeline_stage_spec(self.layer.spec(),
                                           self.virtual_pipeline_size),

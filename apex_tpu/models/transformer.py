@@ -75,6 +75,12 @@ class TransformerConfig:
     hidden_dropout: float = 0.1
     attention_dropout: float = 0.1
     layernorm_epsilon: float = 1e-5
+    # "learned" (reference GPT/BERT fixtures), "rope" (rotary via the fused
+    # rope op applied to q/k inside attention, the NeMo/Megatron fused_rope
+    # capability in real use), or "none"
+    position_embedding_type: str = "learned"
+    rotary_percent: float = 1.0        # fraction of head_dim rotated
+    rope_theta: float = 10000.0
     attn_mask_type: AttnMaskType = AttnMaskType.causal
     sequence_parallel: bool = False
     # context parallelism (long-context; the reference has none, SURVEY.md §5):
@@ -96,6 +102,16 @@ class TransformerConfig:
     init_method_std: float = 0.02
     axis_name: str = TENSOR_AXIS
 
+    def __post_init__(self):
+        if self.position_embedding_type not in ("learned", "rope", "none"):
+            raise ValueError(
+                f"position_embedding_type must be 'learned', 'rope', or "
+                f"'none', got {self.position_embedding_type!r}")
+        if not 0.0 < self.rotary_percent <= 1.0:
+            raise ValueError(
+                f"rotary_percent must be in (0, 1], got "
+                f"{self.rotary_percent}")
+
     @property
     def ffn_size(self) -> int:
         return self.ffn_hidden_size or 4 * self.hidden_size
@@ -112,6 +128,19 @@ class TransformerConfig:
         divide(self.num_attention_heads, self.num_query_groups)  # validates
         return self.num_query_groups
 
+    @property
+    def rotary_dim(self) -> int:
+        """Even number of head-dim channels rotated by RoPE (≥ 2; a
+        rotary_percent low enough to round below 2 is rejected)."""
+        rot = int(self.head_dim * self.rotary_percent)
+        rot -= rot % 2
+        if rot < 2:
+            raise ValueError(
+                f"rotary_percent ({self.rotary_percent}) rotates fewer than "
+                f"2 of {self.head_dim} head-dim channels; use "
+                f"position_embedding_type='none' to disable rotation")
+        return rot
+
     def init_method(self) -> Callable:
         std = self.init_method_std
         return jax.nn.initializers.normal(stddev=std)
@@ -121,6 +150,37 @@ class TransformerConfig:
         # (standalone_transformer_lm.py `scaled_init_method_normal`).
         std = self.init_method_std / (2.0 * self.num_layers) ** 0.5
         return jax.nn.initializers.normal(stddev=std)
+
+
+def position_table_params(config: "TransformerConfig", key) -> dict:
+    """Learned-position table params, or ``{}`` under rope/none — the one
+    shared guard every model's ``init`` uses so param trees stay consistent
+    across GPT/BERT/encoder-decoder/pipelined for the same config."""
+    if config.position_embedding_type != "learned":
+        return {}
+    return {"position_embeddings": config.init_method()(
+        key, (config.max_position_embeddings, config.hidden_size),
+        config.params_dtype)}
+
+
+def position_table_spec(config: "TransformerConfig") -> dict:
+    if config.position_embedding_type != "learned":
+        return {}
+    return {"position_embeddings": PartitionSpec()}
+
+
+def rope_freqs(start, length: int, rot_dim: int, theta: float) -> jax.Array:
+    """RoPE angles for positions ``[start, start+length)`` in the layout
+    :func:`apex_tpu.ops.fused_rope` expects: ``[s, 1, 1, rot_dim]`` with the
+    Megatron ``concat(f, f)`` convention (reference
+    ``apex/transformer/functional/fused_rope.py`` pairs with
+    ``RotaryEmbedding`` in NeMo producing exactly this). ``start`` may be a
+    traced value (decode offset, context-parallel shard offset)."""
+    inv = 1.0 / theta ** (jnp.arange(0, rot_dim, 2, dtype=jnp.float32)
+                          / rot_dim)
+    pos = start + jnp.arange(length, dtype=jnp.float32)
+    f = pos[:, None] * inv[None, :]                   # [s, rot_dim/2]
+    return jnp.concatenate([f, f], axis=-1)[:, None, None, :]
 
 
 def _dropout(x, rate, key, deterministic, model_parallel_region, axis_name):
@@ -146,23 +206,25 @@ def embed_tokens(embedding, emb_params, tokens, config, *, tokentype_params=None
 
     emb = embedding.apply(emb_params["word_embeddings"], tokens)
     s_local = tokens.shape[1]
-    if c.context_parallel_method and axis_bound(c.context_axis):
-        # tokens are this context rank's contiguous sequence chunk: position
-        # ids start at rank * s_local. dynamic_slice clamps out-of-range
-        # starts, so overlong sequences must be rejected loudly here (the
-        # unsharded path fails with a shape error instead).
-        cp = lax.axis_size(c.context_axis)
-        if cp * s_local > c.max_position_embeddings:
-            raise ValueError(
-                f"global sequence length ({cp} context shards x {s_local}) "
-                f"exceeds max_position_embeddings "
-                f"({c.max_position_embeddings})")
-        offset = lax.axis_index(c.context_axis) * s_local
-        pos = lax.dynamic_slice_in_dim(
-            emb_params["position_embeddings"], offset, s_local, axis=0)
-    else:
-        pos = emb_params["position_embeddings"][:s_local]
-    emb = emb + pos[None, :, :]
+    if c.position_embedding_type == "learned":
+        if c.context_parallel_method and axis_bound(c.context_axis):
+            # tokens are this context rank's contiguous sequence chunk:
+            # position ids start at rank * s_local. dynamic_slice clamps
+            # out-of-range starts, so overlong sequences must be rejected
+            # loudly here (the unsharded path fails with a shape error
+            # instead).
+            cp = lax.axis_size(c.context_axis)
+            if cp * s_local > c.max_position_embeddings:
+                raise ValueError(
+                    f"global sequence length ({cp} context shards x "
+                    f"{s_local}) exceeds max_position_embeddings "
+                    f"({c.max_position_embeddings})")
+            offset = lax.axis_index(c.context_axis) * s_local
+            pos = lax.dynamic_slice_in_dim(
+                emb_params["position_embeddings"], offset, s_local, axis=0)
+        else:
+            pos = emb_params["position_embeddings"][:s_local]
+        emb = emb + pos[None, :, :]
     if tokentype_ids is not None:
         emb = emb + jnp.take(tokentype_params, tokentype_ids, axis=0)
     hidden = emb.transpose(1, 0, 2).astype(c.compute_dtype)
@@ -409,6 +471,19 @@ class ParallelAttention:
             k = qkv[:, :, :, qpg]
             v = qkv[:, :, :, qpg + 1]
             local_heads = local_groups * qpg
+            if c.position_embedding_type == "rope":
+                from apex_tpu.ops import fused_rope
+
+                start = 0 if cache_index is None else cache_index
+                if (cache_index is None and c.context_parallel_method):
+                    from apex_tpu.transformer.tensor_parallel.mappings import (
+                        axis_bound,
+                    )
+                    if axis_bound(c.context_axis):
+                        start = lax.axis_index(c.context_axis) * s
+                freqs = rope_freqs(start, s, c.rotary_dim, c.rope_theta)
+                q = fused_rope(q, freqs)
+                k = fused_rope(k, freqs)
         else:
             if encoder_output is None:
                 raise ValueError("cross-attention needs encoder_output")
