@@ -26,6 +26,7 @@ Design (not a port):
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -81,6 +82,10 @@ class TransformerConfig:
     position_embedding_type: str = "learned"
     rotary_percent: float = 1.0        # fraction of head_dim rotated
     rope_theta: float = 10000.0
+    # MLP activation: "gelu" (reference ParallelMLP), "relu", or the gated
+    # pairs "swiglu"/"geglu" (LLaMA/PaLM-class; adds a parallel gate
+    # projection, act(gate) * up)
+    activation: str = "gelu"
     attn_mask_type: AttnMaskType = AttnMaskType.causal
     sequence_parallel: bool = False
     # context parallelism (long-context; the reference has none, SURVEY.md §5):
@@ -111,6 +116,15 @@ class TransformerConfig:
             raise ValueError(
                 f"rotary_percent must be in (0, 1], got "
                 f"{self.rotary_percent}")
+        if self.activation not in ("gelu", "relu", "swiglu", "geglu"):
+            raise ValueError(
+                f"activation must be 'gelu', 'relu', 'swiglu', or 'geglu', "
+                f"got {self.activation!r}")
+        if self.num_moe_experts and self.activation != "gelu":
+            raise NotImplementedError(
+                f"activation={self.activation!r} with MoE: SwitchMLP experts "
+                "run gelu; thread activation through MoEConfig before "
+                "combining them")
 
     @property
     def ffn_size(self) -> int:
@@ -259,22 +273,33 @@ def _ln(params, x, eps, sequence_parallel=False, axis_name=TENSOR_AXIS):
 
 @dataclass
 class ParallelMLP:
-    """h -> 4h (column) -> gelu -> h (row).
+    """h -> ffn (column) -> act -> h (row).
 
     Reference: ``standalone_transformer_lm.py`` ``ParallelMLP`` (~:610-672):
     ColumnParallelLinear with ``gather_output=False``, fused bias-gelu,
-    RowParallelLinear with ``input_is_parallel=True``.
+    RowParallelLinear with ``input_is_parallel=True``. Gated activations
+    (``config.activation = "swiglu"/"geglu"``, LLaMA/PaLM-class — exceeds
+    the gelu-only reference) add a second column-parallel gate projection:
+    ``act(gate(x)) * up(x)`` — two TP-sharded matmuls whose product stays on
+    the sharded ffn dim, so the TP comm pattern is unchanged.
     """
 
     config: TransformerConfig
 
     def __post_init__(self):
         c = self.config
+        self.gated = c.activation in ("swiglu", "geglu")
         self.dense_h_to_4h = ColumnParallelLinear(
             c.hidden_size, c.ffn_size, gather_output=False,
             init_method=c.init_method(),
             sequence_parallel_enabled=c.sequence_parallel,
             params_dtype=c.params_dtype, axis_name=c.axis_name)
+        if self.gated:
+            self.gate_proj = ColumnParallelLinear(
+                c.hidden_size, c.ffn_size, gather_output=False,
+                init_method=c.init_method(), bias=False,
+                sequence_parallel_enabled=c.sequence_parallel,
+                params_dtype=c.params_dtype, axis_name=c.axis_name)
         self.dense_4h_to_h = RowParallelLinear(
             c.ffn_size, c.hidden_size, input_is_parallel=True,
             init_method=c.output_init_method(),
@@ -282,17 +307,35 @@ class ParallelMLP:
             params_dtype=c.params_dtype, axis_name=c.axis_name)
 
     def init(self, key):
+        # 2-way split as always, so default-gelu models keep the exact
+        # init stream of older checkpoints; the gate key is folded in only
+        # when the gated path exists
         k1, k2 = jax.random.split(key)
-        return {"dense_h_to_4h": self.dense_h_to_4h.init(k1),
-                "dense_4h_to_h": self.dense_4h_to_h.init(k2)}
+        p = {"dense_h_to_4h": self.dense_h_to_4h.init(k1),
+             "dense_4h_to_h": self.dense_4h_to_h.init(k2)}
+        if self.gated:
+            p["gate_proj"] = self.gate_proj.init(jax.random.fold_in(key, 2))
+        return p
 
     def spec(self):
-        return {"dense_h_to_4h": self.dense_h_to_4h.spec(),
-                "dense_4h_to_h": self.dense_4h_to_h.spec()}
+        s = {"dense_h_to_4h": self.dense_h_to_4h.spec(),
+             "dense_4h_to_h": self.dense_4h_to_h.spec()}
+        if self.gated:
+            s["gate_proj"] = self.gate_proj.spec()
+        return s
 
     def apply(self, params, hidden):
+        c = self.config
         x = self.dense_h_to_4h.apply(params["dense_h_to_4h"], hidden)
-        x = jax.nn.gelu(x, approximate=True)
+        if self.gated:
+            gate = self.gate_proj.apply(params["gate_proj"], hidden)
+            act = (jax.nn.silu if c.activation == "swiglu"
+                   else functools.partial(jax.nn.gelu, approximate=True))
+            x = act(gate) * x
+        elif c.activation == "relu":
+            x = jax.nn.relu(x)
+        else:
+            x = jax.nn.gelu(x, approximate=True)
         return self.dense_4h_to_h.apply(params["dense_4h_to_h"], x)
 
 
@@ -473,14 +516,20 @@ class ParallelAttention:
             local_heads = local_groups * qpg
             if c.position_embedding_type == "rope":
                 from apex_tpu.ops import fused_rope
+                from apex_tpu.transformer.tensor_parallel.mappings import (
+                    axis_bound,
+                )
 
                 start = 0 if cache_index is None else cache_index
-                if (cache_index is None and c.context_parallel_method):
-                    from apex_tpu.transformer.tensor_parallel.mappings import (
-                        axis_bound,
-                    )
-                    if axis_bound(c.context_axis):
-                        start = lax.axis_index(c.context_axis) * s
+                if c.context_parallel_method and axis_bound(c.context_axis):
+                    if cache_index is not None:
+                        raise NotImplementedError(
+                            "incremental decode (kv_cache) with a bound "
+                            "context-parallel axis and rope positions: the "
+                            "per-rank rope offset for a sharded cache is "
+                            "not wired up — decode without the context "
+                            "axis")
+                    start = lax.axis_index(c.context_axis) * s
                 freqs = rope_freqs(start, s, c.rotary_dim, c.rope_theta)
                 q = fused_rope(q, freqs)
                 k = fused_rope(k, freqs)

@@ -191,3 +191,92 @@ def test_pipelined_param_tree_matches_gpt():
     params = pp.init(jax.random.PRNGKey(0))
     assert "position_embeddings" not in params["embedding"]
     assert "position_embeddings" not in pp.spec()["embedding"]
+
+
+class TestActivations:
+    """MLP activation config incl. gated variants (swiglu/geglu — exceeds
+    the gelu-only reference ParallelMLP)."""
+
+    @pytest.mark.parametrize("act", ["gelu", "relu", "swiglu", "geglu"])
+    def test_trains(self, act):
+        from apex_tpu.optimizers import FusedAdam
+
+        model = GPTModel(_cfg(activation=act,
+                              position_embedding_type="learned"))
+        params = model.init(jax.random.PRNGKey(0))
+        if act in ("swiglu", "geglu"):
+            mlp = params["transformer"]["layers"]["mlp"]
+            assert "gate_proj" in mlp
+            assert "bias" not in mlp["gate_proj"]
+        opt = FusedAdam(lr=2e-3)
+        st = opt.init(params)
+        toks = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 64)
+        labs = jax.random.randint(jax.random.PRNGKey(2), (4, 16), 0, 64)
+
+        @jax.jit
+        def step(p, s):
+            l, g = jax.value_and_grad(
+                lambda p: model.apply(p, toks, labs))(p)
+            return opt.step(g, p, s) + (l,)
+
+        losses = []
+        for _ in range(4):
+            params, st, l = step(params, st)
+            losses.append(float(l))
+        assert losses[-1] < losses[0]
+
+    def test_swiglu_tp2_matches_unsharded(self):
+        from jax.sharding import PartitionSpec as P
+
+        from apex_tpu.optimizers import FusedAdam
+        from apex_tpu.training import make_train_step
+        from apex_tpu.transformer import parallel_state
+
+        def train(tp):
+            parallel_state.destroy_model_parallel()
+            mesh = parallel_state.initialize_model_parallel(
+                tensor_model_parallel_size=tp)
+            model = GPTModel(_cfg(activation="swiglu"))
+            params = model.init(jax.random.PRNGKey(0))
+            opt = FusedAdam(lr=1e-3)
+            ost = opt.init(params)
+            step = make_train_step(
+                lambda p, b, r: model.apply(p, b["tokens"], b["labels"],
+                                            rng=r),
+                opt, mesh, model.spec(),
+                {"tokens": P("data"), "labels": P("data")},
+                params_template=params)
+            toks = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, 64)
+            labs = jax.random.randint(jax.random.PRNGKey(2), (8, 16), 0, 64)
+            out = []
+            for _ in range(3):
+                params, ost, loss = step(params, ost,
+                                         {"tokens": toks, "labels": labs},
+                                         jax.random.PRNGKey(3))
+                out.append(float(loss))
+            parallel_state.destroy_model_parallel()
+            return out
+
+        np.testing.assert_allclose(train(1), train(2), atol=2e-5, rtol=2e-5)
+
+    def test_invalid_activation_rejected(self):
+        with pytest.raises(ValueError, match="activation"):
+            _cfg(activation="swish")
+
+
+def test_moe_with_gated_activation_rejected():
+    with pytest.raises(NotImplementedError, match="MoE"):
+        _cfg(activation="swiglu", num_moe_experts=4)
+
+
+def test_gelu_init_stream_unchanged_by_gate_key():
+    """Default-gelu params must be identical whether or not the gated code
+    path exists (seed-stable init for old checkpoints)."""
+    from apex_tpu.models.transformer import ParallelMLP
+
+    mlp = ParallelMLP(_cfg(position_embedding_type="learned"))
+    p = mlp.init(jax.random.PRNGKey(7))
+    k1, k2 = jax.random.split(jax.random.PRNGKey(7))
+    ref = mlp.dense_h_to_4h.init(k1)
+    np.testing.assert_array_equal(np.asarray(p["dense_h_to_4h"]["weight"]),
+                                  np.asarray(ref["weight"]))
