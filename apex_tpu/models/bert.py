@@ -80,7 +80,8 @@ class BertModel:
                         ks[4], (c.hidden_size, c.hidden_size), c.params_dtype),
                     "bias": jnp.zeros((c.hidden_size,), c.params_dtype),
                 },
-                "layernorm": _ln_params(c.hidden_size, c.params_dtype),
+                "layernorm": _ln_params(c.hidden_size, c.params_dtype,
+                                        c.normalization),
             },
         }
         if self.add_binary_head:
@@ -106,7 +107,8 @@ class BertModel:
                 "tokentype_embeddings": PartitionSpec(),
             },
             "transformer": self.transformer.spec(),
-            "lm_head": {"dense": dict(dense_spec), "layernorm": _ln_spec()},
+            "lm_head": {"dense": dict(dense_spec),
+                        "layernorm": _ln_spec(self.config.normalization)},
         }
         if self.add_binary_head:
             spec["binary_head"] = {"pooler": dict(dense_spec),
@@ -173,7 +175,8 @@ class BertModel:
         h = h @ params["lm_head"]["dense"]["weight"].T.astype(jnp.float32) \
             + params["lm_head"]["dense"]["bias"]
         h = jax.nn.gelu(h, approximate=True)
-        h = _ln(params["lm_head"]["layernorm"], h, c.layernorm_epsilon)
+        h = _ln(params["lm_head"]["layernorm"], h, c.layernorm_epsilon,
+                norm=c.normalization)
         logits = linear_with_grad_accumulation_and_async_allreduce(
             h,
             params["embedding"]["word_embeddings"]["weight"].astype(

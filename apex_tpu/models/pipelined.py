@@ -92,7 +92,8 @@ class PipelinedGPT:
                 **position_table_params(c, k_pos),
             },
             "stages": stages,
-            "final_layernorm": _ln_params(c.hidden_size, c.params_dtype),
+            "final_layernorm": _ln_params(c.hidden_size, c.params_dtype,
+                                          c.normalization),
         }
 
     def spec(self) -> Dict[str, Any]:
@@ -103,7 +104,7 @@ class PipelinedGPT:
             },
             "stages": pipeline_stage_spec(self.layer.spec(),
                                           self.virtual_pipeline_size),
-            "final_layernorm": _ln_spec(),
+            "final_layernorm": _ln_spec(self.config.normalization),
         }
 
     # -- stage functions ----------------------------------------------------
@@ -137,7 +138,7 @@ class PipelinedGPT:
         emb = mark_pipeline_replicated(params["embedding"])
         fln = mark_pipeline_replicated(params["final_layernorm"])
         hidden = _ln(fln, hidden, c.layernorm_epsilon,
-                     c.sequence_parallel, c.axis_name)
+                     c.sequence_parallel, c.axis_name, c.normalization)
         return lm_head_loss(emb["word_embeddings"]["weight"], hidden,
                             mb["labels"], mb.get("loss_mask"), c)
 

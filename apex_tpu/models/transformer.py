@@ -35,7 +35,11 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec
 
-from apex_tpu.ops import flash_attention, fused_layer_norm_affine
+from apex_tpu.ops import (
+    flash_attention,
+    fused_layer_norm_affine,
+    fused_rms_norm_affine,
+)
 from apex_tpu.transformer.enums import AttnMaskType, AttnType, LayerType
 from apex_tpu.transformer.functional import FusedScaleMaskSoftmax
 from apex_tpu.transformer.parallel_state import CONTEXT_AXIS, TENSOR_AXIS
@@ -86,6 +90,9 @@ class TransformerConfig:
     # pairs "swiglu"/"geglu" (LLaMA/PaLM-class; adds a parallel gate
     # projection, act(gate) * up)
     activation: str = "gelu"
+    # "layernorm" (reference) or "rmsnorm" (LLaMA-class; bias-free, RMS
+    # statistics via the fused Pallas RMSNorm kernel)
+    normalization: str = "layernorm"
     attn_mask_type: AttnMaskType = AttnMaskType.causal
     sequence_parallel: bool = False
     # context parallelism (long-context; the reference has none, SURVEY.md §5):
@@ -120,6 +127,10 @@ class TransformerConfig:
             raise ValueError(
                 f"activation must be 'gelu', 'relu', 'swiglu', or 'geglu', "
                 f"got {self.activation!r}")
+        if self.normalization not in ("layernorm", "rmsnorm"):
+            raise ValueError(
+                f"normalization must be 'layernorm' or 'rmsnorm', got "
+                f"{self.normalization!r}")
         if self.num_moe_experts and self.activation != "gelu":
             raise NotImplementedError(
                 f"activation={self.activation!r} with MoE: SwitchMLP experts "
@@ -252,21 +263,31 @@ def embed_tokens(embedding, emb_params, tokens, config, *, tokentype_params=None
                     axis_name=c.axis_name)
 
 
-def _ln_params(hidden_size, dtype):
-    return {"weight": jnp.ones((hidden_size,), dtype),
-            "bias": jnp.zeros((hidden_size,), dtype)}
+def _ln_params(hidden_size, dtype, norm: str = "layernorm"):
+    p = {"weight": jnp.ones((hidden_size,), dtype)}
+    if norm == "layernorm":
+        p["bias"] = jnp.zeros((hidden_size,), dtype)
+    return p
 
 
-def _ln_spec():
-    return {"weight": PartitionSpec(), "bias": PartitionSpec()}
+def _ln_spec(norm: str = "layernorm"):
+    s = {"weight": PartitionSpec()}
+    if norm == "layernorm":
+        s["bias"] = PartitionSpec()
+    return s
 
 
-def _ln(params, x, eps, sequence_parallel=False, axis_name=TENSOR_AXIS):
-    w, b = params["weight"], params["bias"]
+def _ln(params, x, eps, sequence_parallel=False, axis_name=TENSOR_AXIS,
+        norm: str = "layernorm"):
+    w = params["weight"]
     if sequence_parallel:
         # norm runs on sequence shards; psum the param grads (reference
         # layer_norm.py:26-99 ``sequence_parallel_enabled`` marking)
         w = mark_sequence_parallel_parameter(w, axis_name)
+    if norm == "rmsnorm":
+        return fused_rms_norm_affine(x, w, (x.shape[-1],), eps)
+    b = params["bias"]
+    if sequence_parallel:
         b = mark_sequence_parallel_parameter(b, axis_name)
     return fused_layer_norm_affine(x, w, b, (x.shape[-1],), eps)
 
@@ -279,9 +300,10 @@ class ParallelMLP:
     ColumnParallelLinear with ``gather_output=False``, fused bias-gelu,
     RowParallelLinear with ``input_is_parallel=True``. Gated activations
     (``config.activation = "swiglu"/"geglu"``, LLaMA/PaLM-class — exceeds
-    the gelu-only reference) add a second column-parallel gate projection:
-    ``act(gate(x)) * up(x)`` — two TP-sharded matmuls whose product stays on
-    the sharded ffn dim, so the TP comm pattern is unchanged.
+    the gelu-only reference) widen the column projection to ``2*ffn`` with
+    gate/up **unit-interleaved** along the output dim (column ``2i`` =
+    gate_i, ``2i+1`` = up_i), so one matmul + one input-grad collective
+    serves both halves and every TP slice holds matched pairs.
     """
 
     config: TransformerConfig
@@ -290,16 +312,11 @@ class ParallelMLP:
         c = self.config
         self.gated = c.activation in ("swiglu", "geglu")
         self.dense_h_to_4h = ColumnParallelLinear(
-            c.hidden_size, c.ffn_size, gather_output=False,
+            c.hidden_size, (2 if self.gated else 1) * c.ffn_size,
+            gather_output=False,
             init_method=c.init_method(),
             sequence_parallel_enabled=c.sequence_parallel,
             params_dtype=c.params_dtype, axis_name=c.axis_name)
-        if self.gated:
-            self.gate_proj = ColumnParallelLinear(
-                c.hidden_size, c.ffn_size, gather_output=False,
-                init_method=c.init_method(), bias=False,
-                sequence_parallel_enabled=c.sequence_parallel,
-                params_dtype=c.params_dtype, axis_name=c.axis_name)
         self.dense_4h_to_h = RowParallelLinear(
             c.ffn_size, c.hidden_size, input_is_parallel=True,
             init_method=c.output_init_method(),
@@ -307,31 +324,24 @@ class ParallelMLP:
             params_dtype=c.params_dtype, axis_name=c.axis_name)
 
     def init(self, key):
-        # 2-way split as always, so default-gelu models keep the exact
-        # init stream of older checkpoints; the gate key is folded in only
-        # when the gated path exists
         k1, k2 = jax.random.split(key)
-        p = {"dense_h_to_4h": self.dense_h_to_4h.init(k1),
-             "dense_4h_to_h": self.dense_4h_to_h.init(k2)}
-        if self.gated:
-            p["gate_proj"] = self.gate_proj.init(jax.random.fold_in(key, 2))
-        return p
+        return {"dense_h_to_4h": self.dense_h_to_4h.init(k1),
+                "dense_4h_to_h": self.dense_4h_to_h.init(k2)}
 
     def spec(self):
-        s = {"dense_h_to_4h": self.dense_h_to_4h.spec(),
-             "dense_4h_to_h": self.dense_4h_to_h.spec()}
-        if self.gated:
-            s["gate_proj"] = self.gate_proj.spec()
-        return s
+        return {"dense_h_to_4h": self.dense_h_to_4h.spec(),
+                "dense_4h_to_h": self.dense_4h_to_h.spec()}
 
     def apply(self, params, hidden):
         c = self.config
         x = self.dense_h_to_4h.apply(params["dense_h_to_4h"], hidden)
         if self.gated:
-            gate = self.gate_proj.apply(params["gate_proj"], hidden)
+            # de-interleave the local slice: [..., 2j]=gate_j, [..., 2j+1]=up_j
+            x = x.reshape(*x.shape[:-1], x.shape[-1] // 2, 2)
+            gate, up = x[..., 0], x[..., 1]
             act = (jax.nn.silu if c.activation == "swiglu"
                    else functools.partial(jax.nn.gelu, approximate=True))
-            x = act(gate) * x
+            x = act(gate) * up
         elif c.activation == "relu":
             x = jax.nn.relu(x)
         else:
@@ -618,27 +628,30 @@ class ParallelTransformerLayer:
         c = self.config
         k1, k2, k3 = jax.random.split(key, 3)
         p = {
-            "input_layernorm": _ln_params(c.hidden_size, c.params_dtype),
+            "input_layernorm": _ln_params(c.hidden_size, c.params_dtype,
+                                          c.normalization),
             "self_attention": self.attention.init(k1),
-            "post_attention_layernorm": _ln_params(c.hidden_size, c.params_dtype),
+            "post_attention_layernorm": _ln_params(
+                c.hidden_size, c.params_dtype, c.normalization),
             "mlp": self.mlp.init(k2),
         }
         if self.layer_type == LayerType.decoder:
             p["inter_attention"] = self.inter_attention.init(k3)
             p["post_inter_attention_layernorm"] = _ln_params(
-                c.hidden_size, c.params_dtype)
+                c.hidden_size, c.params_dtype, c.normalization)
         return p
 
     def spec(self):
+        norm = self.config.normalization
         s = {
-            "input_layernorm": _ln_spec(),
+            "input_layernorm": _ln_spec(norm),
             "self_attention": self.attention.spec(),
-            "post_attention_layernorm": _ln_spec(),
+            "post_attention_layernorm": _ln_spec(norm),
             "mlp": self.mlp.spec(),
         }
         if self.layer_type == LayerType.decoder:
             s["inter_attention"] = self.inter_attention.spec()
-            s["post_inter_attention_layernorm"] = _ln_spec()
+            s["post_inter_attention_layernorm"] = _ln_spec(norm)
         return s
 
     def apply(self, params, hidden, *, encoder_output=None,
@@ -661,7 +674,7 @@ class ParallelTransformerLayer:
         rngs = ((None,) * n_keys if rng is None
                 else tuple(jax.random.split(rng, n_keys)))
         x = _ln(params["input_layernorm"], hidden, c.layernorm_epsilon,
-                c.sequence_parallel, c.axis_name)
+                c.sequence_parallel, c.axis_name, c.normalization)
         attn_out = self.attention.apply(
             params["self_attention"], x.astype(c.compute_dtype),
             attention_mask=attention_mask, kv_lengths=kv_lengths,
@@ -676,7 +689,8 @@ class ParallelTransformerLayer:
         hidden = hidden + attn_out
         if decoder:
             x = _ln(params["post_attention_layernorm"], hidden,
-                    c.layernorm_epsilon, c.sequence_parallel, c.axis_name)
+                    c.layernorm_epsilon, c.sequence_parallel, c.axis_name,
+                    c.normalization)
             r_attn = None if rngs[3] is None else jax.random.fold_in(rngs[3], 0)
             r_drop = None if rngs[3] is None else jax.random.fold_in(rngs[3], 1)
             inter_out = self.inter_attention.apply(
@@ -694,7 +708,8 @@ class ParallelTransformerLayer:
         else:
             norm_name = "post_attention_layernorm"
         x = _ln(params[norm_name], hidden,
-                c.layernorm_epsilon, c.sequence_parallel, c.axis_name)
+                c.layernorm_epsilon, c.sequence_parallel, c.axis_name,
+                c.normalization)
         if c.num_moe_experts:
             moe_rng = (None if rngs[1] is None
                        else jax.random.fold_in(rngs[1], 1))
@@ -735,15 +750,17 @@ class ParallelTransformer:
         keys = jax.random.split(key, self.config.num_layers)
         stacked = jax.vmap(self.layer.init)(keys)
         return {"layers": stacked,
-                "final_layernorm": _ln_params(self.config.hidden_size,
-                                              self.config.params_dtype)}
+                "final_layernorm": _ln_params(
+                    self.config.hidden_size, self.config.params_dtype,
+                    self.config.normalization)}
 
     def spec(self):
         layer_spec = self.layer.spec()
         stacked = jax.tree.map(
             lambda s: PartitionSpec(None, *s), layer_spec,
             is_leaf=lambda x: isinstance(x, PartitionSpec))
-        return {"layers": stacked, "final_layernorm": _ln_spec()}
+        return {"layers": stacked,
+                "final_layernorm": _ln_spec(self.config.normalization)}
 
     def apply(self, params, hidden, *, encoder_output=None,
               enc_dec_attn_mask=None, enc_kv_lengths=None,
@@ -789,7 +806,7 @@ class ParallelTransformer:
         if final_norm:
             hidden = _ln(params["final_layernorm"], hidden,
                          c.layernorm_epsilon, c.sequence_parallel,
-                         c.axis_name)
+                         c.axis_name, c.normalization)
         if kv_caches is not None:
             return hidden, new_caches
         return (hidden, aux_sum) if moe else hidden

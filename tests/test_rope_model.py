@@ -133,38 +133,63 @@ def test_training_decreases_loss():
     assert losses[-1] < losses[0]
 
 
-def test_tp2_matches_unsharded():
+def _tp_parity_train(tp, cfg_kwargs, sp=False, steps=3):
+    """Train the same seeded GPT under (tp, sp); return the loss trace."""
     from jax.sharding import PartitionSpec as P
 
     from apex_tpu.optimizers import FusedAdam
     from apex_tpu.training import make_train_step
     from apex_tpu.transformer import parallel_state
 
-    def train(tp):
-        parallel_state.destroy_model_parallel()
-        mesh = parallel_state.initialize_model_parallel(
-            tensor_model_parallel_size=tp)
-        model = GPTModel(_cfg())
-        params = model.init(jax.random.PRNGKey(0))
-        opt = FusedAdam(lr=1e-3)
-        ost = opt.init(params)
-        step = make_train_step(
-            lambda p, b, r: model.apply(p, b["tokens"], b["labels"], rng=r),
-            opt, mesh, model.spec(),
-            {"tokens": P("data"), "labels": P("data")},
-            params_template=params)
-        toks = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, 64)
-        labs = jax.random.randint(jax.random.PRNGKey(2), (8, 16), 0, 64)
-        losses = []
-        for _ in range(3):
-            params, ost, loss = step(params, ost,
-                                     {"tokens": toks, "labels": labs},
-                                     jax.random.PRNGKey(3))
-            losses.append(float(loss))
-        parallel_state.destroy_model_parallel()
-        return losses
+    parallel_state.destroy_model_parallel()
+    mesh = parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size=tp)
+    model = GPTModel(_cfg(sequence_parallel=sp, **cfg_kwargs))
+    params = model.init(jax.random.PRNGKey(0))
+    opt = FusedAdam(lr=1e-3)
+    ost = opt.init(params)
+    step = make_train_step(
+        lambda p, b, r: model.apply(p, b["tokens"], b["labels"], rng=r),
+        opt, mesh, model.spec(),
+        {"tokens": P("data"), "labels": P("data")},
+        params_template=params)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, 64)
+    labs = jax.random.randint(jax.random.PRNGKey(2), (8, 16), 0, 64)
+    losses = []
+    for _ in range(steps):
+        params, ost, loss = step(params, ost,
+                                 {"tokens": toks, "labels": labs},
+                                 jax.random.PRNGKey(3))
+        losses.append(float(loss))
+    parallel_state.destroy_model_parallel()
+    return losses
 
-    np.testing.assert_allclose(train(1), train(2), atol=2e-5, rtol=2e-5)
+
+def _losses_after_training(model, steps=4, lr=2e-3):
+    from apex_tpu.optimizers import FusedAdam
+
+    params = model.init(jax.random.PRNGKey(0))
+    opt = FusedAdam(lr=lr)
+    st = opt.init(params)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 64)
+    labs = jax.random.randint(jax.random.PRNGKey(2), (4, 16), 0, 64)
+
+    @jax.jit
+    def step(p, s):
+        l, g = jax.value_and_grad(lambda p: model.apply(p, toks, labs))(p)
+        return opt.step(g, p, s) + (l,)
+
+    losses = []
+    for _ in range(steps):
+        params, st, l = step(params, st)
+        losses.append(float(l))
+    return losses, params
+
+
+def test_tp2_matches_unsharded():
+    np.testing.assert_allclose(_tp_parity_train(1, {}),
+                               _tp_parity_train(2, {}),
+                               atol=2e-5, rtol=2e-5)
 
 
 def test_invalid_position_type_rejected():
@@ -195,88 +220,67 @@ def test_pipelined_param_tree_matches_gpt():
 
 class TestActivations:
     """MLP activation config incl. gated variants (swiglu/geglu — exceeds
-    the gelu-only reference ParallelMLP)."""
+    the gelu-only reference ParallelMLP). Gated runs one fused 2*ffn
+    column projection with gate/up unit-interleaved."""
 
     @pytest.mark.parametrize("act", ["gelu", "relu", "swiglu", "geglu"])
     def test_trains(self, act):
-        from apex_tpu.optimizers import FusedAdam
-
         model = GPTModel(_cfg(activation=act,
                               position_embedding_type="learned"))
-        params = model.init(jax.random.PRNGKey(0))
+        losses, params = _losses_after_training(model)
         if act in ("swiglu", "geglu"):
-            mlp = params["transformer"]["layers"]["mlp"]
-            assert "gate_proj" in mlp
-            assert "bias" not in mlp["gate_proj"]
-        opt = FusedAdam(lr=2e-3)
-        st = opt.init(params)
-        toks = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 64)
-        labs = jax.random.randint(jax.random.PRNGKey(2), (4, 16), 0, 64)
-
-        @jax.jit
-        def step(p, s):
-            l, g = jax.value_and_grad(
-                lambda p: model.apply(p, toks, labs))(p)
-            return opt.step(g, p, s) + (l,)
-
-        losses = []
-        for _ in range(4):
-            params, st, l = step(params, st)
-            losses.append(float(l))
+            w = params["transformer"]["layers"]["mlp"]["dense_h_to_4h"][
+                "weight"]
+            assert w.shape[-2] == 2 * 4 * 64   # fused [2*ffn, h], per layer
         assert losses[-1] < losses[0]
 
     def test_swiglu_tp2_matches_unsharded(self):
-        from jax.sharding import PartitionSpec as P
-
-        from apex_tpu.optimizers import FusedAdam
-        from apex_tpu.training import make_train_step
-        from apex_tpu.transformer import parallel_state
-
-        def train(tp):
-            parallel_state.destroy_model_parallel()
-            mesh = parallel_state.initialize_model_parallel(
-                tensor_model_parallel_size=tp)
-            model = GPTModel(_cfg(activation="swiglu"))
-            params = model.init(jax.random.PRNGKey(0))
-            opt = FusedAdam(lr=1e-3)
-            ost = opt.init(params)
-            step = make_train_step(
-                lambda p, b, r: model.apply(p, b["tokens"], b["labels"],
-                                            rng=r),
-                opt, mesh, model.spec(),
-                {"tokens": P("data"), "labels": P("data")},
-                params_template=params)
-            toks = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, 64)
-            labs = jax.random.randint(jax.random.PRNGKey(2), (8, 16), 0, 64)
-            out = []
-            for _ in range(3):
-                params, ost, loss = step(params, ost,
-                                         {"tokens": toks, "labels": labs},
-                                         jax.random.PRNGKey(3))
-                out.append(float(loss))
-            parallel_state.destroy_model_parallel()
-            return out
-
-        np.testing.assert_allclose(train(1), train(2), atol=2e-5, rtol=2e-5)
+        np.testing.assert_allclose(
+            _tp_parity_train(1, {"activation": "swiglu"}),
+            _tp_parity_train(2, {"activation": "swiglu"}),
+            atol=2e-5, rtol=2e-5)
 
     def test_invalid_activation_rejected(self):
         with pytest.raises(ValueError, match="activation"):
             _cfg(activation="swish")
 
 
-def test_moe_with_gated_activation_rejected():
-    with pytest.raises(NotImplementedError, match="MoE"):
-        _cfg(activation="swiglu", num_moe_experts=4)
+class TestNormalization:
+    """normalization="rmsnorm" (LLaMA-class, bias-free RMS statistics via
+    the fused RMSNorm kernel) vs the reference's LayerNorm default."""
 
+    def test_rmsnorm_params_have_no_bias(self):
+        model = GPTModel(_cfg(normalization="rmsnorm"))
+        params = model.init(jax.random.PRNGKey(0))
+        ln = params["transformer"]["layers"]["input_layernorm"]
+        assert "bias" not in ln and "weight" in ln
+        fln = params["transformer"]["final_layernorm"]
+        assert "bias" not in fln
 
-def test_gelu_init_stream_unchanged_by_gate_key():
-    """Default-gelu params must be identical whether or not the gated code
-    path exists (seed-stable init for old checkpoints)."""
-    from apex_tpu.models.transformer import ParallelMLP
+    def test_rmsnorm_trains_llama_trio(self):
+        model = GPTModel(_cfg(normalization="rmsnorm", activation="swiglu"))
+        losses, _ = _losses_after_training(model)
+        assert losses[-1] < losses[0]
 
-    mlp = ParallelMLP(_cfg(position_embedding_type="learned"))
-    p = mlp.init(jax.random.PRNGKey(7))
-    k1, k2 = jax.random.split(jax.random.PRNGKey(7))
-    ref = mlp.dense_h_to_4h.init(k1)
-    np.testing.assert_array_equal(np.asarray(p["dense_h_to_4h"]["weight"]),
-                                  np.asarray(ref["weight"]))
+    def test_rmsnorm_matches_manual(self):
+        from apex_tpu.models.transformer import _ln, _ln_params
+
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 8, 32))
+        p = _ln_params(32, jnp.float32, "rmsnorm")
+        y = _ln(p, x, 1e-5, norm="rmsnorm")
+        ref = x / np.sqrt(np.mean(np.asarray(x) ** 2, -1, keepdims=True)
+                          + 1e-5)
+        np.testing.assert_allclose(np.asarray(y), ref, rtol=2e-5, atol=2e-5)
+
+    def test_rmsnorm_tp2_sp_matches_unsharded(self):
+        ref = _tp_parity_train(1, {"normalization": "rmsnorm"})
+        np.testing.assert_allclose(
+            ref, _tp_parity_train(2, {"normalization": "rmsnorm"}),
+            atol=2e-5, rtol=2e-5)
+        np.testing.assert_allclose(
+            ref, _tp_parity_train(2, {"normalization": "rmsnorm"}, sp=True),
+            atol=2e-5, rtol=2e-5)
+
+    def test_invalid_normalization_rejected(self):
+        with pytest.raises(ValueError, match="normalization"):
+            _cfg(normalization="batchnorm")
