@@ -52,6 +52,11 @@ from apex_tpu.transformer.tensor_parallel.mappings import (
 )
 from apex_tpu.transformer.tensor_parallel.random import model_parallel_rng_key
 from apex_tpu.transformer.tensor_parallel.utils import divide
+from apex_tpu.utils.activations import (
+    apply_activation,
+    is_gated,
+    validate_activation,
+)
 
 __all__ = [
     "TransformerConfig",
@@ -87,8 +92,8 @@ class TransformerConfig:
     rotary_percent: float = 1.0        # fraction of head_dim rotated
     rope_theta: float = 10000.0
     # MLP activation: "gelu" (reference ParallelMLP), "relu", or the gated
-    # pairs "swiglu"/"geglu" (LLaMA/PaLM-class; adds a parallel gate
-    # projection, act(gate) * up)
+    # pairs "swiglu"/"geglu" (LLaMA/PaLM-class; one fused bias-free 2*ffn
+    # column projection, gate/up unit-interleaved — utils/activations.py)
     activation: str = "gelu"
     # "layernorm" (reference) or "rmsnorm" (LLaMA-class; bias-free, RMS
     # statistics via the fused Pallas RMSNorm kernel)
@@ -123,19 +128,11 @@ class TransformerConfig:
             raise ValueError(
                 f"rotary_percent must be in (0, 1], got "
                 f"{self.rotary_percent}")
-        if self.activation not in ("gelu", "relu", "swiglu", "geglu"):
-            raise ValueError(
-                f"activation must be 'gelu', 'relu', 'swiglu', or 'geglu', "
-                f"got {self.activation!r}")
+        validate_activation(self.activation)
         if self.normalization not in ("layernorm", "rmsnorm"):
             raise ValueError(
                 f"normalization must be 'layernorm' or 'rmsnorm', got "
                 f"{self.normalization!r}")
-        if self.num_moe_experts and self.activation != "gelu":
-            raise NotImplementedError(
-                f"activation={self.activation!r} with MoE: SwitchMLP experts "
-                "run gelu; thread activation through MoEConfig before "
-                "combining them")
 
     @property
     def ffn_size(self) -> int:
@@ -310,10 +307,13 @@ class ParallelMLP:
 
     def __post_init__(self):
         c = self.config
-        self.gated = c.activation in ("swiglu", "geglu")
+        self.gated = is_gated(c.activation)
+        # gated projections are bias-free (LLaMA convention; the pre-fusion
+        # gate_proj had bias=False — the fused layout keeps that invariant
+        # for both halves)
         self.dense_h_to_4h = ColumnParallelLinear(
             c.hidden_size, (2 if self.gated else 1) * c.ffn_size,
-            gather_output=False,
+            gather_output=False, bias=not self.gated,
             init_method=c.init_method(),
             sequence_parallel_enabled=c.sequence_parallel,
             params_dtype=c.params_dtype, axis_name=c.axis_name)
@@ -335,17 +335,7 @@ class ParallelMLP:
     def apply(self, params, hidden):
         c = self.config
         x = self.dense_h_to_4h.apply(params["dense_h_to_4h"], hidden)
-        if self.gated:
-            # de-interleave the local slice: [..., 2j]=gate_j, [..., 2j+1]=up_j
-            x = x.reshape(*x.shape[:-1], x.shape[-1] // 2, 2)
-            gate, up = x[..., 0], x[..., 1]
-            act = (jax.nn.silu if c.activation == "swiglu"
-                   else functools.partial(jax.nn.gelu, approximate=True))
-            x = act(gate) * up
-        elif c.activation == "relu":
-            x = jax.nn.relu(x)
-        else:
-            x = jax.nn.gelu(x, approximate=True)
+        x = apply_activation(x, c.activation)
         return self.dense_4h_to_h.apply(params["dense_4h_to_h"], x)
 
 
@@ -618,6 +608,7 @@ class ParallelTransformerLayer:
                 aux_loss_weight=c.moe_aux_loss_weight,
                 router_jitter=c.moe_router_jitter,
                 expert_axis=c.moe_expert_axis,
+                activation=c.activation,
                 params_dtype=c.params_dtype,
                 compute_dtype=c.compute_dtype,
                 init_method_std=c.init_method_std))

@@ -35,6 +35,11 @@ from jax.sharding import PartitionSpec
 from apex_tpu.transformer.parallel_state import DATA_AXIS
 from apex_tpu.transformer.tensor_parallel.mappings import axis_bound
 from apex_tpu.transformer.tensor_parallel.utils import divide
+from apex_tpu.utils.activations import (
+    apply_activation,
+    is_gated,
+    validate_activation,
+)
 
 __all__ = ["MoEConfig", "SwitchMLP"]
 
@@ -49,9 +54,19 @@ class MoEConfig:
     aux_loss_weight: float = 1e-2
     router_jitter: float = 0.0          # multiplicative input jitter at train
     expert_axis: Optional[str] = DATA_AXIS
+    # expert FFN activation; gated pairs ("swiglu"/"geglu") widen w_in to
+    # 2*ffn with gate/up unit-interleaved (same layout as ParallelMLP)
+    activation: str = "gelu"
     params_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.float32
     init_method_std: float = 0.02
+
+    def __post_init__(self):
+        validate_activation(self.activation)
+
+    @property
+    def gated(self) -> bool:
+        return is_gated(self.activation)
 
 
 class SwitchMLP:
@@ -73,29 +88,36 @@ class SwitchMLP:
         kr, k1, k2 = jax.random.split(key, 3)
         std = c.init_method_std
         dt = c.params_dtype
-        return {
+        fin = (2 if c.gated else 1) * c.ffn_hidden_size
+        p = {
             "router": jax.random.normal(
                 kr, (c.hidden_size, c.num_experts), dt) * std,
             "w_in": jax.random.normal(
-                k1, (c.num_experts, c.hidden_size, c.ffn_hidden_size),
+                k1, (c.num_experts, c.hidden_size, fin),
                 dt) * std,
-            "b_in": jnp.zeros((c.num_experts, c.ffn_hidden_size), dt),
             "w_out": jax.random.normal(
                 k2, (c.num_experts, c.ffn_hidden_size, c.hidden_size),
                 dt) * std,
             "b_out": jnp.zeros((c.num_experts, c.hidden_size), dt),
         }
+        if not c.gated:
+            # gated projections are bias-free (shared convention with
+            # ParallelMLP, utils/activations.py)
+            p["b_in"] = jnp.zeros((c.num_experts, fin), dt)
+        return p
 
     def spec(self) -> Dict[str, PartitionSpec]:
         """Experts sharded dim-0 over the expert axis; router replicated."""
         e = self.config.expert_axis
-        return {
+        s = {
             "router": PartitionSpec(),
             "w_in": PartitionSpec(e, None, None),
-            "b_in": PartitionSpec(e, None),
             "w_out": PartitionSpec(e, None, None),
             "b_out": PartitionSpec(e, None),
         }
+        if not self.config.gated:
+            s["b_in"] = PartitionSpec(e, None)
+        return s
 
     # -- routing -------------------------------------------------------------
 
@@ -178,11 +200,13 @@ class SwitchMLP:
         cd = c.compute_dtype
         # params inside shard_map are already the local expert shard
         # ([E/ep, ...]) under spec(); unsharded they are the full bank
-        w_in, b_in = params["w_in"], params["b_in"]
+        w_in = params["w_in"]
         w_out, b_out = params["w_out"], params["b_out"]
         hmid = jnp.einsum("ech,ehf->ecf", buffers.astype(cd),
-                          w_in.astype(cd)) + b_in[:, None, :].astype(cd)
-        hmid = jax.nn.gelu(hmid)
+                          w_in.astype(cd))
+        if not c.gated:
+            hmid = hmid + params["b_in"][:, None, :].astype(cd)
+        hmid = apply_activation(hmid, c.activation)
         out = jnp.einsum("ecf,efh->ech", hmid,
                          w_out.astype(cd)) + b_out[:, None, :].astype(cd)
 

@@ -284,3 +284,45 @@ class TestNormalization:
     def test_invalid_normalization_rejected(self):
         with pytest.raises(ValueError, match="normalization"):
             _cfg(normalization="batchnorm")
+
+
+def test_gelu_init_stream_is_plain_two_way_split():
+    """Default-gelu params come from the historical 2-way key split
+    (seed-stable init for old checkpoints)."""
+    from apex_tpu.models.transformer import ParallelMLP
+
+    mlp = ParallelMLP(_cfg(position_embedding_type="learned"))
+    p = mlp.init(jax.random.PRNGKey(7))
+    k1, _ = jax.random.split(jax.random.PRNGKey(7))
+    ref = mlp.dense_h_to_4h.init(k1)
+    np.testing.assert_array_equal(np.asarray(p["dense_h_to_4h"]["weight"]),
+                                  np.asarray(ref["weight"]))
+
+
+def test_moe_with_gated_activation():
+    """activation threads through MoEConfig: swiglu experts get the
+    unit-interleaved 2*ffn w_in and the model trains."""
+    model = GPTModel(_cfg(activation="swiglu", num_moe_experts=4,
+                          position_embedding_type="learned",
+                          moe_expert_axis=None))
+    losses, params = _losses_after_training(model)
+    w_in = params["transformer"]["layers"]["mlp"]["w_in"]
+    assert w_in.shape[-1] == 2 * 4 * 64      # [L, E, h, 2*ffn]
+    assert losses[-1] < losses[0]
+
+
+def test_gated_projection_is_bias_free():
+    """LLaMA convention: the fused gate/up projection carries no bias
+    (dense and MoE paths share it via utils/activations.py)."""
+    from apex_tpu.transformer.moe import MoEConfig, SwitchMLP
+
+    model = GPTModel(_cfg(activation="swiglu",
+                          position_embedding_type="learned"))
+    params = model.init(jax.random.PRNGKey(0))
+    assert "bias" not in params["transformer"]["layers"]["mlp"][
+        "dense_h_to_4h"]
+    moe = SwitchMLP(MoEConfig(hidden_size=32, ffn_hidden_size=64,
+                              num_experts=2, activation="swiglu",
+                              expert_axis=None))
+    mp = moe.init(jax.random.PRNGKey(0))
+    assert "b_in" not in mp and "b_in" not in moe.spec()
