@@ -60,10 +60,28 @@ class FusedOptimizer:
     """
 
     def __init__(self, lr: float, weight_decay: float = 0.0,
-                 master_weights: bool = False):
+                 master_weights: bool = False, weight_decay_mask=None):
         self.lr = lr
         self.weight_decay = weight_decay
         self.master_weights = master_weights
+        # param-groups parity (torch optimizers put norm/bias params in a
+        # wd=0 group): a pytree of bools matching params, or a callable
+        # params -> bool pytree; True = decay this leaf
+        self.weight_decay_mask = weight_decay_mask
+
+    def _wd_leaves(self, params_tree):
+        """Per-leaf weight decay: ``self.weight_decay`` where the mask keeps
+        it, 0.0 elsewhere. Leaves are python floats so subclasses keep their
+        trace-time ``wd != 0`` branches per leaf."""
+        if self.weight_decay_mask is None:
+            return tree_map(lambda _: self.weight_decay, params_tree)
+        mask = (self.weight_decay_mask(params_tree)
+                if callable(self.weight_decay_mask)
+                else self.weight_decay_mask)
+        # joint map: a mask whose structure mismatches params fails loudly
+        return tree_map(
+            lambda keep, _: self.weight_decay if keep else 0.0,
+            mask, params_tree)
 
     # -- subclass API -----------------------------------------------------
     def _init_slots(self, params32) -> Any:

@@ -122,6 +122,11 @@ class DistributedFusedAdam(FusedAdam):
     def __init__(self, lr: float = 1e-3, *, num_shards: Optional[int] = None,
                  axis_name: str = DATA_AXIS, **adam_kw):
         adam_kw.pop("master_weights", None)
+        if adam_kw.get("weight_decay_mask") is not None:
+            raise NotImplementedError(
+                "weight_decay_mask is per-leaf; the ZeRO-sharded optimizers "
+                "update one flat buffer — use per-leaf FusedAdam or set "
+                "weight_decay=0")
         super().__init__(lr=lr, master_weights=True, **adam_kw)
         if num_shards is None:
             from apex_tpu.transformer import parallel_state

@@ -16,8 +16,9 @@ from apex_tpu.optimizers.base import FusedOptimizer, tree_map, tree_map_multi
 class FusedAdagrad(FusedOptimizer):
     def __init__(self, lr: float = 1e-2, eps: float = 1e-10,
                  weight_decay: float = 0.0, adagrad_w_mode: bool = False,
-                 master_weights: bool = False):
-        super().__init__(lr, weight_decay, master_weights)
+                 master_weights: bool = False, weight_decay_mask=None):
+        super().__init__(lr, weight_decay, master_weights,
+                         weight_decay_mask)
         self.eps = eps
         self.adagrad_w_mode = adagrad_w_mode
 
@@ -25,9 +26,9 @@ class FusedAdagrad(FusedOptimizer):
         return {"sum": tree_map(jnp.zeros_like, params32)}
 
     def _update(self, g32, p32, slots, step, lr):
-        wd = self.weight_decay
+        wds = self._wd_leaves(p32)
 
-        def upd(g, p, h):
+        def upd(g, p, h, wd):
             if not self.adagrad_w_mode and wd != 0.0:
                 g = g + wd * p
             h = h + g * g
@@ -36,5 +37,6 @@ class FusedAdagrad(FusedOptimizer):
                 update = update + wd * p
             return p - lr * update, h
 
-        new_p, new_h = tree_map_multi(upd, 2, g32, p32, slots["sum"])
+        new_p, new_h = tree_map_multi(upd, 2, g32, p32, slots["sum"],
+                                      wds)
         return new_p, {"sum": new_h}

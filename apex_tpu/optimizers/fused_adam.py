@@ -23,11 +23,12 @@ class FusedAdam(FusedOptimizer):
                  betas: Tuple[float, float] = (0.9, 0.999), eps: float = 1e-8,
                  adam_w_mode: bool = True, weight_decay: float = 0.0,
                  amsgrad: bool = False, master_weights: bool = False,
-                 capturable: bool = False):
+                 capturable: bool = False, weight_decay_mask=None):
         if amsgrad:
             raise RuntimeError("FusedAdam does not support the AMSGrad variant "
                                "(parity with apex/optimizers/fused_adam.py:112-113)")
-        super().__init__(lr, weight_decay, master_weights)
+        super().__init__(lr, weight_decay, master_weights,
+                         weight_decay_mask)
         self.bias_correction = bias_correction
         self.betas = betas
         self.eps = eps
@@ -45,9 +46,9 @@ class FusedAdam(FusedOptimizer):
         t = step.astype(jnp.float32)
         bc1 = 1.0 - b1 ** t if self.bias_correction else 1.0
         bc2 = 1.0 - b2 ** t if self.bias_correction else 1.0
-        wd = self.weight_decay
+        wds = self._wd_leaves(p32)
 
-        def upd(g, p, m, v):
+        def upd(g, p, m, v, wd):
             if not self.adam_w_mode and wd != 0.0:
                 g = g + wd * p
             m = b1 * m + (1.0 - b1) * g
@@ -58,7 +59,7 @@ class FusedAdam(FusedOptimizer):
             return p - lr * update, m, v
 
         new_p, new_m, new_v = tree_map_multi(
-            upd, 3, g32, p32, slots["exp_avg"], slots["exp_avg_sq"])
+            upd, 3, g32, p32, slots["exp_avg"], slots["exp_avg_sq"], wds)
         return new_p, {"exp_avg": new_m, "exp_avg_sq": new_v}
 
 

@@ -24,10 +24,12 @@ class FusedLAMB(FusedOptimizer):
                  weight_decay: float = 0.01, amsgrad: bool = False,
                  adam_w_mode: bool = True, grad_averaging: bool = True,
                  max_grad_norm: float = 1.0, trust_clip: bool = False,
-                 always_adapt: bool = False, master_weights: bool = False):
+                 always_adapt: bool = False, master_weights: bool = False,
+                 weight_decay_mask=None):
         if amsgrad:
             raise RuntimeError("FusedLAMB does not support the AMSGrad variant")
-        super().__init__(lr, weight_decay, master_weights)
+        super().__init__(lr, weight_decay, master_weights,
+                         weight_decay_mask)
         self.bias_correction = bias_correction
         self.betas = betas
         self.eps = eps
@@ -49,7 +51,7 @@ class FusedLAMB(FusedOptimizer):
         bc1 = 1.0 - b1 ** t if self.bias_correction else 1.0
         bc2 = 1.0 - b2 ** t if self.bias_correction else 1.0
         beta3 = 1.0 - b1 if self.grad_averaging else 1.0
-        wd = self.weight_decay
+        wds = self._wd_leaves(p32)
 
         # phase 1: global grad norm → clip factor (fused_lamb.py:167-185)
         gnorm = global_norm(g32)
@@ -57,7 +59,7 @@ class FusedLAMB(FusedOptimizer):
             (self.max_grad_norm > 0.0) & (gnorm > self.max_grad_norm),
             gnorm / self.max_grad_norm, 1.0)
 
-        def upd(g, p, m, v):
+        def upd(g, p, m, v, wd):
             g = g / clip
             if not self.adam_w_mode and wd != 0.0:
                 g = g + wd * p
@@ -78,5 +80,5 @@ class FusedLAMB(FusedOptimizer):
             return p - lr * ratio * update, m, v
 
         new_p, new_m, new_v = tree_map_multi(
-            upd, 3, g32, p32, slots["exp_avg"], slots["exp_avg_sq"])
+            upd, 3, g32, p32, slots["exp_avg"], slots["exp_avg_sq"], wds)
         return new_p, {"exp_avg": new_m, "exp_avg_sq": new_v}

@@ -22,12 +22,14 @@ class FusedNovoGrad(FusedOptimizer):
                  weight_decay: float = 0.0, grad_averaging: bool = False,
                  amsgrad: bool = False, reg_inside_moment: bool = False,
                  norm_type: int = 2, init_zero: bool = False,
-                 master_weights: bool = False):
+                 master_weights: bool = False,
+                 weight_decay_mask=None):
         if amsgrad:
             raise RuntimeError("FusedNovoGrad does not support the AMSGrad variant")
         if norm_type not in (0, 2):
             raise RuntimeError(f"FusedNovoGrad only supports l2/inf norm now, got {norm_type}")
-        super().__init__(lr, weight_decay, master_weights)
+        super().__init__(lr, weight_decay, master_weights,
+                         weight_decay_mask)
         self.bias_correction = bias_correction
         self.betas = betas
         self.eps = eps
@@ -54,10 +56,10 @@ class FusedNovoGrad(FusedOptimizer):
         bc1 = 1.0 - b1 ** t if self.bias_correction else 1.0
         bc2 = 1.0 - b2 ** t if self.bias_correction else 1.0
         beta3 = 1.0 - b1 if self.grad_averaging else 1.0
-        wd = self.weight_decay
+        wds = self._wd_leaves(p32)
         first = step == 1
 
-        def upd(g, p, m, v):
+        def upd(g, p, m, v, wd):
             if wd != 0.0 and self.reg_inside_moment:
                 g = g + wd * p
             gnorm = self._norm(g)
@@ -74,5 +76,5 @@ class FusedNovoGrad(FusedOptimizer):
             return p - lr * (m_new / bc1), m_new, v_new
 
         new_p, new_m, new_v = tree_map_multi(
-            upd, 3, g32, p32, slots["exp_avg"], slots["exp_avg_sq"])
+            upd, 3, g32, p32, slots["exp_avg"], slots["exp_avg_sq"], wds)
         return new_p, {"exp_avg": new_m, "exp_avg_sq": new_v}

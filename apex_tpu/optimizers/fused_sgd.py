@@ -18,10 +18,12 @@ class FusedSGD(FusedOptimizer):
                  weight_decay: float = 0.0, nesterov: bool = False,
                  wd_after_momentum: bool = False,
                  materialize_master_grads: bool = True,
-                 master_weights: bool = False):
+                 master_weights: bool = False,
+                 weight_decay_mask=None):
         if nesterov and (momentum <= 0 or dampening != 0):
             raise ValueError("Nesterov momentum requires a momentum and zero dampening")
-        super().__init__(lr, weight_decay, master_weights)
+        super().__init__(lr, weight_decay, master_weights,
+                         weight_decay_mask)
         self.momentum = momentum
         self.dampening = dampening
         self.nesterov = nesterov
@@ -33,11 +35,11 @@ class FusedSGD(FusedOptimizer):
         return {"momentum_buffer": tree_map(jnp.zeros_like, params32)}
 
     def _update(self, g32, p32, slots, step, lr):
-        wd = self.weight_decay
+        wds = self._wd_leaves(p32)
         mom = self.momentum
         first = step == 1
 
-        def upd(g, p, buf):
+        def upd(g, p, buf, wd):
             d_p = g
             if wd != 0.0 and not self.wd_after_momentum:
                 d_p = d_p + wd * p
@@ -51,8 +53,9 @@ class FusedSGD(FusedOptimizer):
 
         if mom == 0.0:
             new_p = tree_map(
-                lambda g, p: upd(g, p, jnp.zeros(()))[0], g32, p32)
+                lambda g, p, wd: upd(g, p, jnp.zeros(()), wd)[0],
+                g32, p32, wds)
             return new_p, {"momentum_buffer": None}
         new_p, new_buf = tree_map_multi(
-            upd, 2, g32, p32, slots["momentum_buffer"])
+            upd, 2, g32, p32, slots["momentum_buffer"], wds)
         return new_p, {"momentum_buffer": new_buf}
