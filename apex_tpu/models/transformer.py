@@ -98,6 +98,10 @@ class TransformerConfig:
     # statistics via the fused Pallas RMSNorm kernel)
     normalization: str = "layernorm"
     attn_mask_type: AttnMaskType = AttnMaskType.causal
+    # Mistral-class local attention: keep only the last sliding_window keys
+    # per query (causal only); far-past flash blocks are skipped, cost
+    # O(seq * window). None = full attention.
+    sliding_window: Optional[int] = None
     sequence_parallel: bool = False
     # context parallelism (long-context; the reference has none, SURVEY.md §5):
     # None | "ring" (ppermute KV rotation) | "ulysses" (all-to-all head swap)
@@ -132,6 +136,17 @@ class TransformerConfig:
             raise ValueError(
                 f"normalization must be 'layernorm' or 'rmsnorm', got "
                 f"{self.normalization!r}")
+        if self.sliding_window is not None:
+            if self.sliding_window < 1:
+                raise ValueError(
+                    f"sliding_window must be >= 1, got "
+                    f"{self.sliding_window}")
+            if self.attn_mask_type != AttnMaskType.causal:
+                raise ValueError("sliding_window requires causal attention")
+            if self.context_parallel_method:
+                raise NotImplementedError(
+                    "sliding_window under context parallelism is not wired "
+                    "up (the window spans shard boundaries)")
 
     @property
     def ffn_size(self) -> int:
@@ -412,8 +427,12 @@ class ParallelAttention:
                 "dense": self.dense.spec()}
 
     def _core_attention(self, q, k, v, attention_mask, kv_lengths,
-                        rng, deterministic):
-        """q/k/v: [b, local_heads, s, dh]."""
+                        rng, deterministic, window=None):
+        """q/k/v: [b, local_heads, s, dh]. ``window``: sliding-window span
+        for THIS call — the caller zeroes it on the cache-decode path, where
+        the window is already folded into ``attention_mask`` at the correct
+        cache offsets (the generic row/col clause below assumes queries sit
+        at the sequence end, which padded caches violate)."""
         c = self.config
         causal = (self.attn_type == AttnType.self_attn
                   and c.attn_mask_type == AttnMaskType.causal)
@@ -452,7 +471,8 @@ class ParallelAttention:
             deterministic or c.attention_dropout == 0.0)
         if use_flash:
             return flash_attention(q, k, v, causal=causal,
-                                   kv_lengths=kv_lengths)
+                                   kv_lengths=kv_lengths,
+                                   sliding_window=window)
         if kv_lengths is not None:
             # fold varlen lengths into the boolean mask (True = masked out)
             # so the unfused path matches flash semantics
@@ -460,6 +480,14 @@ class ParallelAttention:
                 kv_lengths[:, None, None, None]
             attention_mask = invalid if attention_mask is None else (
                 jnp.logical_or(attention_mask, invalid))
+        if window is not None and causal:
+            # window clause for the unfused path (the causal clause rides
+            # the mask-type dispatcher / explicit mask)
+            row = jnp.arange(q.shape[2])[None, None, :, None]
+            col = jnp.arange(k.shape[2])[None, None, None, :]
+            far = col <= row + (k.shape[2] - q.shape[2]) - window
+            attention_mask = far if attention_mask is None else (
+                jnp.logical_or(attention_mask, far))
         inv_scale = jnp.sqrt(
             jnp.asarray(c.head_dim, jnp.float32)).astype(q.dtype)
         if k.shape[1] != q.shape[1]:
@@ -564,10 +592,16 @@ class ParallelAttention:
             slots = jnp.arange(k.shape[2])[None, None, None, :]
             allowed_up_to = cache_index + jnp.arange(s)[None, None, :, None]
             invalid = slots > allowed_up_to
+            if c.sliding_window is not None:
+                invalid = jnp.logical_or(
+                    invalid, slots <= allowed_up_to - c.sliding_window)
             attention_mask = (invalid if attention_mask is None
                               else jnp.logical_or(attention_mask, invalid))
+        window = (c.sliding_window
+                  if (self.attn_type == AttnType.self_attn
+                      and kv_cache is None) else None)
         ctx = self._core_attention(q, k, v, attention_mask, kv_lengths,
-                                   rng, deterministic)
+                                   rng, deterministic, window=window)
         ctx = ctx.transpose(2, 0, 1, 3).reshape(s, b, local_heads * dh)
         out = self.dense.apply(params["dense"], ctx)
         return out if new_cache is None else (out, new_cache)

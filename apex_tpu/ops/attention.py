@@ -53,20 +53,31 @@ _DEFAULT_BLOCK_Q = 512
 _DEFAULT_BLOCK_K = 512
 
 
-def _mask_block(s, i, j, bq, bk, sq, sk, kvl, causal):
-    """Mask a (bq, bk) logit block; returns (masked logits, validity)."""
+def _mask_block(s, i, j, bq, bk, sq, sk, kvl, causal, window=None):
+    """Mask a (bq, bk) logit block; returns (masked logits, validity).
+    ``window``: sliding-window span (keep the last ``window`` keys incl.
+    self; requires causal)."""
     row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + i * bq
     col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + j * bk
     limit = jnp.minimum(sk, kvl) if kvl is not None else sk
     valid = col < limit
     if causal:
         valid = jnp.logical_and(valid, col <= row + (sk - sq))
+    if window is not None:
+        valid = jnp.logical_and(valid, col > row + (sk - sq) - window)
     return jnp.where(valid, s, _NEG_INF), valid
 
 
-def _causal_block_skip(i, j, bq, bk, sq, sk):
-    """True when k-block j has at least one unmasked column for q-block i."""
-    return j * bk <= i * bq + bq - 1 + (sk - sq)
+def _causal_block_skip(i, j, bq, bk, sq, sk, window=None):
+    """True when k-block j has at least one unmasked column for q-block i
+    (below the causal diagonal AND, with a sliding window, not entirely in
+    the masked-out far past — the skipped far-past blocks are what makes
+    window attention O(s*window) instead of O(s^2))."""
+    keep = j * bk <= i * bq + bq - 1 + (sk - sq)
+    if window is not None:
+        keep = jnp.logical_and(
+            keep, j * bk + bk - 1 > i * bq + (sk - sq) - window)
+    return keep
 
 
 # ---------------------------------------------------------------------------
@@ -74,7 +85,8 @@ def _causal_block_skip(i, j, bq, bk, sq, sk):
 # ---------------------------------------------------------------------------
 
 def _fwd_kernel(kvl_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
-                m_scr, l_scr, acc_scr, *, scale, bq, bk, nk, sq, sk, causal):
+                m_scr, l_scr, acc_scr, *, scale, bq, bk, nk, sq, sk,
+                causal, window=None):
     b, i, j = pl.program_id(0), pl.program_id(2), pl.program_id(3)
 
     @pl.when(j == 0)
@@ -90,7 +102,8 @@ def _fwd_kernel(kvl_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         kvl = kvl_ref[b] if kvl_ref is not None else None
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        s, valid = _mask_block(s, i, j, bq, bk, sq, sk, kvl, causal)
+        s, valid = _mask_block(s, i, j, bq, bk, sq, sk, kvl, causal,
+                               window)
         m_prev = m_scr[:, :1]
         l_prev = l_scr[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -102,8 +115,8 @@ def _fwd_kernel(kvl_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
 
-    if causal:
-        pl.when(_causal_block_skip(i, j, bq, bk, sq, sk))(_step)
+    if causal or window is not None:
+        pl.when(_causal_block_skip(i, j, bq, bk, sq, sk, window))(_step)
     else:
         _step()
 
@@ -117,7 +130,8 @@ def _fwd_kernel(kvl_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0, 0] = jnp.broadcast_to(lse.T, lse_ref.shape[2:])
 
 
-def _run_fwd(q, k, v, kv_lengths, scale, causal, sq, sk, bq, bk, group=1):
+def _run_fwd(q, k, v, kv_lengths, scale, causal, sq, sk, bq, bk,
+             group=1, window=None):
     """q/k/v padded to block multiples; returns padded (o, lse). ``group``
     q heads share each K/V head (GQA/MQA): the K/V index maps divide the
     head coordinate, so grouped heads reread the same blocks instead of the
@@ -134,7 +148,8 @@ def _run_fwd(q, k, v, kv_lengths, scale, causal, sq, sk, bq, bk, group=1):
     kernel = functools.partial(
         _fwd_kernel if kv_lengths is not None else
         (lambda *r, **kw: _fwd_kernel(None, *r, **kw)),
-        scale=scale, bq=bq, bk=bk, nk=nk, sq=sq, sk=sk, causal=causal)
+        scale=scale, bq=bq, bk=bk, nk=nk, sq=sq, sk=sk, causal=causal,
+        window=window)
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -171,7 +186,8 @@ def _run_fwd(q, k, v, kv_lengths, scale, causal, sq, sk, bq, bk, group=1):
 # ---------------------------------------------------------------------------
 
 def _dq_kernel(kvl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-               dq_ref, dq_scr, *, scale, bq, bk, nk, sq, sk, causal):
+               dq_ref, dq_scr, *, scale, bq, bk, nk, sq, sk, causal,
+               window=None):
     b, i, j = pl.program_id(0), pl.program_id(2), pl.program_id(3)
 
     @pl.when(j == 0)
@@ -188,7 +204,7 @@ def _dq_kernel(kvl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         kvl = kvl_ref[b] if kvl_ref is not None else None
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        s, _ = _mask_block(s, i, j, bq, bk, sq, sk, kvl, causal)
+        s, _ = _mask_block(s, i, j, bq, bk, sq, sk, kvl, causal, window)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -196,8 +212,8 @@ def _dq_kernel(kvl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_scr[:] = dq_scr[:] + scale * jax.lax.dot(
             ds.astype(k.dtype), k, preferred_element_type=jnp.float32)
 
-    if causal:
-        pl.when(_causal_block_skip(i, j, bq, bk, sq, sk))(_step)
+    if causal or window is not None:
+        pl.when(_causal_block_skip(i, j, bq, bk, sq, sk, window))(_step)
     else:
         _step()
 
@@ -208,7 +224,8 @@ def _dq_kernel(kvl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _dkv_kernel(kvl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_scr, dv_scr,
-                *, scale, bq, bk, nq, sq, sk, causal, group=1):
+                *, scale, bq, bk, nq, sq, sk, causal, group=1,
+                window=None):
     # grid: (batch, kv_heads, nk, group * nq) — the trailing dim walks every
     # (q head in group, q block) pair so dk/dv accumulate over the whole
     # query group in one scratch pass (GQA/MQA backward)
@@ -230,7 +247,7 @@ def _dkv_kernel(kvl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         kvl = kvl_ref[b] if kvl_ref is not None else None
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        s, _ = _mask_block(s, i, j, bq, bk, sq, sk, kvl, causal)
+        s, _ = _mask_block(s, i, j, bq, bk, sq, sk, kvl, causal, window)
         p = jnp.exp(s - lse)
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -242,8 +259,8 @@ def _dkv_kernel(kvl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    if causal:
-        pl.when(_causal_block_skip(i, j, bq, bk, sq, sk))(_step)
+    if causal or window is not None:
+        pl.when(_causal_block_skip(i, j, bq, bk, sq, sk, window))(_step)
     else:
         _step()
 
@@ -254,7 +271,7 @@ def _dkv_kernel(kvl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _run_bwd(q, k, v, do, lse, delta, kv_lengths, scale, causal,
-             sq, sk, bq, bk, group=1):
+             sq, sk, bq, bk, group=1, window=None):
     batch, heads, sqp, dp = q.shape
     kv_heads, skp = k.shape[1], k.shape[2]
     nq, nk = sqp // bq, skp // bk
@@ -280,7 +297,7 @@ def _run_bwd(q, k, v, do, lse, delta, kv_lengths, scale, causal,
     ]
     dq = pl.pallas_call(
         wrap(_dq_kernel, scale=scale, bq=bq, bk=bk, nk=nk, sq=sq, sk=sk,
-             causal=causal),
+             causal=causal, window=window),
         grid=(batch, heads, nq, nk),
         in_specs=kvl_spec + row_specs,
         out_specs=pl.BlockSpec((1, 1, bq, dp), lambda b, h, i, j: (b, h, i, 0)),
@@ -307,7 +324,7 @@ def _run_bwd(q, k, v, do, lse, delta, kv_lengths, scale, causal,
     ]
     dk, dv = pl.pallas_call(
         wrap(_dkv_kernel, scale=scale, bq=bq, bk=bk, nq=nq, sq=sq, sk=sk,
-             causal=causal, group=group),
+             causal=causal, group=group, window=window),
         grid=(batch, kv_heads, nk, group * nq),
         in_specs=kvl_spec + col_specs,
         out_specs=[
@@ -346,28 +363,30 @@ def _pad_qkv(q, k, v, bq, bk):
     return pad(q, sqp), pad(k, skp), pad(v, skp)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash(q, k, v, kv_lengths, scale, causal, bq, bk):
-    o, _ = _flash_fwd_impl(q, k, v, kv_lengths, scale, causal, bq, bk)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, kv_lengths, scale, causal, bq, bk, window):
+    o, _ = _flash_fwd_impl(q, k, v, kv_lengths, scale, causal, bq, bk,
+                           window)
     return o
 
 
-def _flash_fwd_impl(q, k, v, kv_lengths, scale, causal, bq, bk):
+def _flash_fwd_impl(q, k, v, kv_lengths, scale, causal, bq, bk, window):
     sq, d = q.shape[2], q.shape[3]
     sk = k.shape[2]
     group = q.shape[1] // k.shape[1]
     qp, kp, vp = _pad_qkv(q, k, v, bq, bk)
     o, lse = _run_fwd(qp, kp, vp, kv_lengths, scale, causal, sq, sk, bq, bk,
-                      group=group)
+                      group=group, window=window)
     return o[:, :, :sq, :d], lse[:, :, :sq]
 
 
-def _flash_vjp_fwd(q, k, v, kv_lengths, scale, causal, bq, bk):
-    o, lse = _flash_fwd_impl(q, k, v, kv_lengths, scale, causal, bq, bk)
+def _flash_vjp_fwd(q, k, v, kv_lengths, scale, causal, bq, bk, window):
+    o, lse = _flash_fwd_impl(q, k, v, kv_lengths, scale, causal, bq, bk,
+                             window)
     return o, (q, k, v, kv_lengths, o, lse)
 
 
-def _flash_vjp_bwd(scale, causal, bq, bk, res, do):
+def _flash_vjp_bwd(scale, causal, bq, bk, window, res, do):
     q, k, v, kv_lengths, o, lse = res
     sq, d = q.shape[2], q.shape[3]
     sk = k.shape[2]
@@ -382,7 +401,8 @@ def _flash_vjp_bwd(scale, causal, bq, bk, res, do):
     # reshape row-vectors to (B, H, 1, sqp) for the (1,1,1,bq) block specs
     dq, dk, dv = _run_bwd(qp, kp, vp, dop, lsep[:, :, None, :],
                           delta[:, :, None, :], kv_lengths, scale, causal,
-                          sq, sk, bq, bk, group=q.shape[1] // k.shape[1])
+                          sq, sk, bq, bk, group=q.shape[1] // k.shape[1],
+                          window=window)
     dq = dq[:, :, :sq, :d]
     dk = dk[:, :, :sk, :d]
     dv = dv[:, :, :sk, :d]
@@ -400,7 +420,7 @@ _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 # reference (XLA) path
 # ---------------------------------------------------------------------------
 
-def _mha_reference(q, k, v, kv_lengths, scale, causal):
+def _mha_reference(q, k, v, kv_lengths, scale, causal, window=None):
     sq, sk = q.shape[2], k.shape[2]
     if k.shape[1] != q.shape[1]:     # GQA/MQA: broadcast the K/V heads
         group = q.shape[1] // k.shape[1]
@@ -415,6 +435,8 @@ def _mha_reference(q, k, v, kv_lengths, scale, causal):
         valid = jnp.logical_and(valid, col < kv_lengths[:, None, None, None])
     if causal:
         valid = jnp.logical_and(valid, col <= row + (sk - sq))
+    if window is not None:
+        valid = jnp.logical_and(valid, col > row + (sk - sq) - window)
     s = jnp.where(valid, s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     # fully-masked rows (empty batch elements / kv_lengths == 0) get zero
@@ -432,6 +454,7 @@ def flash_attention(
     causal: bool = False,
     softmax_scale: Optional[float] = None,
     kv_lengths: Optional[jax.Array] = None,
+    sliding_window: Optional[int] = None,
     block_q: int = _DEFAULT_BLOCK_Q,
     block_k: int = _DEFAULT_BLOCK_K,
 ) -> jax.Array:
@@ -449,6 +472,10 @@ def flash_attention(
       softmax_scale: defaults to ``1/sqrt(head_dim)``.
       kv_lengths: optional int32 ``[batch]`` valid key/value lengths (the
         fmha padded-batch capability, ``apex/contrib/fmha/fmha.py:41-56``).
+      sliding_window: keep only the last ``sliding_window`` keys per query
+        (incl. self; requires ``causal``) — Mistral-class local attention.
+        Far-past K blocks are skipped entirely, so cost is O(seq * window)
+        rather than O(seq^2).
     """
     if q.ndim != 4 or k.ndim != 4 or v.ndim != 4:
         raise ValueError("flash_attention expects [batch, heads, seq, dim]")
@@ -456,10 +483,18 @@ def flash_attention(
         raise ValueError(
             f"kv_heads ({k.shape[1]}) must divide query heads "
             f"({q.shape[1]}) for GQA/MQA")
+    if sliding_window is not None:
+        if not causal:
+            raise ValueError("sliding_window requires causal attention")
+        if sliding_window < 1:
+            raise ValueError(f"sliding_window must be >= 1, got "
+                             f"{sliding_window}")
     scale = float(softmax_scale if softmax_scale is not None
                   else 1.0 / np.sqrt(q.shape[-1]))
     if not use_pallas():
-        return _mha_reference(q, k, v, kv_lengths, scale, causal)
+        return _mha_reference(q, k, v, kv_lengths, scale, causal,
+                              sliding_window)
     bq = min(block_q, round_up(q.shape[2], 8))
     bk = min(block_k, round_up(k.shape[2], 128))
-    return _flash(q, k, v, kv_lengths, scale, causal, bq, bk)
+    return _flash(q, k, v, kv_lengths, scale, causal, bq, bk,
+                  sliding_window)

@@ -81,7 +81,11 @@ class FusedScaleMaskSoftmax:
         (same gate as the reference's ``sq == sk`` check)."""
         if not self.scaled_masked_softmax_fusion:
             return False
-        if self.attn_mask_type == AttnMaskType.causal and sq != sk:
+        if self.attn_mask_type == AttnMaskType.causal and (
+                sq != sk or mask is not None):
+            # the fused causal kernel takes no mask argument — an explicit
+            # mask (sliding window, varlen, KV-cache slots) must ride the
+            # unfused path, which applies causal AND the mask
             return False
         return True
 

@@ -149,3 +149,60 @@ class TestGQA:
         k = _rand((1, 2, 32, 64))
         with pytest.raises(ValueError, match="divide"):
             flash_attention(q, k, k)
+
+
+class TestSlidingWindow:
+    """Mistral-class local attention: keep the last ``window`` keys per
+    query; far-past K blocks are skipped in the kernel."""
+
+    @pytest.mark.parametrize("window", [1, 16, 100, 1000])
+    def test_forward(self, window):
+        q = _rand((2, 2, 300, 64), seed=21)
+        k = _rand((2, 2, 300, 64), seed=22)
+        v = _rand((2, 2, 300, 64), seed=23)
+        out = flash_attention(q, k, v, causal=True, sliding_window=window)
+        ref = _mha_reference(q, k, v, None, 1.0 / np.sqrt(64), True, window)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_backward(self):
+        q = _rand((1, 2, 160, 64), seed=24)
+        k = _rand((1, 2, 160, 64), seed=25)
+        v = _rand((1, 2, 160, 64), seed=26)
+
+        def loss(fn):
+            return lambda q, k, v: jnp.sum(jnp.sin(fn(q, k, v)))
+
+        g = jax.grad(loss(lambda q, k, v: flash_attention(
+            q, k, v, causal=True, sliding_window=48)),
+            argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss(lambda q, k, v: _mha_reference(
+            q, k, v, None, 1.0 / np.sqrt(64), True, 48)),
+            argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-5, rtol=5e-5)
+
+    def test_gqa_window(self):
+        q = _rand((1, 4, 128, 64), seed=27)
+        k = _rand((1, 2, 128, 64), seed=28)
+        v = _rand((1, 2, 128, 64), seed=29)
+        out = flash_attention(q, k, v, causal=True, sliding_window=32)
+        ref = _mha_reference(q, k, v, None, 1.0 / np.sqrt(64), True, 32)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_window_one_attends_self_only(self):
+        q = _rand((1, 1, 32, 64), seed=30)
+        k = _rand((1, 1, 32, 64), seed=31)
+        v = _rand((1, 1, 32, 64), seed=32)
+        out = flash_attention(q, k, v, causal=True, sliding_window=1)
+        # softmax over a single key == that key's value
+        np.testing.assert_allclose(np.asarray(out, np.float32),
+                                   np.asarray(v, np.float32),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_requires_causal(self):
+        q = _rand((1, 1, 32, 64))
+        with pytest.raises(ValueError, match="causal"):
+            flash_attention(q, q, q, sliding_window=8)
