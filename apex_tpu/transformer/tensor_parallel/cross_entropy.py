@@ -37,7 +37,8 @@ def _tp(axis_name):
     return 0, 1, False
 
 
-def _forward(vocab_parallel_logits, target, label_smoothing, axis_name):
+def _forward(vocab_parallel_logits, target, label_smoothing, axis_name,
+             z_loss=0.0):
     rank, size, bound = _tp(axis_name)
     in_dtype = vocab_parallel_logits.dtype
     # fp32 internal math regardless of logits dtype (the reference CUDA
@@ -85,35 +86,51 @@ def _forward(vocab_parallel_logits, target, label_smoothing, axis_name):
         mean_log_probs = sum_log_probs / global_vocab
         loss = (1.0 - smoothing) * loss - smoothing * mean_log_probs
 
+    # z-loss (exceeds reference; PaLM/Megatron-LM logit regularization):
+    # coef * log(Z)^2 with the TRUE partition function (max re-added).
+    # Added AFTER the smoothing rescale so forward and the custom backward
+    # agree on exactly z * logZ^2 per token; computed only when active so
+    # the default path saves no extra residual.
+    log_z = None
+    if z_loss > 0.0:
+        log_z = jnp.log(sum_exp) + logits_max
+        loss = loss + z_loss * log_z * log_z
+
     # residual kept in the caller's dtype: halves backward HBM traffic for
     # bf16 logits (the grad is bf16 anyway — it feeds a bf16 matmul)
     residuals = (softmax.astype(in_dtype), in_range, masked_target,
-                 smoothing, global_vocab)
+                 smoothing, global_vocab, log_z)
     return loss, residuals
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
 def vocab_parallel_cross_entropy(
     vocab_parallel_logits: jax.Array,
     target: jax.Array,
     label_smoothing: float = 0.0,
     axis_name: str = TENSOR_AXIS,
+    z_loss: float = 0.0,
 ) -> jax.Array:
-    """Per-token CE loss from vocab-sharded logits [..., V/tp] and global ids."""
-    loss, _ = _forward(vocab_parallel_logits, target, label_smoothing, axis_name)
+    """Per-token CE loss from vocab-sharded logits [..., V/tp] and global
+    ids. ``z_loss`` adds PaLM-style logit regularization
+    ``z_loss * log(Z)^2`` per token (exceeds the reference)."""
+    loss, _ = _forward(vocab_parallel_logits, target, label_smoothing,
+                       axis_name, z_loss)
     return loss
 
 
-def _vjp_fwd(vocab_parallel_logits, target, label_smoothing, axis_name):
+def _vjp_fwd(vocab_parallel_logits, target, label_smoothing, axis_name,
+             z_loss):
     loss, residuals = _forward(
-        vocab_parallel_logits, target, label_smoothing, axis_name)
+        vocab_parallel_logits, target, label_smoothing, axis_name, z_loss)
     return loss, residuals
 
 
-def _vjp_bwd(label_smoothing, axis_name, residuals, g):
+def _vjp_bwd(label_smoothing, axis_name, z_loss, residuals, g):
     # Reference backward (:100-134): grad = softmax - onehot(target) on the
     # local shard, with the smoothing correction spread over the vocab.
-    softmax, in_range, masked_target, smoothing, global_vocab = residuals
+    softmax, in_range, masked_target, smoothing, global_vocab, log_z = \
+        residuals
     grad = softmax.astype(jnp.float32)     # fp32 math, output in input dtype
     onehot = jax.nn.one_hot(
         masked_target, softmax.shape[-1], dtype=jnp.float32)
@@ -122,6 +139,10 @@ def _vjp_bwd(label_smoothing, axis_name, residuals, g):
         grad = grad - (1.0 - smoothing) * onehot - smoothing / global_vocab
     else:
         grad = grad - onehot
+    if z_loss > 0.0:
+        # d/dlogits [z * logZ^2] = 2 z logZ * softmax
+        grad = grad + (2.0 * z_loss) * log_z[..., None] * \
+            softmax.astype(jnp.float32)
     grad = grad * g[..., None].astype(jnp.float32)
     return (grad.astype(softmax.dtype), None)
 
