@@ -462,3 +462,32 @@ class TestZLoss:
         gr = jax.grad(ref)(logits)
         np.testing.assert_allclose(np.asarray(g), np.asarray(gr),
                                    rtol=1e-4, atol=1e-5)
+
+    def test_sharded_matches_unsharded(self, mesh8):
+        """z-loss under a bound tensor axis: logZ must use the psum'd
+        denominator + pmax'd max — sharded loss/grads == unsharded."""
+        from apex_tpu.transformer.tensor_parallel import (
+            vocab_parallel_cross_entropy,
+        )
+
+        logits = jax.random.normal(jax.random.PRNGKey(8), (3, 4, 32))
+        target = jax.random.randint(jax.random.PRNGKey(9), (3, 4), 0, 32)
+        ref_loss = vocab_parallel_cross_entropy(logits, target, z_loss=1e-2)
+        ref_grad = jax.grad(lambda l: jnp.sum(
+            vocab_parallel_cross_entropy(l, target, z_loss=1e-2)))(logits)
+
+        def body(l, t):
+            loss = vocab_parallel_cross_entropy(l, t, z_loss=1e-2)
+            grad = jax.grad(lambda ll: jnp.sum(
+                vocab_parallel_cross_entropy(ll, t, z_loss=1e-2)))(l)
+            return loss, grad
+
+        loss, grad = jax.jit(jax.shard_map(
+            body, mesh=mesh8,
+            in_specs=(P(None, None, "tensor"), P()),
+            out_specs=(P(), P(None, None, "tensor")),
+            check_vma=False))(logits, target)
+        np.testing.assert_allclose(np.asarray(loss), np.asarray(ref_loss),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(grad), np.asarray(ref_grad),
+                                   rtol=1e-5, atol=1e-5)
