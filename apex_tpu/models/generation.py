@@ -101,8 +101,6 @@ def generate(model, params, prompt: jax.Array, max_new_tokens: int, *,
     """
     if temperature > 0.0 and rng is None:
         raise ValueError("sampling (temperature > 0) needs rng")
-    if model.config.num_moe_experts:
-        raise NotImplementedError("generation with MoE is not supported")
     b, prompt_len = prompt.shape
     total = prompt_len + max_new_tokens
     if (model.config.position_embedding_type == "learned"

@@ -739,7 +739,8 @@ class ParallelTransformerLayer:
                        else jax.random.fold_in(rngs[1], 1))
             mlp_out, aux = self.mlp.apply(
                 params["mlp"], x.astype(c.compute_dtype),
-                rng=moe_rng, deterministic=deterministic)
+                rng=moe_rng, deterministic=deterministic,
+                drop_free=kv_cache is not None)
         else:
             mlp_out = self.mlp.apply(params["mlp"], x.astype(c.compute_dtype))
             aux = None
@@ -748,9 +749,9 @@ class ParallelTransformerLayer:
                            axis_name=c.axis_name)
         out = hidden + mlp_out
         if new_cache is not None:
-            if c.num_moe_experts:
-                raise NotImplementedError(
-                    "kv_cache decoding with MoE layers is not supported")
+            # decode is inference: the MoE load-balancing aux loss is a
+            # training signal, so it is dropped on the cache path (expert
+            # dispatch itself runs normally inside the decode scan)
             return out, new_cache
         return (out, aux) if c.num_moe_experts else out
 

@@ -122,11 +122,6 @@ class DistributedFusedAdam(FusedAdam):
     def __init__(self, lr: float = 1e-3, *, num_shards: Optional[int] = None,
                  axis_name: str = DATA_AXIS, **adam_kw):
         adam_kw.pop("master_weights", None)
-        if adam_kw.get("weight_decay_mask") is not None:
-            raise NotImplementedError(
-                "weight_decay_mask is per-leaf; the ZeRO-sharded optimizers "
-                "update one flat buffer — use per-leaf FusedAdam or set "
-                "weight_decay=0")
         super().__init__(lr=lr, master_weights=True, **adam_kw)
         if num_shards is None:
             from apex_tpu.transformer import parallel_state
@@ -135,8 +130,53 @@ class DistributedFusedAdam(FusedAdam):
                           else 1)
         self.num_shards = num_shards
         self.axis_name = axis_name
+        self._segment_cache: dict = {}
 
     # -- flat buffer layout --------------------------------------------------
+
+    def _segment_ids(self, params) -> Tuple[jax.Array, int]:
+        """int32 ``[num_shards * chunk]`` mapping each flat-buffer slot to its
+        leaf index; padding maps to a dead segment ``n_leaves``. Shared by the
+        per-element weight-decay masks (here) and the LAMB subclass's
+        per-tensor trust-ratio norms."""
+        leaves = jax.tree_util.tree_leaves(params)
+        sizes = tuple(int(np.prod(l.shape, dtype=np.int64)) for l in leaves)
+        if sizes not in self._segment_cache:
+            total = sum(sizes)
+            chunk = self._chunk_size(total)
+            padded = chunk * self.num_shards
+            ids = np.full((padded,), len(sizes), dtype=np.int32)
+            off = 0
+            for i, n in enumerate(sizes):
+                ids[off:off + n] = i
+                off += n
+            self._segment_cache[sizes] = ids      # numpy: safe across traces
+        return jnp.asarray(self._segment_cache[sizes]), len(sizes)
+
+    def _local_segment_ids(self, params, chunk: int,
+                           sharded: bool) -> Tuple[jax.Array, int]:
+        """This rank's slice of the segment-id map (full map unsharded)."""
+        ids_full, n_leaves = self._segment_ids(params)
+        if sharded:
+            ids = lax.dynamic_slice(
+                ids_full, (lax.axis_index(self.axis_name) * chunk,), (chunk,))
+        else:
+            ids = ids_full
+        return ids, n_leaves
+
+    def _wd_segment_values(self, params, n_leaves: int) -> jax.Array:
+        """fp32 ``[n_leaves + 1]`` weight-decay value per leaf segment (mask
+        applied; the dead padding segment decays 0)."""
+        wd_tree = self._wd_leaves(params)
+        vals = [float(w) for w in jax.tree_util.tree_leaves(wd_tree)] + [0.0]
+        return jnp.asarray(vals, jnp.float32)
+
+    def _flat_wd_local(self, params, chunk: int, sharded: bool) -> jax.Array:
+        """Per-element decay multipliers for this rank's flat shard — the
+        flat-buffer translation of the per-leaf ``weight_decay_mask``
+        (param-groups parity the reference keeps via torch param_groups)."""
+        ids, n_leaves = self._local_segment_ids(params, chunk, sharded)
+        return self._wd_segment_values(params, n_leaves)[ids]
 
     def _model_axis_sizes(self):
         from apex_tpu.transformer import parallel_state
@@ -286,7 +326,15 @@ class DistributedFusedAdam(FusedAdam):
         slots = {"exp_avg": state["exp_avg"].reshape(-1),
                  "exp_avg_sq": state["exp_avg_sq"].reshape(-1)}
         step = state["step"] + 1
-        new_p, new_slots = self._update(g_local, p_local, slots, step, lr)
+        # always pass wds explicitly: the flat buffer is a single leaf, so
+        # the base _wd_leaves (which maps a per-leaf mask over the params
+        # tree) must never run here
+        if self.weight_decay_mask is not None and self.weight_decay != 0.0:
+            wds = [self._flat_wd_local(params, g_local.shape[0], sharded)]
+        else:
+            wds = [self.weight_decay]
+        new_p, new_slots = self._update(g_local, p_local, slots, step, lr,
+                                        wds=wds)
         if found_inf is not None:
             new_p = jnp.where(found_inf, p_local, new_p)
             new_slots = jax.tree.map(

@@ -46,35 +46,17 @@ class DistributedFusedLAMB(DistributedFusedAdam):
                  betas: Tuple[float, float] = (0.9, 0.999), eps: float = 1e-6,
                  weight_decay: float = 0.01, adam_w_mode: bool = True,
                  grad_averaging: bool = True, max_grad_norm: float = 1.0,
-                 trust_clip: bool = False, always_adapt: bool = False):
+                 trust_clip: bool = False, always_adapt: bool = False,
+                 weight_decay_mask=None):
         super().__init__(lr=lr, num_shards=num_shards, axis_name=axis_name,
                          bias_correction=bias_correction, betas=betas,
                          eps=eps, adam_w_mode=adam_w_mode,
-                         weight_decay=weight_decay)
+                         weight_decay=weight_decay,
+                         weight_decay_mask=weight_decay_mask)
         self.grad_averaging = grad_averaging
         self.max_grad_norm = max_grad_norm
         self.trust_clip = trust_clip
         self.always_adapt = always_adapt
-        self._segment_cache: dict = {}
-
-    # -- segment map ---------------------------------------------------------
-
-    def _segment_ids(self, params) -> Tuple[jax.Array, int]:
-        """int32 ``[num_shards * chunk]`` mapping each flat-buffer slot to its
-        leaf index; padding maps to a dead segment ``n_leaves``."""
-        leaves = jax.tree_util.tree_leaves(params)
-        sizes = tuple(int(np.prod(l.shape, dtype=np.int64)) for l in leaves)
-        if sizes not in self._segment_cache:
-            total = sum(sizes)
-            chunk = self._chunk_size(total)
-            padded = chunk * self.num_shards
-            ids = np.full((padded,), len(sizes), dtype=np.int32)
-            off = 0
-            for i, n in enumerate(sizes):
-                ids[off:off + n] = i
-                off += n
-            self._segment_cache[sizes] = ids      # numpy: safe across traces
-        return jnp.asarray(self._segment_cache[sizes]), len(sizes)
 
     # -- step ----------------------------------------------------------------
 
@@ -96,12 +78,7 @@ class DistributedFusedLAMB(DistributedFusedAdam):
             gnorm / self.max_grad_norm, 1.0)
         g = g / clip
 
-        ids_full, n_leaves = self._segment_ids(params)
-        if sharded:
-            ids = lax.dynamic_slice(
-                ids_full, (lax.axis_index(self.axis_name) * chunk,), (chunk,))
-        else:
-            ids = ids_full
+        ids, n_leaves = self._local_segment_ids(params, chunk, sharded)
 
         # phase 2: Adam moments + per-tensor trust-ratio step on the shard
         b1, b2 = self.betas
@@ -110,22 +87,30 @@ class DistributedFusedLAMB(DistributedFusedAdam):
         bc1 = 1.0 - b1 ** t if self.bias_correction else 1.0
         bc2 = 1.0 - b2 ** t if self.bias_correction else 1.0
         beta3 = 1.0 - b1 if self.grad_averaging else 1.0
-        wd = self.weight_decay
+        # a mask with wd=0 decays nothing — skip the per-element machinery
+        masked = self.weight_decay_mask is not None and self.weight_decay != 0.0
+        if masked:
+            wd_vals = self._wd_segment_values(params, n_leaves)  # [nseg]
+            wd = wd_vals[ids]                # per-element decay multipliers
+            apply_wd = True
+        else:
+            wd = self.weight_decay
+            apply_wd = wd != 0.0
 
         shard_shape = state["master"].shape
         p = state["master"].reshape(-1)
         m = state["exp_avg"].reshape(-1)
         v = state["exp_avg_sq"].reshape(-1)
 
-        if not self.adam_w_mode and wd != 0.0:
+        if not self.adam_w_mode and apply_wd:
             g = g + wd * p
         m = b1 * m + beta3 * g
         v = b2 * v + (1.0 - b2) * g * g
         update = (m / bc1) / (jnp.sqrt(v / bc2) + self.eps)
-        if self.adam_w_mode and wd != 0.0:
+        if self.adam_w_mode and apply_wd:
             update = update + wd * p
 
-        if wd != 0.0 or self.always_adapt:
+        if apply_wd or self.always_adapt:
             nseg = n_leaves + 1          # +1 dead segment for padding
             w_sumsq = jax.ops.segment_sum(p * p, ids, num_segments=nseg)
             u_sumsq = jax.ops.segment_sum(update * update, ids,
@@ -139,6 +124,10 @@ class DistributedFusedLAMB(DistributedFusedAdam):
                               w_norm / jnp.maximum(u_norm, 1e-30), 1.0)
             if self.trust_clip:
                 ratio = jnp.minimum(ratio, 1.0)
+            if masked and not self.always_adapt:
+                # per-leaf parity: undecayed leaves skip trust adaptation
+                # (FusedLAMB's ``wd != 0 or always_adapt`` branch per leaf)
+                ratio = jnp.where(wd_vals != 0.0, ratio, 1.0)
             scale_e = ratio[ids]
         else:
             scale_e = 1.0

@@ -41,20 +41,24 @@ class FusedAdam(FusedOptimizer):
             "exp_avg_sq": tree_map(jnp.zeros_like, params32),
         }
 
-    def _update(self, g32, p32, slots, step, lr):
+    def _update(self, g32, p32, slots, step, lr, wds=None):
         b1, b2 = self.betas
         t = step.astype(jnp.float32)
         bc1 = 1.0 - b1 ** t if self.bias_correction else 1.0
         bc2 = 1.0 - b2 ** t if self.bias_correction else 1.0
-        wds = self._wd_leaves(p32)
+        # ``wds`` override: the ZeRO flat-buffer subclass passes per-element
+        # decay arrays (leaf masks flattened to buffer segments) instead of
+        # the per-leaf floats
+        wds = self._wd_leaves(p32) if wds is None else wds
 
         def upd(g, p, m, v, wd):
-            if not self.adam_w_mode and wd != 0.0:
+            apply_wd = not isinstance(wd, float) or wd != 0.0
+            if not self.adam_w_mode and apply_wd:
                 g = g + wd * p
             m = b1 * m + (1.0 - b1) * g
             v = b2 * v + (1.0 - b2) * g * g
             update = (m / bc1) / (jnp.sqrt(v / bc2) + self.eps)
-            if self.adam_w_mode and wd != 0.0:
+            if self.adam_w_mode and apply_wd:
                 update = update + wd * p
             return p - lr * update, m, v
 

@@ -153,14 +153,19 @@ class SwitchMLP:
         cap = int(tokens * c.capacity_factor * c.top_k / c.num_experts)
         return max(cap, 1)
 
-    def apply(self, params, x, *, rng=None,
-              deterministic: bool = True) -> Tuple[jax.Array, jax.Array]:
+    def apply(self, params, x, *, rng=None, deterministic: bool = True,
+              drop_free: bool = False) -> Tuple[jax.Array, jax.Array]:
+        """``drop_free=True`` sizes the capacity buffers at ``tokens`` (an
+        expert can hold every token), guaranteeing no capacity drops — the
+        decode path uses this: per-step token counts are tiny, so the
+        factor-based capacity would drop tokens batch-size-dependently and
+        decode logits would silently diverge from the batched forward."""
         c = self.config
         s, b, h = x.shape
         tokens = s * b
         x2d = x.reshape(tokens, h)
         weights, experts, aux = self._route(params, x2d, rng, deterministic)
-        cap = self._capacity(tokens)
+        cap = tokens if drop_free else self._capacity(tokens)
 
         # position of each token within its expert's capacity buffer, one
         # pass per k (cumsum over the one-hot assignment matrix)

@@ -136,6 +136,20 @@ class TestDistributedFusedAdamSharded:
         np.testing.assert_allclose(z_losses, ref_losses, rtol=1e-5)
         assert z_s["master"].shape[0] == 4  # dp shards
 
+    def test_weight_decay_mask_matches_per_leaf(self):
+        # biases excluded from decay, exactly as torch param-groups would
+        mask = {"w1": True, "b1": False, "w2": True}
+        ref_losses, ref_p, _ = self._train(
+            FusedAdam(lr=1e-2, weight_decay=0.1, weight_decay_mask=mask))
+        z_losses, z_p, _ = self._train(
+            DistributedFusedAdam(lr=1e-2, weight_decay=0.1,
+                                 weight_decay_mask=mask, num_shards=8))
+        np.testing.assert_allclose(z_losses, ref_losses, rtol=1e-5)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4,
+                                                    atol=1e-6),
+            z_p, ref_p)
+
 
 class TestCheckpoint:
     def test_roundtrip(self, tmp_path):
@@ -281,6 +295,22 @@ class TestDistributedFusedLAMB:
                                                     atol=1e-6),
             z_p, ref_p)
         assert z_s["master"].shape[0] == 8
+
+    def test_weight_decay_mask_matches_per_leaf(self):
+        from apex_tpu.optimizers import DistributedFusedLAMB, FusedLAMB
+
+        mask = {"w1": True, "b1": False, "w2": True}
+        harness = TestDistributedFusedAdamSharded()
+        ref_losses, ref_p, _ = harness._train(
+            FusedLAMB(lr=1e-2, weight_decay=0.1, weight_decay_mask=mask))
+        z_losses, z_p, _ = harness._train(
+            DistributedFusedLAMB(lr=1e-2, weight_decay=0.1,
+                                 weight_decay_mask=mask, num_shards=8))
+        np.testing.assert_allclose(z_losses, ref_losses, rtol=1e-5)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4,
+                                                    atol=1e-6),
+            z_p, ref_p)
 
     def test_no_decay_no_adapt_matches_adam_shape(self):
         from apex_tpu.optimizers import DistributedFusedLAMB
