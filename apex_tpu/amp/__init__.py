@@ -32,8 +32,10 @@ from apex_tpu.amp.frontend import (
     OPT_LEVELS,
 )
 from apex_tpu.amp.handle import scale_loss, unscale_and_update, apply_if_finite
+from apex_tpu.amp import fp8
 
 __all__ = [
+    "fp8",
     "Policy",
     "disable_casts",
     "half_function",

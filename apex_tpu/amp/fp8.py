@@ -1,0 +1,160 @@
+"""fp8 delayed scaling — amax tracking, scale computation, quantize hooks.
+
+Capability analog of the reference's fp8 plumbing: the reference itself only
+builds the AMAX reduction process groups (``apex/transformer/parallel_state.py
+:280-292``) that TransformerEngine-style delayed scaling consumes; the actual
+recipe (amax history window -> scale, margin, e4m3 fwd / e5m2 bwd) is the
+public TE delayed-scaling algorithm, implemented here fresh in functional JAX
+form so it jits and shards:
+
+- per-tensor state = ``{"amax_history": [H], "scale": []}`` carried as a
+  pytree through the train step (no mutable globals — the TPU analog of the
+  reference's capturable no-host-sync design);
+- amax reduction over *mesh axes* instead of a process group:
+  ``lax.pmax(amax, parallel_state.amax_reduction_axes())`` inside
+  ``shard_map`` — every rank holding shards/replicas of one tensor agrees on
+  its scale (reference group = TP x DP per pipeline stage);
+- quantization is qdq (quantize-dequantize): values round-trip through the
+  fp8 storage dtype and come back in the compute dtype, so any matmul can be
+  "fp8-simulated" today and swapped for native fp8 ``dot_general`` where the
+  TPU generation supports it (v5p+/Trillium).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "Fp8Recipe",
+    "E4M3",
+    "E5M2",
+    "fp8_max",
+    "init_fp8_state",
+    "compute_amax",
+    "reduce_amaxes",
+    "update_fp8_state",
+    "quantize",
+    "dequantize",
+    "qdq",
+]
+
+# Storage dtypes: e4m3 for forward activations/weights (more mantissa),
+# e5m2 for backward gradients (more range) — the standard hybrid recipe.
+E4M3 = jnp.float8_e4m3fn
+E5M2 = jnp.float8_e5m2
+
+_FP8_MAX = {E4M3: 448.0, E5M2: 57344.0}
+
+
+def fp8_max(dtype) -> float:
+    """Largest finite value representable in the fp8 storage dtype."""
+    return _FP8_MAX[jnp.dtype(dtype).type if not isinstance(dtype, type)
+                    else dtype]
+
+
+@dataclasses.dataclass(frozen=True)
+class Fp8Recipe:
+    """Delayed-scaling hyperparameters (TE ``DelayedScaling`` semantics)."""
+
+    margin: int = 0                    # scale headroom: 2**margin
+    amax_history_len: int = 16         # rolling window of per-step amaxes
+    amax_compute_algo: str = "max"     # "max" over window | "most_recent"
+    fwd_dtype: Any = E4M3
+    bwd_dtype: Any = E5M2
+
+    def __post_init__(self):
+        if self.amax_history_len < 1:
+            raise ValueError("amax_history_len must be >= 1")
+        if self.amax_compute_algo not in ("max", "most_recent"):
+            raise ValueError(
+                f"amax_compute_algo must be 'max' or 'most_recent', got "
+                f"{self.amax_compute_algo!r}")
+
+
+def init_fp8_state(names: Sequence[str],
+                   recipe: Fp8Recipe = Fp8Recipe()) -> Dict[str, Any]:
+    """State pytree: one ``{"amax_history": [H], "scale": []}`` per tensor
+    name. Scales start at 1.0 (identity until the first update)."""
+    return {
+        n: {
+            "amax_history": jnp.zeros((recipe.amax_history_len,),
+                                      jnp.float32),
+            "scale": jnp.ones((), jnp.float32),
+        }
+        for n in names
+    }
+
+
+def compute_amax(x: jax.Array) -> jax.Array:
+    """Current-step absolute maximum (fp32 scalar)."""
+    return jnp.max(jnp.abs(x)).astype(jnp.float32)
+
+
+def reduce_amaxes(amaxes, axis_names: Optional[Sequence[str]] = None):
+    """pmax each amax over the bound reduction axes — the collective the
+    reference's ``_AMAX_REDUCTION_GROUP`` exists for. Outside ``shard_map``
+    (or with no bound axes) this is the identity."""
+    if axis_names is None:
+        from apex_tpu.transformer.parallel_state import amax_reduction_axes
+        axis_names = amax_reduction_axes()
+    from apex_tpu.utils.sharding import bound_axes
+    axes = bound_axes(axis_names)
+    if not axes:
+        return amaxes
+    return jax.tree.map(lambda a: lax.pmax(a, axes), amaxes)
+
+
+def _new_scale(history: jax.Array, old_scale: jax.Array,
+               recipe: Fp8Recipe, dtype) -> jax.Array:
+    amax = (jnp.max(history) if recipe.amax_compute_algo == "max"
+            else history[0])
+    sf = fp8_max(dtype) / (amax * (2.0 ** recipe.margin))
+    # amax == 0 (nothing observed yet) keeps the previous scale
+    return jnp.where((amax > 0.0) & jnp.isfinite(sf), sf, old_scale)
+
+
+def update_fp8_state(state: Dict[str, Any], amaxes: Dict[str, jax.Array],
+                     recipe: Fp8Recipe = Fp8Recipe(), *,
+                     axis_names: Optional[Sequence[str]] = None,
+                     dtypes: Optional[Dict[str, Any]] = None
+                     ) -> Dict[str, Any]:
+    """One delayed-scaling step: reduce this step's ``amaxes`` over the
+    amax-reduction axes, roll each history window, recompute scales.
+
+    ``dtypes`` optionally maps tensor name -> storage dtype (default:
+    ``recipe.fwd_dtype``; pass ``recipe.bwd_dtype`` for gradient tensors).
+    """
+    amaxes = reduce_amaxes(amaxes, axis_names)
+    new = {}
+    for name, s in state.items():
+        hist = jnp.roll(s["amax_history"], 1)
+        hist = hist.at[0].set(amaxes[name])
+        dt = (dtypes or {}).get(name, recipe.fwd_dtype)
+        new[name] = {
+            "amax_history": hist,
+            "scale": _new_scale(hist, s["scale"], recipe, dt),
+        }
+    return new
+
+
+def quantize(x: jax.Array, scale: jax.Array, dtype=E4M3) -> jax.Array:
+    """Scale into the fp8 representable range and cast to storage dtype."""
+    clipped = jnp.clip(x.astype(jnp.float32) * scale,
+                       -fp8_max(dtype), fp8_max(dtype))
+    return clipped.astype(dtype)
+
+
+def dequantize(xq: jax.Array, scale: jax.Array, dtype=jnp.float32):
+    return (xq.astype(jnp.float32) / scale).astype(dtype)
+
+
+def qdq(x: jax.Array, scale: jax.Array, dtype=E4M3) -> jax.Array:
+    """Quantize-dequantize: fp8 rounding applied, original dtype returned —
+    the simulation hook a Policy/layer wraps around matmul operands until
+    native fp8 ``dot_general`` is wired for the target TPU generation."""
+    return dequantize(quantize(x, scale, dtype), scale, x.dtype)

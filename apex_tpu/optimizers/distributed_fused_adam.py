@@ -243,6 +243,16 @@ class DistributedFusedAdam(FusedAdam):
         else:
             spec_leaves = jax.tree_util.tree_structure(params).flatten_up_to(
                 param_spec)
+        from apex_tpu.utils.sharding import spec_axis_names
+        for s in spec_leaves:
+            if self.axis_name in spec_axis_names(s):
+                raise NotImplementedError(
+                    f"a parameter is sharded over the ZeRO axis "
+                    f"'{self.axis_name}' (e.g. expert parallelism riding the "
+                    "data axis): its per-rank values differ, which breaks "
+                    "the flat-buffer reduce-scatter. Use the per-leaf "
+                    "FusedAdam/FusedLAMB for such models, or put experts on "
+                    "a different mesh axis.")
         local_numel = sum(
             _local_numel(l.shape, s, axes)
             for l, s in zip(leaves, spec_leaves))

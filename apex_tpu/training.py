@@ -28,19 +28,46 @@ from apex_tpu.transformer.parallel_state import DATA_AXIS
 __all__ = ["make_train_step", "sync_data_parallel_grads"]
 
 
-def sync_data_parallel_grads(grads, axis_names: Sequence[str]):
+def sync_data_parallel_grads(grads, axis_names: Sequence[str],
+                             param_spec=None):
     """pmean grads over the bound data axes (DDP's allreduce + divide,
-    reference ``distributed.py:429-480`` predivide/postdivide semantics)."""
-    axes = []
-    for a in axis_names:
-        try:
-            lax.axis_index(a)
-            axes.append(a)
-        except NameError:
-            pass
+    reference ``distributed.py:429-480`` predivide/postdivide semantics).
+
+    With ``param_spec`` (full or prefix pytree, same semantics as shard_map
+    in_specs), leaves *sharded over a data axis* (expert-parallel parameters
+    riding the data axis) are handled per-leaf: their local grads already
+    accumulate every rank's token contributions through the ``all_to_all``
+    transpose, so averaging them across that axis would mix different
+    experts — instead they are divided by the axis size so every leaf's
+    synced grad equals d(global mean loss)/d(leaf), matching the pmean
+    convention of the replicated leaves.
+    """
+    from apex_tpu.utils.sharding import (
+        bound_axes,
+        broadcast_spec,
+        spec_axis_names,
+    )
+
+    axes = bound_axes(axis_names)
     if not axes:
         return grads
-    return jax.tree.map(lambda g: lax.pmean(g, tuple(axes)), grads)
+    if param_spec is None:
+        return jax.tree.map(lambda g: lax.pmean(g, axes), grads)
+
+    def one(g, spec):
+        used = spec_axis_names(spec)
+        rest = tuple(a for a in axes if a not in used)
+        if rest:
+            g = lax.pmean(g, rest)
+        for a in axes:
+            if a in used:
+                g = g / lax.axis_size(a)
+        return g
+
+    g_leaves, treedef = jax.tree_util.tree_flatten(grads)
+    spec_leaves = broadcast_spec(param_spec, grads)
+    return jax.tree_util.tree_unflatten(
+        treedef, [one(g, s) for g, s in zip(g_leaves, spec_leaves)])
 
 
 def make_train_step(
@@ -99,7 +126,7 @@ def make_train_step(
                     idx = 0
                 rng = jax.random.fold_in(rng, idx)
         loss, grads = jax.value_and_grad(loss_fn)(params, batch, rng)
-        grads = sync_data_parallel_grads(grads, grad_sync_axes)
+        grads = sync_data_parallel_grads(grads, grad_sync_axes, param_spec)
         loss = sync_data_parallel_grads(loss, data_axes)
         new_params, new_state = optimizer.step(grads, params, opt_state)
         return new_params, new_state, loss

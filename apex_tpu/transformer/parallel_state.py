@@ -38,6 +38,7 @@ TENSOR_AXIS = "tensor"
 MESH_AXIS_NAMES = (DATA_AXIS, PIPELINE_AXIS, CONTEXT_AXIS, TENSOR_AXIS)
 
 _MESH: Optional[Mesh] = None
+_NUM_SLICES: int = 1
 
 # Interleaved-schedule virtual pipeline state
 # (reference: parallel_state.py:675-696).
@@ -54,6 +55,7 @@ def initialize_model_parallel(
     virtual_pipeline_model_parallel_size: Optional[int] = None,
     context_parallel_size: int = 1,
     *,
+    num_slices: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
     """Build and install the global mesh.
@@ -61,8 +63,25 @@ def initialize_model_parallel(
     Data-parallel size is inferred as ``n_devices // (tp * pp * cp)``, exactly
     as the reference infers ``data_parallel_size`` from the world size
     (``apex/transformer/parallel_state.py:213-222``).
+
+    ``num_slices > 1`` declares a multi-slice (DCN-connected) topology — the
+    TPU analog of the reference's hybrid IB/socket NCCL group construction
+    keyed on ``NUM_GPUS_PER_IB_BLOCK`` (``parallel_state.py:108-153``).
+    Invariants enforced:
+
+    - the model axes (pipeline/context/tensor) must fit inside ONE slice, so
+      their latency-sensitive collectives ride ICI only;
+    - the data axis is laid out DCN-major: data coordinate ``d`` lives on
+      slice ``d // (dp_per_slice)``, so the gradient all-reduce decomposes
+      into fast intra-slice ICI segments plus the unavoidable cross-slice
+      DCN hop (XLA performs this decomposition when the layout permits it).
+
+    On real multi-slice hardware the per-slice device sets come from each
+    device's ``slice_index``; elsewhere (virtual CPU meshes, single slice)
+    the enumeration order of ``jax.devices()`` — process/slice-major — is
+    used as the slice layout.
     """
-    global _MESH, _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE
+    global _MESH, _NUM_SLICES, _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE
     devs = list(devices) if devices is not None else jax.devices()
     n = len(devs)
     tp, pp, cp = tensor_model_parallel_size, pipeline_model_parallel_size, context_parallel_size
@@ -73,11 +92,56 @@ def initialize_model_parallel(
             f"({tp}) x pipeline_model_parallel_size ({pp}) x context_parallel_size ({cp})"
         )
     dp = n // denom
-    dev_array = np.array(devs).reshape(dp, pp, cp, tp)
+    if num_slices < 1:
+        raise ValueError(f"num_slices must be >= 1, got {num_slices}")
+    if num_slices > 1:
+        if n % num_slices:
+            raise RuntimeError(
+                f"device count ({n}) is not divisible by num_slices "
+                f"({num_slices})")
+        per_slice = n // num_slices
+        if per_slice % denom:
+            raise RuntimeError(
+                f"model-parallel block (tp {tp} x pp {pp} x cp {cp} = "
+                f"{denom}) does not fit evenly in one slice ({per_slice} "
+                f"devices): model axes must never cross the DCN boundary")
+        # group devices by slice (DCN-major): physical slice_index when the
+        # platform exposes it, enumeration order otherwise
+        if all(getattr(d, "slice_index", None) is not None for d in devs):
+            from collections import Counter
+            counts = Counter(d.slice_index for d in devs)
+            if len(counts) != num_slices or set(counts.values()) != {per_slice}:
+                raise RuntimeError(
+                    f"num_slices={num_slices} needs {per_slice} devices on "
+                    f"each physical slice, but the device set spans "
+                    f"{dict(sorted(counts.items()))} (slice_index -> count); "
+                    "an uneven layout would let model axes cross the DCN "
+                    "boundary")
+            order = sorted(range(n), key=lambda i: (devs[i].slice_index, i))
+            devs = [devs[i] for i in order]
+        dev_array = np.array(devs).reshape(dp, pp, cp, tp)
+    else:
+        dev_array = np.array(devs).reshape(dp, pp, cp, tp)
     _MESH = Mesh(dev_array, MESH_AXIS_NAMES)
+    _NUM_SLICES = num_slices
     if virtual_pipeline_model_parallel_size is not None:
         _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE = virtual_pipeline_model_parallel_size
     return _MESH
+
+
+def get_num_slices() -> int:
+    """Declared DCN slice count of the current mesh (1 = single slice)."""
+    return _NUM_SLICES
+
+
+def get_data_parallel_dcn_size() -> int:
+    """Cross-slice (DCN) factor of the data axis."""
+    return _NUM_SLICES
+
+
+def get_data_parallel_ici_size() -> int:
+    """Intra-slice (ICI) factor of the data axis."""
+    return get_data_parallel_world_size() // _NUM_SLICES
 
 
 def model_parallel_is_initialized() -> bool:
@@ -95,9 +159,10 @@ def get_mesh() -> Mesh:
 
 def destroy_model_parallel() -> None:
     """Reference: ``parallel_state.py:761-792``."""
-    global _MESH, _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK
+    global _MESH, _NUM_SLICES, _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK
     global _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE
     _MESH = None
+    _NUM_SLICES = 1
     _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK = None
     _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE = None
     _FAKE_SIZES.clear()
@@ -223,6 +288,29 @@ def get_virtual_pipeline_model_parallel_world_size() -> Optional[int]:
 def set_virtual_pipeline_model_parallel_world_size(size: Optional[int]) -> None:
     global _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE
     _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE = size
+
+
+# ---------------------------------------------------------------------------
+# fp8 amax reduction (reference parallel_state.py:280-292 builds one
+# TP x DP process group per pipeline stage when use_fp8_ is set)
+# ---------------------------------------------------------------------------
+
+def amax_reduction_axes(include_pipeline: bool = False) -> tuple:
+    """Mesh axes an fp8 amax reduction spans.
+
+    The reference's ``_AMAX_REDUCTION_GROUP`` covers ``tensor x data`` ranks
+    within one pipeline stage (``parallel_state.py:284-292``): every rank
+    holding replicas or shards of the *same* layer's tensors must agree on
+    the delayed-scaling factors. The mesh translation is all axes except
+    ``pipeline`` (different stages hold different layers; pass
+    ``include_pipeline=True`` to force globally uniform scales anyway).
+    Returns the axis names; reduce with ``lax.pmax(amax, axes)`` inside
+    ``shard_map`` (see :mod:`apex_tpu.amp.fp8`).
+    """
+    axes = [DATA_AXIS, CONTEXT_AXIS, TENSOR_AXIS]
+    if include_pipeline:
+        axes.insert(1, PIPELINE_AXIS)
+    return tuple(axes)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,149 @@
+"""fp8 delayed-scaling scaffolding tests.
+
+The reference's fp8 story is the AMAX reduction process group
+(``apex/transformer/parallel_state.py:280-292``, TP x DP per pipeline
+stage); here that group is a set of mesh axes and the reduction is a
+``lax.pmax``. These tests pin (a) the mesh-axis translation — every rank in
+the amax group computes the identical scale, pipeline stages stay
+independent — and (b) the delayed-scaling recipe math.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.amp import fp8
+from apex_tpu.transformer import parallel_state
+
+
+class TestRecipe:
+    def test_scale_from_history_max(self):
+        state = fp8.init_fp8_state(["w"], fp8.Fp8Recipe(amax_history_len=4))
+        r = fp8.Fp8Recipe(amax_history_len=4)
+        for amax in (2.0, 8.0, 4.0):
+            state = fp8.update_fp8_state(
+                state, {"w": jnp.asarray(amax)}, r, axis_names=())
+        # window max = 8 -> scale = 448 / 8
+        np.testing.assert_allclose(float(state["w"]["scale"]), 448.0 / 8.0)
+        np.testing.assert_allclose(
+            np.asarray(state["w"]["amax_history"])[:3], [4.0, 8.0, 2.0])
+
+    def test_most_recent_and_margin(self):
+        r = fp8.Fp8Recipe(amax_history_len=4, amax_compute_algo="most_recent",
+                          margin=1)
+        state = fp8.init_fp8_state(["w"], r)
+        for amax in (8.0, 2.0):
+            state = fp8.update_fp8_state(state, {"w": jnp.asarray(amax)}, r,
+                                         axis_names=())
+        np.testing.assert_allclose(float(state["w"]["scale"]),
+                                   448.0 / (2.0 * 2.0))
+
+    def test_zero_amax_keeps_scale(self):
+        r = fp8.Fp8Recipe(amax_history_len=2)
+        state = fp8.init_fp8_state(["w"], r)
+        state = fp8.update_fp8_state(state, {"w": jnp.asarray(0.0)}, r,
+                                     axis_names=())
+        np.testing.assert_allclose(float(state["w"]["scale"]), 1.0)
+
+    def test_bwd_dtype_range(self):
+        r = fp8.Fp8Recipe(amax_history_len=1)
+        state = fp8.init_fp8_state(["g"], r)
+        state = fp8.update_fp8_state(state, {"g": jnp.asarray(2.0)}, r,
+                                     axis_names=(),
+                                     dtypes={"g": r.bwd_dtype})
+        np.testing.assert_allclose(float(state["g"]["scale"]), 57344.0 / 2.0)
+
+    def test_qdq_roundtrip_error_bounded(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (64, 64)) * 3.0
+        scale = jnp.asarray(448.0 / float(jnp.max(jnp.abs(x))))
+        y = fp8.qdq(x, scale, fp8.E4M3)
+        assert y.dtype == x.dtype
+        # e4m3 has 3 mantissa bits -> relative step 2^-3; scaled to amax
+        err = np.max(np.abs(np.asarray(y - x)))
+        assert err <= float(jnp.max(jnp.abs(x))) / 8.0
+        # and fp8 rounding genuinely happened
+        assert not np.allclose(np.asarray(y), np.asarray(x), atol=1e-6)
+
+
+class TestAmaxReductionMesh:
+    def test_axes_exclude_pipeline(self):
+        axes = parallel_state.amax_reduction_axes()
+        assert "pipeline" not in axes
+        assert set(axes) == {"data", "context", "tensor"}
+        assert "pipeline" in parallel_state.amax_reduction_axes(
+            include_pipeline=True)
+
+    def test_scales_agree_within_group_and_differ_across_stages(self):
+        parallel_state.destroy_model_parallel()
+        mesh = parallel_state.initialize_model_parallel(
+            tensor_model_parallel_size=2, pipeline_model_parallel_size=2)
+        r = fp8.Fp8Recipe(amax_history_len=2)
+
+        def per_rank(x):
+            # per-rank distinct activations; stages see different tensors
+            state = fp8.init_fp8_state(["h"], r)
+            state = fp8.update_fp8_state(state, {"h": fp8.compute_amax(x)}, r)
+            return state["h"]["scale"].reshape(1, 1, 1)
+
+        # amax on (dp, pp, tp) rank = crafted so the group max differs per
+        # pipeline stage: stage 0 sees max 4, stage 1 sees max 16
+        x = jnp.asarray([[[1.0, 4.0], [2.0, 16.0]],
+                         [[3.0, 2.0], [8.0, 1.0]]])   # [dp, pp, tp]
+        scales = jax.jit(jax.shard_map(
+            per_rank, mesh=mesh,
+            in_specs=P("data", "pipeline", "tensor"),
+            out_specs=P("data", "pipeline", "tensor"),
+            check_vma=False))(x[..., None])
+        scales = np.asarray(scales).reshape(2, 2, 2)
+        # within each pipeline stage: all dp x tp ranks agree
+        np.testing.assert_allclose(scales[:, 0, :], 448.0 / 4.0)
+        np.testing.assert_allclose(scales[:, 1, :], 448.0 / 16.0)
+        parallel_state.destroy_model_parallel()
+
+    def test_unsharded_is_identity(self):
+        a = {"w": jnp.asarray(3.0)}
+        out = fp8.reduce_amaxes(a, ("data", "tensor"))
+        np.testing.assert_allclose(float(out["w"]), 3.0)
+
+
+class TestMultiSliceMesh:
+    def test_dcn_major_data_axis(self):
+        parallel_state.destroy_model_parallel()
+        mesh = parallel_state.initialize_model_parallel(
+            tensor_model_parallel_size=2, num_slices=2)
+        assert parallel_state.get_num_slices() == 2
+        assert parallel_state.get_data_parallel_world_size() == 4
+        assert parallel_state.get_data_parallel_dcn_size() == 2
+        assert parallel_state.get_data_parallel_ici_size() == 2
+        # model-axis groups never cross the slice boundary: with 8 devices
+        # in enumeration order, slice = id // 4
+        devs = mesh.devices          # [dp, pp, cp, tp]
+        per_slice = 4
+        for d in range(devs.shape[0]):
+            block = devs[d].reshape(-1)
+            slices = {dev.id // per_slice for dev in block}
+            assert len(slices) == 1, (
+                f"data coord {d} spans slices {slices}")
+        # DCN-major: data coords 0,1 on slice 0; 2,3 on slice 1
+        slice_of = [devs[d, 0, 0, 0].id // per_slice
+                    for d in range(devs.shape[0])]
+        assert slice_of == [0, 0, 1, 1]
+        parallel_state.destroy_model_parallel()
+
+    def test_model_axes_cannot_cross_dcn(self):
+        import pytest
+
+        parallel_state.destroy_model_parallel()
+        with pytest.raises(RuntimeError, match="DCN"):
+            parallel_state.initialize_model_parallel(
+                tensor_model_parallel_size=8, num_slices=2)
+        parallel_state.destroy_model_parallel()
+
+    def test_indivisible_slices_rejected(self):
+        import pytest
+
+        parallel_state.destroy_model_parallel()
+        with pytest.raises(RuntimeError, match="num_slices"):
+            parallel_state.initialize_model_parallel(num_slices=3)
+        parallel_state.destroy_model_parallel()

@@ -159,6 +159,79 @@ class TestExpertParallel:
         parallel_state.destroy_model_parallel()
 
 
+class TestExpertParallelTraining:
+    """Whole-model EP-over-DP training: expert params sharded over the data
+    axis must train identically to the dense unsharded model — pins the
+    spec-aware gradient sync (expert grads divided by the data-axis size
+    instead of pmean'd, which would mix different experts)."""
+
+    def test_ep_training_matches_dense(self):
+        from apex_tpu.models import GPTModel, TransformerConfig
+        from apex_tpu.optimizers import FusedAdam
+        from apex_tpu.training import make_train_step
+
+        cfg = dict(num_layers=2, hidden_size=32, num_attention_heads=4,
+                   vocab_size=64, max_position_embeddings=32,
+                   hidden_dropout=0.0, attention_dropout=0.0,
+                   num_moe_experts=8,       # divisible by the dp=8 axis
+                   moe_capacity_factor=8.0,   # = num_experts -> no drops
+                   # the aux loss is a nonlinear function of per-shard token
+                   # statistics, so its pmean differs from the global-batch
+                   # value; zero it for exact loss parity
+                   moe_aux_loss_weight=0.0)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, 64)
+        labels = jax.random.randint(jax.random.PRNGKey(2), (8, 16), 0, 64)
+
+        # dense reference, unsharded
+        parallel_state.destroy_model_parallel()
+        ref_model = GPTModel(TransformerConfig(**cfg))
+        params = ref_model.init(jax.random.PRNGKey(0))
+        opt = FusedAdam(lr=1e-2)
+        p_ref, s_ref = params, opt.init(params)
+        ref_losses = []
+
+        @jax.jit
+        def ref_step(p, s):
+            loss, g = jax.value_and_grad(
+                lambda p: ref_model.apply(p, tokens, labels))(p)
+            p, s = opt.step(g, p, s)
+            return p, s, loss
+
+        for _ in range(3):
+            p_ref, s_ref, loss = ref_step(p_ref, s_ref)
+            ref_losses.append(float(loss))
+
+        # EP over the data axis on the 8-device mesh
+        mesh = parallel_state.initialize_model_parallel()   # dp = 8
+        ep_model = GPTModel(TransformerConfig(**cfg, moe_expert_axis="data"))
+        opt2 = FusedAdam(lr=1e-2)
+        p_ep, s_ep = params, opt2.init(params)
+        step = make_train_step(
+            lambda p, b, rng: ep_model.apply(p, b["tokens"], b["labels"]),
+            opt2, mesh, ep_model.spec(),
+            {"tokens": P("data"), "labels": P("data")},
+            opt_state_spec=opt2.state_spec(params, ep_model.spec()))
+        ep_losses = []
+        for _ in range(3):
+            p_ep, s_ep, loss = step(p_ep, s_ep,
+                                    {"tokens": tokens, "labels": labels},
+                                    None)
+            ep_losses.append(float(loss))
+        np.testing.assert_allclose(ep_losses, ref_losses, rtol=2e-5)
+        parallel_state.destroy_model_parallel()
+
+    def test_zero_rejects_data_sharded_params(self):
+        from apex_tpu.optimizers import DistributedFusedAdam
+
+        parallel_state.destroy_model_parallel()
+        parallel_state.initialize_model_parallel()
+        params = {"w": jnp.zeros((8, 4))}
+        opt = DistributedFusedAdam(lr=1e-3, num_shards=8)
+        with pytest.raises(NotImplementedError, match="ZeRO axis"):
+            opt.init(params, {"w": P("data", None)})
+        parallel_state.destroy_model_parallel()
+
+
 class TestMoETransformer:
     """MoE wired into the transformer stack (TransformerConfig.num_moe_experts)."""
 

@@ -151,3 +151,58 @@ def test_syncbn_process_groups_sub_axis():
     # global BN (sync over both axes) would instead leave opposite-signed
     # group means ~ +-2.5/std; assert we did NOT do that
     assert abs(float(y[:8].mean() - y[8:].mean())) < 0.2
+
+
+class TestSpecAwareGradSync:
+    """sync_data_parallel_grads with param_spec: prefix pytrees (the same
+    prefix semantics shard_map in_specs accept) and data-sharded leaves."""
+
+    def test_prefix_spec_accepted(self):
+        from apex_tpu.training import sync_data_parallel_grads
+
+        parallel_state.destroy_model_parallel()
+        mesh = parallel_state.initialize_model_parallel()   # data = 8
+        grads = {"block": {"w": jnp.ones((8, 4)), "b": jnp.ones((4,))},
+                 "head": jnp.ones((4, 2))}
+        # prefix spec: one entry covers the whole nested "block" subtree
+        spec = {"block": P(), "head": P()}
+
+        def per_rank(g):
+            g = jax.tree.map(
+                lambda x: x * (1.0 + jax.lax.axis_index("data")), g)
+            return sync_data_parallel_grads(g, ("data",), spec)
+
+        out = jax.jit(jax.shard_map(
+            per_rank, mesh=mesh, in_specs=(jax.tree.map(lambda _: P(), grads),),
+            out_specs=jax.tree.map(lambda _: P(), grads),
+            check_vma=False))(grads)
+        # pmean of (1..8) = 4.5 for every replicated leaf
+        jax.tree.map(
+            lambda x: np.testing.assert_allclose(np.asarray(x), 4.5),
+            out)
+        parallel_state.destroy_model_parallel()
+
+    def test_data_sharded_leaf_divided_not_averaged(self):
+        from apex_tpu.training import sync_data_parallel_grads
+
+        parallel_state.destroy_model_parallel()
+        mesh = parallel_state.initialize_model_parallel()
+        grads = {"expert": jnp.ones((8, 4)), "shared": jnp.ones((8, 4))}
+        spec = {"expert": P("data", None), "shared": P()}
+
+        def per_rank(g):
+            g = jax.tree.map(
+                lambda x: x * (1.0 + jax.lax.axis_index("data")), g)
+            return sync_data_parallel_grads(g, ("data",), spec)
+
+        out = jax.jit(jax.shard_map(
+            per_rank, mesh=mesh,
+            in_specs=({"expert": P("data", None), "shared": P()},),
+            out_specs={"expert": P("data", None), "shared": P()},
+            check_vma=False))(grads)
+        # sharded leaf: rank r's rows scaled by (1+r)/8, no cross-rank mixing
+        expert = np.asarray(out["expert"])
+        for r in range(8):
+            np.testing.assert_allclose(expert[r], (1.0 + r) / 8.0)
+        np.testing.assert_allclose(np.asarray(out["shared"]), 4.5)
+        parallel_state.destroy_model_parallel()
