@@ -114,8 +114,10 @@ def _new_scale(history: jax.Array, old_scale: jax.Array,
     amax = (jnp.max(history) if recipe.amax_compute_algo == "max"
             else history[0])
     sf = fp8_max(dtype) / (amax * (2.0 ** recipe.margin))
-    # amax == 0 (nothing observed yet) keeps the previous scale
-    return jnp.where((amax > 0.0) & jnp.isfinite(sf), sf, old_scale)
+    # amax == 0 (nothing observed yet) or non-finite keeps the previous
+    # scale; requiring sf > 0 also rejects amax = inf -> sf = 0.0
+    return jnp.where(jnp.isfinite(amax) & (sf > 0.0) & jnp.isfinite(sf),
+                     sf, old_scale)
 
 
 def update_fp8_state(state: Dict[str, Any], amaxes: Dict[str, jax.Array],
@@ -132,8 +134,14 @@ def update_fp8_state(state: Dict[str, Any], amaxes: Dict[str, jax.Array],
     amaxes = reduce_amaxes(amaxes, axis_names)
     new = {}
     for name, s in state.items():
+        # overflow steps record amax=inf; storing it would pin the window
+        # max at inf (scale frozen for the whole history) and a naive
+        # fp8_max/inf = 0.0 scale would NaN every dequantize — record 0
+        # instead (TE behavior: non-finite amaxes don't update the scale)
+        a = amaxes[name]
+        a = jnp.where(jnp.isfinite(a), a, 0.0)
         hist = jnp.roll(s["amax_history"], 1)
-        hist = hist.at[0].set(amaxes[name])
+        hist = hist.at[0].set(a)
         dt = (dtypes or {}).get(name, recipe.fwd_dtype)
         new[name] = {
             "amax_history": hist,

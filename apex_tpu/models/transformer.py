@@ -737,10 +737,14 @@ class ParallelTransformerLayer:
         if c.num_moe_experts:
             moe_rng = (None if rngs[1] is None
                        else jax.random.fold_in(rngs[1], 1))
+            # drop-free capacity only for single-token decode steps (tiny
+            # per-step token counts make factor-based capacity drop tokens
+            # batch-size-dependently); batched prefill keeps the factor rule
+            # — cap = tokens there would blow dispatch up to [T, E, T]
             mlp_out, aux = self.mlp.apply(
                 params["mlp"], x.astype(c.compute_dtype),
                 rng=moe_rng, deterministic=deterministic,
-                drop_free=kv_cache is not None)
+                drop_free=kv_cache is not None and x.shape[0] == 1)
         else:
             mlp_out = self.mlp.apply(params["mlp"], x.astype(c.compute_dtype))
             aux = None

@@ -3,33 +3,48 @@
 Reference: ``apex/transformer/pipeline_parallel/schedules/
 fwd_bwd_pipelining_without_interleaving.py:241-597`` — warmup forwards,
 steady-state 1F1B with fused ``send_forward_recv_backward``, cooldown
-backwards, all driven eagerly per-rank with NCCL p2p.
+backwards, all driven eagerly per-rank with NCCL p2p. The defining property
+of 1F1B is its memory bound: each stage holds at most O(pipeline-depth)
+in-flight activations, *independent of the number of microbatches*.
 
-TPU design: the forward pipeline is a ``lax.scan`` over ``M + S - 1`` ticks.
-Per tick every stage applies its layer chunk to the activation it holds, then
-the whole ring does one ``ppermute`` shift (exactly the lock-step p2p pattern
-of the reference's steady state). Stage 0 injects microbatch ``t`` at tick
-``t``; stage ``S-1``'s output at tick ``t`` is microbatch ``t - (S-1)`` and is
-collected into an output buffer. The loss is computed once, batched over all
-collected microbatch outputs, masked to the last stage, and ``psum``-reduced.
+TPU design — synchronous 1F1B under one ``lax.scan``:
 
-The backward schedule is **derived, not written**: ``jax.grad`` through the
-scan produces the reverse pipeline (the VJP of ``ppermute`` is the opposite
-ring shift), with per-tick stage recompute under ``jax.checkpoint`` bounding
-live activations — the role 1F1B's in-flight-microbatch cap plays in the
-reference.
+Every tick, every stage does one forward AND one backward (for different
+microbatches), then the ring does one ``ppermute`` in each direction
+(activations stage i -> i+1, cotangents i+1 -> i) — the lock-step statement
+of the reference's fused ``send_forward_recv_backward`` steady state. The
+wavefront schedule on stage ``i`` of ``S``:
 
-Stages run redundant compute during bubble ticks (zeros flow through); that is
-the pipeline bubble made explicit — the same ``(S-1)/M`` overhead the
-reference pays in idle waits.
+- forward of microbatch ``m`` at tick ``t = m + i``;
+- backward of microbatch ``m`` at tick ``t = m + 2(S-1) - i``
+  (the loss cotangent is born on the last stage at ``m + S - 1`` and rides
+  ``S-1-i`` reverse hops back).
+
+Total ticks ``T = M + 2(S-1)`` — the same ``2(S-1)``-tick bubble as the
+reference's warmup+cooldown. Stage ``i`` keeps a circular stash of its
+in-flight *input* activations, at most ``2(S-1)+1`` entries — the in-flight
+cap (the reference's ``num_warmup_microbatches`` bound, ``:241-597``);
+memory is flat in M. The backward recomputes each stage forward from the
+stashed input (``jax.vjp``) — full activation recompute, the
+``tensor_parallel/random.py:~240-311`` checkpoint story, traded for the
+O(S) memory bound.
+
+Because the backward is *explicit* (grads accumulated in the same scan), the
+whole schedule is wrapped in ``jax.custom_vjp``: ``loss_fn`` still composes
+with ``jax.value_and_grad``/``make_train_step``, but differentiation returns
+the 1F1B-accumulated grads instead of autodiffing through the scan (which
+would buffer O(M) carries). Forward-only calls run a lean forward pipeline
+with streamed losses (no stash, no vjps).
 """
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from apex_tpu.transformer.parallel_state import PIPELINE_AXIS
@@ -47,17 +62,38 @@ def _index_microbatch(batch: Any, m) -> Any:
         lambda x: lax.dynamic_index_in_dim(x, m, 0, keepdims=False), batch)
 
 
-from functools import partial
+def _select(pred, a, b):
+    return jax.tree.map(lambda x, y: jnp.where(pred, x, y), a, b)
+
+
+def _zeros_of(tree):
+    return jax.tree.map(jnp.zeros_like, tree)
+
+
+def _zero_cotangent(batch):
+    """Cotangents for the (non-differentiable) batch: float0 for integer
+    leaves, zeros for float leaves."""
+    def one(x):
+        if jnp.issubdtype(x.dtype, jnp.inexact):
+            return jnp.zeros_like(x)
+        return np.zeros(x.shape, jax.dtypes.float0)
+    return jax.tree.map(one, batch)
+
+
+def _axis_info(axis_name: str):
+    pipelined = axis_bound(axis_name)
+    S = lax.axis_size(axis_name) if pipelined else 1
+    i = lax.axis_index(axis_name) if pipelined else 0
+    return pipelined, S, i
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(1,))
 def _broadcast_last_stage_loss(x, axis_name: str):
     """psum in the forward (replicating the last stage's masked loss to every
-    rank), identity in the backward.
-
-    A plain ``psum`` here would S-fold the gradients: per-rank autodiff seeds
-    a cotangent of 1.0 on *every* rank's (identical) output and psum's
-    transpose sums them. The last-stage mask already routes the single real
+    rank), identity in the backward. Used by the autodiff-derived interleaved
+    schedule: a plain ``psum`` would S-fold the gradients — per-rank autodiff
+    seeds a cotangent of 1.0 on every rank's (identical) output and psum's
+    transpose sums them; the last-stage mask already routes the single real
     cotangent, so the broadcast must be gradient-transparent."""
     return lax.psum(x, axis_name)
 
@@ -82,73 +118,160 @@ def make_pipelined_loss_fn(
     axis_name: str = PIPELINE_AXIS,
     remat: bool = True,
 ) -> Callable:
-    """Build ``loss_fn(params, batch) -> scalar`` running the pipeline.
+    """Build ``loss_fn(params, batch) -> scalar`` running the 1F1B pipeline.
 
     Args:
       preprocess_fn: ``(params, microbatch) -> hidden`` — the first-stage
-        input transform (embedding). Evaluated batched over all microbatches
-        up front; only stage 0's copy feeds the pipeline (other stages'
-        results carry zero gradient through the injection select).
+        input transform (embedding). Runs one microbatch per tick; only
+        stage 0's result feeds the pipeline (its backward is seeded only on
+        stage 0).
       stage_fn: ``(params, hidden, tick) -> hidden`` — applies this rank's
         layer chunk. Must be shape-preserving (homogeneous stages, the same
         constraint the reference's ``tensor_shape`` argument encodes).
-      postprocess_fn: ``(params, hidden, microbatch) -> scalar`` — final norm
-        + head + loss for one microbatch. Evaluated batched after the loop;
-        only the last stage's value survives the mask.
+        ``tick`` identifies the forward slot for dropout-stream purposes;
+        the backward recompute replays the identical tick value.
+      postprocess_fn: ``(params, hidden, microbatch) -> scalar`` — final
+        norm + head + loss for one microbatch, streamed on the last stage.
       num_microbatches: M. Must be known statically (it sizes the scan).
-      remat: wrap ``stage_fn`` in ``jax.checkpoint`` so the backward pipeline
-        recomputes stage activations instead of storing every tick's
-        intermediates (the activation-recompute story of
-        ``tensor_parallel/random.py:~240-311``).
+      remat: accepted for API parity; the 1F1B backward *always* recomputes
+        stage activations from the stashed inputs (that recompute is what
+        buys the O(pipeline-depth) memory bound).
 
     The returned function must run inside ``shard_map`` with ``axis_name``
-    bound (at world size 1 it degrades to sequential microbatching).
+    bound (at world size 1 it degrades to sequential microbatching with
+    per-microbatch backward — same flat memory). It composes with
+    ``jax.value_and_grad``: differentiation returns the explicitly
+    accumulated 1F1B grads via ``jax.custom_vjp``.
     """
+    del remat  # the backward always recomputes; see docstring
     M = num_microbatches
 
-    def loss_fn(params, batch):
-        staged = jax.checkpoint(stage_fn) if remat else stage_fn
+    # -- forward-only pipeline (primal when not differentiated) -------------
 
-        pipelined = axis_bound(axis_name)
-        S = lax.axis_size(axis_name) if pipelined else 1
-        i = lax.axis_index(axis_name) if pipelined else 0
-
-        # Embed all microbatches batched (one big MXU-friendly gather) rather
-        # than per tick.
-        injected = jax.vmap(lambda mb: preprocess_fn(params, mb))(batch)
-        state0 = jax.tree.map(lambda x: jnp.zeros_like(x[0]), injected)
-        outbuf0 = jax.tree.map(jnp.zeros_like, injected)
+    def _forward_only(params, batch):
+        pipelined, S, i = _axis_info(axis_name)
+        mb0 = _index_microbatch(batch, 0)
+        h_shape = jax.eval_shape(preprocess_fn, params, mb0)
+        state0 = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), h_shape)
 
         def tick(carry, t):
-            state, outbuf = carry
-            m_in = jnp.clip(t, 0, M - 1)
-            inj = _index_microbatch(injected, m_in)
-            h = (jax.tree.map(lambda a, b: jnp.where(i == 0, a, b), inj, state)
-                 if pipelined else inj)
-            y = staged(params, h, t)
-            # stage S-1's tick-t output is microbatch t-(S-1); bubble ticks
-            # (m_out < 0) write garbage into slot 0, overwritten at t = S-1.
-            m_out = jnp.clip(t - (S - 1), 0, M - 1)
-            outbuf = jax.tree.map(
-                lambda buf, leaf: lax.dynamic_update_index_in_dim(
-                    buf, leaf, m_out, 0), outbuf, y)
+            state, lacc = carry
+            m_f = t - i
+            mb_f = _index_microbatch(batch, jnp.clip(m_f, 0, M - 1))
+            h0 = preprocess_fn(params, mb_f)
+            h_in = _select(i == 0, h0, state) if pipelined else h0
+            y = stage_fn(params, h_in, t)
+            m_out = t - (S - 1)
+            mb_out = _index_microbatch(batch, jnp.clip(m_out, 0, M - 1))
+            l = postprocess_fn(params, y, mb_out)
+            take = (i == S - 1) & (m_out >= 0) & (m_out < M)
+            lacc = lacc + jnp.where(take, l.astype(jnp.float32), 0.0)
             state = ring_shift(y, axis_name=axis_name) if pipelined else y
-            return (state, outbuf), None
+            return (state, lacc), None
 
-        (_, outbuf), _ = lax.scan(
-            tick, (state0, outbuf0), jnp.arange(M + S - 1))
+        (_, lacc), _ = lax.scan(
+            tick, (state0, jnp.zeros((), jnp.float32)),
+            jnp.arange(M + S - 1))
+        loss = lacc / M
+        # only the last stage accumulated real losses; psum replicates
+        # (reference: losses live on the last stage only, ``:597``)
+        return lax.psum(loss, axis_name) if pipelined else loss
 
-        losses = jax.vmap(
-            lambda y, mb: postprocess_fn(params, y, mb))(outbuf, batch)
-        local = jnp.mean(losses)
-        if not pipelined:
-            return local
-        # only the last stage holds real outputs; broadcast the masked value
-        # so every rank returns the same scalar (reference: losses live on
-        # the last stage only, ``:597``, then are broadcast by the caller).
-        return _broadcast_last_stage_loss(
-            jnp.where(i == S - 1, local, 0.0), axis_name)
+    # -- fused forward+backward 1F1B (differentiation path) -----------------
 
+    def _fwd_bwd(params, batch):
+        pipelined, S, i = _axis_info(axis_name)
+        B = 2 * (S - 1) + 1            # in-flight input-activation cap
+        drain = 2 * (S - 1)            # bubble ticks (warmup + cooldown)
+        mb0 = _index_microbatch(batch, 0)
+        h_shape = jax.eval_shape(preprocess_fn, params, mb0)
+        zeros_h = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), h_shape)
+        stash0 = jax.tree.map(
+            lambda s: jnp.zeros((B,) + s.shape, s.dtype), h_shape)
+        gacc0 = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+
+        def tick(carry, t):
+            fwd_state, bwd_state, stash, gacc, lacc = carry
+
+            # ---- forward half: microbatch m_f = t - i ----
+            m_f = t - i
+            fwd_valid = (m_f >= 0) & (m_f < M)
+            mb_f = _index_microbatch(batch, jnp.clip(m_f, 0, M - 1))
+            h0 = preprocess_fn(params, mb_f)
+            h_in = _select(i == 0, h0, fwd_state) if pipelined else h0
+            slot_f = jnp.clip(m_f, 0, None) % B
+            written = jax.tree.map(
+                lambda s, h: lax.dynamic_update_index_in_dim(s, h, slot_f, 0),
+                stash, h_in)
+            stash = _select(fwd_valid, written, stash)
+            y = stage_fn(params, h_in, t)
+
+            # ---- backward half: microbatch m_b = t - 2(S-1) + i ----
+            m_b = t - drain + i
+            bwd_valid = (m_b >= 0) & (m_b < M)
+            mb_b = _index_microbatch(batch, jnp.clip(m_b, 0, M - 1))
+            slot_b = jnp.clip(m_b, 0, None) % B
+            h_in_b = jax.tree.map(
+                lambda s: lax.dynamic_index_in_dim(s, slot_b, 0,
+                                                   keepdims=False), stash)
+            tick_b = m_b + i           # the tick this forward originally ran
+            y_b, vjp_stage = jax.vjp(
+                lambda p, h: stage_fn(p, h, tick_b), params, h_in_b)
+            l, vjp_post = jax.vjp(
+                lambda h, p: postprocess_fn(p, h, mb_b), y_b, params)
+            # loss cotangent born on the last stage (1/M for the mean)
+            seed = jnp.where((i == S - 1) & bwd_valid,
+                             1.0 / M, 0.0).astype(l.dtype)
+            g_y_post, g_p_post = vjp_post(seed)
+            g_y = (_select(i == S - 1, g_y_post, bwd_state)
+                   if pipelined else g_y_post)
+            g_y = _select(bwd_valid, g_y, _zeros_of(g_y))
+            g_p_stage, g_h = vjp_stage(g_y)
+            # preprocess backward, seeded only on stage 0
+            _, vjp_pre = jax.vjp(lambda p: preprocess_fn(p, mb_b), params)
+            (g_p_pre,) = vjp_pre(_select(i == 0, g_h, _zeros_of(g_h))
+                                 if pipelined else g_h)
+
+            gacc = jax.tree.map(
+                lambda a, s_, p_, e: a + s_.astype(jnp.float32)
+                + p_.astype(jnp.float32) + e.astype(jnp.float32),
+                gacc, g_p_stage, g_p_post, g_p_pre)
+            lacc = lacc + jnp.where((i == S - 1) & bwd_valid,
+                                    l.astype(jnp.float32), 0.0)
+
+            # ---- ring comms: activations down, cotangents up ----
+            if pipelined:
+                fwd_state = ring_shift(y, axis_name=axis_name)
+                bwd_state = ring_shift(g_h, reverse=True, axis_name=axis_name)
+            return (fwd_state, bwd_state, stash, gacc, lacc), None
+
+        carry0 = (zeros_h, zeros_h, stash0, gacc0,
+                  jnp.zeros((), jnp.float32))
+        (_, _, _, gacc, lacc), _ = lax.scan(
+            tick, carry0, jnp.arange(M + drain))
+        loss = lacc / M
+        if pipelined:
+            loss = lax.psum(loss, axis_name)
+        grads = jax.tree.map(lambda g, p: g.astype(p.dtype), gacc, params)
+        return loss, grads
+
+    # -- custom_vjp wiring ---------------------------------------------------
+
+    @jax.custom_vjp
+    def loss_fn(params, batch):
+        return _forward_only(params, batch)
+
+    def _vjp_fwd(params, batch):
+        loss, grads = _fwd_bwd(params, batch)
+        return loss, (grads, batch)
+
+    def _vjp_bwd(res, g):
+        grads, batch = res
+        return (jax.tree.map(lambda x: x * g.astype(x.dtype), grads),
+                _zero_cotangent(batch))
+
+    loss_fn.defvjp(_vjp_fwd, _vjp_bwd)
     return loss_fn
 
 

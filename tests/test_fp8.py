@@ -46,6 +46,27 @@ class TestRecipe:
                                      axis_names=())
         np.testing.assert_allclose(float(state["w"]["scale"]), 1.0)
 
+    def test_inf_amax_keeps_scale_and_recovers(self):
+        # an overflow step (amax = inf) must neither zero the scale (NaN
+        # dequantize) nor pin the window at inf
+        r = fp8.Fp8Recipe(amax_history_len=2)
+        state = fp8.init_fp8_state(["w"], r)
+        state = fp8.update_fp8_state(state, {"w": jnp.asarray(4.0)}, r,
+                                     axis_names=())
+        s_before = float(state["w"]["scale"])
+        state = fp8.update_fp8_state(state, {"w": jnp.asarray(jnp.inf)}, r,
+                                     axis_names=())
+        assert float(state["w"]["scale"]) == s_before
+        y = fp8.dequantize(fp8.quantize(jnp.ones(4), state["w"]["scale"]),
+                           state["w"]["scale"])
+        assert np.isfinite(np.asarray(y)).all()
+        # window rolls the sanitized 0 out; next finite amax takes over
+        state = fp8.update_fp8_state(state, {"w": jnp.asarray(2.0)}, r,
+                                     axis_names=())
+        state = fp8.update_fp8_state(state, {"w": jnp.asarray(2.0)}, r,
+                                     axis_names=())
+        np.testing.assert_allclose(float(state["w"]["scale"]), 448.0 / 2.0)
+
     def test_bwd_dtype_range(self):
         r = fp8.Fp8Recipe(amax_history_len=1)
         state = fp8.init_fp8_state(["g"], r)
