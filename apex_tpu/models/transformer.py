@@ -143,10 +143,9 @@ class TransformerConfig:
                     f"{self.sliding_window}")
             if self.attn_mask_type != AttnMaskType.causal:
                 raise ValueError("sliding_window requires causal attention")
-            if self.context_parallel_method:
-                raise NotImplementedError(
-                    "sliding_window under context parallelism is not wired "
-                    "up (the window spans shard boundaries)")
+            # under context parallelism the window is exact across chunk
+            # boundaries: ring masks with global positions, ulysses windows
+            # the gathered full sequence
 
     @property
     def ffn_size(self) -> int:
@@ -440,10 +439,11 @@ class ParallelAttention:
             raise NotImplementedError(
                 "context parallelism shards the self-attention sequence; "
                 "cross-attention K/V come from the (unsharded) encoder")
-        if k.shape[1] != q.shape[1] and c.context_parallel_method:
-            # GQA under context parallelism: materialize the head broadcast
-            # (ring/ulysses shard over heads); the flash and grouped-einsum
-            # paths below read shared K/V natively instead
+        if (k.shape[1] != q.shape[1]
+                and c.context_parallel_method == "ulysses"):
+            # GQA under Ulysses: the all-to-all swaps the head dim, so K/V
+            # must match the query head count. The ring path reads shared
+            # K/V natively (only the small kv-head chunks rotate).
             rep = q.shape[1] // k.shape[1]
             k = jnp.repeat(k, rep, axis=1)
             v = jnp.repeat(v, rep, axis=1)
@@ -459,14 +459,9 @@ class ParallelAttention:
                     "without attention dropout or explicit masks")
             fn = {"ring": ring_attention,
                   "ulysses": ulysses_attention}[c.context_parallel_method]
-            kw = {"kv_lengths": kv_lengths} if (
-                c.context_parallel_method == "ulysses"
-                and kv_lengths is not None) else {}
-            if c.context_parallel_method == "ring" and kv_lengths is not None:
-                raise NotImplementedError(
-                    "ring attention does not take kv_lengths; pad-free "
-                    "varlen rides the ulysses path")
-            return fn(q, k, v, causal=causal, axis_name=c.context_axis, **kw)
+            # kv_lengths are GLOBAL valid lengths for both CP methods
+            return fn(q, k, v, causal=causal, axis_name=c.context_axis,
+                      kv_lengths=kv_lengths, sliding_window=window)
         use_flash = attention_mask is None and (
             deterministic or c.attention_dropout == 0.0)
         if use_flash:

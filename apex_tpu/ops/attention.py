@@ -38,7 +38,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from apex_tpu.ops._support import pallas_interpret, round_up, use_pallas
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "flash_chunk_fwd", "flash_chunk_bwd"]
 
 _NEG_INF = -1e30
 # lse sentinel for fully-masked (padding) query rows: exp(s - BIG) == 0 in the
@@ -53,30 +53,44 @@ _DEFAULT_BLOCK_Q = 512
 _DEFAULT_BLOCK_K = 512
 
 
-def _mask_block(s, i, j, bq, bk, sq, sk, kvl, causal, window=None):
+def _mask_block(s, i, j, bq, bk, sk, kvl, causal, window, q_off, k_off):
     """Mask a (bq, bk) logit block; returns (masked logits, validity).
-    ``window``: sliding-window span (keep the last ``window`` keys incl.
-    self; requires causal)."""
-    row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + i * bq
+
+    Positions are GLOBAL: query row ``r`` sits at ``r + q_off``, key column
+    ``c`` at ``c + k_off``. Plain (single-chunk) attention passes
+    ``q_off = sk - sq, k_off = 0``, reproducing the standard causal offset;
+    context-parallel ring chunks pass ``q_off = rank*sc, k_off = j*sc`` so
+    cross-chunk causality, sliding windows, and varlen limits are exact
+    across shard boundaries. ``kvl`` (valid key length) is in global
+    positions. ``window``: keep the last ``window`` keys incl. self
+    (requires causal)."""
+    row_g = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + i * bq + q_off
     col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + j * bk
-    limit = jnp.minimum(sk, kvl) if kvl is not None else sk
-    valid = col < limit
+    col_g = col + k_off
+    valid = col < sk                       # local K padding bound
+    if kvl is not None:
+        valid = jnp.logical_and(valid, col_g < kvl)
     if causal:
-        valid = jnp.logical_and(valid, col <= row + (sk - sq))
+        valid = jnp.logical_and(valid, col_g <= row_g)
     if window is not None:
-        valid = jnp.logical_and(valid, col > row + (sk - sq) - window)
+        valid = jnp.logical_and(valid, col_g > row_g - window)
     return jnp.where(valid, s, _NEG_INF), valid
 
 
-def _causal_block_skip(i, j, bq, bk, sq, sk, window=None):
+def _causal_block_skip(i, j, bq, bk, causal, window, q_off, k_off):
     """True when k-block j has at least one unmasked column for q-block i
     (below the causal diagonal AND, with a sliding window, not entirely in
     the masked-out far past — the skipped far-past blocks are what makes
-    window attention O(s*window) instead of O(s^2))."""
-    keep = j * bk <= i * bq + bq - 1 + (sk - sq)
+    window attention O(s*window) instead of O(s^2), and what makes
+    fully-future ring chunks near-free). Offsets as in :func:`_mask_block`;
+    with traced offsets (ring chunks) the result is a traced bool for
+    ``pl.when``."""
+    keep = True
+    if causal:
+        keep = j * bk + k_off <= i * bq + bq - 1 + q_off
     if window is not None:
         keep = jnp.logical_and(
-            keep, j * bk + bk - 1 > i * bq + (sk - sq) - window)
+            keep, j * bk + bk - 1 + k_off > i * bq + q_off - window)
     return keep
 
 
@@ -84,10 +98,11 @@ def _causal_block_skip(i, j, bq, bk, sq, sk, window=None):
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(kvl_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
-                m_scr, l_scr, acc_scr, *, scale, bq, bk, nk, sq, sk,
+def _fwd_kernel(offs_ref, kvl_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, scale, bq, bk, nk, sk,
                 causal, window=None):
     b, i, j = pl.program_id(0), pl.program_id(2), pl.program_id(3)
+    q_off, k_off = offs_ref[0], offs_ref[1]
 
     @pl.when(j == 0)
     def _init():
@@ -102,8 +117,8 @@ def _fwd_kernel(kvl_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         kvl = kvl_ref[b] if kvl_ref is not None else None
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        s, valid = _mask_block(s, i, j, bq, bk, sq, sk, kvl, causal,
-                               window)
+        s, valid = _mask_block(s, i, j, bq, bk, sk, kvl, causal, window,
+                               q_off, k_off)
         m_prev = m_scr[:, :1]
         l_prev = l_scr[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -116,7 +131,8 @@ def _fwd_kernel(kvl_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
 
     if causal or window is not None:
-        pl.when(_causal_block_skip(i, j, bq, bk, sq, sk, window))(_step)
+        pl.when(_causal_block_skip(i, j, bq, bk, causal, window,
+                                   q_off, k_off))(_step)
     else:
         _step()
 
@@ -130,30 +146,42 @@ def _fwd_kernel(kvl_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0, 0] = jnp.broadcast_to(lse.T, lse_ref.shape[2:])
 
 
+def _offsets(q_off, k_off, sq, sk):
+    """SMEM [q_off, k_off] operand; defaults to the classic queries-at-the-
+    end convention (``q_off = sk - sq``)."""
+    if q_off is None:
+        q_off = sk - sq
+    if k_off is None:
+        k_off = 0
+    return jnp.stack([jnp.asarray(q_off, jnp.int32),
+                      jnp.asarray(k_off, jnp.int32)])
+
+
 def _run_fwd(q, k, v, kv_lengths, scale, causal, sq, sk, bq, bk,
-             group=1, window=None):
+             group=1, window=None, q_off=None, k_off=None):
     """q/k/v padded to block multiples; returns padded (o, lse). ``group``
     q heads share each K/V head (GQA/MQA): the K/V index maps divide the
     head coordinate, so grouped heads reread the same blocks instead of the
-    caller materializing a broadcast copy in HBM."""
+    caller materializing a broadcast copy in HBM. ``q_off``/``k_off``:
+    global-position offsets (traced OK) — see :func:`_mask_block`."""
     batch, heads, sqp, dp = q.shape
     skp = k.shape[2]
     nq, nk = sqp // bq, skp // bk
     grid = (batch, heads, nq, nk)
     kvl_spec = []
-    args = []
+    args = [_offsets(q_off, k_off, sq, sk)]
     if kv_lengths is not None:
         kvl_spec = [pl.BlockSpec(memory_space=pltpu.SMEM)]
-        args = [kv_lengths.astype(jnp.int32)]
+        args.append(kv_lengths.astype(jnp.int32))
     kernel = functools.partial(
         _fwd_kernel if kv_lengths is not None else
-        (lambda *r, **kw: _fwd_kernel(None, *r, **kw)),
-        scale=scale, bq=bq, bk=bk, nk=nk, sq=sq, sk=sk, causal=causal,
+        (lambda offs, *r, **kw: _fwd_kernel(offs, None, *r, **kw)),
+        scale=scale, bq=bq, bk=bk, nk=nk, sk=sk, causal=causal,
         window=window)
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=kvl_spec + [
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)] + kvl_spec + [
             pl.BlockSpec((1, 1, bq, dp), lambda b, h, i, j: (b, h, i, 0)),
             pl.BlockSpec((1, 1, bk, dp),
                          lambda b, h, i, j: (b, h // group, j, 0)),
@@ -185,10 +213,11 @@ def _run_fwd(q, k, v, kv_lengths, scale, causal, sq, sk, bq, bk,
 # backward
 # ---------------------------------------------------------------------------
 
-def _dq_kernel(kvl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-               dq_ref, dq_scr, *, scale, bq, bk, nk, sq, sk, causal,
+def _dq_kernel(offs_ref, kvl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+               delta_ref, dq_ref, dq_scr, *, scale, bq, bk, nk, sk, causal,
                window=None):
     b, i, j = pl.program_id(0), pl.program_id(2), pl.program_id(3)
+    q_off, k_off = offs_ref[0], offs_ref[1]
 
     @pl.when(j == 0)
     def _init():
@@ -204,7 +233,8 @@ def _dq_kernel(kvl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         kvl = kvl_ref[b] if kvl_ref is not None else None
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        s, _ = _mask_block(s, i, j, bq, bk, sq, sk, kvl, causal, window)
+        s, _ = _mask_block(s, i, j, bq, bk, sk, kvl, causal, window,
+                           q_off, k_off)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -213,7 +243,8 @@ def _dq_kernel(kvl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds.astype(k.dtype), k, preferred_element_type=jnp.float32)
 
     if causal or window is not None:
-        pl.when(_causal_block_skip(i, j, bq, bk, sq, sk, window))(_step)
+        pl.when(_causal_block_skip(i, j, bq, bk, causal, window,
+                                   q_off, k_off))(_step)
     else:
         _step()
 
@@ -222,15 +253,16 @@ def _dq_kernel(kvl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(kvl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, dk_scr, dv_scr,
-                *, scale, bq, bk, nq, sq, sk, causal, group=1,
+def _dkv_kernel(offs_ref, kvl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                delta_ref, dk_ref, dv_ref, dk_scr, dv_scr,
+                *, scale, bq, bk, nq, sk, causal, group=1,
                 window=None):
     # grid: (batch, kv_heads, nk, group * nq) — the trailing dim walks every
     # (q head in group, q block) pair so dk/dv accumulate over the whole
     # query group in one scratch pass (GQA/MQA backward)
     b, j, t = pl.program_id(0), pl.program_id(2), pl.program_id(3)
     i = t % nq
+    q_off, k_off = offs_ref[0], offs_ref[1]
 
     @pl.when(t == 0)
     def _init():
@@ -247,7 +279,8 @@ def _dkv_kernel(kvl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         kvl = kvl_ref[b] if kvl_ref is not None else None
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        s, _ = _mask_block(s, i, j, bq, bk, sq, sk, kvl, causal, window)
+        s, _ = _mask_block(s, i, j, bq, bk, sk, kvl, causal, window,
+                           q_off, k_off)
         p = jnp.exp(s - lse)
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -260,7 +293,8 @@ def _dkv_kernel(kvl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32)
 
     if causal or window is not None:
-        pl.when(_causal_block_skip(i, j, bq, bk, sq, sk, window))(_step)
+        pl.when(_causal_block_skip(i, j, bq, bk, causal, window,
+                                   q_off, k_off))(_step)
     else:
         _step()
 
@@ -271,19 +305,21 @@ def _dkv_kernel(kvl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _run_bwd(q, k, v, do, lse, delta, kv_lengths, scale, causal,
-             sq, sk, bq, bk, group=1, window=None):
+             sq, sk, bq, bk, group=1, window=None, q_off=None, k_off=None):
     batch, heads, sqp, dp = q.shape
     kv_heads, skp = k.shape[1], k.shape[2]
     nq, nk = sqp // bq, skp // bk
-    kvl_spec, args = [], []
+    kvl_spec = []
+    args = [_offsets(q_off, k_off, sq, sk)]
     if kv_lengths is not None:
         kvl_spec = [pl.BlockSpec(memory_space=pltpu.SMEM)]
-        args = [kv_lengths.astype(jnp.int32)]
+        args.append(kv_lengths.astype(jnp.int32))
 
     def wrap(fn, **kw):
         if kv_lengths is not None:
             return functools.partial(fn, **kw)
-        return functools.partial(lambda *r, **k2: fn(None, *r, **k2), **kw)
+        return functools.partial(
+            lambda offs, *r, **k2: fn(offs, None, *r, **k2), **kw)
 
     row_specs = [
         pl.BlockSpec((1, 1, bq, dp), lambda b, h, i, j: (b, h, i, 0)),   # q
@@ -296,10 +332,11 @@ def _run_bwd(q, k, v, do, lse, delta, kv_lengths, scale, causal,
         pl.BlockSpec((1, 1, 1, bq), lambda b, h, i, j: (b, h, 0, i)),    # delta
     ]
     dq = pl.pallas_call(
-        wrap(_dq_kernel, scale=scale, bq=bq, bk=bk, nk=nk, sq=sq, sk=sk,
+        wrap(_dq_kernel, scale=scale, bq=bq, bk=bk, nk=nk, sk=sk,
              causal=causal, window=window),
         grid=(batch, heads, nq, nk),
-        in_specs=kvl_spec + row_specs,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)] + kvl_spec
+        + row_specs,
         out_specs=pl.BlockSpec((1, 1, bq, dp), lambda b, h, i, j: (b, h, i, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, dp), jnp.float32)],
@@ -323,10 +360,11 @@ def _run_bwd(q, k, v, do, lse, delta, kv_lengths, scale, causal,
                      lambda b, h, j, t: (b, h * group + t // nq, 0, t % nq)),
     ]
     dk, dv = pl.pallas_call(
-        wrap(_dkv_kernel, scale=scale, bq=bq, bk=bk, nq=nq, sq=sq, sk=sk,
+        wrap(_dkv_kernel, scale=scale, bq=bq, bk=bk, nq=nq, sk=sk,
              causal=causal, group=group, window=window),
         grid=(batch, kv_heads, nk, group * nq),
-        in_specs=kvl_spec + col_specs,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)] + kvl_spec
+        + col_specs,
         out_specs=[
             pl.BlockSpec((1, 1, bk, dp), lambda b, h, j, i: (b, h, j, 0)),
             pl.BlockSpec((1, 1, bk, dp), lambda b, h, j, i: (b, h, j, 0)),
@@ -414,6 +452,139 @@ def _flash_vjp_bwd(scale, causal, bq, bk, window, res, do):
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+# ---------------------------------------------------------------------------
+# chunk-level API (ring attention building blocks)
+# ---------------------------------------------------------------------------
+# Non-differentiable raw kernels over one (q chunk, kv chunk) pair with
+# GLOBAL position offsets: ring attention composes these per hop and defines
+# its own vjp (apex_tpu/ops/ring_attention.py). The lse convention matches
+# the flash kernel: fp32 ``m + log(l)`` per row, ``_LSE_PAD`` for rows with
+# no visible keys.
+
+def _chunk_valid(sq, sk, q_start, k_start, kv_lengths, causal, window):
+    row_g = q_start + jnp.arange(sq)[:, None]
+    col_g = k_start + jnp.arange(sk)[None, :]
+    valid = jnp.ones((sq, sk), bool)
+    if causal:
+        valid = jnp.logical_and(valid, col_g <= row_g)
+    if window is not None:
+        valid = jnp.logical_and(valid, col_g > row_g - window)
+    valid = valid[None, None]                            # [1, 1, sq, sk]
+    if kv_lengths is not None:
+        valid = jnp.logical_and(
+            valid, (col_g[None] < kv_lengths[:, None, None])[:, None])
+    return valid
+
+
+def _chunk_reference_fwd(q, k, v, kv_lengths, scale, causal, window,
+                         q_start, k_start):
+    group = q.shape[1] // k.shape[1]
+    if group > 1:
+        k = jnp.repeat(k, group, axis=1)
+        v = jnp.repeat(v, group, axis=1)
+    sq, sk = q.shape[2], k.shape[2]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    valid = _chunk_valid(sq, sk, q_start, k_start, kv_lengths, causal,
+                         window)
+    s = jnp.where(valid, s, _NEG_INF)
+    any_valid = jnp.any(valid, axis=-1)
+    m = jnp.max(s, axis=-1)
+    l = jnp.sum(jnp.exp(s - m[..., None]), axis=-1)
+    lse = jnp.where(any_valid, m + jnp.log(l), _LSE_PAD)
+    p = jnp.where(any_valid[..., None], jnp.exp(s - lse[..., None]), 0.0)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return o.astype(q.dtype), lse
+
+
+def _chunk_reference_bwd(q, k, v, do, lse, delta, kv_lengths, scale,
+                         causal, window, q_start, k_start):
+    group = q.shape[1] // k.shape[1]
+    kf = jnp.repeat(k, group, axis=1) if group > 1 else k
+    vf = jnp.repeat(v, group, axis=1) if group > 1 else v
+    sq, sk = q.shape[2], kf.shape[2]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   kf.astype(jnp.float32)) * scale
+    valid = _chunk_valid(sq, sk, q_start, k_start, kv_lengths, causal,
+                         window)
+    s = jnp.where(valid, s, _NEG_INF)
+    p = jnp.exp(s - lse[..., None].astype(jnp.float32))
+    do32 = do.astype(jnp.float32)
+    dv = jnp.einsum("bhqk,bhqd->bhkd", p, do32)
+    dp = jnp.einsum("bhqd,bhkd->bhqk", do32, vf.astype(jnp.float32))
+    ds = p * (dp - delta[..., None].astype(jnp.float32))
+    dq = scale * jnp.einsum("bhqk,bhkd->bhqd", ds, kf.astype(jnp.float32))
+    dk = scale * jnp.einsum("bhqk,bhqd->bhkd", ds, q.astype(jnp.float32))
+    if group > 1:
+        b, _, skc, d = k.shape
+        dk = dk.reshape(b, k.shape[1], group, skc, d).sum(2)
+        dv = dv.reshape(b, k.shape[1], group, skc, d).sum(2)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+def flash_chunk_fwd(q, k, v, *, q_start, k_start, causal=False, window=None,
+                    kv_lengths=None, softmax_scale=None,
+                    block_q: int = _DEFAULT_BLOCK_Q,
+                    block_k: int = _DEFAULT_BLOCK_K):
+    """One flash forward over a (q chunk, kv chunk) pair -> ``(o, lse)``.
+
+    ``q_start``/``k_start`` (traced OK) place the chunks in GLOBAL sequence
+    positions, so causal masks, sliding windows, and ``kv_lengths`` (global
+    valid length) are exact across chunk boundaries; a chunk that is
+    entirely in the causal future costs only grid overhead (every k-block
+    is skipped) and returns ``lse = _LSE_PAD`` rows that merge with weight
+    zero."""
+    scale = float(softmax_scale if softmax_scale is not None
+                  else 1.0 / np.sqrt(q.shape[-1]))
+    if not use_pallas():
+        return _chunk_reference_fwd(q, k, v, kv_lengths, scale, causal,
+                                    window, q_start, k_start)
+    sq, d = q.shape[2], q.shape[3]
+    sk = k.shape[2]
+    bq = min(block_q, round_up(sq, 8))
+    bk = min(block_k, round_up(sk, 128))
+    group = q.shape[1] // k.shape[1]
+    qp, kp, vp = _pad_qkv(q, k, v, bq, bk)
+    o, lse = _run_fwd(qp, kp, vp, kv_lengths, scale, causal, sq, sk, bq, bk,
+                      group=group, window=window, q_off=q_start,
+                      k_off=k_start)
+    return o[:, :, :sq, :d], lse[:, :, :sq]
+
+
+def flash_chunk_bwd(q, k, v, do, lse, delta, *, q_start, k_start,
+                    causal=False, window=None, kv_lengths=None,
+                    softmax_scale=None,
+                    block_q: int = _DEFAULT_BLOCK_Q,
+                    block_k: int = _DEFAULT_BLOCK_K):
+    """Flash backward over one chunk pair with the GLOBAL ``lse``/``delta``
+    residuals -> ``(dq, dk, dv)``. Exactness rests on the flash-backward
+    decomposition: with the global log-sum-exp, per-chunk contributions sum
+    to the full-sequence gradients."""
+    scale = float(softmax_scale if softmax_scale is not None
+                  else 1.0 / np.sqrt(q.shape[-1]))
+    if not use_pallas():
+        return _chunk_reference_bwd(q, k, v, do, lse, delta, kv_lengths,
+                                    scale, causal, window, q_start, k_start)
+    sq, d = q.shape[2], q.shape[3]
+    sk = k.shape[2]
+    bq = min(block_q, round_up(sq, 8))
+    bk = min(block_k, round_up(sk, 128))
+    group = q.shape[1] // k.shape[1]
+    sqp = round_up(sq, bq)
+    qp, kp, vp = _pad_qkv(q, k, v, bq, bk)
+    dop = jnp.pad(do, ((0, 0), (0, 0), (0, sqp - sq),
+                       (0, qp.shape[3] - d)))
+    deltap = jnp.pad(delta, ((0, 0), (0, 0), (0, sqp - sq)))
+    lsep = jnp.pad(lse, ((0, 0), (0, 0), (0, sqp - sq)),
+                   constant_values=_LSE_PAD)
+    dq, dk, dv = _run_bwd(qp, kp, vp, dop, lsep[:, :, None, :],
+                          deltap[:, :, None, :], kv_lengths, scale, causal,
+                          sq, sk, bq, bk, group=group, window=window,
+                          q_off=q_start, k_off=k_start)
+    return (dq[:, :, :sq, :d], dk[:, :, :k.shape[2], :d],
+            dv[:, :, :k.shape[2], :d])
 
 
 # ---------------------------------------------------------------------------

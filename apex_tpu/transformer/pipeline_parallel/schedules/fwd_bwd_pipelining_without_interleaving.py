@@ -190,9 +190,34 @@ def make_pipelined_loss_fn(
             lambda s: jnp.zeros((B,) + s.shape, s.dtype), h_shape)
         gacc0 = jax.tree.map(
             lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        # input (batch) cotangents exist only for float leaves (regression
+        # targets, float features); the common all-int GPT batch allocates
+        # nothing here
+        has_float_batch = any(
+            jnp.issubdtype(x.dtype, jnp.inexact)
+            for x in jax.tree_util.tree_leaves(batch))
+        bgacc0 = (jax.tree.map(
+            lambda x: (jnp.zeros(x.shape, jnp.float32)
+                       if jnp.issubdtype(x.dtype, jnp.inexact) else
+                       jnp.zeros((), jnp.float32)), batch)
+            if has_float_batch else None)
+
+        def _accum_batch_grads(bgacc, m, *contribs):
+            """Add per-microbatch input-grad contributions into slot ``m``
+            of the [M, ...]-shaped accumulators (int leaves hold a dummy
+            scalar; their float0 cotangents are dropped)."""
+            def one(acc, x, *gs):
+                if not jnp.issubdtype(x.dtype, jnp.inexact):
+                    return acc
+                total = sum((g.astype(jnp.float32) for g in gs),
+                            jnp.zeros(x.shape[1:], jnp.float32))
+                cur = lax.dynamic_index_in_dim(acc, m, 0, keepdims=False)
+                return lax.dynamic_update_index_in_dim(
+                    acc, cur + total, m, 0)
+            return jax.tree.map(one, bgacc, batch, *contribs)
 
         def tick(carry, t):
-            fwd_state, bwd_state, stash, gacc, lacc = carry
+            fwd_state, bwd_state, stash, gacc, bgacc, lacc = carry
 
             # ---- forward half: microbatch m_f = t - i ----
             m_f = t - i
@@ -210,7 +235,8 @@ def make_pipelined_loss_fn(
             # ---- backward half: microbatch m_b = t - 2(S-1) + i ----
             m_b = t - drain + i
             bwd_valid = (m_b >= 0) & (m_b < M)
-            mb_b = _index_microbatch(batch, jnp.clip(m_b, 0, M - 1))
+            m_b_c = jnp.clip(m_b, 0, M - 1)
+            mb_b = _index_microbatch(batch, m_b_c)
             slot_b = jnp.clip(m_b, 0, None) % B
             h_in_b = jax.tree.map(
                 lambda s: lax.dynamic_index_in_dim(s, slot_b, 0,
@@ -219,24 +245,29 @@ def make_pipelined_loss_fn(
             y_b, vjp_stage = jax.vjp(
                 lambda p, h: stage_fn(p, h, tick_b), params, h_in_b)
             l, vjp_post = jax.vjp(
-                lambda h, p: postprocess_fn(p, h, mb_b), y_b, params)
+                lambda h, p, mb: postprocess_fn(p, h, mb), y_b, params, mb_b)
             # loss cotangent born on the last stage (1/M for the mean)
             seed = jnp.where((i == S - 1) & bwd_valid,
                              1.0 / M, 0.0).astype(l.dtype)
-            g_y_post, g_p_post = vjp_post(seed)
+            g_y_post, g_p_post, g_mb_post = vjp_post(seed)
             g_y = (_select(i == S - 1, g_y_post, bwd_state)
                    if pipelined else g_y_post)
             g_y = _select(bwd_valid, g_y, _zeros_of(g_y))
             g_p_stage, g_h = vjp_stage(g_y)
             # preprocess backward, seeded only on stage 0
-            _, vjp_pre = jax.vjp(lambda p: preprocess_fn(p, mb_b), params)
-            (g_p_pre,) = vjp_pre(_select(i == 0, g_h, _zeros_of(g_h))
-                                 if pipelined else g_h)
+            _, vjp_pre = jax.vjp(
+                lambda p, mb: preprocess_fn(p, mb), params, mb_b)
+            g_p_pre, g_mb_pre = vjp_pre(
+                _select(i == 0, g_h, _zeros_of(g_h)) if pipelined else g_h)
 
             gacc = jax.tree.map(
                 lambda a, s_, p_, e: a + s_.astype(jnp.float32)
                 + p_.astype(jnp.float32) + e.astype(jnp.float32),
                 gacc, g_p_stage, g_p_post, g_p_pre)
+            if bgacc is not None:
+                # contributions are zero off-stage/off-schedule (linear vjps
+                # of zero seeds); bubble ticks add zeros into a clipped slot
+                bgacc = _accum_batch_grads(bgacc, m_b_c, g_mb_pre, g_mb_post)
             lacc = lacc + jnp.where((i == S - 1) & bwd_valid,
                                     l.astype(jnp.float32), 0.0)
 
@@ -244,17 +275,25 @@ def make_pipelined_loss_fn(
             if pipelined:
                 fwd_state = ring_shift(y, axis_name=axis_name)
                 bwd_state = ring_shift(g_h, reverse=True, axis_name=axis_name)
-            return (fwd_state, bwd_state, stash, gacc, lacc), None
+            return (fwd_state, bwd_state, stash, gacc, bgacc, lacc), None
 
-        carry0 = (zeros_h, zeros_h, stash0, gacc0,
+        carry0 = (zeros_h, zeros_h, stash0, gacc0, bgacc0,
                   jnp.zeros((), jnp.float32))
-        (_, _, _, gacc, lacc), _ = lax.scan(
+        (_, _, _, gacc, bgacc, lacc), _ = lax.scan(
             tick, carry0, jnp.arange(M + drain))
         loss = lacc / M
         if pipelined:
             loss = lax.psum(loss, axis_name)
         grads = jax.tree.map(lambda g, p: g.astype(p.dtype), gacc, params)
-        return loss, grads
+        if bgacc is None:
+            bgrads = None
+        else:
+            bgrads = jax.tree.map(
+                lambda a, x: (a.astype(x.dtype)
+                              if jnp.issubdtype(x.dtype, jnp.inexact)
+                              else np.zeros(x.shape, jax.dtypes.float0)),
+                bgacc, batch)
+        return loss, grads, bgrads
 
     # -- custom_vjp wiring ---------------------------------------------------
 
@@ -263,13 +302,20 @@ def make_pipelined_loss_fn(
         return _forward_only(params, batch)
 
     def _vjp_fwd(params, batch):
-        loss, grads = _fwd_bwd(params, batch)
-        return loss, (grads, batch)
+        loss, grads, bgrads = _fwd_bwd(params, batch)
+        return loss, (grads, bgrads, batch)
 
     def _vjp_bwd(res, g):
-        grads, batch = res
-        return (jax.tree.map(lambda x: x * g.astype(x.dtype), grads),
-                _zero_cotangent(batch))
+        grads, bgrads, batch = res
+        if bgrads is None:
+            bg = _zero_cotangent(batch)
+        else:
+            bg = jax.tree.map(
+                lambda x, orig: (x * g.astype(x.dtype)
+                                 if jnp.issubdtype(orig.dtype, jnp.inexact)
+                                 else x),
+                bgrads, batch)
+        return (jax.tree.map(lambda x: x * g.astype(x.dtype), grads), bg)
 
     loss_fn.defvjp(_vjp_fwd, _vjp_bwd)
     return loss_fn

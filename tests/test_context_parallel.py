@@ -164,3 +164,125 @@ class TestGPTContextParallel:
         np.testing.assert_allclose(float(loss), float(ref_loss),
                                    rtol=2e-5, atol=2e-5)
         parallel_state.destroy_model_parallel()
+
+
+class TestRingVarlenWindowGQA:
+    """Flash-blockwise ring features that close the reference 16k cap
+    (scaled_masked_softmax.h:460) with exact cross-chunk semantics."""
+
+    def _ref_and_ring(self, q, k, v, cp=4, **kw):
+        ref = flash_attention(q, k, v, causal=True, **kw)
+        parallel_state.destroy_model_parallel()
+        mesh = parallel_state.initialize_model_parallel(
+            context_parallel_size=cp)
+        out = jax.jit(jax.shard_map(
+            lambda q, k, v: ring_attention(q, k, v, causal=True, **kw),
+            mesh=mesh, in_specs=(P(None, None, "context"),) * 3,
+            out_specs=P(None, None, "context"),
+            check_vma=False))(q, k, v)
+        parallel_state.destroy_model_parallel()
+        return np.asarray(ref), np.asarray(out)
+
+    def test_kv_lengths_global_across_chunks(self):
+        q, k, v = _qkv(b=3, s=32)
+        kvl = jnp.asarray([9, 32, 17], jnp.int32)   # crosses chunk bounds
+        ref, out = self._ref_and_ring(q, k, v, kv_lengths=kvl)
+        np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+    def test_sliding_window_across_chunks(self):
+        q, k, v = _qkv(s=32)
+        # window 11 spans chunk boundaries at cp=4 (chunks of 8)
+        ref, out = self._ref_and_ring(q, k, v, sliding_window=11)
+        np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+    def test_gqa_ring(self):
+        q, _, _ = _qkv(h=4)
+        _, k, v = _qkv(h=2, key=3)
+        ref, out = self._ref_and_ring(q, k, v)
+        np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+    def test_window_grads_match(self):
+        q, k, v = _qkv(s=32)
+
+        def run(fn, sharded):
+            def loss(q, k, v):
+                o = fn(q, k, v)
+                sc = o.shape[2]
+                off = (jax.lax.axis_index("context") * sc if sharded else 0)
+                w = _weights(o.shape, off, 32)
+                l = jnp.sum(o * w)
+                return jax.lax.pmean(l, "context") if sharded else l
+            return jax.value_and_grad(loss, argnums=(0, 1, 2))
+
+        ref_loss, ref_grads = run(
+            lambda q, k, v: flash_attention(q, k, v, causal=True,
+                                            sliding_window=11), False)(q, k, v)
+        parallel_state.destroy_model_parallel()
+        mesh = parallel_state.initialize_model_parallel(
+            context_parallel_size=4)
+        loss, grads = jax.jit(jax.shard_map(
+            run(lambda q, k, v: ring_attention(q, k, v, causal=True,
+                                               sliding_window=11), True),
+            mesh=mesh, in_specs=(P(None, None, "context"),) * 3,
+            out_specs=(P(), (P(None, None, "context"),) * 3),
+            check_vma=False))(q, k, v)
+        parallel_state.destroy_model_parallel()
+        np.testing.assert_allclose(float(loss) * 4, float(ref_loss),
+                                   rtol=1e-5)
+        for g, rg in zip(grads, ref_grads):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(rg),
+                                       rtol=1e-3, atol=1e-3)
+
+
+class TestRingMemory:
+    """Ring attention's point: per-rank memory scales with the LOCAL chunk,
+    not the global sequence. Compare compiled temp memory against gather-
+    everything attention (all_gather K/V then full attention) at a long
+    sequence on the virtual mesh."""
+
+    def test_ring_temp_memory_beats_allgather(self):
+        # measured on the XLA fallback path (interpret-mode emulation
+        # buffers would dominate): the contrast here is the DESIGN —
+        # per-hop local-chunk math vs a gathered full sequence; the Pallas
+        # block-memory bound is benchmarked on real TPU
+        from apex_tpu.ops import _support
+
+        prior = os.environ.get("APEX_TPU_FORCE_PALLAS")
+        os.environ["APEX_TPU_FORCE_PALLAS"] = "off"
+        _support.pallas_mode.cache_clear()
+        b, h, s, d = 1, 2, 4096, 32
+        q = jnp.zeros((b, h, s, d), jnp.bfloat16)
+        k, v = q, q
+        parallel_state.destroy_model_parallel()
+        mesh = parallel_state.initialize_model_parallel(
+            context_parallel_size=8)
+
+        def ring(q, k, v):
+            return ring_attention(q, k, v, causal=True)
+
+        def allgather(q, k, v):
+            kg = jax.lax.all_gather(k, "context", axis=2, tiled=True)
+            vg = jax.lax.all_gather(v, "context", axis=2, tiled=True)
+            return flash_attention(q, kg, vg, causal=False)
+
+        def temp(fn):
+            f = jax.jit(jax.shard_map(
+                fn, mesh=mesh, in_specs=(P(None, None, "context"),) * 3,
+                out_specs=P(None, None, "context"), check_vma=False))
+            ma = f.lower(q, k, v).compile().memory_analysis()
+            if ma is None:
+                pytest.skip("no memory_analysis on this backend")
+            return ma.temp_size_in_bytes
+
+        try:
+            ring_b, gather_b = temp(ring), temp(allgather)
+        finally:
+            if prior is None:
+                os.environ.pop("APEX_TPU_FORCE_PALLAS", None)
+            else:
+                os.environ["APEX_TPU_FORCE_PALLAS"] = prior
+            _support.pallas_mode.cache_clear()
+            parallel_state.destroy_model_parallel()
+        assert ring_b < gather_b / 2, (
+            f"ring temp {ring_b}B not substantially below all-gather "
+            f"{gather_b}B at s={s}, cp=8")
