@@ -441,12 +441,19 @@ class ParallelAttention:
                 "cross-attention K/V come from the (unsharded) encoder")
         if (k.shape[1] != q.shape[1]
                 and c.context_parallel_method == "ulysses"):
-            # GQA under Ulysses: the all-to-all swaps the head dim, so K/V
-            # must match the query head count. The ring path reads shared
-            # K/V natively (only the small kv-head chunks rotate).
-            rep = q.shape[1] // k.shape[1]
-            k = jnp.repeat(k, rep, axis=1)
-            v = jnp.repeat(v, rep, axis=1)
+            from apex_tpu.transformer.tensor_parallel.mappings import (
+                axis_bound,
+            )
+            cp_sz = (lax.axis_size(c.context_axis)
+                     if axis_bound(c.context_axis) else 1)
+            if k.shape[1] % cp_sz:
+                # GQA under Ulysses needs kv_heads divisible by cp for the
+                # head all-to-all (grouped reads stay aligned after the
+                # swap); broadcast K/V heads only up to that. The ring path
+                # reads shared K/V natively (the small kv chunks rotate).
+                rep = q.shape[1] // k.shape[1]
+                k = jnp.repeat(k, rep, axis=1)
+                v = jnp.repeat(v, rep, axis=1)
         if c.context_parallel_method:
             from apex_tpu.ops.ring_attention import (
                 ring_attention,

@@ -127,26 +127,34 @@ def _ring_vjp_bwd(causal, window, scale, axis_name, res, do):
     # with no visible keys (merged lse keeps them at -inf)
     lse_b = jnp.where(jnp.isneginf(lse), _LSE_PAD, lse)
 
-    def hop(carry, t):
-        kc, vc, dk, dv, dq = carry
-        j = (rank - t) % cp
-        dq_j, dk_j, dv_j = flash_chunk_bwd(
+    def chunk_bwd(kc, vc, j):
+        return flash_chunk_bwd(
             q, kc, vc, do, lse_b, delta, q_start=q_start, k_start=j * sc,
             causal=causal, window=window, kv_lengths=kv_lengths,
             softmax_scale=scale)
+
+    def hop(carry, t):
+        kc, vc, dk, dv, dq = carry
+        dq_j, dk_j, dv_j = chunk_bwd(kc, vc, (rank - t) % cp)
         dq = dq + dq_j.astype(jnp.float32)
         dk = dk + dk_j.astype(jnp.float32)
         dv = dv + dv_j.astype(jnp.float32)
-        # dK/dV partials travel WITH their chunk; after cp process+rotate
-        # cycles each accumulator is back at its owner
+        # dK/dV partials travel WITH their chunk; after cp total rotations
+        # each accumulator is back at its owner
         kc, vc, dk, dv = _rotate((kc, vc, dk, dv), axis_name, cp)
         return (kc, vc, dk, dv, dq), None
 
     zeros_kv = jnp.zeros(k.shape, jnp.float32)
-    (_, _, dk, dv, dq), _ = lax.scan(
+    (kc, vc, dk, dv, dq), _ = lax.scan(
         hop, (k, v, zeros_kv, jnp.zeros(v.shape, jnp.float32),
               jnp.zeros(q.shape, jnp.float32)),
-        jnp.arange(cp))
+        jnp.arange(cp - 1))
+    # final chunk: accumulate, then rotate ONLY the accumulators home — the
+    # K/V chunks' last rotation would be discarded traffic
+    dq_j, dk_j, dv_j = chunk_bwd(kc, vc, (rank - (cp - 1)) % cp)
+    dq = dq + dq_j.astype(jnp.float32)
+    dk, dv = _rotate((dk + dk_j.astype(jnp.float32),
+                      dv + dv_j.astype(jnp.float32)), axis_name, cp)
     dkvl = (None if kv_lengths is None
             else np.zeros(kv_lengths.shape, dtype=jax.dtypes.float0))
     return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),

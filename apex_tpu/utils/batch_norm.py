@@ -28,20 +28,27 @@ def bn_init(c: int):
 def bn_apply(p, s, x, *, train: bool, momentum: float, eps: float,
              axis_name: Optional[str]):
     """NHWC batch norm; returns ``(y, new_state)``. With ``axis_name`` bound
-    the batch statistics are synchronized across that mesh axis."""
-    x32 = x.astype(jnp.float32)
+    the batch statistics are synchronized across that mesh axis.
+
+    Performance shape (v5e, RN50-sized activations): statistics are ONE
+    fused fp32 pass (sum + sum-of-squares reduced together, one ``psum``
+    for both under SyncBN) instead of the textbook two-pass
+    ``E[(x-mean)^2]``, and the normalize itself is a per-channel affine
+    ``x * a + b`` applied in the activation dtype — the big elementwise op
+    stays bf16 and fuses into the surrounding conv, only the tiny [C]
+    vectors are fp32. This is the same split the reference's Welford CUDA
+    kernels make (fp32 stats, fp16 apply; ``csrc/welford.cu``).
+    """
     if train:
-        n = jnp.asarray(x32.shape[0] * x32.shape[1] * x32.shape[2],
-                        jnp.float32)
-        total = jnp.sum(x32, axis=(0, 1, 2))
+        x32 = x.astype(jnp.float32)      # fused into the reduction by XLA
+        n = jnp.asarray(x.shape[0] * x.shape[1] * x.shape[2], jnp.float32)
+        stats = jnp.stack([jnp.sum(x32, axis=(0, 1, 2)),
+                           jnp.sum(jnp.square(x32), axis=(0, 1, 2))])
         if axis_name is not None:
-            total = lax.psum(total, axis_name)
+            stats = lax.psum(stats, axis_name)
             n = lax.psum(n, axis_name)
-        mean = total / n
-        sq = jnp.sum(jnp.square(x32 - mean), axis=(0, 1, 2))
-        if axis_name is not None:
-            sq = lax.psum(sq, axis_name)
-        var = sq / n
+        mean = stats[0] / n
+        var = jnp.maximum(stats[1] / n - jnp.square(mean), 0.0)
         new_s = {
             "mean": (1 - momentum) * s["mean"] + momentum * mean,
             # running var uses the unbiased estimate, torch BN semantics
@@ -51,5 +58,6 @@ def bn_apply(p, s, x, *, train: bool, momentum: float, eps: float,
     else:
         mean, var, new_s = s["mean"], s["var"], s
     inv = lax.rsqrt(var + eps)
-    y = (x32 - mean) * (inv * p["scale"]) + p["bias"]
-    return y.astype(x.dtype), new_s
+    a = (inv * p["scale"]).astype(x.dtype)
+    b = (p["bias"] - mean * inv * p["scale"]).astype(x.dtype)
+    return x * a + b, new_s

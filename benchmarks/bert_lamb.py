@@ -10,7 +10,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 import jax.numpy as jnp
 
-from benchmarks._harness import run
+from benchmarks._harness import run, transformer_train_flops
 from apex_tpu.models import BertModel, TransformerConfig
 from apex_tpu.optimizers import FusedLAMB
 from apex_tpu.transformer.enums import AttnMaskType
@@ -39,8 +39,11 @@ def main(batch=16, seq=512):
         params, opt_state = opt.step(grads, params, opt_state)
         return params, opt_state, loss
 
-    run("bert_base_lamb_train_tokens_per_sec_per_chip", "tokens/sec",
-        step, params, opt_state, work_per_step=batch * seq)
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    return run("bert_base_lamb_train_tokens_per_sec_per_chip", "tokens/sec",
+               step, params, opt_state, work_per_step=batch * seq,
+               model_flops_per_step=transformer_train_flops(
+                   n_params, batch * seq, 12, 768, seq, causal=False))
 
 
 if __name__ == "__main__":

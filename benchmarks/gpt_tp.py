@@ -13,7 +13,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 import jax.numpy as jnp
 
-from benchmarks._harness import run
+from benchmarks._harness import run, transformer_train_flops
 from apex_tpu.models import GPTModel, TransformerConfig
 from apex_tpu.optimizers import FusedAdam
 from apex_tpu.training import make_train_step
@@ -55,9 +55,13 @@ def main(batch=8, seq=1024):
         p, o, loss = step_fn(params, opt_state, batch_dict, None)
         return p, o, loss
 
-    run(f"gpt2_124m_tp{tp}_train_tokens_per_sec_per_chip", "tokens/sec",
-        step, params, opt_state, work_per_step=batch * seq / ndev)
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    out = run(f"gpt2_124m_tp{tp}_train_tokens_per_sec_per_chip", "tokens/sec",
+              step, params, opt_state, work_per_step=batch * seq / ndev,
+              model_flops_per_step=transformer_train_flops(
+                  n_params, batch * seq, 12, 768, seq, causal=True) / ndev)
     parallel_state.destroy_model_parallel()
+    return out
 
 
 if __name__ == "__main__":

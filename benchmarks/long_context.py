@@ -1,0 +1,61 @@
+"""Long-context benchmark: GPT training at 32k tokens on one chip.
+
+The reference's attention kernels hard-cap at 16k
+(``/root/reference/csrc/megatron/scaled_masked_softmax.h:460``); this config
+runs a full GPT-2-size training step at 2x that length through the Pallas
+flash kernel (O(seq) memory), plus a sliding-window variant
+(O(seq * window) compute). Context-parallel ring/Ulysses extend the same
+kernels across chips (``tests/test_context_parallel.py`` pins parity and
+per-rank memory; multi-chip speed needs a real mesh).
+Usage: ``PYTHONPATH=/root/repo:/root/.axon_site python benchmarks/long_context.py``
+"""
+
+import sys, os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from benchmarks._harness import run, transformer_train_flops
+from apex_tpu.models import GPTModel, TransformerConfig
+from apex_tpu.optimizers import FusedAdam
+
+
+def main(seq=32768, window=None):
+    cfg = TransformerConfig(
+        num_layers=12, hidden_size=768, num_attention_heads=12,
+        vocab_size=50304, max_position_embeddings=seq,
+        position_embedding_type="rope",
+        hidden_dropout=0.0, attention_dropout=0.0,
+        sliding_window=window,
+        recompute=True, compute_dtype=jnp.bfloat16)
+    model = GPTModel(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    opt = FusedAdam(lr=1e-4)
+    opt_state = opt.init(params)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (1, seq), 0, 50304)
+
+    @jax.jit
+    def step(params, opt_state):
+        loss, grads = jax.value_and_grad(
+            lambda p: model.apply(p, tokens, tokens))(params)
+        params, opt_state = opt.step(grads, params, opt_state)
+        return params, opt_state, loss
+
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    # attention term reflects the true window span when sliding
+    eff_span = min(window, seq) if window else seq
+    name = (f"gpt2_124m_seq32k_window{window}" if window
+            else "gpt2_124m_seq32k")
+    # full causal attention averages s/2 keys per query; a sliding window
+    # averages ~window keys (no halving)
+    return run(f"{name}_train_tokens_per_sec_per_chip", "tokens/sec",
+               step, params, opt_state, work_per_step=seq, steps=5,
+               model_flops_per_step=transformer_train_flops(
+                   n_params, seq, 12, 768, eff_span,
+                   causal=(window is None)))
+
+
+if __name__ == "__main__":
+    main()
+    main(window=1024)

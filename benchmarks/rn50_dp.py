@@ -13,12 +13,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-from benchmarks._harness import run
+from benchmarks._harness import resnet50_train_flops, run
 from apex_tpu.models import ResNet, ResNetConfig
 from apex_tpu.optimizers import FusedSGD
 
 
-def main(batch=128, image=128):
+def main(batch=256, image=224):
     devices = jax.devices()
     ndev = len(devices)
     model = ResNet(ResNetConfig(
@@ -57,9 +57,10 @@ def main(batch=128, image=128):
         p, b, o, loss = fn(params, bn_state, opt_state, x, y)
         return p, b, o, loss
 
-    run("rn50_amp_o2_dp_imgs_per_sec_per_chip", "imgs/sec",
-        step, params, bn_state, opt_state,
-        work_per_step=batch / ndev)
+    return run(f"rn50_{image}px_amp_o2_dp_imgs_per_sec_per_chip", "imgs/sec",
+               step, params, bn_state, opt_state,
+               work_per_step=batch / ndev,
+               model_flops_per_step=resnet50_train_flops(batch / ndev, image))
 
 
 if __name__ == "__main__":

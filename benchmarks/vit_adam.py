@@ -9,7 +9,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 import jax.numpy as jnp
 
-from benchmarks._harness import run
+from benchmarks._harness import run, transformer_train_flops
 from apex_tpu.models import vit_l16
 from apex_tpu.optimizers import FusedAdam
 
@@ -33,8 +33,13 @@ def main(batch=32, image=224):
         params, opt_state = opt.step(grads, params, opt_state)
         return params, opt_state, loss
 
-    run("vit_l16_adam_train_imgs_per_sec_per_chip", "imgs/sec",
-        step, params, opt_state, work_per_step=batch)
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    tokens = batch * ((image // 16) ** 2 + 1)
+    return run("vit_l16_adam_train_imgs_per_sec_per_chip", "imgs/sec",
+               step, params, opt_state, work_per_step=batch,
+               model_flops_per_step=transformer_train_flops(
+                   n_params, tokens, 24, 1024, (image // 16) ** 2 + 1,
+                   causal=False))
 
 
 if __name__ == "__main__":
