@@ -1,3 +1,13 @@
 from apex_tpu.data.loader import PrefetchLoader
+from apex_tpu.data.pipeline import (
+    disk_image_batches,
+    make_input_pipeline,
+    write_synthetic_imagenet,
+)
 
-__all__ = ["PrefetchLoader"]
+__all__ = [
+    "PrefetchLoader",
+    "disk_image_batches",
+    "make_input_pipeline",
+    "write_synthetic_imagenet",
+]

@@ -35,6 +35,11 @@ class PrefetchLoader:
       num_workers: worker threads pulling from ``batches``. With >1 worker
         the source iterator is shared behind a lock (order is then
         arrival-order, as with torch DataLoader workers).
+      map_fn: optional per-batch transform run in the worker threads
+        OUTSIDE the source lock — this is where the heavy work (decode,
+        augment, normalize) must live for ``num_workers > 1`` to buy
+        parallelism; keep the source iterator itself cheap (e.g. yield
+        indices/descriptors).
       device_put: optional function applied to each batch on the consumer
         side (e.g. ``jax.device_put`` / a sharded put); done one batch ahead
         so the transfer overlaps the previous step.
@@ -42,11 +47,13 @@ class PrefetchLoader:
 
     def __init__(self, batches: Iterable[Any] | Callable[[], Iterator[Any]],
                  *, prefetch: int = 2, num_workers: int = 1,
+                 map_fn: Optional[Callable[[Any], Any]] = None,
                  device_put: Optional[Callable[[Any], Any]] = None):
         self._make_iter = (batches if callable(batches)
                            else lambda: iter(batches))
         self.prefetch = max(1, prefetch)
         self.num_workers = max(1, num_workers)
+        self.map_fn = map_fn
         self.device_put = device_put
 
     def __iter__(self) -> Iterator[Any]:
@@ -68,7 +75,9 @@ class PrefetchLoader:
                             batch = next(src)
                         except StopIteration:
                             break
-                    tok = next(counter)
+                        tok = next(counter)
+                    if self.map_fn is not None:
+                        batch = self.map_fn(batch)   # parallel region
                     slots[tok] = batch
                     if not queue.put(tok):   # queue closed under us
                         slots.pop(tok, None)

@@ -449,9 +449,15 @@ class ParallelAttention:
             if k.shape[1] % cp_sz:
                 # GQA under Ulysses needs kv_heads divisible by cp for the
                 # head all-to-all (grouped reads stay aligned after the
-                # swap); broadcast K/V heads only up to that. The ring path
-                # reads shared K/V natively (the small kv chunks rotate).
-                rep = q.shape[1] // k.shape[1]
+                # swap); broadcast K/V heads only up to the SMALLEST such
+                # multiple — the repeat factor must also divide the query
+                # group so each repeated head serves a whole subgroup. The
+                # ring path reads shared K/V natively (small chunks rotate).
+                group = q.shape[1] // k.shape[1]
+                rep = next((r for r in range(1, group + 1)
+                            if group % r == 0
+                            and (k.shape[1] * r) % cp_sz == 0),
+                           group)   # fallback: ulysses raises its own error
                 k = jnp.repeat(k, rep, axis=1)
                 v = jnp.repeat(v, rep, axis=1)
         if c.context_parallel_method:

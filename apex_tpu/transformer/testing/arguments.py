@@ -28,6 +28,8 @@ def parse_args(extra_args_provider: Optional[Callable] = None,
     g.add_argument("--num-layers", type=int, default=2)
     g.add_argument("--hidden-size", type=int, default=128)
     g.add_argument("--num-attention-heads", type=int, default=8)
+    g.add_argument("--num-query-groups", type=int, default=None,
+                   help="GQA/MQA K/V head groups (None = MHA)")
     g.add_argument("--ffn-hidden-size", type=int, default=None)
     g.add_argument("--seq-length", type=int, default=128)
     g.add_argument("--max-position-embeddings", type=int, default=128)
@@ -36,14 +38,40 @@ def parse_args(extra_args_provider: Optional[Callable] = None,
     g.add_argument("--attention-dropout", type=float, default=0.1)
     g.add_argument("--layernorm-epsilon", type=float, default=1e-5)
     g.add_argument("--init-method-std", type=float, default=0.02)
+    g.add_argument("--position-embedding-type", type=str, default="learned",
+                   choices=["learned", "rope", "none"])
+    g.add_argument("--rotary-percent", type=float, default=1.0)
+    g.add_argument("--rotary-base", type=float, default=10000.0,
+                   help="rope theta")
+    g.add_argument("--normalization", type=str, default="layernorm",
+                   choices=["layernorm", "rmsnorm"])
+    g.add_argument("--swiglu", action="store_true",
+                   help="gated SiLU MLP (sets activation=swiglu)")
+    g.add_argument("--activation", type=str, default=None,
+                   help="explicit MLP activation (overrides --swiglu)")
+    g.add_argument("--sliding-window", type=int, default=None,
+                   help="causal local-attention span (Mistral-style)")
+
+    g = parser.add_argument_group("moe")
+    g.add_argument("--num-experts", type=int, default=None,
+                   help="SwitchMLP experts per layer (None = dense)")
+    g.add_argument("--moe-router-topk", type=int, default=1)
+    g.add_argument("--moe-capacity-factor", type=float, default=1.25)
+    g.add_argument("--moe-aux-loss-coeff", type=float, default=1e-2)
+    g.add_argument("--moe-expert-axis", type=str, default=None,
+                   help="mesh axis for expert parallelism (e.g. 'data')")
 
     g = parser.add_argument_group("parallel")
     g.add_argument("--tensor-model-parallel-size", type=int, default=1)
     g.add_argument("--pipeline-model-parallel-size", type=int, default=1)
     g.add_argument("--context-parallel-size", type=int, default=1)
+    g.add_argument("--context-parallel-method", type=str, default=None,
+                   choices=[None, "ring", "ulysses"])
     g.add_argument("--virtual-pipeline-model-parallel-size", type=int,
                    default=None)
     g.add_argument("--sequence-parallel", action="store_true")
+    g.add_argument("--num-slices", type=int, default=1,
+                   help="multi-slice (DCN) topology: data axis DCN-major")
     g.add_argument("--world-size", type=int, default=None,
                    help="defaults to jax.device_count()")
 
@@ -53,9 +81,17 @@ def parse_args(extra_args_provider: Optional[Callable] = None,
     g.add_argument("--rampup-batch-size", type=int, nargs=3, default=None,
                    metavar=("START", "INCR", "SAMPLES"))
     g.add_argument("--train-iters", type=int, default=10)
+    g.add_argument("--optimizer", type=str, default="adam",
+                   choices=["adam", "lamb", "sgd"])
     g.add_argument("--lr", type=float, default=1e-4)
+    g.add_argument("--adam-beta1", type=float, default=0.9)
+    g.add_argument("--adam-beta2", type=float, default=0.999)
+    g.add_argument("--adam-eps", type=float, default=1e-8)
+    g.add_argument("--sgd-momentum", type=float, default=0.9)
     g.add_argument("--weight-decay", type=float, default=0.01)
     g.add_argument("--clip-grad", type=float, default=1.0)
+    g.add_argument("--use-distributed-optimizer", action="store_true",
+                   help="ZeRO-sharded optimizer state over the data axis")
     g.add_argument("--seed", type=int, default=1234)
 
     g = parser.add_argument_group("precision")
@@ -66,6 +102,10 @@ def parse_args(extra_args_provider: Optional[Callable] = None,
     g.add_argument("--initial-loss-scale", type=float, default=2.0 ** 32)
     g.add_argument("--loss-scale-window", type=int, default=1000)
     g.add_argument("--hysteresis", type=int, default=2)
+    g.add_argument("--fp8", action="store_true",
+                   help="fp8 delayed-scaling qdq hooks (amp.fp8)")
+    g.add_argument("--fp8-margin", type=int, default=0)
+    g.add_argument("--fp8-amax-history-len", type=int, default=16)
 
     g = parser.add_argument_group("checkpoint/misc")
     g.add_argument("--recompute", action="store_true",
@@ -112,6 +152,21 @@ def parse_args(extra_args_provider: Optional[Callable] = None,
         ns.ffn_hidden_size = 4 * ns.hidden_size
     if ns.fp16 and ns.bf16:
         raise ValueError("--fp16 and --bf16 are mutually exclusive")
+    if ns.activation is None:
+        ns.activation = "swiglu" if ns.swiglu else "gelu"
+    if (ns.num_query_groups is not None
+            and ns.num_attention_heads % ns.num_query_groups):
+        raise ValueError(
+            f"num_attention_heads ({ns.num_attention_heads}) must be "
+            f"divisible by num_query_groups ({ns.num_query_groups})")
+    if ns.num_experts is not None and ns.moe_expert_axis == "data":
+        ep = ns.data_parallel_size
+        if ep > 1 and ns.num_experts % ep:
+            raise ValueError(
+                f"num_experts ({ns.num_experts}) must divide evenly over "
+                f"the expert axis (data, size {ep})")
+    if ns.context_parallel_size > 1 and ns.context_parallel_method is None:
+        ns.context_parallel_method = "ring"
     ns.params_dtype = "float32"
     if ns.bf16:
         ns.params_dtype = "bfloat16"
@@ -133,6 +188,7 @@ def core_transformer_config_from_args(args):
         num_layers=args.num_layers,
         hidden_size=args.hidden_size,
         num_attention_heads=args.num_attention_heads,
+        num_query_groups=args.num_query_groups,
         ffn_hidden_size=args.ffn_hidden_size,
         vocab_size=args.vocab_size,
         max_position_embeddings=args.max_position_embeddings,
@@ -140,7 +196,21 @@ def core_transformer_config_from_args(args):
         attention_dropout=args.attention_dropout,
         layernorm_epsilon=args.layernorm_epsilon,
         init_method_std=args.init_method_std,
+        position_embedding_type=args.position_embedding_type,
+        rotary_percent=args.rotary_percent,
+        rope_theta=args.rotary_base,
+        normalization=args.normalization,
+        activation=args.activation,
+        sliding_window=args.sliding_window,
         sequence_parallel=args.sequence_parallel,
+        context_parallel_method=(
+            args.context_parallel_method
+            if args.context_parallel_size > 1 else None),
+        num_moe_experts=args.num_experts,
+        moe_top_k=args.moe_router_topk,
+        moe_capacity_factor=args.moe_capacity_factor,
+        moe_aux_loss_weight=args.moe_aux_loss_coeff,
+        moe_expert_axis=args.moe_expert_axis,
         recompute=args.recompute,
         compute_dtype=compute,
     )

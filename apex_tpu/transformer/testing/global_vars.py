@@ -13,9 +13,12 @@ _GLOBAL_ARGS = None
 
 
 def set_global_variables(args=None, *, extra_args_provider=None,
-                         defaults=None, ignore_unknown_args=False):
+                         defaults=None, ignore_unknown_args=False,
+                         build_microbatch_calculator: bool = True):
     """Parse and install the global args (idempotent only via
-    :func:`destroy_global_vars`)."""
+    :func:`destroy_global_vars`), and build the microbatch-calculator
+    singleton from them (reference
+    ``global_vars.py:_build_num_microbatches_calculator``)."""
     global _GLOBAL_ARGS
     if _GLOBAL_ARGS is not None:
         raise RuntimeError("global args are already initialized")
@@ -24,6 +27,16 @@ def set_global_variables(args=None, *, extra_args_provider=None,
                           defaults=defaults,
                           ignore_unknown_args=ignore_unknown_args)
     _GLOBAL_ARGS = args
+    if build_microbatch_calculator:
+        from apex_tpu.transformer.pipeline_parallel import utils as pp_utils
+
+        pp_utils._destroy_microbatch_calculator()
+        pp_utils.setup_microbatch_calculator(
+            rank=0,
+            rampup_batch_size=args.rampup_batch_size,
+            global_batch_size=args.global_batch_size,
+            micro_batch_size=args.micro_batch_size,
+            data_parallel_size=args.data_parallel_size)
     return args
 
 
@@ -34,11 +47,48 @@ def get_args():
     return _GLOBAL_ARGS
 
 
+def get_num_microbatches() -> int:
+    """Reference ``global_vars.py:40`` — delegates to the calculator
+    singleton."""
+    from apex_tpu.transformer.pipeline_parallel import utils as pp_utils
+
+    return pp_utils.get_num_microbatches()
+
+
 def get_current_global_batch_size() -> Optional[int]:
-    args = get_args()
-    return getattr(args, "global_batch_size", None)
+    from apex_tpu.transformer.pipeline_parallel import utils as pp_utils
+
+    try:
+        return pp_utils.get_current_global_batch_size()
+    except AttributeError:
+        args = get_args()
+        return getattr(args, "global_batch_size", None)
+
+
+def get_timers():
+    """Reference ``global_vars.py:81`` — the named-timer singleton."""
+    from apex_tpu.transformer.pipeline_parallel import utils as pp_utils
+
+    return pp_utils.get_timers()
+
+
+def get_adlr_autoresume():
+    """Reference ``global_vars.py:75``; None unless an autoresume hook was
+    installed (SURVEY.md §5: the only failure-recovery integration point)."""
+    from apex_tpu.transformer.pipeline_parallel import utils as pp_utils
+
+    return pp_utils.get_autoresume()
+
+
+def get_tensorboard_writer():
+    """Reference ``global_vars.py:69``; observability rides the library
+    logger here — always None, kept for call-site parity."""
+    return None
 
 
 def destroy_global_vars() -> None:
     global _GLOBAL_ARGS
     _GLOBAL_ARGS = None
+    from apex_tpu.transformer.pipeline_parallel import utils as pp_utils
+
+    pp_utils._destroy_microbatch_calculator()

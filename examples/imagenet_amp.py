@@ -9,11 +9,18 @@ SGD with momentum, and the per-interval ``Speed`` (imgs/sec) printout of
 
 Runs on whatever devices exist: the real TPU chip (DP=1) or a virtual CPU
 mesh (``XLA_FLAGS=--xla_force_host_platform_device_count=8`` + env
-``JAX_PLATFORMS=cpu``). Data is synthetic (the reference reads ImageNet from
-disk; the input pipeline is not the capability under test).
+``JAX_PLATFORMS=cpu``).
+
+Data flows through the real input pipeline
+(:mod:`apex_tpu.data.pipeline`): ``--data-dir`` points at an on-disk
+uint8-shard dataset (materialized synthetically on first run when absent —
+swap in real ImageNet by replacing the shard reader), worker threads
+augment/normalize, the C++ token queue stages batches, and ``device_put``
+runs one batch ahead — the DALI/DataLoader prefetch role of the reference
+example.
 
 Usage: ``PYTHONPATH=/root/repo:/root/.axon_site python examples/imagenet_amp.py
-[--iters N] [--batch B] [--image-size S]``
+[--iters N] [--batch B] [--image-size S] [--data-dir DIR]``
 """
 
 import argparse
@@ -38,6 +45,10 @@ def main():
     ap.add_argument("--num-classes", type=int, default=1000)
     ap.add_argument("--lr", type=float, default=0.1)
     ap.add_argument("--print-freq", type=int, default=10)
+    ap.add_argument("--data-dir", type=str, default="/tmp/apex_tpu_imagenet",
+                    help="on-disk dataset root (synthesized when absent)")
+    ap.add_argument("--num-workers", type=int, default=2)
+    ap.add_argument("--prefetch", type=int, default=2)
     args = ap.parse_args()
 
     devices = jax.devices()
@@ -87,13 +98,23 @@ def main():
     else:
         step = jax.jit(per_rank_step, donate_argnums=(0, 1, 2))
 
-    key = jax.random.PRNGKey(1)
-    images = jax.random.normal(
-        key, (args.batch, args.image_size, args.image_size, 3), jnp.float32)
-    labels = jax.random.randint(
-        jax.random.PRNGKey(2), (args.batch,), 0, args.num_classes)
+    # real input pipeline: on-disk shards -> worker-thread augment -> C++
+    # token queue -> device_put one batch ahead (apex_tpu.data.pipeline)
+    from apex_tpu.data import make_input_pipeline, write_synthetic_imagenet
+
+    stored = max(args.image_size, int(args.image_size * 1.15))
+    write_synthetic_imagenet(
+        args.data_dir, num_shards=4,
+        per_shard=max(args.batch, 256), image_size=stored,
+        num_classes=args.num_classes)
+    loader = make_input_pipeline(
+        args.data_dir, args.batch, mesh=mesh if ndev > 1 else None,
+        crop=args.image_size, prefetch=args.prefetch,
+        num_workers=args.num_workers)
+    batches = iter(loader)
 
     # warmup/compile
+    images, labels = next(batches)
     params, bn_state, opt_state, loss, acc = step(
         params, bn_state, opt_state, images, labels)
     jax.block_until_ready(loss)
@@ -102,6 +123,7 @@ def main():
     t0 = time.perf_counter()
     tlast, seen = t0, 0
     for it in range(1, args.iters + 1):
+        images, labels = next(batches)
         params, bn_state, opt_state, loss, acc = step(
             params, bn_state, opt_state, images, labels)
         seen += args.batch

@@ -1,12 +1,16 @@
-"""L1-tier: amp opt-level convergence parity.
+"""L1-tier: amp opt-level convergence parity — the cross-product sweep.
 
-Mirrors the reference's integration sweep (``tests/L1/common/run_test.sh:
-29-48`` + ``compare.py``): train the same model under O0 (pure fp32 baseline)
-and each other opt level / loss-scale configuration, record loss and
-grad-norm traces, and require them to track the baseline within
-precision-appropriate tolerances. The reference does this with ResNet-50 on
-ImageNet; here a conv+norm+linear stack on synthetic data exercises the same
-plumbing (cast policy, scaler, master weights, BN fp32) in minutes not hours.
+Mirrors the reference's integration matrix (``tests/L1/common/run_test.sh:
+29-48`` + ``compare.py``): train the same model under O0 (pure fp32
+baseline) and the cross product of opt level x loss scale (default / 1.0 /
+128.0 / dynamic) x keep_batchnorm_fp32 (default / True / False), plus the
+FusedAdam O2 configuration (``ADAM_ARGS``), recording loss and grad-norm
+traces and requiring them to track the baseline within precision-
+appropriate tolerances. The reference does this with ResNet-50 on ImageNet
+over hours; here a ResNet-18-w16 on synthetic data exercises the same
+plumbing (cast policy, scaler flavors, master weights, BN dtype) in the
+30-minute suite budget — the sweep samples the matrix the way run_test.sh's
+loops do, skipping only redundant points.
 """
 
 import jax
@@ -16,7 +20,7 @@ import pytest
 
 from apex_tpu import amp
 from apex_tpu.models import ResNet, ResNetConfig
-from apex_tpu.optimizers import FusedSGD
+from apex_tpu.optimizers import FusedAdam, FusedSGD
 from apex_tpu.utils.tree import global_norm
 
 STEPS = 12
@@ -28,25 +32,47 @@ def _data(n=16, hw=24, classes=8):
     return x, y
 
 
-def _train_trace(opt_level: str, loss_scale=None):
+def _cast_bn_params(params, dtype):
+    """keep_batchnorm_fp32=False: BN scale/bias participate in half —
+    the reference's ``--keep-batchnorm-fp32 False`` leg."""
+    from jax.tree_util import tree_map_with_path
+
+    def f(path, x):
+        keys = "/".join(str(getattr(p, "key", p)) for p in path)
+        return x.astype(dtype) if "bn" in keys else x
+
+    return tree_map_with_path(f, params)
+
+
+def _train_trace(opt_level: str, loss_scale=None, keep_bn=None,
+                 use_adam: bool = False):
     """Train a small ResNet under one amp config; return (losses, gnorms)."""
     amp_state = amp.initialize(
-        opt_level, loss_scale=loss_scale,
+        opt_level, loss_scale=loss_scale, keep_batchnorm_fp32=keep_bn,
         half_dtype=jnp.bfloat16)
+    props = amp_state.properties
     compute = (jnp.float32 if opt_level == "O0" else jnp.bfloat16)
     model = ResNet(ResNetConfig(depth=18, num_classes=8, width=16,
                                 compute_dtype=compute))
     params, state = model.init(jax.random.PRNGKey(0))
-    opt = FusedSGD(lr=0.05, momentum=0.9,
-                   master_weights=(opt_level == "O2"))
+    if use_adam:
+        # run_test.sh ADAM_ARGS: --opt-level O2 --keep-batchnorm-fp32 False
+        # --fused-adam
+        opt = FusedAdam(lr=1e-3, master_weights=bool(props.master_weights))
+    else:
+        opt = FusedSGD(lr=0.05, momentum=0.9,
+                       master_weights=bool(props.master_weights))
     opt_state = opt.init(params)
     scaler = amp_state.scaler
     sstate = amp_state.scaler_states[0]
     x, y = _data()
+    half_bn = props.keep_batchnorm_fp32 is False and opt_level != "O0"
 
     @jax.jit
     def step(params, state, opt_state, sstate):
         def loss_fn(p):
+            if half_bn:
+                p = _cast_bn_params(p, jnp.bfloat16)
             logits, new_s = model.apply(p, state, x, train=True)
             logp = jax.nn.log_softmax(logits)
             return -jnp.mean(logp[jnp.arange(16), y]), new_s
@@ -78,39 +104,59 @@ def baseline():
     return _train_trace("O0")
 
 
-class TestOptLevelParity:
-    """Each O-level's loss trace must track the O0 baseline (reference
-    compare.py semantics, loosened to bf16-appropriate tolerances)."""
+def _check(losses, gnorms, base, loss_tol):
+    b_losses, b_gnorms = base
+    assert np.isfinite(losses).all() and np.isfinite(gnorms).all()
+    # same qualitative descent
+    assert losses[-1] < losses[0]
+    np.testing.assert_allclose(losses, b_losses, rtol=loss_tol,
+                               atol=loss_tol)
+    # grad norms must track too (catches broken unscale factors that
+    # leave losses within tolerance), loosely: bf16 grads drift more
+    np.testing.assert_allclose(gnorms, b_gnorms,
+                               rtol=3 * loss_tol, atol=3 * loss_tol)
 
-    def _check(self, losses, gnorms, base, loss_tol):
-        b_losses, b_gnorms = base
-        assert np.isfinite(losses).all() and np.isfinite(gnorms).all()
-        # same qualitative descent
-        assert losses[-1] < losses[0]
-        np.testing.assert_allclose(losses, b_losses, rtol=loss_tol,
-                                   atol=loss_tol)
-        # grad norms must track too (catches broken unscale factors that
-        # leave losses within tolerance), loosely: bf16 grads drift more
-        np.testing.assert_allclose(gnorms, b_gnorms,
-                                   rtol=3 * loss_tol, atol=3 * loss_tol)
 
-    def test_o1(self, baseline):
-        losses, gnorms = _train_trace("O1")
-        self._check(losses, gnorms, baseline, loss_tol=0.12)
+# the run_test.sh matrix, sampled: every loss-scale leg for O1 and O2,
+# both keep_batchnorm legs for O2 (None = the level's default)
+_SWEEP = [
+    ("O1", None, None),
+    ("O1", 1.0, None),
+    ("O1", 128.0, None),
+    ("O1", "dynamic", None),
+    ("O2", None, None),
+    ("O2", 1.0, None),
+    ("O2", 128.0, None),
+    ("O2", "dynamic", None),
+    ("O2", None, True),
+    ("O2", None, False),
+]
 
-    def test_o2(self, baseline):
-        losses, gnorms = _train_trace("O2")
-        self._check(losses, gnorms, baseline, loss_tol=0.12)
 
-    def test_o2_static_scale(self, baseline):
-        losses, gnorms = _train_trace("O2", loss_scale=128.0)
-        self._check(losses, gnorms, baseline, loss_tol=0.12)
+class TestOptLevelSweep:
+    """Loss/grad-trace parity vs the O0 baseline across the matrix
+    (reference ``compare.py`` semantics at bf16-appropriate tolerances)."""
 
-    def test_o3(self, baseline):
+    @pytest.mark.parametrize("opt_level,loss_scale,keep_bn", _SWEEP)
+    def test_tracks_baseline(self, baseline, opt_level, loss_scale, keep_bn):
+        losses, gnorms = _train_trace(opt_level, loss_scale=loss_scale,
+                                      keep_bn=keep_bn)
+        _check(losses, gnorms, baseline, loss_tol=0.12)
+
+    @pytest.mark.parametrize("keep_bn", [True, False])
+    def test_o3_runs_and_descends(self, keep_bn):
         # O3 (no master weights, pure half) is allowed to drift further;
         # the reference only requires it to run and roughly converge
-        losses, _ = _train_trace("O3")
+        losses, _ = _train_trace("O3", keep_bn=keep_bn)
         assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0]
+
+    def test_o2_fused_adam(self, baseline):
+        # ADAM_ARGS leg: O2 + keep_batchnorm_fp32 False + FusedAdam; Adam's
+        # trajectory differs from SGD's, so the bar is finite + descending
+        # with the amp plumbing (scaler, master weights, half BN) active
+        losses, gnorms = _train_trace("O2", keep_bn=False, use_adam=True)
+        assert np.isfinite(losses).all() and np.isfinite(gnorms).all()
         assert losses[-1] < losses[0]
 
     def test_o0_deterministic(self, baseline):

@@ -240,3 +240,78 @@ class TestProfiling:
         found = any("trace" in f or f.endswith(".pb") or "plugins" in r
                     for r, _, fs in os.walk(tmp_path) for f in fs + [r])
         assert found
+
+
+class TestExtendedArgSurface:
+    """Round-2 arg-surface growth: every model knob added to the framework
+    (GQA, rope, rmsnorm, swiglu, sliding window, MoE, CP method, fp8,
+    optimizer selection) parses and reaches TransformerConfig."""
+
+    def test_modern_llm_config(self):
+        from apex_tpu.transformer.testing.arguments import (
+            core_transformer_config_from_args,
+        )
+
+        args = parse_args(args=[
+            "--num-layers", "4", "--hidden-size", "256",
+            "--num-attention-heads", "8", "--num-query-groups", "2",
+            "--position-embedding-type", "rope", "--rotary-percent", "0.5",
+            "--normalization", "rmsnorm", "--swiglu",
+            "--sliding-window", "64", "--bf16"])
+        cfg = core_transformer_config_from_args(args)
+        assert cfg.num_query_groups == 2
+        assert cfg.position_embedding_type == "rope"
+        assert cfg.rotary_percent == 0.5
+        assert cfg.normalization == "rmsnorm"
+        assert cfg.activation == "swiglu"
+        assert cfg.sliding_window == 64
+
+    def test_moe_and_cp_args(self):
+        from apex_tpu.transformer.testing.arguments import (
+            core_transformer_config_from_args,
+        )
+
+        args = parse_args(args=[
+            "--num-experts", "4", "--moe-router-topk", "2",
+            "--moe-expert-axis", "data", "--world-size", "4",
+            "--context-parallel-size", "1"])
+        cfg = core_transformer_config_from_args(args)
+        assert cfg.num_moe_experts == 4
+        assert cfg.moe_top_k == 2
+        # cp size 1 -> no CP method regardless of flag default
+        assert cfg.context_parallel_method is None
+
+    def test_cp_method_defaults_to_ring(self):
+        args = parse_args(args=["--context-parallel-size", "2",
+                                "--world-size", "2"])
+        assert args.context_parallel_method == "ring"
+
+    def test_gqa_divisibility_enforced(self):
+        import pytest
+
+        with pytest.raises(ValueError, match="num_query_groups"):
+            parse_args(args=["--num-attention-heads", "8",
+                             "--num-query-groups", "3"])
+
+    def test_optimizer_and_fp8_groups(self):
+        args = parse_args(args=["--optimizer", "lamb", "--fp8",
+                                "--fp8-amax-history-len", "8",
+                                "--use-distributed-optimizer"])
+        assert args.optimizer == "lamb"
+        assert args.fp8 and args.fp8_amax_history_len == 8
+        assert args.use_distributed_optimizer
+
+    def test_global_vars_build_microbatch_calculator(self):
+        from apex_tpu.transformer.testing import global_vars
+        from apex_tpu.transformer.pipeline_parallel import utils as pp_utils
+
+        global_vars.destroy_global_vars()
+        global_vars.set_global_variables(parse_args(args=[
+            "--micro-batch-size", "2", "--global-batch-size", "8",
+            "--world-size", "1"]))
+        assert global_vars.get_num_microbatches() == 4
+        assert global_vars.get_current_global_batch_size() == 8
+        assert global_vars.get_timers() is not None
+        assert global_vars.get_adlr_autoresume() is None
+        assert global_vars.get_tensorboard_writer() is None
+        global_vars.destroy_global_vars()
