@@ -100,6 +100,11 @@ class PrefetchLoader:
             staged = None
             try:
                 while True:
+                    # surface worker failures promptly: with an infinite
+                    # source the queue never closes, so waiting for drain
+                    # would swallow the error and silently drop the batch
+                    if errors:
+                        raise errors[0]
                     tok = queue.get()
                     if tok is None:           # closed + drained
                         break

@@ -44,7 +44,8 @@ def write_synthetic_imagenet(root: str, *, num_shards: int = 4,
     os.makedirs(root, exist_ok=True)
     meta_path = os.path.join(root, "meta.json")
     wanted = {"num_shards": num_shards, "per_shard": per_shard,
-              "image_size": image_size, "num_classes": num_classes}
+              "image_size": image_size, "num_classes": num_classes,
+              "seed": seed}
     if os.path.exists(meta_path):
         with open(meta_path) as f:
             have = json.load(f)

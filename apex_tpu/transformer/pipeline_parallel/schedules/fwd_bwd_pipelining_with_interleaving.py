@@ -1,4 +1,4 @@
-"""Interleaved (virtual-pipeline) schedule.
+"""Interleaved (virtual-pipeline) schedule — 1F1B memory semantics.
 
 Reference: ``apex/transformer/pipeline_parallel/schedules/
 fwd_bwd_pipelining_with_interleaving.py:27-744`` — each rank hosts
@@ -6,15 +6,25 @@ fwd_bwd_pipelining_with_interleaving.py:27-744`` — each rank hosts
 the pipeline has ``V = S * vpp`` virtual stages and the warmup bubble per
 chunk shrinks by ``vpp``.
 
-TPU design (circular pipeline): each rank carries a ``[vpp, ...]`` activation
-buffer — slot ``c`` holds the microbatch currently at this rank's chunk ``c``
-(virtual stage ``v = c * S + rank``). Per tick every rank computes **all**
-its chunks (each on a different in-flight microbatch), then one ``ppermute``
-moves the whole buffer to the next rank; the wrap-around at rank 0 shifts the
-chunk dimension by one (stage ``c*S + S-1`` feeds stage ``(c+1)*S``), rank 0
-slot 0 takes the next injected microbatch, and rank ``S-1`` slot ``vpp-1``
-emits finished microbatches. Ticks: ``M + V - 1``. Backward comes from
-autodiff, as in the non-interleaved schedule.
+TPU design — synchronous 1F1B over virtual stages, one ``lax.scan``:
+
+Chunk ``c`` of rank ``i`` is virtual stage ``v = c*S + i`` (the reference's
+chunk-to-rank assignment, ``parallel_state.py:675-696``). The wavefront:
+forward of microbatch ``m`` at stage ``v`` on tick ``t = m + v``; its
+backward at tick ``t = m + 2(V-1) - v`` (the loss cotangent is born at
+stage ``V-1`` and rides back one virtual stage per tick). Every tick each
+rank runs forward+backward for ALL its chunks, then both ring buffers move:
+activations one hop forward (the wrap into rank 0 climbs one chunk),
+cotangents one hop backward (the wrap into rank ``S-1`` descends one
+chunk). Each (rank, chunk) keeps a circular stash of in-flight *input*
+activations — at most ``2(V-1)+1`` each, independent of the microbatch
+count — and the backward recomputes the chunk forward from the stash
+(``jax.vjp``), exactly the non-interleaved schedule's memory/compute trade.
+Ticks: ``M + 2(V-1)``.
+
+As in the non-interleaved schedule, the explicit backward is wrapped in
+``jax.custom_vjp`` so ``jax.value_and_grad`` composes; forward-only calls
+run a lean streamed-loss pipeline.
 """
 
 from __future__ import annotations
@@ -23,15 +33,18 @@ from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from apex_tpu.transformer.parallel_state import PIPELINE_AXIS
 from apex_tpu.transformer.pipeline_parallel.p2p_communication import ring_shift
 from apex_tpu.transformer.pipeline_parallel.schedules.fwd_bwd_pipelining_without_interleaving import (
-    _broadcast_last_stage_loss,
+    _axis_info,
     _index_microbatch,
+    _select,
+    _zero_cotangent,
+    _zeros_of,
 )
-from apex_tpu.transformer.tensor_parallel.mappings import axis_bound
 
 __all__ = [
     "make_interleaved_pipelined_loss_fn",
@@ -52,70 +65,229 @@ def make_interleaved_pipelined_loss_fn(
     """Build ``loss_fn(params, batch) -> scalar`` for the circular pipeline.
 
     ``stage_fn(params, hidden, chunk, tick) -> hidden`` applies this rank's
-    layer chunk ``chunk`` (``0..vpp-1``); chunk ``c`` of rank ``i`` is virtual
-    stage ``c * S + i``, matching the reference's chunk-to-rank assignment
-    (``parallel_state.py:675-696`` virtual rank state). Other arguments as in
-    :func:`...fwd_bwd_pipelining_without_interleaving.make_pipelined_loss_fn`.
+    layer chunk ``chunk`` (``0..vpp-1``). ``remat`` is accepted for API
+    parity; the backward always recomputes from the stashed chunk inputs.
     """
+    del remat
     M = num_microbatches
     vpp = virtual_pipeline_size
 
-    def loss_fn(params, batch):
-        staged = jax.checkpoint(stage_fn) if remat else stage_fn
+    # -- forward-only pipeline ----------------------------------------------
 
-        pipelined = axis_bound(axis_name)
-        S = lax.axis_size(axis_name) if pipelined else 1
-        i = lax.axis_index(axis_name) if pipelined else 0
+    def _forward_only(params, batch):
+        pipelined, S, i = _axis_info(axis_name)
         V = S * vpp
-
-        injected = jax.vmap(lambda mb: preprocess_fn(params, mb))(batch)
-        hidden0 = jax.tree.map(lambda x: jnp.zeros_like(x[0]), injected)
-        # [vpp, ...] in-flight buffer; slot c = this rank's chunk c.
-        state0 = jax.tree.map(
-            lambda x: jnp.broadcast_to(x, (vpp,) + x.shape), hidden0)
-        outbuf0 = jax.tree.map(jnp.zeros_like, injected)
-        chunk_ids = jnp.arange(vpp)
+        mb0 = _index_microbatch(batch, 0)
+        h_shape = jax.eval_shape(preprocess_fn, params, mb0)
+        buf0 = jax.tree.map(
+            lambda s: jnp.zeros((vpp,) + s.shape, s.dtype), h_shape)
 
         def tick(carry, t):
-            state, outbuf = carry
-            m_in = jnp.clip(t, 0, M - 1)
-            inj = _index_microbatch(injected, m_in)
-            # rank 0 slot 0 <- injected microbatch
-            state = jax.tree.map(
-                lambda s, x: jnp.where(
-                    (i == 0)
-                    & (jnp.arange(vpp) == 0).reshape(
-                        (vpp,) + (1,) * x.ndim),
-                    x[None], s),
-                state, inj)
-            # compute every chunk (each a different in-flight microbatch)
-            y = lax.map(
-                lambda args: staged(params, args[0], args[1], t),
-                (state, chunk_ids))
-            # rank S-1 chunk vpp-1 output = finished microbatch t - (V-1)
-            m_out = jnp.clip(t - (V - 1), 0, M - 1)
-            outbuf = jax.tree.map(
-                lambda buf, leaf: lax.dynamic_update_index_in_dim(
-                    buf, leaf[vpp - 1], m_out, 0), outbuf, y)
-            # one ring hop for the whole buffer; the wrap into rank 0 climbs
-            # one chunk (virtual stage c*S + S-1 -> (c+1)*S)
+            fwd_buf, lacc = carry
+            ys = []
+            for c in range(vpp):
+                v = c * S + i
+                m_f = t - v
+                mb_f = _index_microbatch(batch, jnp.clip(m_f, 0, M - 1))
+                h_c = jax.tree.map(lambda x: x[c], fwd_buf)
+                if c == 0:
+                    h0 = preprocess_fn(params, mb_f)
+                    h_c = _select(i == 0, h0, h_c) if pipelined else h0
+                y_c = stage_fn(params, h_c, c, t)
+                ys.append(y_c)
+                if c == vpp - 1:
+                    m_out = t - (V - 1)
+                    mb_out = _index_microbatch(
+                        batch, jnp.clip(m_out, 0, M - 1))
+                    l = postprocess_fn(params, y_c, mb_out)
+                    take = ((i == S - 1) & (m_out >= 0) & (m_out < M))
+                    lacc = lacc + jnp.where(take, l.astype(jnp.float32), 0.0)
+            y = jax.tree.map(lambda *xs: jnp.stack(xs), *ys)
             arrived = ring_shift(y, axis_name=axis_name) if pipelined else y
-            shifted = jax.tree.map(lambda a: jnp.roll(a, 1, axis=0), arrived)
-            state = jax.tree.map(
-                lambda sh, ar: jnp.where(i == 0, sh, ar), shifted, arrived)
-            return (state, outbuf), None
+            # wrap into rank 0 climbs one chunk (stage c*S+S-1 -> (c+1)*S)
+            rolled = jax.tree.map(lambda a: jnp.roll(a, 1, axis=0), arrived)
+            fwd_buf = (_select(i == 0, rolled, arrived) if pipelined
+                       else rolled)
+            return (fwd_buf, lacc), None
 
-        (_, outbuf), _ = lax.scan(
-            tick, (state0, outbuf0), jnp.arange(M + V - 1))
+        (_, lacc), _ = lax.scan(
+            tick, (buf0, jnp.zeros((), jnp.float32)),
+            jnp.arange(M + V - 1))
+        loss = lacc / M
+        return lax.psum(loss, axis_name) if pipelined else loss
 
-        losses = jax.vmap(
-            lambda y, mb: postprocess_fn(params, y, mb))(outbuf, batch)
-        local = jnp.mean(losses)
-        if not pipelined:
-            return local
-        return _broadcast_last_stage_loss(
-            jnp.where(i == S - 1, local, 0.0), axis_name)
+    # -- fused forward+backward ---------------------------------------------
 
+    def _fwd_bwd(params, batch):
+        pipelined, S, i = _axis_info(axis_name)
+        V = S * vpp
+        B = 2 * (V - 1) + 1            # per-chunk in-flight input cap
+        drain = 2 * (V - 1)
+        mb0 = _index_microbatch(batch, 0)
+        h_shape = jax.eval_shape(preprocess_fn, params, mb0)
+        buf0 = jax.tree.map(
+            lambda s: jnp.zeros((vpp,) + s.shape, s.dtype), h_shape)
+        stash0 = jax.tree.map(
+            lambda s: jnp.zeros((vpp, B) + s.shape, s.dtype), h_shape)
+        gacc0 = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        has_float_batch = any(
+            jnp.issubdtype(x.dtype, jnp.inexact)
+            for x in jax.tree_util.tree_leaves(batch))
+        bgacc0 = (jax.tree.map(
+            lambda x: (jnp.zeros(x.shape, jnp.float32)
+                       if jnp.issubdtype(x.dtype, jnp.inexact) else
+                       jnp.zeros((), jnp.float32)), batch)
+            if has_float_batch else None)
+
+        def _accum_batch_grads(bgacc, m, *contribs):
+            def one(acc, x, *gs):
+                if not jnp.issubdtype(x.dtype, jnp.inexact):
+                    return acc
+                total = sum((g.astype(jnp.float32) for g in gs),
+                            jnp.zeros(x.shape[1:], jnp.float32))
+                cur = lax.dynamic_index_in_dim(acc, m, 0, keepdims=False)
+                return lax.dynamic_update_index_in_dim(acc, cur + total, m, 0)
+            return jax.tree.map(one, bgacc, batch, *contribs)
+
+        def tick(carry, t):
+            fwd_buf, bwd_buf, stash, gacc, bgacc, lacc = carry
+            ys, ghs = [], []
+            for c in range(vpp):
+                v = c * S + i
+
+                # ---- forward: microbatch m_f = t - v ----
+                m_f = t - v
+                fwd_valid = (m_f >= 0) & (m_f < M)
+                mb_f = _index_microbatch(batch, jnp.clip(m_f, 0, M - 1))
+                h_c = jax.tree.map(lambda x: x[c], fwd_buf)
+                if c == 0:
+                    h0 = preprocess_fn(params, mb_f)
+                    h_c = _select(i == 0, h0, h_c) if pipelined else h0
+                slot_f = jnp.clip(m_f, 0, None) % B
+                written = jax.tree.map(
+                    lambda s, h: lax.dynamic_update_index_in_dim(
+                        s, h, slot_f, 0),
+                    jax.tree.map(lambda s: s[c], stash), h_c)
+                stash = jax.tree.map(
+                    lambda s, w: lax.dynamic_update_index_in_dim(
+                        s, jnp.where(fwd_valid, w, s[c]), c, 0),
+                    stash, written)
+                ys.append(stage_fn(params, h_c, c, t))
+
+                # ---- backward: microbatch m_b = t - 2(V-1) + v ----
+                m_b = t - drain + v
+                bwd_valid = (m_b >= 0) & (m_b < M)
+                m_b_c = jnp.clip(m_b, 0, M - 1)
+                mb_b = _index_microbatch(batch, m_b_c)
+                slot_b = jnp.clip(m_b, 0, None) % B
+                h_in_b = jax.tree.map(
+                    lambda s: lax.dynamic_index_in_dim(
+                        s[c], slot_b, 0, keepdims=False), stash)
+                tick_b = m_b + v
+                y_b, vjp_stage = jax.vjp(
+                    lambda p, h: stage_fn(p, h, c, tick_b), params, h_in_b)
+                g_p_post = g_mb_post = None
+                if c == vpp - 1:
+                    l, vjp_post = jax.vjp(
+                        lambda h, p, mb: postprocess_fn(p, h, mb),
+                        y_b, params, mb_b)
+                    seed = jnp.where((i == S - 1) & bwd_valid,
+                                     1.0 / M, 0.0).astype(l.dtype)
+                    g_y_post, g_p_post, g_mb_post = vjp_post(seed)
+                    g_y = (_select(i == S - 1, g_y_post,
+                                   jax.tree.map(lambda x: x[c], bwd_buf))
+                           if pipelined else g_y_post)
+                    lacc = lacc + jnp.where((i == S - 1) & bwd_valid,
+                                            l.astype(jnp.float32), 0.0)
+                else:
+                    g_y = jax.tree.map(lambda x: x[c], bwd_buf)
+                g_y = _select(bwd_valid, g_y, _zeros_of(g_y))
+                g_p_stage, g_h = vjp_stage(g_y)
+                ghs.append(g_h)
+                contribs = [g_p_stage]
+                if g_p_post is not None:
+                    contribs.append(g_p_post)
+                mb_contribs = []
+                if g_mb_post is not None:
+                    mb_contribs.append(g_mb_post)
+                if c == 0:
+                    _, vjp_pre = jax.vjp(
+                        lambda p, mb: preprocess_fn(p, mb), params, mb_b)
+                    g_p_pre, g_mb_pre = vjp_pre(
+                        _select(i == 0, g_h, _zeros_of(g_h))
+                        if pipelined else g_h)
+                    contribs.append(g_p_pre)
+                    mb_contribs.append(g_mb_pre)
+                gacc = jax.tree.map(
+                    lambda a, *gs: a + sum(g.astype(jnp.float32)
+                                           for g in gs),
+                    gacc, *contribs)
+                if bgacc is not None and mb_contribs:
+                    bgacc = _accum_batch_grads(bgacc, m_b_c, *mb_contribs)
+
+            # ---- comms: both buffers move, with chunk rolls at the wraps
+            y = jax.tree.map(lambda *xs: jnp.stack(xs), *ys)
+            gh = jax.tree.map(lambda *xs: jnp.stack(xs), *ghs)
+            if pipelined:
+                arrived = ring_shift(y, axis_name=axis_name)
+                rolled = jax.tree.map(lambda a: jnp.roll(a, 1, axis=0),
+                                      arrived)
+                fwd_buf = _select(i == 0, rolled, arrived)
+                # cotangent of chunk c at rank 0 (stage c*S) feeds chunk
+                # c-1 at rank S-1 (stage c*S - 1): reverse hop + roll -1
+                arr_b = ring_shift(gh, reverse=True, axis_name=axis_name)
+                rolled_b = jax.tree.map(lambda a: jnp.roll(a, -1, axis=0),
+                                        arr_b)
+                bwd_buf = _select(i == S - 1, rolled_b, arr_b)
+            else:
+                # single rank: stage c feeds c+1 directly (roll +1), and
+                # cotangent of chunk c feeds chunk c-1 (roll -1)
+                fwd_buf = jax.tree.map(lambda a: jnp.roll(a, 1, axis=0), y)
+                bwd_buf = jax.tree.map(lambda a: jnp.roll(a, -1, axis=0), gh)
+            return (fwd_buf, bwd_buf, stash, gacc, bgacc, lacc), None
+
+        carry0 = (buf0, buf0, stash0, gacc0, bgacc0,
+                  jnp.zeros((), jnp.float32))
+        (_, _, _, gacc, bgacc, lacc), _ = lax.scan(
+            tick, carry0, jnp.arange(M + drain))
+        loss = lacc / M
+        if pipelined:
+            loss = lax.psum(loss, axis_name)
+        grads = jax.tree.map(lambda g, p: g.astype(p.dtype), gacc, params)
+        if bgacc is None:
+            bgrads = None
+        else:
+            bgrads = jax.tree.map(
+                lambda a, x: (a.astype(x.dtype)
+                              if jnp.issubdtype(x.dtype, jnp.inexact)
+                              else np.zeros(x.shape, jax.dtypes.float0)),
+                bgacc, batch)
+        return loss, grads, bgrads
+
+    # -- custom_vjp wiring ---------------------------------------------------
+
+    @jax.custom_vjp
+    def loss_fn(params, batch):
+        return _forward_only(params, batch)
+
+    def _vjp_fwd(params, batch):
+        loss, grads, bgrads = _fwd_bwd(params, batch)
+        return loss, (grads, bgrads, batch)
+
+    def _vjp_bwd(res, g):
+        grads, bgrads, batch = res
+        if bgrads is None:
+            bg = _zero_cotangent(batch)
+        else:
+            bg = jax.tree.map(
+                lambda x, orig: (x * g.astype(x.dtype)
+                                 if jnp.issubdtype(orig.dtype, jnp.inexact)
+                                 else x),
+                bgrads, batch)
+        return (jax.tree.map(lambda x: x * g.astype(x.dtype), grads), bg)
+
+    loss_fn.defvjp(_vjp_fwd, _vjp_bwd)
     return loss_fn
 
 

@@ -30,7 +30,9 @@ def set_global_variables(args=None, *, extra_args_provider=None,
     if build_microbatch_calculator:
         from apex_tpu.transformer.pipeline_parallel import utils as pp_utils
 
-        pp_utils._destroy_microbatch_calculator()
+        # setup raises if a calculator already exists (reference
+        # _ensure-not-initialized semantics) — clobbering a directly
+        # installed calculator here would silently change the schedule
         pp_utils.setup_microbatch_calculator(
             rank=0,
             rampup_batch_size=args.rampup_batch_size,

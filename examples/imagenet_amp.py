@@ -103,12 +103,16 @@ def main():
     from apex_tpu.data import make_input_pipeline, write_synthetic_imagenet
 
     stored = max(args.image_size, int(args.image_size * 1.15))
+    per_shard = max(args.batch, 256)
+    # key the scratch dataset dir by its shape config so flag changes
+    # regenerate instead of tripping the meta-mismatch guard
+    data_dir = (f"{args.data_dir}-{stored}px-{per_shard}x4"
+                f"-c{args.num_classes}")
     write_synthetic_imagenet(
-        args.data_dir, num_shards=4,
-        per_shard=max(args.batch, 256), image_size=stored,
+        data_dir, num_shards=4, per_shard=per_shard, image_size=stored,
         num_classes=args.num_classes)
     loader = make_input_pipeline(
-        args.data_dir, args.batch, mesh=mesh if ndev > 1 else None,
+        data_dir, args.batch, mesh=mesh if ndev > 1 else None,
         crop=args.image_size, prefetch=args.prefetch,
         num_workers=args.num_workers)
     batches = iter(loader)

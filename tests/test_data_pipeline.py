@@ -129,3 +129,23 @@ class TestReviewRegressions:
             return out
 
         assert collect(1) == collect(3)
+
+    def test_worker_error_surfaces_with_infinite_source(self):
+        """map_fn failure must raise promptly even when the source never
+        ends (another worker keeps the queue alive forever otherwise)."""
+        def infinite():
+            i = 0
+            while True:
+                yield i
+                i += 1
+
+        def boom(i):
+            if i == 3:
+                raise RuntimeError("corrupted shard 3")
+            return np.zeros((2,))
+
+        loader = PrefetchLoader(infinite, prefetch=2, num_workers=2,
+                                map_fn=boom)
+        with pytest.raises(RuntimeError, match="corrupted shard 3"):
+            for n, _ in enumerate(loader):
+                assert n < 100   # must fail long before this

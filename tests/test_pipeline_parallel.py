@@ -604,3 +604,51 @@ class Test1F1BInputGradients:
                                    np.asarray(ref_bg["y"]),
                                    rtol=1e-4, atol=1e-6)
         parallel_state.destroy_model_parallel()
+
+
+class TestInterleavedMemory:
+    """The interleaved schedule shares the 1F1B property now: per-chunk
+    in-flight stashes bounded by the virtual pipeline depth, flat in M."""
+
+    def _temp_bytes(self, M):
+        from apex_tpu.models import PipelinedGPT
+        from apex_tpu.transformer.pipeline_parallel.utils import (
+            split_batch_into_microbatches,
+        )
+
+        parallel_state.destroy_model_parallel()
+        mesh = parallel_state.initialize_model_parallel(
+            pipeline_model_parallel_size=2,
+            virtual_pipeline_model_parallel_size=2)
+        cfg = TransformerConfig(
+            num_layers=4, hidden_size=64, num_attention_heads=4,
+            vocab_size=256, max_position_embeddings=64,
+            hidden_dropout=0.0, attention_dropout=0.0)
+        model = PipelinedGPT(cfg, pipeline_size=2, num_microbatches=M,
+                             virtual_pipeline_size=2)
+        params = model.init(jax.random.PRNGKey(0))
+        loss_fn = model.make_loss_fn()
+        batch = split_batch_into_microbatches(
+            {"tokens": jnp.zeros((4 * M, 32), jnp.int32),
+             "labels": jnp.zeros((4 * M, 32), jnp.int32)}, M)
+
+        def per_rank(p, b):
+            return jax.value_and_grad(lambda p: loss_fn(p, b))(p)
+
+        f = jax.jit(jax.shard_map(
+            per_rank, mesh=mesh,
+            in_specs=(model.spec(),
+                      {"tokens": P(None, "data"), "labels": P(None, "data")}),
+            out_specs=(P(), model.spec()), check_vma=False))
+        ma = f.lower(params, batch).compile().memory_analysis()
+        parallel_state.destroy_model_parallel()
+        if ma is None:
+            pytest.skip("backend does not expose memory_analysis")
+        return ma.temp_size_in_bytes
+
+    def test_temp_memory_flat_in_microbatch_count(self):
+        small = self._temp_bytes(4)
+        big = self._temp_bytes(32)
+        assert big < small * 1.2, (
+            f"interleaved temp arena grew {big / small:.2f}x from M=4 "
+            f"({small}B) to M=32 ({big}B)")
