@@ -98,13 +98,30 @@ def _causal_block_skip(i, j, bq, bk, causal, window, q_off, k_off):
 # forward
 # ---------------------------------------------------------------------------
 
+def _win_j_base(i, bq, bk, qoff_static, window):
+    """First k-block that can intersect q-block ``i``'s window band (static
+    offsets only — the banded-grid fast path for sliding windows)."""
+    lo = i * bq + qoff_static - window + 1
+    return jnp.maximum(lo // bk, 0)
+
+
+def _win_i_base(j, bq, bk, qoff_static, window):
+    """First q-block whose window band can reach k-block ``j``."""
+    lo = j * bk - qoff_static
+    return jnp.maximum(lo // bq, 0)
+
+
 def _fwd_kernel(offs_ref, kvl_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                 m_scr, l_scr, acc_scr, *, scale, bq, bk, nk, sk,
-                causal, window=None):
-    b, i, j = pl.program_id(0), pl.program_id(2), pl.program_id(3)
+                causal, window=None, win_grid=None):
+    b, i, jl = pl.program_id(0), pl.program_id(2), pl.program_id(3)
+    # banded grid: the j axis only walks blocks near the window diagonal;
+    # jl is the grid coordinate, j the actual k-block index
+    j = (jl + _win_j_base(i, bq, bk, win_grid, window)
+         if win_grid is not None else jl)
     q_off, k_off = offs_ref[0], offs_ref[1]
 
-    @pl.when(j == 0)
+    @pl.when(jl == 0)
     def _init():
         m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
         l_scr[:] = jnp.zeros_like(l_scr)
@@ -131,12 +148,18 @@ def _fwd_kernel(offs_ref, kvl_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
 
     if causal or window is not None:
-        pl.when(_causal_block_skip(i, j, bq, bk, causal, window,
-                                   q_off, k_off))(_step)
+        keep = _causal_block_skip(i, j, bq, bk, causal, window,
+                                  q_off, k_off)
+        if win_grid is not None:
+            # banded grid can run past the last real k-block at the bottom
+            # rows; those steps are skipped (their DMA is clipped in the
+            # index maps)
+            keep = jnp.logical_and(keep, j <= nk - 1)
+        pl.when(keep)(_step)
     else:
         _step()
 
-    @pl.when(j == nk - 1)
+    @pl.when(jl == pl.num_programs(3) - 1)
     def _finish():
         l = l_scr[:, :1]
         m = m_scr[:, :1]
@@ -167,7 +190,23 @@ def _run_fwd(q, k, v, kv_lengths, scale, causal, sq, sk, bq, bk,
     batch, heads, sqp, dp = q.shape
     skp = k.shape[2]
     nq, nk = sqp // bq, skp // bk
-    grid = (batch, heads, nq, nk)
+    # banded grid for sliding windows with STATIC offsets (the plain flash
+    # path): only the ~(window+bq)/bk k-blocks near the diagonal are walked,
+    # making windowed attention O(s*window) in grid steps too, not just in
+    # executed matmuls (grid overhead dominated the skip-only version)
+    win_grid = None
+    nk_grid = nk
+    if window is not None and q_off is None and k_off is None:
+        win_grid = sk - sq
+        nk_grid = min(nk, (bq + window - 2) // bk + 2)
+
+    def _kj(i, j):
+        if win_grid is None:
+            return j
+        return jnp.minimum(j + _win_j_base(i, bq, bk, win_grid, window),
+                           nk - 1)
+
+    grid = (batch, heads, nq, nk_grid)
     kvl_spec = []
     args = [_offsets(q_off, k_off, sq, sk)]
     if kv_lengths is not None:
@@ -177,16 +216,16 @@ def _run_fwd(q, k, v, kv_lengths, scale, causal, sq, sk, bq, bk,
         _fwd_kernel if kv_lengths is not None else
         (lambda offs, *r, **kw: _fwd_kernel(offs, None, *r, **kw)),
         scale=scale, bq=bq, bk=bk, nk=nk, sk=sk, causal=causal,
-        window=window)
+        window=window, win_grid=win_grid)
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)] + kvl_spec + [
             pl.BlockSpec((1, 1, bq, dp), lambda b, h, i, j: (b, h, i, 0)),
             pl.BlockSpec((1, 1, bk, dp),
-                         lambda b, h, i, j: (b, h // group, j, 0)),
+                         lambda b, h, i, j: (b, h // group, _kj(i, j), 0)),
             pl.BlockSpec((1, 1, bk, dp),
-                         lambda b, h, i, j: (b, h // group, j, 0)),
+                         lambda b, h, i, j: (b, h // group, _kj(i, j), 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, bq, dp), lambda b, h, i, j: (b, h, i, 0)),
@@ -215,11 +254,13 @@ def _run_fwd(q, k, v, kv_lengths, scale, causal, sq, sk, bq, bk,
 
 def _dq_kernel(offs_ref, kvl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                delta_ref, dq_ref, dq_scr, *, scale, bq, bk, nk, sk, causal,
-               window=None):
-    b, i, j = pl.program_id(0), pl.program_id(2), pl.program_id(3)
+               window=None, win_grid=None):
+    b, i, jl = pl.program_id(0), pl.program_id(2), pl.program_id(3)
+    j = (jl + _win_j_base(i, bq, bk, win_grid, window)
+         if win_grid is not None else jl)
     q_off, k_off = offs_ref[0], offs_ref[1]
 
-    @pl.when(j == 0)
+    @pl.when(jl == 0)
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
@@ -243,12 +284,15 @@ def _dq_kernel(offs_ref, kvl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             ds.astype(k.dtype), k, preferred_element_type=jnp.float32)
 
     if causal or window is not None:
-        pl.when(_causal_block_skip(i, j, bq, bk, causal, window,
-                                   q_off, k_off))(_step)
+        keep = _causal_block_skip(i, j, bq, bk, causal, window,
+                                  q_off, k_off)
+        if win_grid is not None:
+            keep = jnp.logical_and(keep, j <= nk - 1)
+        pl.when(keep)(_step)
     else:
         _step()
 
-    @pl.when(j == nk - 1)
+    @pl.when(jl == pl.num_programs(3) - 1)
     def _finish():
         dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
 
@@ -256,12 +300,16 @@ def _dq_kernel(offs_ref, kvl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 def _dkv_kernel(offs_ref, kvl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                 delta_ref, dk_ref, dv_ref, dk_scr, dv_scr,
                 *, scale, bq, bk, nq, sk, causal, group=1,
-                window=None):
-    # grid: (batch, kv_heads, nk, group * nq) — the trailing dim walks every
-    # (q head in group, q block) pair so dk/dv accumulate over the whole
-    # query group in one scratch pass (GQA/MQA backward)
+                window=None, win_grid=None, nq_grid=None):
+    # grid: (batch, kv_heads, nk, group * nq_grid) — the trailing dim walks
+    # every (q head in group, q block) pair so dk/dv accumulate over the
+    # whole query group in one scratch pass (GQA/MQA backward); with a
+    # banded window grid only the q-blocks near the diagonal are walked
     b, j, t = pl.program_id(0), pl.program_id(2), pl.program_id(3)
-    i = t % nq
+    ng = nq if nq_grid is None else nq_grid
+    il = t % ng
+    i = (il + _win_i_base(j, bq, bk, win_grid, window)
+         if win_grid is not None else il)
     q_off, k_off = offs_ref[0], offs_ref[1]
 
     @pl.when(t == 0)
@@ -293,12 +341,15 @@ def _dkv_kernel(offs_ref, kvl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             preferred_element_type=jnp.float32)
 
     if causal or window is not None:
-        pl.when(_causal_block_skip(i, j, bq, bk, causal, window,
-                                   q_off, k_off))(_step)
+        keep = _causal_block_skip(i, j, bq, bk, causal, window,
+                                  q_off, k_off)
+        if win_grid is not None:
+            keep = jnp.logical_and(keep, i <= nq - 1)
+        pl.when(keep)(_step)
     else:
         _step()
 
-    @pl.when(t == group * nq - 1)
+    @pl.when(t == pl.num_programs(3) - 1)
     def _finish():
         dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
@@ -309,6 +360,29 @@ def _run_bwd(q, k, v, do, lse, delta, kv_lengths, scale, causal,
     batch, heads, sqp, dp = q.shape
     kv_heads, skp = k.shape[1], k.shape[2]
     nq, nk = sqp // bq, skp // bk
+    # banded window grids (see _run_fwd)
+    win_grid = None
+    nk_grid, nq_grid = nk, nq
+    if window is not None and q_off is None and k_off is None:
+        win_grid = sk - sq
+        nk_grid = min(nk, (bq + window - 2) // bk + 2)
+        nq_grid = min(nq, (bk + window - 2) // bq + 2)
+
+    def _kj(i, j):
+        if win_grid is None:
+            return j
+        return jnp.minimum(j + _win_j_base(i, bq, bk, win_grid, window),
+                           nk - 1)
+
+    def _qi(j, t):
+        if win_grid is None:
+            return t % nq
+        return jnp.minimum(
+            t % nq_grid + _win_i_base(j, bq, bk, win_grid, window), nq - 1)
+
+    def _qh(h, t):
+        return h * group + t // (nq if win_grid is None else nq_grid)
+
     kvl_spec = []
     args = [_offsets(q_off, k_off, sq, sk)]
     if kv_lengths is not None:
@@ -324,17 +398,17 @@ def _run_bwd(q, k, v, do, lse, delta, kv_lengths, scale, causal,
     row_specs = [
         pl.BlockSpec((1, 1, bq, dp), lambda b, h, i, j: (b, h, i, 0)),   # q
         pl.BlockSpec((1, 1, bk, dp),
-                     lambda b, h, i, j: (b, h // group, j, 0)),          # k
+                     lambda b, h, i, j: (b, h // group, _kj(i, j), 0)),  # k
         pl.BlockSpec((1, 1, bk, dp),
-                     lambda b, h, i, j: (b, h // group, j, 0)),          # v
+                     lambda b, h, i, j: (b, h // group, _kj(i, j), 0)),  # v
         pl.BlockSpec((1, 1, bq, dp), lambda b, h, i, j: (b, h, i, 0)),   # do
         pl.BlockSpec((1, 1, 1, bq), lambda b, h, i, j: (b, h, 0, i)),    # lse
         pl.BlockSpec((1, 1, 1, bq), lambda b, h, i, j: (b, h, 0, i)),    # delta
     ]
     dq = pl.pallas_call(
         wrap(_dq_kernel, scale=scale, bq=bq, bk=bk, nk=nk, sk=sk,
-             causal=causal, window=window),
-        grid=(batch, heads, nq, nk),
+             causal=causal, window=window, win_grid=win_grid),
+        grid=(batch, heads, nq, nk_grid),
         in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)] + kvl_spec
         + row_specs,
         out_specs=pl.BlockSpec((1, 1, bq, dp), lambda b, h, i, j: (b, h, i, 0)),
@@ -346,23 +420,25 @@ def _run_bwd(q, k, v, do, lse, delta, kv_lengths, scale, causal,
         interpret=pallas_interpret(),
     )(*args, q, k, v, do, lse, delta)
 
-    # trailing grid dim walks (q head in group, q block) pairs: t = g*nq + i
+    # trailing grid dim walks (q head in group, q block) pairs:
+    # t = g*nq_grid + i_local
     col_specs = [
         pl.BlockSpec((1, 1, bq, dp),
-                     lambda b, h, j, t: (b, h * group + t // nq, t % nq, 0)),
+                     lambda b, h, j, t: (b, _qh(h, t), _qi(j, t), 0)),   # q
         pl.BlockSpec((1, 1, bk, dp), lambda b, h, j, t: (b, h, j, 0)),   # k
         pl.BlockSpec((1, 1, bk, dp), lambda b, h, j, t: (b, h, j, 0)),   # v
         pl.BlockSpec((1, 1, bq, dp),
-                     lambda b, h, j, t: (b, h * group + t // nq, t % nq, 0)),
+                     lambda b, h, j, t: (b, _qh(h, t), _qi(j, t), 0)),   # do
         pl.BlockSpec((1, 1, 1, bq),
-                     lambda b, h, j, t: (b, h * group + t // nq, 0, t % nq)),
+                     lambda b, h, j, t: (b, _qh(h, t), 0, _qi(j, t))),   # lse
         pl.BlockSpec((1, 1, 1, bq),
-                     lambda b, h, j, t: (b, h * group + t // nq, 0, t % nq)),
+                     lambda b, h, j, t: (b, _qh(h, t), 0, _qi(j, t))),   # delta
     ]
     dk, dv = pl.pallas_call(
         wrap(_dkv_kernel, scale=scale, bq=bq, bk=bk, nq=nq, sk=sk,
-             causal=causal, group=group, window=window),
-        grid=(batch, kv_heads, nk, group * nq),
+             causal=causal, group=group, window=window,
+             win_grid=win_grid, nq_grid=nq_grid),
+        grid=(batch, kv_heads, nk, group * nq_grid),
         in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)] + kvl_spec
         + col_specs,
         out_specs=[

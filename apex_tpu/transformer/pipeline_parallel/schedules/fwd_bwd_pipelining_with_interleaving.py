@@ -40,9 +40,11 @@ from apex_tpu.transformer.parallel_state import PIPELINE_AXIS
 from apex_tpu.transformer.pipeline_parallel.p2p_communication import ring_shift
 from apex_tpu.transformer.pipeline_parallel.schedules.fwd_bwd_pipelining_without_interleaving import (
     _axis_info,
+    _finalize_batch_grads,
     _index_microbatch,
+    _init_batch_grads,
     _select,
-    _zero_cotangent,
+    _wrap_custom_vjp,
     _zeros_of,
 )
 
@@ -131,24 +133,7 @@ def make_interleaved_pipelined_loss_fn(
             lambda s: jnp.zeros((vpp, B) + s.shape, s.dtype), h_shape)
         gacc0 = jax.tree.map(
             lambda p: jnp.zeros(p.shape, jnp.float32), params)
-        has_float_batch = any(
-            jnp.issubdtype(x.dtype, jnp.inexact)
-            for x in jax.tree_util.tree_leaves(batch))
-        bgacc0 = (jax.tree.map(
-            lambda x: (jnp.zeros(x.shape, jnp.float32)
-                       if jnp.issubdtype(x.dtype, jnp.inexact) else
-                       jnp.zeros((), jnp.float32)), batch)
-            if has_float_batch else None)
-
-        def _accum_batch_grads(bgacc, m, *contribs):
-            def one(acc, x, *gs):
-                if not jnp.issubdtype(x.dtype, jnp.inexact):
-                    return acc
-                total = sum((g.astype(jnp.float32) for g in gs),
-                            jnp.zeros(x.shape[1:], jnp.float32))
-                cur = lax.dynamic_index_in_dim(acc, m, 0, keepdims=False)
-                return lax.dynamic_update_index_in_dim(acc, cur + total, m, 0)
-            return jax.tree.map(one, bgacc, batch, *contribs)
+        bgacc0, _accum_batch_grads = _init_batch_grads(batch)
 
         def tick(carry, t):
             fwd_buf, bwd_buf, stash, gacc, bgacc, lacc = carry
@@ -255,40 +240,9 @@ def make_interleaved_pipelined_loss_fn(
         if pipelined:
             loss = lax.psum(loss, axis_name)
         grads = jax.tree.map(lambda g, p: g.astype(p.dtype), gacc, params)
-        if bgacc is None:
-            bgrads = None
-        else:
-            bgrads = jax.tree.map(
-                lambda a, x: (a.astype(x.dtype)
-                              if jnp.issubdtype(x.dtype, jnp.inexact)
-                              else np.zeros(x.shape, jax.dtypes.float0)),
-                bgacc, batch)
-        return loss, grads, bgrads
+        return loss, grads, _finalize_batch_grads(bgacc, batch)
 
-    # -- custom_vjp wiring ---------------------------------------------------
-
-    @jax.custom_vjp
-    def loss_fn(params, batch):
-        return _forward_only(params, batch)
-
-    def _vjp_fwd(params, batch):
-        loss, grads, bgrads = _fwd_bwd(params, batch)
-        return loss, (grads, bgrads, batch)
-
-    def _vjp_bwd(res, g):
-        grads, bgrads, batch = res
-        if bgrads is None:
-            bg = _zero_cotangent(batch)
-        else:
-            bg = jax.tree.map(
-                lambda x, orig: (x * g.astype(x.dtype)
-                                 if jnp.issubdtype(orig.dtype, jnp.inexact)
-                                 else x),
-                bgrads, batch)
-        return (jax.tree.map(lambda x: x * g.astype(x.dtype), grads), bg)
-
-    loss_fn.defvjp(_vjp_fwd, _vjp_bwd)
-    return loss_fn
+    return _wrap_custom_vjp(_forward_only, _fwd_bwd)
 
 
 def forward_backward_pipelining_with_interleaving(

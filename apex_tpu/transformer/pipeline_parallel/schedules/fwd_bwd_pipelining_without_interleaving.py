@@ -87,6 +87,74 @@ def _axis_info(axis_name: str):
     return pipelined, S, i
 
 
+def _init_batch_grads(batch):
+    """(bgacc0 | None, accum_fn) — input-cotangent accumulators for the
+    float leaves of ``batch`` (int leaves hold a dummy scalar; the common
+    all-int GPT batch allocates nothing). Shared by both 1F1B schedules."""
+    has_float = any(jnp.issubdtype(x.dtype, jnp.inexact)
+                    for x in jax.tree_util.tree_leaves(batch))
+    if not has_float:
+        return None, None
+    bgacc0 = jax.tree.map(
+        lambda x: (jnp.zeros(x.shape, jnp.float32)
+                   if jnp.issubdtype(x.dtype, jnp.inexact) else
+                   jnp.zeros((), jnp.float32)), batch)
+
+    def accum(bgacc, m, *contribs):
+        """Add per-microbatch input-grad contributions into slot ``m`` of
+        the [M, ...]-shaped accumulators (float0 cotangents of int leaves
+        are dropped)."""
+        def one(acc, x, *gs):
+            if not jnp.issubdtype(x.dtype, jnp.inexact):
+                return acc
+            total = sum((g.astype(jnp.float32) for g in gs),
+                        jnp.zeros(x.shape[1:], jnp.float32))
+            cur = lax.dynamic_index_in_dim(acc, m, 0, keepdims=False)
+            return lax.dynamic_update_index_in_dim(acc, cur + total, m, 0)
+        return jax.tree.map(one, bgacc, batch, *contribs)
+
+    return bgacc0, accum
+
+
+def _finalize_batch_grads(bgacc, batch):
+    if bgacc is None:
+        return None
+    return jax.tree.map(
+        lambda a, x: (a.astype(x.dtype)
+                      if jnp.issubdtype(x.dtype, jnp.inexact)
+                      else np.zeros(x.shape, jax.dtypes.float0)),
+        bgacc, batch)
+
+
+def _wrap_custom_vjp(forward_only_fn, fwd_bwd_fn):
+    """Build the custom_vjp'd ``loss_fn(params, batch)`` both schedules
+    share: primal = lean forward pipeline; differentiation returns the
+    explicitly 1F1B-accumulated grads (params and float batch leaves)."""
+
+    @jax.custom_vjp
+    def loss_fn(params, batch):
+        return forward_only_fn(params, batch)
+
+    def _vjp_fwd(params, batch):
+        loss, grads, bgrads = fwd_bwd_fn(params, batch)
+        return loss, (grads, bgrads, batch)
+
+    def _vjp_bwd(res, g):
+        grads, bgrads, batch = res
+        if bgrads is None:
+            bg = _zero_cotangent(batch)
+        else:
+            bg = jax.tree.map(
+                lambda x, orig: (x * g.astype(x.dtype)
+                                 if jnp.issubdtype(orig.dtype, jnp.inexact)
+                                 else x),
+                bgrads, batch)
+        return (jax.tree.map(lambda x: x * g.astype(x.dtype), grads), bg)
+
+    loss_fn.defvjp(_vjp_fwd, _vjp_bwd)
+    return loss_fn
+
+
 @partial(jax.custom_vjp, nondiff_argnums=(1,))
 def _broadcast_last_stage_loss(x, axis_name: str):
     """psum in the forward (replicating the last stage's masked loss to every
@@ -190,31 +258,7 @@ def make_pipelined_loss_fn(
             lambda s: jnp.zeros((B,) + s.shape, s.dtype), h_shape)
         gacc0 = jax.tree.map(
             lambda p: jnp.zeros(p.shape, jnp.float32), params)
-        # input (batch) cotangents exist only for float leaves (regression
-        # targets, float features); the common all-int GPT batch allocates
-        # nothing here
-        has_float_batch = any(
-            jnp.issubdtype(x.dtype, jnp.inexact)
-            for x in jax.tree_util.tree_leaves(batch))
-        bgacc0 = (jax.tree.map(
-            lambda x: (jnp.zeros(x.shape, jnp.float32)
-                       if jnp.issubdtype(x.dtype, jnp.inexact) else
-                       jnp.zeros((), jnp.float32)), batch)
-            if has_float_batch else None)
-
-        def _accum_batch_grads(bgacc, m, *contribs):
-            """Add per-microbatch input-grad contributions into slot ``m``
-            of the [M, ...]-shaped accumulators (int leaves hold a dummy
-            scalar; their float0 cotangents are dropped)."""
-            def one(acc, x, *gs):
-                if not jnp.issubdtype(x.dtype, jnp.inexact):
-                    return acc
-                total = sum((g.astype(jnp.float32) for g in gs),
-                            jnp.zeros(x.shape[1:], jnp.float32))
-                cur = lax.dynamic_index_in_dim(acc, m, 0, keepdims=False)
-                return lax.dynamic_update_index_in_dim(
-                    acc, cur + total, m, 0)
-            return jax.tree.map(one, bgacc, batch, *contribs)
+        bgacc0, _accum_batch_grads = _init_batch_grads(batch)
 
         def tick(carry, t):
             fwd_state, bwd_state, stash, gacc, bgacc, lacc = carry
@@ -285,40 +329,9 @@ def make_pipelined_loss_fn(
         if pipelined:
             loss = lax.psum(loss, axis_name)
         grads = jax.tree.map(lambda g, p: g.astype(p.dtype), gacc, params)
-        if bgacc is None:
-            bgrads = None
-        else:
-            bgrads = jax.tree.map(
-                lambda a, x: (a.astype(x.dtype)
-                              if jnp.issubdtype(x.dtype, jnp.inexact)
-                              else np.zeros(x.shape, jax.dtypes.float0)),
-                bgacc, batch)
-        return loss, grads, bgrads
+        return loss, grads, _finalize_batch_grads(bgacc, batch)
 
-    # -- custom_vjp wiring ---------------------------------------------------
-
-    @jax.custom_vjp
-    def loss_fn(params, batch):
-        return _forward_only(params, batch)
-
-    def _vjp_fwd(params, batch):
-        loss, grads, bgrads = _fwd_bwd(params, batch)
-        return loss, (grads, bgrads, batch)
-
-    def _vjp_bwd(res, g):
-        grads, bgrads, batch = res
-        if bgrads is None:
-            bg = _zero_cotangent(batch)
-        else:
-            bg = jax.tree.map(
-                lambda x, orig: (x * g.astype(x.dtype)
-                                 if jnp.issubdtype(orig.dtype, jnp.inexact)
-                                 else x),
-                bgrads, batch)
-        return (jax.tree.map(lambda x: x * g.astype(x.dtype), grads), bg)
-
-    loss_fn.defvjp(_vjp_fwd, _vjp_bwd)
-    return loss_fn
+    return _wrap_custom_vjp(_forward_only, _fwd_bwd)
 
 
 def forward_backward_pipelining_without_interleaving(

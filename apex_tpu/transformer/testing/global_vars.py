@@ -26,19 +26,21 @@ def set_global_variables(args=None, *, extra_args_provider=None,
         args = parse_args(extra_args_provider=extra_args_provider,
                           defaults=defaults,
                           ignore_unknown_args=ignore_unknown_args)
-    _GLOBAL_ARGS = args
     if build_microbatch_calculator:
         from apex_tpu.transformer.pipeline_parallel import utils as pp_utils
 
         # setup raises if a calculator already exists (reference
         # _ensure-not-initialized semantics) — clobbering a directly
-        # installed calculator here would silently change the schedule
+        # installed calculator here would silently change the schedule.
+        # It runs BEFORE _GLOBAL_ARGS is installed so a failure leaves the
+        # module fully uninitialized rather than half-set.
         pp_utils.setup_microbatch_calculator(
             rank=0,
             rampup_batch_size=args.rampup_batch_size,
             global_batch_size=args.global_batch_size,
             micro_batch_size=args.micro_batch_size,
             data_parallel_size=args.data_parallel_size)
+    _GLOBAL_ARGS = args
     return args
 
 

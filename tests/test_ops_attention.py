@@ -206,3 +206,56 @@ class TestSlidingWindow:
         q = _rand((1, 1, 32, 64))
         with pytest.raises(ValueError, match="causal"):
             flash_attention(q, q, q, sliding_window=8)
+
+
+class TestBandedWindowGrid:
+    """The sliding-window banded grid (static-offset fast path): the k/q
+    grid axes only walk blocks near the window diagonal. These sizes force
+    multiple blocks and nonzero band bases (seq >> block), pinning the
+    band-base arithmetic, the nk_grid/nq_grid sizing, and the edge clamps
+    that single-block tests never reach."""
+
+    @pytest.mark.parametrize("window", [1, 130, 200, 1000])
+    def test_fwd_parity_multiblock(self, window):
+        q = _rand((1, 2, 1024, 32), seed=1)
+        k = _rand((1, 2, 1024, 32), seed=2)
+        v = _rand((1, 2, 1024, 32), seed=3)
+        out = flash_attention(q, k, v, causal=True, sliding_window=window,
+                              block_q=128, block_k=256)
+        ref = _mha_reference(q, k, v, None, 1.0 / np.sqrt(32), True, window)
+        np.testing.assert_allclose(np.asarray(out, np.float32),
+                                   np.asarray(ref, np.float32),
+                                   rtol=2e-2, atol=2e-2)
+
+    def test_bwd_parity_multiblock(self):
+        q = _rand((1, 2, 768, 32), seed=4)
+        k = _rand((1, 2, 768, 32), seed=5)
+        v = _rand((1, 2, 768, 32), seed=6)
+
+        def loss(fn):
+            return lambda q, k, v: jnp.sum(
+                fn(q, k, v).astype(jnp.float32) ** 2)
+
+        g_new = jax.grad(loss(lambda q, k, v: flash_attention(
+            q, k, v, causal=True, sliding_window=200,
+            block_q=128, block_k=128)), argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss(lambda q, k, v: _mha_reference(
+            q, k, v, None, 1.0 / np.sqrt(32), True, 200)),
+            argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_new, g_ref):
+            np.testing.assert_allclose(np.asarray(a, np.float32),
+                                       np.asarray(b, np.float32),
+                                       rtol=5e-2, atol=5e-2)
+
+    def test_cross_attention_offset_band(self):
+        # sk > sq: queries sit at the end; the band base includes the
+        # static sk-sq offset
+        q = _rand((1, 2, 256, 32), seed=7)
+        k = _rand((1, 2, 1024, 32), seed=8)
+        v = _rand((1, 2, 1024, 32), seed=9)
+        out = flash_attention(q, k, v, causal=True, sliding_window=300,
+                              block_q=128, block_k=128)
+        ref = _mha_reference(q, k, v, None, 1.0 / np.sqrt(32), True, 300)
+        np.testing.assert_allclose(np.asarray(out, np.float32),
+                                   np.asarray(ref, np.float32),
+                                   rtol=2e-2, atol=2e-2)
