@@ -23,6 +23,7 @@ form so it jits and shards:
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any, Dict, Optional, Sequence, Tuple
 
 import jax
@@ -41,6 +42,7 @@ __all__ = [
     "quantize",
     "dequantize",
     "qdq",
+    "fp8_dense",
 ]
 
 # Storage dtypes: e4m3 for forward activations/weights (more mantissa),
@@ -166,3 +168,61 @@ def qdq(x: jax.Array, scale: jax.Array, dtype=E4M3) -> jax.Array:
     the simulation hook a Policy/layer wraps around matmul operands until
     native fp8 ``dot_general`` is wired for the target TPU generation."""
     return dequantize(quantize(x, scale, dtype), scale, x.dtype)
+
+
+def fp8_dense(x: jax.Array, w: jax.Array, state: Dict[str, Any],
+              *, x_name: str = "x", w_name: str = "w",
+              recipe: Fp8Recipe = Fp8Recipe(),
+              axis_names: Optional[Sequence[str]] = None
+              ) -> Tuple[jax.Array, Dict[str, Any]]:
+    """fp8 delayed-scaling matmul hook: ``y = qdq(x) @ qdq(w)`` with the
+    CURRENT scales, returning ``(y, new_state)`` where the state absorbed
+    this step's amaxes (reduced over the amax axes). The standard usage —
+    scales trail the data by one step, exactly TE delayed scaling:
+
+        y, fp8_state = fp8.fp8_dense(x, w, fp8_state)
+
+    The backward: quantization itself is straight-through (identity
+    derivative), and the incoming cotangent is qdq'd into
+    ``recipe.bwd_dtype`` (e5m2) with *current* scaling — its scale computed
+    from the cotangent's own amax on the spot, since the backward cannot
+    thread delayed state out of the vjp — so gradient-path fp8 effects are
+    simulated too (TE's hybrid recipe; current scaling is one of its
+    supported amax modes).
+    """
+    xs = state[x_name]["scale"]
+    ws = state[w_name]["scale"]
+    xq = _ste_qdq(x, xs, recipe.fwd_dtype, recipe.bwd_dtype)
+    wq = _ste_qdq(w, ws, recipe.fwd_dtype, recipe.bwd_dtype)
+    y = xq @ wq
+    new_state = dict(state)
+    upd = update_fp8_state(
+        {x_name: state[x_name], w_name: state[w_name]},
+        {x_name: compute_amax(x), w_name: compute_amax(w)},
+        recipe, axis_names=axis_names)
+    new_state.update(upd)
+    return y, new_state
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _ste_qdq(x, scale, dtype, bwd_dtype=None):
+    return qdq(x, scale, dtype)
+
+
+def _ste_fwd(x, scale, dtype, bwd_dtype):
+    return qdq(x, scale, dtype), scale
+
+
+def _ste_bwd(dtype, bwd_dtype, scale, g):
+    # straight-through: d qdq/dx ~= 1 (no cotangent into the scale, which
+    # is statistics-driven, not loss-driven). The cotangent itself is
+    # e5m2-simulated with current scaling when a bwd_dtype is set.
+    if bwd_dtype is not None:
+        amax = jnp.max(jnp.abs(g)).astype(jnp.float32)
+        gs = jnp.where((amax > 0.0) & jnp.isfinite(amax),
+                       fp8_max(bwd_dtype) / amax, 1.0)
+        g = qdq(g, gs, bwd_dtype)
+    return g, jnp.zeros_like(scale)
+
+
+_ste_qdq.defvjp(_ste_fwd, _ste_bwd)

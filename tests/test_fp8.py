@@ -168,3 +168,88 @@ class TestMultiSliceMesh:
         with pytest.raises(RuntimeError, match="num_slices"):
             parallel_state.initialize_model_parallel(num_slices=3)
         parallel_state.destroy_model_parallel()
+
+
+class TestFp8Dense:
+    """The delayed-scaling matmul hook: scales trail the data one step,
+    gradients pass straight-through the quantizer."""
+
+    def test_trains_and_scales_adapt(self):
+        r = fp8.Fp8Recipe(amax_history_len=4)
+        w = jax.random.normal(jax.random.PRNGKey(0), (32, 16)) * 0.3
+        x = jax.random.normal(jax.random.PRNGKey(1), (64, 32)) * 2.0
+        y_t = jax.random.normal(jax.random.PRNGKey(2), (64, 16))
+        state = fp8.init_fp8_state(["x", "w"], r)
+
+        @jax.jit
+        def step(w, state):
+            def loss_fn(w):
+                y, new_state = fp8.fp8_dense(x, w, state, recipe=r,
+                                             axis_names=())
+                return jnp.mean((y - y_t) ** 2), new_state
+            (loss, new_state), g = jax.value_and_grad(
+                loss_fn, has_aux=True)(w)
+            return w - 0.05 * g, new_state, loss
+
+        losses = []
+        for _ in range(25):
+            w, state, loss = step(w, state)
+            losses.append(float(loss))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0] * 0.7
+        # scales adapted to the observed amaxes (no longer the init 1.0)
+        assert float(state["x"]["scale"]) != 1.0
+        assert float(state["w"]["scale"]) != 1.0
+
+    def test_matches_unquantized_within_fp8_tolerance(self):
+        r = fp8.Fp8Recipe(amax_history_len=1)
+        x = jax.random.normal(jax.random.PRNGKey(3), (32, 64))
+        w = jax.random.normal(jax.random.PRNGKey(4), (64, 32)) * 0.1
+        state = fp8.init_fp8_state(["x", "w"], r)
+        # one warmup call installs data-driven scales
+        _, state = fp8.fp8_dense(x, w, state, recipe=r, axis_names=())
+        y, _ = fp8.fp8_dense(x, w, state, recipe=r, axis_names=())
+        ref = x @ w
+        rel = float(jnp.max(jnp.abs(y - ref)) / jnp.max(jnp.abs(ref)))
+        assert rel < 0.1          # e4m3 rounding, not garbage
+
+    def test_straight_through_gradient(self):
+        r = fp8.Fp8Recipe(amax_history_len=1)
+        x = jnp.ones((4, 8))
+        w = jnp.full((8, 2), 0.5)
+        state = fp8.init_fp8_state(["x", "w"], r)
+        _, state = fp8.fp8_dense(x, w, state, recipe=r, axis_names=())
+
+        def loss_fn(w):
+            y, _ = fp8.fp8_dense(x, w, state, recipe=r, axis_names=())
+            return jnp.sum(y)
+
+        g = jax.grad(loss_fn)(w)
+        # d(sum(xq @ wq))/dw ~= x^T @ ones through the straight-through path
+        ref = jnp.ones((4, 8)).T @ jnp.ones((4, 2))
+        np.testing.assert_allclose(np.asarray(g), np.asarray(ref),
+                                   rtol=0.1)
+
+    def test_backward_e5m2_rounding_applied(self):
+        # the cotangent path must show e5m2 quantization effects (current
+        # scaling): grads through fp8_dense differ from exact bf16 grads
+        # by bounded rounding, and disabling bwd_dtype recovers exactness
+        x = jax.random.normal(jax.random.PRNGKey(5), (16, 32))
+        w = jax.random.normal(jax.random.PRNGKey(6), (32, 8)) * 0.1
+        r = fp8.Fp8Recipe(amax_history_len=1)
+        state = fp8.init_fp8_state(["x", "w"], r)
+        _, state = fp8.fp8_dense(x, w, state, recipe=r, axis_names=())
+        ct = jax.random.normal(jax.random.PRNGKey(7), (16, 8))
+
+        def loss_fn(w):
+            y, _ = fp8.fp8_dense(x, w, state, recipe=r, axis_names=())
+            return jnp.sum(y * ct)
+
+        g = jax.grad(loss_fn)(w)
+        # reference: same fwd qdq operands, exact backward
+        xq = fp8.qdq(x, state["x"]["scale"])
+        ref = jax.grad(lambda w: jnp.sum(
+            (xq @ fp8.qdq(w, state["w"]["scale"])) * ct))(w)
+        rel = float(jnp.max(jnp.abs(g - ref)) / jnp.max(jnp.abs(ref)))
+        assert rel < 0.4             # e5m2 (2 mantissa bits), not garbage
+        assert rel > 0.0             # and genuinely quantized
