@@ -3,7 +3,11 @@
 Prints one JSON line per config ({"metric", "value", "unit", "vs_baseline",
 "mfu", "model_tflops"}), finishing with the headline flagship line (GPT-2
 124M training throughput, ``vs_baseline`` = fused/Pallas vs the repo's own
-unfused-XLA path — the reference publishes no absolute numbers, BASELINE.md).
+unfused-XLA path, each at its best feasible config: the fused path skips
+activation recompute because flash attention's O(seq) memory permits it,
+the unfused path cannot — so the ratio measures the kernels AND the memory
+headroom they buy. The reference publishes no absolute numbers,
+BASELINE.md).
 
 Configs (BASELINE.md / BASELINE.json):
   1. ResNet-50 224px, amp-O2-equivalent bf16 + FusedSGD (north-star config)
@@ -70,14 +74,15 @@ def _run(flash: bool):
     # kernel dispatch is keyed on APEX_TPU_FORCE_PALLAS (ops/_support.py);
     # 'off' turns every fused op into its plain-XLA fallback = the baseline
     prev = os.environ.get("APEX_TPU_FORCE_PALLAS")
-    os.environ["APEX_TPU_FORCE_PALLAS"] = (
-        "tpu" if flash and jax.default_backend() == "tpu" else "off")
+    fused = flash and jax.default_backend() == "tpu"
+    os.environ["APEX_TPU_FORCE_PALLAS"] = "tpu" if fused else "off"
     support.pallas_mode.cache_clear()
     # each path runs its best feasible config: the flash kernel's O(seq)
     # memory lets the fused path skip activation recompute (~+4%); the
-    # unfused path materializes per-layer score tensors and OOMs without it
+    # unfused path materializes per-layer score tensors and OOMs without it.
+    # Keyed on whether the Pallas kernels actually engage, not the flag.
     step, params, opt_state, tokens_per_step, n_params, seq = _build(
-        recompute=not flash)
+        recompute=not fused)
     params, opt_state, loss = step(params, opt_state)          # compile
     _ = float(loss)
     # best-of-3 windows: the tunneled backend has multi-second transient

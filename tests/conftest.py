@@ -23,6 +23,12 @@ if os.environ.get("APEX_TPU_TEST_TPU", "0") != "1":
     # the env var JAX_PLATFORMS can be overridden by TPU plugins in this
     # image; the config knob wins
     jax.config.update("jax_platforms", "cpu")
+else:
+    # numerics tests were written against true-fp32 math; TPU's default
+    # matmul precision multiplies fp32 operands in bf16 passes (~4e-3
+    # relative error), which is a precision POLICY, not a kernel bug —
+    # force full fp32 so CPU-calibrated tolerances hold on hardware
+    jax.config.update("jax_default_matmul_precision", "highest")
 
 import pytest  # noqa: E402
 
