@@ -131,8 +131,11 @@ class TestGQA:
             argnums=(0, 1, 2))(q, k, v)
         assert g[1].shape == k.shape     # dk has kv_heads, not heads
         for a, b in zip(g, gr):
+            # atol 2e-4: on real TPU a handful of elements differ at ~1e-4
+            # from fp32 accumulation ORDER (block-wise vs full-row sums),
+            # even at highest matmul precision
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                       atol=5e-5, rtol=5e-5)
+                                       atol=2e-4, rtol=5e-5)
 
     def test_varlen_gqa(self):
         q = _rand((2, 4, 64, 64), seed=17)
@@ -180,8 +183,9 @@ class TestSlidingWindow:
             q, k, v, None, 1.0 / np.sqrt(64), True, 48)),
             argnums=(0, 1, 2))(q, k, v)
         for a, b in zip(g, gr):
+            # atol 2e-4: TPU fp32 accumulation-order noise (see TestGQA)
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                       atol=5e-5, rtol=5e-5)
+                                       atol=2e-4, rtol=5e-5)
 
     def test_gqa_window(self):
         q = _rand((1, 4, 128, 64), seed=27)
