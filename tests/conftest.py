@@ -54,3 +54,13 @@ def data_mesh():
     mesh = parallel_state.initialize_model_parallel()
     yield mesh
     parallel_state.destroy_model_parallel()
+
+
+def require_devices(n: int):
+    """Skip multi-device tests on backends with fewer devices (the real
+    single-chip TPU under APEX_TPU_TEST_TPU=1; virtual CPU meshes always
+    have 8)."""
+    import pytest
+
+    if len(jax.devices()) < n:
+        pytest.skip(f"needs {n} devices, have {len(jax.devices())}")
