@@ -56,11 +56,32 @@ def data_mesh():
     parallel_state.destroy_model_parallel()
 
 
-def require_devices(n: int):
-    """Skip multi-device tests on backends with fewer devices (the real
-    single-chip TPU under APEX_TPU_TEST_TPU=1; virtual CPU meshes always
-    have 8)."""
-    import pytest
+def _skip_if_undersized_mesh(excinfo):
+    """On backends with fewer than 8 devices (the real single-chip TPU
+    under APEX_TPU_TEST_TPU=1), a mesh request the hardware cannot satisfy
+    is a SKIP, not a failure — the same tests run for real on the 8-device
+    virtual CPU mesh."""
+    if (isinstance(excinfo, RuntimeError)
+            and "is not divisible by" in str(excinfo)
+            and len(jax.devices()) < 8):
+        pytest.skip(f"multi-device test on a {len(jax.devices())}-device "
+                    f"backend: {excinfo}")
 
-    if len(jax.devices()) < n:
-        pytest.skip(f"needs {n} devices, have {len(jax.devices())}")
+
+@pytest.hookimpl(wrapper=True)
+def pytest_runtest_call(item):
+    try:
+        return (yield)
+    except RuntimeError as e:
+        _skip_if_undersized_mesh(e)
+        raise
+
+
+@pytest.hookimpl(wrapper=True)
+def pytest_runtest_setup(item):
+    # mesh fixtures (mesh8/data_mesh) raise during setup
+    try:
+        return (yield)
+    except RuntimeError as e:
+        _skip_if_undersized_mesh(e)
+        raise

@@ -153,8 +153,6 @@ class TestGuards:
             generate(model, params, prompt, max_new_tokens=10)
 
     def test_tp_generation_matches_single_rank(self):
-        from conftest import require_devices
-        require_devices(2)
         """Greedy generation under TP == unsharded (full-vocab argmax after
         the vocab all-gather)."""
         from jax.sharding import PartitionSpec as P

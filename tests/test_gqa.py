@@ -109,8 +109,6 @@ def _train(tp, steps=3):
 
 
 def test_tp2_matches_unsharded():
-    from conftest import require_devices
-    require_devices(2)
     """Sharded GQA training reproduces the single-rank run: the grouped QKV
     layout keeps whole K/V groups per TP rank."""
     ref_losses, ref_params = _train(tp=1)
@@ -122,8 +120,6 @@ def test_tp2_matches_unsharded():
 
 
 def test_tp_exceeding_groups_fails_fast():
-    from conftest import require_devices
-    require_devices(2)
     """MQA (1 group) with tp=2 must raise a clear config error, not emit a
     zero-head cache or an opaque reshape failure."""
     from jax.sharding import PartitionSpec as P
