@@ -155,6 +155,9 @@ class TestMultiSliceMesh:
     def test_model_axes_cannot_cross_dcn(self):
         import pytest
 
+        if len(jax.devices()) < 8:
+            pytest.skip("the divisibility check fires before the DCN guard "
+                        "on small device counts")
         parallel_state.destroy_model_parallel()
         with pytest.raises(RuntimeError, match="DCN"):
             parallel_state.initialize_model_parallel(
