@@ -118,6 +118,8 @@ def test_syncbn_process_groups_sub_axis():
 
     from apex_tpu.parallel import SyncBatchNorm
 
+    if len(jax.devices()) < 8:
+        pytest.skip("needs an 8-device mesh (2x4 group layout)")
     devs = np.array(jax.devices()[:8]).reshape(2, 4)
     mesh = Mesh(devs, ("group", "member"))
     bn = SyncBatchNorm(num_features=3, axis_name="member",
@@ -160,6 +162,9 @@ class TestSpecAwareGradSync:
     def test_prefix_spec_accepted(self):
         from apex_tpu.training import sync_data_parallel_grads
 
+        if len(jax.devices()) < 8:
+            pytest.skip("assertions assume an 8-rank data axis")
+
         parallel_state.destroy_model_parallel()
         mesh = parallel_state.initialize_model_parallel()   # data = 8
         grads = {"block": {"w": jnp.ones((8, 4)), "b": jnp.ones((4,))},
@@ -184,6 +189,9 @@ class TestSpecAwareGradSync:
 
     def test_data_sharded_leaf_divided_not_averaged(self):
         from apex_tpu.training import sync_data_parallel_grads
+
+        if len(jax.devices()) < 8:
+            pytest.skip("assertions assume an 8-rank data axis")
 
         parallel_state.destroy_model_parallel()
         mesh = parallel_state.initialize_model_parallel()
