@@ -38,7 +38,10 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec
 
-from apex_tpu.utils.batch_norm import bn_apply as _bn_apply, bn_init as _bn_init
+from apex_tpu.utils.batch_norm import (bn_apply as _bn_apply,
+                                       bn_from_sums as _bn_from_sums,
+                                       bn_init as _bn_init,
+                                       bn_sums as _bn_sums)
 from apex_tpu.utils.conv import conv_nhwc as _conv, he_init as _he_init
 
 __all__ = ["ResNetConfig", "ResNet", "resnet18", "resnet34", "resnet50",
@@ -70,6 +73,15 @@ class ResNetConfig:
     # zero-init the last BN scale of each residual block (torchvision
     # `zero_init_residual`, the standard large-batch RN50 recipe)
     zero_init_residual: bool = True
+    # route bottleneck 1x1 convs through the fused Pallas GEMM+BN+stats
+    # kernel (ops/conv_fused.py) during training — folds the separate BN
+    # statistics and normalize passes into the conv's own HBM streams.
+    # Opt-in (None = off): per-op the kernels beat XLA's backward, but at
+    # the whole-model level XLA's convs and Pallas disagree on activation
+    # layouts, and the boundary copies outweigh the win on v5e (measured
+    # analysis in PERF.md — the same reason the reference ships its fused
+    # bottleneck as opt-in contrib, bottleneck.py:134).
+    fused_conv: Optional[bool] = None
 
     @property
     def block(self) -> str:
@@ -160,8 +172,60 @@ class ResNet:
         return _bn_apply(p, s, x, train=train, momentum=cfg.bn_momentum,
                          eps=cfg.bn_eps, axis_name=cfg.axis_name)
 
+    def _use_fused(self) -> bool:
+        return bool(self.config.fused_conv)
+
+    def _block_apply_fused(self, p, s, x, stride):
+        """Bottleneck block on the fused 1x1-GEMM+BN kernels (training hot
+        path): each 1x1 conv reads its raw input once, applies the previous
+        BN's normalize+ReLU on the fly, and emits its output's batch
+        statistics from a VMEM epilogue — the TPU counterpart of the
+        reference's fused bottleneck graphs
+        (``apex/contrib/bottleneck/bottleneck.py:134-262``). The 3x3 conv
+        stays an XLA convolution (its input normalize fuses into the conv
+        read; its output statistics are one fused reduction pass)."""
+        cfg = self.config
+        from apex_tpu.ops.conv_fused import conv1x1_bn_act
+        new_s = {}
+
+        def close(bn_name, sums, n, y):
+            """bn_from_sums + the normalize affine in the activation
+            dtype; records the updated running stats."""
+            a, b, new_s[bn_name] = _bn_from_sums(
+                p[bn_name], s[bn_name], sums, n, shift=s[bn_name]["mean"],
+                momentum=cfg.bn_momentum, eps=cfg.bn_eps,
+                axis_name=cfg.axis_name)
+            return y * a.astype(y.dtype) + b.astype(y.dtype)
+
+        nhw = x.shape[0] * x.shape[1] * x.shape[2]
+        y1, s1 = conv1x1_bn_act(x, p["conv1"].reshape(x.shape[-1], -1),
+                                stats_shift=s["bn1"]["mean"])
+        z1 = jax.nn.relu(close("bn1", s1, nhw, y1))
+        y2 = _conv(z1, p["conv2"], stride)
+        nhw2 = y2.shape[0] * y2.shape[1] * y2.shape[2]
+        s2 = _bn_sums(y2, s["bn2"]["mean"])
+        a2, b2, new_s["bn2"] = _bn_from_sums(
+            p["bn2"], s["bn2"], s2, nhw2, shift=s["bn2"]["mean"],
+            momentum=cfg.bn_momentum, eps=cfg.bn_eps,
+            axis_name=cfg.axis_name)
+        y3, s3 = conv1x1_bn_act(y2, p["conv3"].reshape(y2.shape[-1], -1),
+                                a2, b2, relu=True,
+                                stats_shift=s["bn3"]["mean"])
+        out = close("bn3", s3, nhw2, y3)
+        if "down_conv" in p:
+            xd = x[:, ::stride, ::stride, :] if stride != 1 else x
+            yd, sd = conv1x1_bn_act(xd,
+                                    p["down_conv"].reshape(x.shape[-1], -1),
+                                    stats_shift=s["down_bn"]["mean"])
+            residual = close("down_bn", sd, nhw2, yd)
+        else:
+            residual = x
+        return jax.nn.relu(out + residual), new_s
+
     def _block_apply(self, p, s, x, stride, train):
         cfg = self.config
+        if cfg.block == "bottleneck" and train and self._use_fused():
+            return self._block_apply_fused(p, s, x, stride)
         new_s = {}
         out = _conv(x, p["conv1"], stride if cfg.block == "basic" else 1)
         out, new_s["bn1"] = self._bn(p["bn1"], s["bn1"], out, train)

@@ -5,16 +5,25 @@ Statistics are always fp32 regardless of activation dtype (the reference's
 ``keep_batchnorm_fp32`` amp rule, ``fp16_utils/fp16util.py:60``), and the
 training-mode reduction optionally ``psum``s over a named mesh axis — the
 SyncBN merge of ``apex/parallel/optimized_sync_batchnorm_kernel.py:7-120``.
+
+The moments are one fused pass of **shifted** sums ``(sum(x - c),
+sum((x - c)^2))`` with ``c`` the running mean: one reduction (one ``psum``
+under SyncBN) like the naive ``E[x^2] - E[x]^2`` form, but centered so it
+does not catastrophically cancel for channels whose mean is large relative
+to their std — the numerical property the reference's Welford kernels
+(``csrc/welford.cu``) exist to provide, recovered here without the
+sequential update Welford needs.
 """
 
 from __future__ import annotations
 
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["bn_init", "bn_apply"]
+__all__ = ["bn_init", "bn_apply", "bn_sums", "bn_from_sums"]
 
 
 def bn_init(c: int):
@@ -25,39 +34,64 @@ def bn_init(c: int):
              "var": jnp.ones((c,), jnp.float32)})
 
 
+def bn_sums(x, shift):
+    """Per-channel fp32 ``[2, C]`` shifted sums of NHWC ``x`` over (N, H, W):
+    row 0 = ``sum(x - shift)``, row 1 = ``sum((x - shift)^2)``. The cast and
+    subtract fuse into the reduction read — one pass over ``x``."""
+    xc = x.astype(jnp.float32) - lax.stop_gradient(
+        shift.astype(jnp.float32))
+    return jnp.stack([jnp.sum(xc, axis=(0, 1, 2)),
+                      jnp.sum(jnp.square(xc), axis=(0, 1, 2))])
+
+
+def bn_from_sums(p, s, sums, n, *, shift, momentum: float, eps: float,
+                 axis_name: Optional[str]):
+    """Close a batch norm from shifted sums: ``shift`` must be the same
+    per-channel shift the sums were built with (see :func:`bn_sums` /
+    ``conv1x1_bn_act(stats_shift=...)``). Returns ``(a, b, new_state)``
+    where the normalize is the per-channel affine ``y = x * a + b``. With
+    ``axis_name`` bound the sums are ``psum``-merged first (SyncBN)."""
+    n = jnp.asarray(n, jnp.float32)
+    if axis_name is not None:
+        sums = lax.psum(sums, axis_name)
+        n = lax.psum(n, axis_name)
+    shift = lax.stop_gradient(shift)
+    d = sums[0] / n
+    mean = shift + d
+    var = jnp.maximum(sums[1] / n - jnp.square(d), 0.0)
+    new_s = {
+        "mean": (1 - momentum) * s["mean"] + momentum * mean,
+        # running var uses the unbiased estimate, torch BN semantics
+        "var": (1 - momentum) * s["var"]
+               + momentum * var * n / jnp.maximum(n - 1, 1.0),
+    }
+    inv = lax.rsqrt(var + eps)
+    a = inv * p["scale"]
+    b = p["bias"] - mean * a
+    return a, b, new_s
+
+
 def bn_apply(p, s, x, *, train: bool, momentum: float, eps: float,
              axis_name: Optional[str]):
     """NHWC batch norm; returns ``(y, new_state)``. With ``axis_name`` bound
     the batch statistics are synchronized across that mesh axis.
 
     Performance shape (v5e, RN50-sized activations): statistics are ONE
-    fused fp32 pass (sum + sum-of-squares reduced together, one ``psum``
-    for both under SyncBN) instead of the textbook two-pass
-    ``E[(x-mean)^2]``, and the normalize itself is a per-channel affine
-    ``x * a + b`` applied in the activation dtype — the big elementwise op
-    stays bf16 and fuses into the surrounding conv, only the tiny [C]
-    vectors are fp32. This is the same split the reference's Welford CUDA
-    kernels make (fp32 stats, fp16 apply; ``csrc/welford.cu``).
+    fused fp32 pass (shifted sum + sum-of-squares reduced together, one
+    ``psum`` for both under SyncBN), and the normalize itself is a
+    per-channel affine ``x * a + b`` applied in the activation dtype — the
+    big elementwise op stays bf16 and fuses into the surrounding conv, only
+    the tiny [C] vectors are fp32. This is the same split the reference's
+    Welford CUDA kernels make (fp32 stats, fp16 apply; ``csrc/welford.cu``).
     """
     if train:
-        x32 = x.astype(jnp.float32)      # fused into the reduction by XLA
-        n = jnp.asarray(x.shape[0] * x.shape[1] * x.shape[2], jnp.float32)
-        stats = jnp.stack([jnp.sum(x32, axis=(0, 1, 2)),
-                           jnp.sum(jnp.square(x32), axis=(0, 1, 2))])
-        if axis_name is not None:
-            stats = lax.psum(stats, axis_name)
-            n = lax.psum(n, axis_name)
-        mean = stats[0] / n
-        var = jnp.maximum(stats[1] / n - jnp.square(mean), 0.0)
-        new_s = {
-            "mean": (1 - momentum) * s["mean"] + momentum * mean,
-            # running var uses the unbiased estimate, torch BN semantics
-            "var": (1 - momentum) * s["var"]
-                   + momentum * var * n / jnp.maximum(n - 1, 1.0),
-        }
+        n = x.shape[0] * x.shape[1] * x.shape[2]
+        a, b, new_s = bn_from_sums(p, s, bn_sums(x, s["mean"]), n,
+                                   shift=s["mean"], momentum=momentum,
+                                   eps=eps, axis_name=axis_name)
     else:
         mean, var, new_s = s["mean"], s["var"], s
-    inv = lax.rsqrt(var + eps)
-    a = (inv * p["scale"]).astype(x.dtype)
-    b = (p["bias"] - mean * inv * p["scale"]).astype(x.dtype)
-    return x * a + b, new_s
+        inv = lax.rsqrt(var + eps)
+        a = inv * p["scale"]
+        b = p["bias"] - mean * a
+    return x * a.astype(x.dtype) + b.astype(x.dtype), new_s
