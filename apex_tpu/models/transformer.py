@@ -116,7 +116,16 @@ class TransformerConfig:
     moe_aux_loss_weight: float = 1e-2
     moe_router_jitter: float = 0.0
     moe_expert_axis: Optional[str] = None   # e.g. "data" for EP over DP
-    recompute: bool = False          # full-layer activation recompute
+    # activation recompute: False = save everything; True/'full' = full
+    # per-layer recompute (reference `tensor_parallel.random.checkpoint`
+    # semantics); 'selective' = save matmul outputs, recompute elementwise
+    # (Megatron's selective activation recompute, expressed as a
+    # jax.checkpoint dot-saveable policy instead of hand-split forward)
+    recompute: Any = False
+    # lax.scan unroll factor for the layer stack: >1 trades compile time
+    # for fewer while-loop iterations and cross-layer fusion of the
+    # activation-save writes (the dynamic-update-slice traffic)
+    scan_unroll: int = 1
     params_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.float32  # activations cast at block entry
     init_method_std: float = 0.02
@@ -831,7 +840,14 @@ class ParallelTransformer:
                     return out        # (h, new_cache)
                 return out if moe else (out, jnp.zeros((), jnp.float32))
 
-            h, extra = (jax.checkpoint(run)(h) if c.recompute else run(h))
+            if c.recompute == "selective":
+                run = jax.checkpoint(
+                    run,
+                    policy=jax.checkpoint_policies
+                    .dots_with_no_batch_dims_saveable)
+            elif c.recompute:
+                run = jax.checkpoint(run)
+            h, extra = run(h)
             if layer_cache is not None:
                 return (h, aux_sum, idx + 1), extra
             return (h, aux_sum + extra, idx + 1), None
@@ -839,7 +855,8 @@ class ParallelTransformer:
         xs = (params["layers"] if kv_caches is None
               else (params["layers"], kv_caches))
         (hidden, aux_sum, _), new_caches = lax.scan(
-            one_layer, (hidden, jnp.zeros((), jnp.float32), 0), xs)
+            one_layer, (hidden, jnp.zeros((), jnp.float32), 0), xs,
+            unroll=min(c.scan_unroll, c.num_layers))
         if final_norm:
             hidden = _ln(params["final_layernorm"], hidden,
                          c.layernorm_epsilon, c.sequence_parallel,

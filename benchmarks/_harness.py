@@ -49,16 +49,24 @@ def resnet50_train_flops(images: int, image_size: int) -> float:
 
 def run(metric: str, unit: str, step_fn: Callable, *state,
         work_per_step: float, steps: int = 10, baseline_fn=None,
-        model_flops_per_step: Optional[float] = None):
+        model_flops_per_step: Optional[float] = None,
+        consume_state: bool = False):
     """``step_fn(*state) -> (*new_state, loss)``; prints the JSON line.
 
     ``baseline_fn``: optional same-signature unoptimized step; when given,
     ``vs_baseline`` reports measured speedup, else 1.0.
     ``model_flops_per_step``: when given, the line carries ``mfu`` (model-
     FLOPs utilization vs the chip's bf16 peak).
+    ``consume_state``: skip the defensive state copy — required when state
+    is a large fraction of HBM (the copy doubles residency and OOMs);
+    incompatible with ``baseline_fn``.
     """
     import jax
     import numpy as _np
+
+    if consume_state and baseline_fn is not None:
+        raise ValueError("consume_state does not compose with baseline_fn "
+                         "(the baseline needs the same initial state)")
 
     def _fetch(x):
         # hard device->host fetch: through tunneled PJRT backends (axon)
@@ -69,8 +77,12 @@ def run(metric: str, unit: str, step_fn: Callable, *state,
     def _time(fn, state):
         # fresh copies per timing run: a donating step consumes its input
         # buffers, and the baseline run must reuse the same initial state
-        state = [jax.tree.map(lambda a: a.copy() if hasattr(a, "copy") else a,
-                              s) for s in state]
+        if not consume_state:
+            state = [jax.tree.map(
+                lambda a: a.copy() if hasattr(a, "copy") else a,
+                s) for s in state]
+        else:
+            state = list(state)
         out = fn(*state)
         _fetch(out[-1])
         state = list(out[:-1])

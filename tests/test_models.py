@@ -37,11 +37,12 @@ def _batch(bs=8, seq=16, vocab=128):
     return {"tokens": toks, "labels": labels}
 
 
-def _train(tp, sp, steps=3, recompute=False):
+def _train(tp, sp, steps=3, recompute=False, scan_unroll=1):
     parallel_state.destroy_model_parallel()
     mesh = parallel_state.initialize_model_parallel(
         tensor_model_parallel_size=tp)
-    cfg = small_config(sequence_parallel=sp, recompute=recompute)
+    cfg = small_config(sequence_parallel=sp, recompute=recompute,
+                       scan_unroll=scan_unroll)
     model = GPTModel(cfg)
     params = model.init(jax.random.PRNGKey(0))
     opt = FusedAdam(lr=1e-3)
@@ -97,6 +98,16 @@ class TestGPT:
         ref_losses, _ = _train(tp=1, sp=False)
         rc_losses, _ = _train(tp=1, sp=False, recompute=True)
         np.testing.assert_allclose(ref_losses, rc_losses, atol=1e-6)
+
+    def test_selective_recompute_and_unroll_match_plain(self):
+        """'selective' remat policy (save dots, recompute elementwise) and
+        an unrolled layer scan are pure schedule changes — numerics must
+        match the plain path."""
+        ref_losses, _ = _train(tp=1, sp=False)
+        sel_losses, _ = _train(tp=1, sp=False, recompute="selective")
+        np.testing.assert_allclose(ref_losses, sel_losses, atol=1e-6)
+        un_losses, _ = _train(tp=1, sp=False, scan_unroll=4)
+        np.testing.assert_allclose(ref_losses, un_losses, atol=1e-6)
 
     def test_dropout_needs_rng_and_decorrelates_ranks(self):
         cfg = small_config(hidden_dropout=0.5)
