@@ -46,20 +46,44 @@ def lm_head_loss(embedding_weight, hidden, labels, loss_mask, config):
     (optionally loss-masked) mean loss.
     """
     c = config
-    # LM-head matmul in compute dtype (bf16 on the MXU runs ~4x fp32 and
-    # halves the [s, b, V] logits footprint); the CE upcasts internally
-    # (vocab_parallel_cross_entropy fp32 math, Megatron kernel semantics)
-    logits = linear_with_grad_accumulation_and_async_allreduce(
-        hidden.astype(c.compute_dtype),
-        embedding_weight,     # callee casts weight to x.dtype (amp-O2 rule)
-        None,
-        sequence_parallel_enabled=c.sequence_parallel,
-        axis_name=c.axis_name)                              # [s, b, V/tp]
+
+    def head(hid):
+        # LM-head matmul in compute dtype (bf16 on the MXU runs ~4x fp32
+        # and halves the [s, b, V] logits footprint); the CE upcasts
+        # internally (vocab_parallel_cross_entropy fp32 math, Megatron
+        # kernel semantics)
+        return linear_with_grad_accumulation_and_async_allreduce(
+            hid.astype(c.compute_dtype),
+            embedding_weight,  # callee casts weight to x.dtype (amp-O2 rule)
+            None,
+            sequence_parallel_enabled=c.sequence_parallel,
+            axis_name=c.axis_name)                          # [s, b, V/tp]
+
     if labels is None:
-        return logits
+        return head(hidden)
     labels_sb = labels.transpose(1, 0)                      # [s, b]
-    losses = vocab_parallel_cross_entropy(logits, labels_sb,
-                                          axis_name=c.axis_name)
+    nc = c.loss_seq_chunks
+    if nc > 1 and not c.sequence_parallel and hidden.shape[0] % nc == 0:
+        # long-context memory guard: the [s, b, V] logits of a 64k sequence
+        # are ~13 GB in fp32 — compute head+CE per sequence chunk under
+        # remat so only one chunk's logits ever exist (the chunk re-runs
+        # its matmul in backward, a cheap trade at vocab width). Skipped
+        # under SP, where the head's all-gather interleaves global
+        # positions across chunks.
+        s = hidden.shape[0]
+        hc = hidden.reshape(nc, s // nc, *hidden.shape[1:])
+        lc = labels_sb.reshape(nc, s // nc, labels_sb.shape[1])
+
+        @jax.checkpoint
+        def chunk_losses(hid, lab):
+            return vocab_parallel_cross_entropy(head(hid), lab,
+                                                axis_name=c.axis_name)
+
+        losses = jax.lax.map(lambda xs: chunk_losses(*xs), (hc, lc))
+        losses = losses.reshape(s, -1)
+    else:
+        losses = vocab_parallel_cross_entropy(head(hidden), labels_sb,
+                                              axis_name=c.axis_name)
     if loss_mask is None:
         return jnp.mean(losses)
     mask_sb = loss_mask.transpose(1, 0).astype(losses.dtype)

@@ -126,6 +126,11 @@ class TransformerConfig:
     # for fewer while-loop iterations and cross-layer fusion of the
     # activation-save writes (the dynamic-update-slice traffic)
     scan_unroll: int = 1
+    # compute the LM-head loss in this many sequence chunks (remat'd scan)
+    # so only one chunk's [s/nc, b, V] logits ever materialize — the
+    # long-context memory guard for the vocab head (no-op at 1, under SP,
+    # or when the sequence does not divide evenly)
+    loss_seq_chunks: int = 1
     params_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.float32  # activations cast at block entry
     init_method_std: float = 0.02

@@ -45,12 +45,26 @@ _NEG_INF = -1e30
 # backward recompute, so padded rows contribute nothing to dk/dv.
 _LSE_PAD = 1e30
 
-# Tuned on TPU v5e (fwd+bwd, causal, head_dim 64, seqs 1k-4k): (512, 512)
-# is the robust optimum — ~20% faster than (512, 1024) at s=1024 and within
-# noise of the best at s=4096; smaller blocks lose to grid/DMA overhead,
-# larger k blocks lose VMEM locality in the backward.
+# Tuned on TPU v5e (fwd+bwd, causal, head_dim 64): (512, 512) is the
+# robust optimum for seqs 1k-4k — smaller blocks lose to grid/DMA
+# overhead, larger k blocks lose VMEM locality in the backward. At long
+# sequence (>= _LONG_SEQ keys) the diagonal-walk reuse flips the trade:
+# (1024, 1024) measures 1.47x faster fwd+bwd at 32k (131ms vs 193ms,
+# PERF.md round 3); (1024, 2048) exceeds the 16MB scoped-vmem budget.
 _DEFAULT_BLOCK_Q = 512
 _DEFAULT_BLOCK_K = 512
+_LONG_SEQ = 8192
+_LONG_BLOCK = 1024
+
+
+def _auto_blocks(block_q, block_k, sk):
+    """Resolve None block sizes by key length (see tuning note above).
+    The long-seq upgrade applies only when the caller specified neither
+    block: auto-completing one side of an explicit choice could assemble
+    an over-VMEM pair like (1024, 2048)."""
+    if block_q is None and block_k is None and sk >= _LONG_SEQ:
+        return _LONG_BLOCK, _LONG_BLOCK
+    return (block_q or _DEFAULT_BLOCK_Q), (block_k or _DEFAULT_BLOCK_K)
 
 
 def _mask_block(s, i, j, bq, bk, sk, kvl, causal, window, q_off, k_off):
@@ -602,8 +616,8 @@ def _chunk_reference_bwd(q, k, v, do, lse, delta, kv_lengths, scale,
 
 def flash_chunk_fwd(q, k, v, *, q_start, k_start, causal=False, window=None,
                     kv_lengths=None, softmax_scale=None,
-                    block_q: int = _DEFAULT_BLOCK_Q,
-                    block_k: int = _DEFAULT_BLOCK_K):
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None):
     """One flash forward over a (q chunk, kv chunk) pair -> ``(o, lse)``.
 
     ``q_start``/``k_start`` (traced OK) place the chunks in GLOBAL sequence
@@ -619,6 +633,7 @@ def flash_chunk_fwd(q, k, v, *, q_start, k_start, causal=False, window=None,
                                     window, q_start, k_start)
     sq, d = q.shape[2], q.shape[3]
     sk = k.shape[2]
+    block_q, block_k = _auto_blocks(block_q, block_k, sk)
     bq = min(block_q, round_up(sq, 8))
     bk = min(block_k, round_up(sk, 128))
     group = q.shape[1] // k.shape[1]
@@ -632,8 +647,8 @@ def flash_chunk_fwd(q, k, v, *, q_start, k_start, causal=False, window=None,
 def flash_chunk_bwd(q, k, v, do, lse, delta, *, q_start, k_start,
                     causal=False, window=None, kv_lengths=None,
                     softmax_scale=None,
-                    block_q: int = _DEFAULT_BLOCK_Q,
-                    block_k: int = _DEFAULT_BLOCK_K):
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None):
     """Flash backward over one chunk pair with the GLOBAL ``lse``/``delta``
     residuals -> ``(dq, dk, dv)``. Exactness rests on the flash-backward
     decomposition: with the global log-sum-exp, per-chunk contributions sum
@@ -645,6 +660,7 @@ def flash_chunk_bwd(q, k, v, do, lse, delta, *, q_start, k_start,
                                     scale, causal, window, q_start, k_start)
     sq, d = q.shape[2], q.shape[3]
     sk = k.shape[2]
+    block_q, block_k = _auto_blocks(block_q, block_k, sk)
     bq = min(block_q, round_up(sq, 8))
     bk = min(block_k, round_up(sk, 128))
     group = q.shape[1] // k.shape[1]
@@ -702,8 +718,8 @@ def flash_attention(
     softmax_scale: Optional[float] = None,
     kv_lengths: Optional[jax.Array] = None,
     sliding_window: Optional[int] = None,
-    block_q: int = _DEFAULT_BLOCK_Q,
-    block_k: int = _DEFAULT_BLOCK_K,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
 ) -> jax.Array:
     """Multi-head attention ``softmax(scale * q @ k^T + mask) @ v``.
 
@@ -741,6 +757,7 @@ def flash_attention(
     if not use_pallas():
         return _mha_reference(q, k, v, kv_lengths, scale, causal,
                               sliding_window)
+    block_q, block_k = _auto_blocks(block_q, block_k, k.shape[2])
     bq = min(block_q, round_up(q.shape[2], 8))
     bk = min(block_k, round_up(k.shape[2], 128))
     return _flash(q, k, v, kv_lengths, scale, causal, bq, bk,

@@ -125,6 +125,8 @@ def _config_matrix():
         ("vit", lambda: vit.main()),
         ("long_context_32k", lambda: long_context.main()),
         ("long_context_32k_window", lambda: long_context.main(window=1024)),
+        ("long_context_64k_window",
+         lambda: long_context.main(seq=65536, window=1024)),
     ]
     for name, fn in configs:
         try:

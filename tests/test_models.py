@@ -99,6 +99,24 @@ class TestGPT:
         rc_losses, _ = _train(tp=1, sp=False, recompute=True)
         np.testing.assert_allclose(ref_losses, rc_losses, atol=1e-6)
 
+    def test_chunked_lm_head_loss_matches_plain(self):
+        """loss_seq_chunks (the long-context vocab-head memory guard) is a
+        pure schedule change — loss and grads must match unchunked."""
+        model_p = GPTModel(small_config())
+        model_c = GPTModel(small_config(loss_seq_chunks=4))
+        params = model_p.init(jax.random.PRNGKey(0))
+        b = _batch()
+
+        def loss(model):
+            return lambda p: model.apply(p, b["tokens"], b["labels"])
+
+        lp, gp = jax.value_and_grad(loss(model_p))(params)
+        lc_, gc = jax.value_and_grad(loss(model_c))(params)
+        np.testing.assert_allclose(float(lp), float(lc_), rtol=1e-6)
+        for a_, b_ in zip(jax.tree.leaves(gp), jax.tree.leaves(gc)):
+            np.testing.assert_allclose(np.asarray(a_), np.asarray(b_),
+                                       atol=1e-6, rtol=1e-5)
+
     def test_selective_recompute_and_unroll_match_plain(self):
         """'selective' remat policy (save dots, recompute elementwise) and
         an unrolled layer scan are pure schedule changes — numerics must
