@@ -50,10 +50,6 @@ class BertModel:
 
     def __post_init__(self):
         c = self.config
-        if c.num_moe_experts:
-            raise NotImplementedError(
-                "MoE (num_moe_experts) is currently wired into GPTModel "
-                "only; BertModel does not consume the (hidden, aux) pair")
         if c.attn_mask_type == AttnMaskType.causal:
             self.config = c = replace(c, attn_mask_type=AttnMaskType.padding)
         self.embedding = VocabParallelEmbedding(
@@ -152,6 +148,9 @@ class BertModel:
         hidden = self.transformer.apply(
             params["transformer"], hidden, attention_mask=mask,
             rng=rngs[1], deterministic=deterministic)
+        moe_aux = None
+        if c.num_moe_experts:
+            hidden, moe_aux = hidden
         if c.sequence_parallel:
             # heads (pooler/dense/layernorm) run on the full sequence; the
             # gather's backward scatters grads back to the shards
@@ -193,4 +192,6 @@ class BertModel:
             lm_loss = jnp.sum(losses * m) / jnp.maximum(jnp.sum(m), 1.0)
         else:
             lm_loss = jnp.mean(losses)
+        if moe_aux is not None:
+            lm_loss = lm_loss + moe_aux    # pre-scaled load-balancing term
         return lm_loss, binary_logits

@@ -62,10 +62,6 @@ class PipelinedGPT:
 
     def __post_init__(self):
         c = self.config
-        if c.num_moe_experts:
-            raise NotImplementedError(
-                "MoE (num_moe_experts) is currently wired into GPTModel "
-                "only; the pipeline scan carries a bare hidden state")
         self.embedding = VocabParallelEmbedding(
             c.vocab_size, c.hidden_size, init_method=c.init_method(),
             params_dtype=c.params_dtype, axis_name=c.axis_name)
@@ -110,17 +106,27 @@ class PipelinedGPT:
     # -- stage functions ----------------------------------------------------
 
     def _run_chunk(self, chunk_params, hidden, rng):
+        """Apply this rank's layer chunk; with MoE the per-layer (pre-
+        scaled) load-balancing losses are summed and returned alongside —
+        ``(hidden, aux)`` — which the schedules consume via ``stage_aux``."""
         deterministic = rng is None
+        moe = bool(self.config.num_moe_experts)
 
         def one_layer(carry, layer_params):
-            h, idx = carry
+            h, aux, idx = carry
             layer_rng = None if rng is None else jax.random.fold_in(rng, idx)
-            h = self.layer.apply(layer_params, h, rng=layer_rng,
-                                 deterministic=deterministic)
-            return (h, idx + 1), None
+            out = self.layer.apply(layer_params, h, rng=layer_rng,
+                                   deterministic=deterministic)
+            if moe:
+                h, a = out
+                aux = aux + a
+            else:
+                h = out
+            return (h, aux, idx + 1), None
 
-        (hidden, _), _ = lax.scan(one_layer, (hidden, 0), chunk_params)
-        return hidden
+        (hidden, aux, _), _ = lax.scan(
+            one_layer, (hidden, jnp.zeros((), jnp.float32), 0), chunk_params)
+        return (hidden, aux) if moe else hidden
 
     def _stage_rng(self, rng, tick):
         """Per-tick dropout stream, decorrelated across pipeline stages (the
@@ -179,13 +185,16 @@ class PipelinedGPT:
 
             batch = dict(batch)
             batch["_mb"] = jnp.arange(M)
+            moe = bool(self.config.num_moe_experts)
             if self.virtual_pipeline_size is not None:
                 inner = make_interleaved_pipelined_loss_fn(
                     preprocess, stage_interleaved, self._postprocess,
-                    M, self.virtual_pipeline_size, remat=remat)
+                    M, self.virtual_pipeline_size, remat=remat,
+                    stage_aux=moe)
             else:
                 inner = make_pipelined_loss_fn(
-                    preprocess, stage, self._postprocess, M, remat=remat)
+                    preprocess, stage, self._postprocess, M, remat=remat,
+                    stage_aux=moe)
             return inner(params, batch)
 
         return loss_fn

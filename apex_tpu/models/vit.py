@@ -54,10 +54,6 @@ class ViTModel:
     ``apply(params, images_nhwc) -> logits``."""
 
     def __init__(self, config: ViTConfig):
-        if config.transformer.num_moe_experts:
-            raise NotImplementedError(
-                "MoE (num_moe_experts) is currently wired into GPTModel "
-                "only; ViTModel does not consume the (hidden, aux) pair")
         self.config = config
         self.encoder = ParallelTransformer(config.transformer)
 
@@ -92,7 +88,10 @@ class ViTModel:
         }
 
     def apply(self, params, images, *, rng=None, deterministic=True):
-        """images: [N, H, W, C] NHWC -> logits [N, num_classes]."""
+        """images: [N, H, W, C] NHWC -> logits [N, num_classes] — or
+        ``(logits, moe_aux_loss)`` when the transformer config enables MoE
+        (``num_moe_experts``): the pre-scaled load-balancing term belongs
+        in the caller's training loss (ViT computes no loss in-model)."""
         cfg = self.config
         t = cfg.transformer
         x = images.astype(t.compute_dtype)
@@ -111,8 +110,12 @@ class ViTModel:
         hidden = hidden + params["pos_embed"].astype(t.compute_dtype)
         hidden = self.encoder.apply(
             params["encoder"], hidden, rng=rng, deterministic=deterministic)
+        moe_aux = None
+        if t.num_moe_experts:
+            hidden, moe_aux = hidden
         cls_out = hidden[0].astype(jnp.float32)          # [batch, hidden]
-        return cls_out @ params["head"]["kernel"] + params["head"]["bias"]
+        logits = cls_out @ params["head"]["kernel"] + params["head"]["bias"]
+        return logits if moe_aux is None else (logits, moe_aux)
 
 
 def _make(name, layers, hidden, heads, patch):

@@ -63,16 +63,24 @@ def make_interleaved_pipelined_loss_fn(
     *,
     axis_name: str = PIPELINE_AXIS,
     remat: bool = True,
+    stage_aux: bool = False,
 ) -> Callable:
     """Build ``loss_fn(params, batch) -> scalar`` for the circular pipeline.
 
     ``stage_fn(params, hidden, chunk, tick) -> hidden`` applies this rank's
     layer chunk ``chunk`` (``0..vpp-1``). ``remat`` is accepted for API
     parity; the backward always recomputes from the stashed chunk inputs.
+    ``stage_aux``: ``stage_fn`` returns ``(hidden, aux)`` — see the
+    non-interleaved schedule; each (rank, chunk)'s aux joins the loss
+    directly with a 1/M cotangent seed.
     """
     del remat
     M = num_microbatches
     vpp = virtual_pipeline_size
+
+    def _stage(params, h, c, t):
+        out = stage_fn(params, h, c, t)
+        return out if stage_aux else (out, jnp.zeros((), jnp.float32))
 
     # -- forward-only pipeline ----------------------------------------------
 
@@ -95,7 +103,10 @@ def make_interleaved_pipelined_loss_fn(
                 if c == 0:
                     h0 = preprocess_fn(params, mb_f)
                     h_c = _select(i == 0, h0, h_c) if pipelined else h0
-                y_c = stage_fn(params, h_c, c, t)
+                y_c, aux_c = _stage(params, h_c, c, t)
+                fwd_valid = (m_f >= 0) & (m_f < M)
+                lacc = lacc + jnp.where(fwd_valid,
+                                        aux_c.astype(jnp.float32), 0.0)
                 ys.append(y_c)
                 if c == vpp - 1:
                     m_out = t - (V - 1)
@@ -158,7 +169,10 @@ def make_interleaved_pipelined_loss_fn(
                     lambda s, w: lax.dynamic_update_index_in_dim(
                         s, jnp.where(fwd_valid, w, s[c]), c, 0),
                     stash, written)
-                ys.append(stage_fn(params, h_c, c, t))
+                y_c, aux_c = _stage(params, h_c, c, t)
+                lacc = lacc + jnp.where(fwd_valid,
+                                        aux_c.astype(jnp.float32), 0.0)
+                ys.append(y_c)
 
                 # ---- backward: microbatch m_b = t - 2(V-1) + v ----
                 m_b = t - drain + v
@@ -170,8 +184,8 @@ def make_interleaved_pipelined_loss_fn(
                     lambda s: lax.dynamic_index_in_dim(
                         s[c], slot_b, 0, keepdims=False), stash)
                 tick_b = m_b + v
-                y_b, vjp_stage = jax.vjp(
-                    lambda p, h: stage_fn(p, h, c, tick_b), params, h_in_b)
+                (y_b, aux_b), vjp_stage = jax.vjp(
+                    lambda p, h: _stage(p, h, c, tick_b), params, h_in_b)
                 g_p_post = g_mb_post = None
                 if c == vpp - 1:
                     l, vjp_post = jax.vjp(
@@ -188,7 +202,9 @@ def make_interleaved_pipelined_loss_fn(
                 else:
                     g_y = jax.tree.map(lambda x: x[c], bwd_buf)
                 g_y = _select(bwd_valid, g_y, _zeros_of(g_y))
-                g_p_stage, g_h = vjp_stage(g_y)
+                aux_seed = jnp.where(bwd_valid,
+                                     1.0 / M, 0.0).astype(aux_b.dtype)
+                g_p_stage, g_h = vjp_stage((g_y, aux_seed))
                 ghs.append(g_h)
                 contribs = [g_p_stage]
                 if g_p_post is not None:

@@ -185,6 +185,7 @@ def make_pipelined_loss_fn(
     *,
     axis_name: str = PIPELINE_AXIS,
     remat: bool = True,
+    stage_aux: bool = False,
 ) -> Callable:
     """Build ``loss_fn(params, batch) -> scalar`` running the 1F1B pipeline.
 
@@ -204,6 +205,11 @@ def make_pipelined_loss_fn(
       remat: accepted for API parity; the 1F1B backward *always* recomputes
         stage activations from the stashed inputs (that recompute is what
         buys the O(pipeline-depth) memory bound).
+      stage_aux: when True, ``stage_fn`` returns ``(hidden, aux)`` with
+        ``aux`` a pre-scaled scalar loss term (the MoE load-balancing
+        loss): every rank's aux for every microbatch is added into the
+        total loss, and the 1F1B backward seeds each stage's aux cotangent
+        directly (the aux reaches the loss without riding the pipeline).
 
     The returned function must run inside ``shard_map`` with ``axis_name``
     bound (at world size 1 it degrades to sequential microbatching with
@@ -213,6 +219,10 @@ def make_pipelined_loss_fn(
     """
     del remat  # the backward always recomputes; see docstring
     M = num_microbatches
+
+    def _stage(params, h, t):
+        out = stage_fn(params, h, t)
+        return out if stage_aux else (out, jnp.zeros((), jnp.float32))
 
     # -- forward-only pipeline (primal when not differentiated) -------------
 
@@ -228,7 +238,9 @@ def make_pipelined_loss_fn(
             mb_f = _index_microbatch(batch, jnp.clip(m_f, 0, M - 1))
             h0 = preprocess_fn(params, mb_f)
             h_in = _select(i == 0, h0, state) if pipelined else h0
-            y = stage_fn(params, h_in, t)
+            y, aux = _stage(params, h_in, t)
+            fwd_valid = (m_f >= 0) & (m_f < M)
+            lacc = lacc + jnp.where(fwd_valid, aux.astype(jnp.float32), 0.0)
             m_out = t - (S - 1)
             mb_out = _index_microbatch(batch, jnp.clip(m_out, 0, M - 1))
             l = postprocess_fn(params, y, mb_out)
@@ -274,7 +286,8 @@ def make_pipelined_loss_fn(
                 lambda s, h: lax.dynamic_update_index_in_dim(s, h, slot_f, 0),
                 stash, h_in)
             stash = _select(fwd_valid, written, stash)
-            y = stage_fn(params, h_in, t)
+            y, aux = _stage(params, h_in, t)
+            lacc = lacc + jnp.where(fwd_valid, aux.astype(jnp.float32), 0.0)
 
             # ---- backward half: microbatch m_b = t - 2(S-1) + i ----
             m_b = t - drain + i
@@ -286,8 +299,8 @@ def make_pipelined_loss_fn(
                 lambda s: lax.dynamic_index_in_dim(s, slot_b, 0,
                                                    keepdims=False), stash)
             tick_b = m_b + i           # the tick this forward originally ran
-            y_b, vjp_stage = jax.vjp(
-                lambda p, h: stage_fn(p, h, tick_b), params, h_in_b)
+            (y_b, aux_b), vjp_stage = jax.vjp(
+                lambda p, h: _stage(p, h, tick_b), params, h_in_b)
             l, vjp_post = jax.vjp(
                 lambda h, p, mb: postprocess_fn(p, h, mb), y_b, params, mb_b)
             # loss cotangent born on the last stage (1/M for the mean)
@@ -297,7 +310,9 @@ def make_pipelined_loss_fn(
             g_y = (_select(i == S - 1, g_y_post, bwd_state)
                    if pipelined else g_y_post)
             g_y = _select(bwd_valid, g_y, _zeros_of(g_y))
-            g_p_stage, g_h = vjp_stage(g_y)
+            # aux joins the loss as sum(aux)/M on every rank: seed 1/M
+            aux_seed = jnp.where(bwd_valid, 1.0 / M, 0.0).astype(aux_b.dtype)
+            g_p_stage, g_h = vjp_stage((g_y, aux_seed))
             # preprocess backward, seeded only on stage 0
             _, vjp_pre = jax.vjp(
                 lambda p, mb: preprocess_fn(p, mb), params, mb_b)

@@ -286,22 +286,40 @@ class TestMoETransformer:
         logits = model.apply(params, tokens)
         assert logits.shape == (8, 2, 64)
 
-    def test_moe_guarded_in_non_gpt_models(self):
-        from apex_tpu.models import (
-            BertModel,
-            PipelinedGPT,
-            TransformerConfig,
-            ViTConfig,
-            ViTModel,
-        )
+    def test_moe_in_bert_adds_aux_to_lm_loss(self):
+        """MoE composes with BERT (round 3): the pre-scaled aux joins the
+        masked-LM loss, and router grads flow."""
+        from apex_tpu.models import BertModel, TransformerConfig
 
         cfg = TransformerConfig(
             num_layers=2, hidden_size=32, num_attention_heads=4,
-            num_moe_experts=4)
-        with pytest.raises(NotImplementedError):
-            BertModel(cfg)
-        with pytest.raises(NotImplementedError):
-            PipelinedGPT(cfg, pipeline_size=2, num_microbatches=2)
-        with pytest.raises(NotImplementedError):
-            ViTModel(ViTConfig(image_size=32, patch_size=16, num_classes=4,
-                               transformer=cfg))
+            vocab_size=64, max_position_embeddings=16,
+            hidden_dropout=0.0, attention_dropout=0.0,
+            num_moe_experts=4, moe_capacity_factor=4.0)
+        model = BertModel(cfg, add_binary_head=False)
+        params = model.init(jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, 64)
+
+        def loss(p):
+            lm_loss, _ = model.apply(p, tokens, lm_labels=tokens)
+            return lm_loss
+
+        l, g = jax.value_and_grad(loss)(params)
+        assert np.isfinite(float(l))
+        router_g = g["transformer"]["layers"]["mlp"]["router"]
+        assert float(jnp.sum(jnp.abs(router_g))) > 0
+
+    def test_moe_in_vit_returns_logits_and_aux(self):
+        from apex_tpu.models import TransformerConfig, ViTConfig, ViTModel
+
+        cfg = TransformerConfig(
+            num_layers=2, hidden_size=32, num_attention_heads=4,
+            hidden_dropout=0.0, attention_dropout=0.0,
+            num_moe_experts=4, moe_capacity_factor=4.0)
+        model = ViTModel(ViTConfig(image_size=32, patch_size=16,
+                                   num_classes=4, transformer=cfg))
+        params = model.init(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 32, 32, 3))
+        logits, aux = model.apply(params, x)
+        assert logits.shape == (2, 4)
+        assert np.isfinite(float(aux))
