@@ -68,7 +68,10 @@ class BatchNorm2d_NHWC:
                 # peer reduction -> one psum over the axis)
                 group = lax.axis_size(self.bn_group_axis)
                 if group != self.bn_group:
-                    raise ValueError(
+                    from apex_tpu.transformer.parallel_state import (
+                        UndersizedMeshError,
+                    )
+                    raise UndersizedMeshError(
                         f"bn_group={self.bn_group} but mesh axis "
                         f"'{self.bn_group_axis}' has {group} ranks; shape "
                         f"the mesh so the axis matches the requested group")

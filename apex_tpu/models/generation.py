@@ -81,7 +81,9 @@ def _cached_forward(model, params, caches, tokens: jax.Array, index):
 def decode_step(model, params, caches, tokens: jax.Array, index) -> Tuple[
         jax.Array, Tuple[jax.Array, jax.Array]]:
     """One incremental step: ``tokens`` [batch] at position ``index`` ->
-    (fp32 full-vocab logits [batch, V], updated caches)."""
+    (fp32 full-vocab logits [batch, V], updated caches). MoE models route
+    drop-free here (single-token steps); see :func:`generate` for the
+    prefill capacity caveat."""
     logits, new_caches = _cached_forward(model, params, caches,
                                          tokens[:, None], index)
     return logits[0], new_caches
@@ -98,6 +100,14 @@ def generate(model, params, prompt: jax.Array, max_new_tokens: int, *,
     truncated to ``top_k`` logits) with ``rng``. ``eos_token`` freezes
     finished rows (they keep emitting ``eos_token``). Fully jittable; decode
     runs as one ``lax.scan``.
+
+    MoE capacity caveat: single-token decode steps route drop-free, but the
+    batched cached **prefill** uses factor-based expert capacity
+    (``moe_capacity_factor``) — so decode-vs-full-forward logit parity for
+    MoE models holds exactly only when the prefill drops no tokens (choose
+    ``moe_capacity_factor`` generously, e.g. ``num_experts``, for exact
+    parity; training-default factors may drop prompt tokens and shift
+    logits slightly).
     """
     if temperature > 0.0 and rng is None:
         raise ValueError("sampling (temperature > 0) needs rng")

@@ -49,6 +49,15 @@ _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE: Optional[int] = None
 _FAKE_SIZES: dict = {}
 
 
+class UndersizedMeshError(RuntimeError):
+    """The available device set cannot satisfy the requested mesh shape.
+
+    Raised (instead of a bare RuntimeError) so the test harness can skip
+    multi-device tests on undersized backends by TYPE — anchoring skips on
+    message substrings would also mask genuine mesh-construction
+    regressions (ADVICE r2)."""
+
+
 def initialize_model_parallel(
     tensor_model_parallel_size: int = 1,
     pipeline_model_parallel_size: int = 1,
@@ -87,7 +96,7 @@ def initialize_model_parallel(
     tp, pp, cp = tensor_model_parallel_size, pipeline_model_parallel_size, context_parallel_size
     denom = tp * pp * cp
     if n % denom != 0:
-        raise RuntimeError(
+        raise UndersizedMeshError(
             f"device count ({n}) is not divisible by tensor_model_parallel_size "
             f"({tp}) x pipeline_model_parallel_size ({pp}) x context_parallel_size ({cp})"
         )
@@ -96,7 +105,7 @@ def initialize_model_parallel(
         raise ValueError(f"num_slices must be >= 1, got {num_slices}")
     if num_slices > 1:
         if n % num_slices:
-            raise RuntimeError(
+            raise UndersizedMeshError(
                 f"device count ({n}) is not divisible by num_slices "
                 f"({num_slices})")
         per_slice = n // num_slices
@@ -111,7 +120,7 @@ def initialize_model_parallel(
             from collections import Counter
             counts = Counter(d.slice_index for d in devs)
             if len(counts) != num_slices or set(counts.values()) != {per_slice}:
-                raise RuntimeError(
+                raise UndersizedMeshError(
                     f"num_slices={num_slices} needs {per_slice} devices on "
                     f"each physical slice, but the device set spans "
                     f"{dict(sorted(counts.items()))} (slice_index -> count); "

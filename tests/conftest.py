@@ -60,15 +60,12 @@ def _skip_if_undersized_mesh(excinfo):
     """On backends with fewer than 8 devices (the real single-chip TPU
     under APEX_TPU_TEST_TPU=1), a mesh request the hardware cannot satisfy
     is a SKIP, not a failure — the same tests run for real on the 8-device
-    virtual CPU mesh."""
-    msg = str(excinfo)
-    # anchor on the mesh-construction messages specifically: a generic
-    # "is not divisible by" also comes from tensor_parallel.utils.divide()
-    # for shape splits, and masking those as skips would hide real bugs
-    undersized = ("device count (" in msg
-                  or ("mesh axis" in msg and "ranks" in msg))
-    if (isinstance(excinfo, (RuntimeError, ValueError))
-            and undersized
+    virtual CPU mesh. Anchored on the dedicated exception TYPE (ADVICE r2:
+    message-substring anchors would also mask genuine mesh-construction
+    regressions, e.g. num_slices divisibility errors)."""
+    from apex_tpu.transformer.parallel_state import UndersizedMeshError
+
+    if (isinstance(excinfo, UndersizedMeshError)
             and len(jax.devices()) < 8):
         pytest.skip(f"multi-device test on a {len(jax.devices())}-device "
                     f"backend: {excinfo}")
@@ -78,7 +75,7 @@ def _skip_if_undersized_mesh(excinfo):
 def pytest_runtest_call(item):
     try:
         return (yield)
-    except (RuntimeError, ValueError) as e:
+    except RuntimeError as e:
         _skip_if_undersized_mesh(e)
         raise
 
@@ -88,6 +85,6 @@ def pytest_runtest_setup(item):
     # mesh fixtures (mesh8/data_mesh) raise during setup
     try:
         return (yield)
-    except (RuntimeError, ValueError) as e:
+    except RuntimeError as e:
         _skip_if_undersized_mesh(e)
         raise
