@@ -110,6 +110,7 @@ def _config_matrix():
     failing config prints an error line instead of killing the run."""
     import benchmarks.bert_lamb as bert
     import benchmarks.dcgan_bf16 as dcgan
+    import benchmarks.generation_bench as generation
     import benchmarks.gpt_large as gpt_large
     import benchmarks.gpt_tp as gpt_tp
     import benchmarks.long_context as long_context
@@ -127,6 +128,7 @@ def _config_matrix():
         ("long_context_32k_window", lambda: long_context.main(window=1024)),
         ("long_context_64k_window",
          lambda: long_context.main(seq=65536, window=1024)),
+        ("generation", lambda: generation.main()),
     ]
     for name, fn in configs:
         try:

@@ -48,7 +48,8 @@ def resnet50_train_flops(images: int, image_size: int) -> float:
 
 
 def run(metric: str, unit: str, step_fn: Callable, *state,
-        work_per_step: float, steps: int = 10, baseline_fn=None,
+        work_per_step: float, steps: int = 10, windows: int = 3,
+        baseline_fn=None,
         model_flops_per_step: Optional[float] = None,
         consume_state: bool = False):
     """``step_fn(*state) -> (*new_state, loss)``; prints the JSON line.
@@ -86,10 +87,10 @@ def run(metric: str, unit: str, step_fn: Callable, *state,
         out = fn(*state)
         _fetch(out[-1])
         state = list(out[:-1])
-        # best-of-3 windows: the tunneled backend has multi-second transient
+        # best-of-N windows: the tunneled backend has multi-second transient
         # stalls that a single window folds into the mean
         best = float("inf")
-        for _w in range(3):
+        for _w in range(windows):
             t0 = time.perf_counter()
             for _ in range(steps):
                 out = fn(*state)

@@ -66,9 +66,11 @@ def main(batch=256, nz=100):
             flops = float(ca["flops"])
     except Exception:
         pass
+    # the G+D step is short (~17 ms); longer windows + more of them pin
+    # the tunnel's run-to-run spread (was 12-21% MFU in round 2)
     return run("dcgan_bf16_imgs_per_sec_per_chip", "imgs/sec",
                step, gp, gs, dp_, ds, g_os, d_os, work_per_step=batch,
-               model_flops_per_step=flops)
+               steps=40, windows=5, model_flops_per_step=flops)
 
 
 if __name__ == "__main__":
