@@ -43,6 +43,7 @@ __all__ = [
     "dequantize",
     "qdq",
     "fp8_dense",
+    "native_fp8_dot_supported",
 ]
 
 # Storage dtypes: e4m3 for forward activations/weights (more mantissa),
@@ -170,10 +171,79 @@ def qdq(x: jax.Array, scale: jax.Array, dtype=E4M3) -> jax.Array:
     return dequantize(quantize(x, scale, dtype), scale, x.dtype)
 
 
+@functools.lru_cache(maxsize=None)
+def native_fp8_dot_supported() -> bool:
+    """Probe: can this backend compile AND run ``dot_general`` directly on
+    fp8 storage dtypes. True on current TPU backends (older generations
+    upcast internally — numerics identical, speed gain arrives on
+    fp8-capable MXUs, v6e/Trillium+); False lets callers keep the qdq
+    simulation. Cached per process."""
+    try:
+        # the probe may be reached while tracing (fp8_dense under jit):
+        # escape the trace so it runs eagerly on the backend — otherwise
+        # the test-execution would stage into the caller's graph and fail,
+        # caching a spurious False
+        with jax.ensure_compile_time_eval():
+            a = jnp.zeros((8, 8), E4M3)
+            y = jax.jit(lambda a, b: lax.dot_general(
+                a, b, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32))(a, a)
+            y.block_until_ready()
+        return True
+    except Exception:
+        return False
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _native_fp8_matmul(x, w, xs, ws, recipe):
+    """``y = (q(x) @ q(w)) / (xs*ws)`` with the dot running ON the fp8
+    storage dtypes (native path). Forward operands are e4m3; the backward
+    quantizes the incoming cotangent to e5m2 with current scaling and runs
+    both grad dots on fp8 operands too (TE hybrid recipe)."""
+    xq = quantize(x, xs, recipe.fwd_dtype)
+    wq = quantize(w, ws, recipe.fwd_dtype)
+    y = lax.dot_general(xq, wq, (((x.ndim - 1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32)
+    return (y / (xs * ws)).astype(x.dtype)
+
+
+def _native_fwd(x, w, xs, ws, recipe):
+    xq = quantize(x, xs, recipe.fwd_dtype)
+    wq = quantize(w, ws, recipe.fwd_dtype)
+    y = lax.dot_general(xq, wq, (((x.ndim - 1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32)
+    return ((y / (xs * ws)).astype(x.dtype),
+            # zero-size carriers: residuals must be JAX types, not dtypes
+            (xq, wq, xs, ws, jnp.zeros((0,), x.dtype),
+             jnp.zeros((0,), w.dtype)))
+
+
+def _native_bwd(recipe, res, g):
+    xq, wq, xs, ws, xdt_c, wdt_c = res
+    xdt, wdt = xdt_c.dtype, wdt_c.dtype
+    amax = jnp.max(jnp.abs(g)).astype(jnp.float32)
+    gs = jnp.where((amax > 0.0) & jnp.isfinite(amax),
+                   fp8_max(recipe.bwd_dtype) / amax, 1.0)
+    gq = quantize(g, gs, recipe.bwd_dtype)
+    # dx = g @ w^T ; dw = x^T @ g — both on fp8 operands
+    dx = lax.dot_general(gq, wq, (((g.ndim - 1,), (1,)), ((), ())),
+                         preferred_element_type=jnp.float32)
+    dx = (dx / (gs * ws)).astype(xdt)
+    lead = tuple(range(g.ndim - 1))
+    dw = lax.dot_general(xq, gq, ((lead, lead), ((), ())),
+                         preferred_element_type=jnp.float32)
+    dw = (dw / (xs * gs)).astype(wdt)
+    return dx, dw, jnp.zeros_like(xs), jnp.zeros_like(ws)
+
+
+_native_fp8_matmul.defvjp(_native_fwd, _native_bwd)
+
+
 def fp8_dense(x: jax.Array, w: jax.Array, state: Dict[str, Any],
               *, x_name: str = "x", w_name: str = "w",
               recipe: Fp8Recipe = Fp8Recipe(),
-              axis_names: Optional[Sequence[str]] = None
+              axis_names: Optional[Sequence[str]] = None,
+              native: Optional[bool] = None
               ) -> Tuple[jax.Array, Dict[str, Any]]:
     """fp8 delayed-scaling matmul hook: ``y = qdq(x) @ qdq(w)`` with the
     CURRENT scales, returning ``(y, new_state)`` where the state absorbed
@@ -189,12 +259,23 @@ def fp8_dense(x: jax.Array, w: jax.Array, state: Dict[str, Any],
     thread delayed state out of the vjp — so gradient-path fp8 effects are
     simulated too (TE's hybrid recipe; current scaling is one of its
     supported amax modes).
+
+    ``native`` routes the dot through fp8 storage dtypes directly
+    (``_native_fp8_matmul``) instead of the qdq simulation; ``None``
+    auto-probes the backend (``native_fp8_dot_supported``). Both paths
+    share the delayed-scaling state machinery and differ only in where the
+    fp8 values live during the dot (fp32 accumulation either way).
     """
     xs = state[x_name]["scale"]
     ws = state[w_name]["scale"]
-    xq = _ste_qdq(x, xs, recipe.fwd_dtype, recipe.bwd_dtype)
-    wq = _ste_qdq(w, ws, recipe.fwd_dtype, recipe.bwd_dtype)
-    y = xq @ wq
+    if native is None:
+        native = native_fp8_dot_supported()
+    if native:
+        y = _native_fp8_matmul(x, w, xs, ws, recipe)
+    else:
+        xq = _ste_qdq(x, xs, recipe.fwd_dtype, recipe.bwd_dtype)
+        wq = _ste_qdq(w, ws, recipe.fwd_dtype, recipe.bwd_dtype)
+        y = xq @ wq
     new_state = dict(state)
     upd = update_fp8_state(
         {x_name: state[x_name], w_name: state[w_name]},
