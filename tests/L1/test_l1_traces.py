@@ -1,0 +1,57 @@
+"""Validate the recorded L1 hardware traces (``tests/L1/traces/*.json``).
+
+The traces are produced by ``run_l1.py`` on real TPU hardware (>=500
+iterations of ResNet-50 per amp configuration) and committed in-tree —
+this test re-applies the ``compare.py`` contract to the stored evidence,
+so trace regressions (or accidentally truncated runs) fail the suite.
+"""
+
+import glob
+import json
+import os
+import sys
+
+import pytest
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _HERE)
+
+from run_l1 import CONFIGS, compare_traces  # noqa: E402
+
+TRACES = {os.path.splitext(os.path.basename(p))[0]: p
+          for p in glob.glob(os.path.join(_HERE, "traces", "*.json"))}
+
+
+def _load(name):
+    with open(TRACES[name]) as f:
+        return json.load(f)
+
+
+@pytest.mark.skipif("o0_fp32" not in TRACES,
+                    reason="no recorded L1 traces (run run_l1.py on "
+                           "hardware)")
+class TestRecordedTraces:
+    def test_all_configs_recorded_at_depth(self):
+        missing = set(CONFIGS) - set(TRACES)
+        assert not missing, f"configs without traces: {missing}"
+        for name in CONFIGS:
+            tr = _load(name)
+            assert tr["config"]["iters"] >= 500, (
+                f"{name} recorded at {tr['config']['iters']} iters (<500)")
+            assert tr["config"]["depth"] == 50
+            assert len(tr["loss"]) == tr["config"]["iters"]
+
+    @pytest.mark.parametrize("name",
+                             [n for n in CONFIGS if n != "o0_fp32"])
+    def test_trace_tracks_baseline(self, name):
+        if name not in TRACES:
+            pytest.skip(f"{name} not recorded")
+        fails = compare_traces(_load(name), _load("o0_fp32"))
+        assert not fails, fails
+
+    def test_baseline_converged(self):
+        import numpy as np
+
+        L = np.asarray(_load("o0_fp32")["loss"])
+        assert np.isfinite(L).all()
+        assert L[-25:].mean() < 0.5 * L[:25].mean()
