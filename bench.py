@@ -14,10 +14,12 @@ Configs (BASELINE.md / BASELINE.json):
   2. DCGAN bf16 G+D step
   3. BERT-base + FusedLAMB
   4. GPT-2 Megatron TP path (tp=1 on a single chip)
-  5. ViT-L/16 + FusedAdam
-  6. long-context: GPT at 32k tokens (+1k sliding window) — the reference
-     caps at 16k
-  7. headline: GPT-2 124M fused-vs-unfused (printed LAST; the driver
+  5. GPT-2 355M (large-GEMM MFU row: bs8, no recompute, unrolled scan)
+  6. ViT-L/16 + FusedAdam
+  7. long-context: GPT at 32k tokens full-causal + 32k/64k sliding-window
+     — the reference caps at 16k
+  8. generation: prefill + jitted KV-cache decode tokens/sec (bs 1 / 8)
+  9. headline: GPT-2 124M fused-vs-unfused (printed LAST; the driver
      records the tail line)
 
 MFU is model-FLOPs utilization against the chip's bf16 peak
@@ -44,7 +46,7 @@ def _build(recompute: bool):
         num_layers=12, hidden_size=768, num_attention_heads=12,
         vocab_size=50304, max_position_embeddings=1024,
         hidden_dropout=0.0, attention_dropout=0.0,
-        recompute=recompute, compute_dtype=jnp.bfloat16)
+        recompute=recompute, scan_unroll=12, compute_dtype=jnp.bfloat16)
     model = GPTModel(cfg)
     params = model.init(jax.random.PRNGKey(0))
     opt = FusedAdam(lr=1e-4)

@@ -31,7 +31,9 @@ def main(batch=8, seq=1024):
         num_layers=12, hidden_size=768, num_attention_heads=12,
         vocab_size=50304, max_position_embeddings=1024,
         hidden_dropout=0.0, attention_dropout=0.0,
-        sequence_parallel=(tp > 1), recompute=True,
+        sequence_parallel=(tp > 1),
+        # r3 tuning: recompute-free + unrolled scan (memory fits at bs8)
+        recompute=False, scan_unroll=12,
         compute_dtype=jnp.bfloat16)
     model = GPTModel(cfg)
     params = model.init(jax.random.PRNGKey(0))

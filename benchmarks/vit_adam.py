@@ -16,14 +16,18 @@ from apex_tpu.optimizers import FusedAdam
 
 def main(batch=32, image=224):
     model = vit_l16(image_size=image, num_classes=1000,
-                    recompute=True, compute_dtype=jnp.bfloat16)
+                    # r3 tuning: no recompute + unrolled scan + donation
+                    recompute=False, scan_unroll=24,
+                    compute_dtype=jnp.bfloat16)
     params = model.init(jax.random.PRNGKey(0))
     opt = FusedAdam(lr=3e-4, weight_decay=0.05)
     opt_state = opt.init(params)
     x = jax.random.normal(jax.random.PRNGKey(1), (batch, image, image, 3))
     y = jax.random.randint(jax.random.PRNGKey(2), (batch,), 0, 1000)
 
-    @jax.jit
+    from functools import partial
+
+    @partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state):
         def loss_fn(p):
             logits = model.apply(p, x)
@@ -37,6 +41,7 @@ def main(batch=32, image=224):
     tokens = batch * ((image // 16) ** 2 + 1)
     return run("vit_l16_adam_train_imgs_per_sec_per_chip", "imgs/sec",
                step, params, opt_state, work_per_step=batch,
+               consume_state=True,
                model_flops_per_step=transformer_train_flops(
                    n_params, tokens, 24, 1024, (image // 16) ** 2 + 1,
                    causal=False))
