@@ -185,29 +185,38 @@ class ResNet:
         stays an XLA convolution (its input normalize fuses into the conv
         read; its output statistics are one fused reduction pass)."""
         cfg = self.config
-        from apex_tpu.ops.conv_fused import conv1x1_bn_act
+        from apex_tpu.ops.conv_fused import conv1x1_bn_act, conv3x3_bn_act
         new_s = {}
 
-        def close(bn_name, sums, n, y):
-            """bn_from_sums + the normalize affine in the activation
-            dtype; records the updated running stats."""
+        def close(bn_name, sums, n, y=None):
+            """bn_from_sums (+ optionally the normalize affine in the
+            activation dtype); records the updated running stats."""
             a, b, new_s[bn_name] = _bn_from_sums(
                 p[bn_name], s[bn_name], sums, n, shift=s[bn_name]["mean"],
                 momentum=cfg.bn_momentum, eps=cfg.bn_eps,
                 axis_name=cfg.axis_name)
+            if y is None:
+                return a, b
             return y * a.astype(y.dtype) + b.astype(y.dtype)
 
         nhw = x.shape[0] * x.shape[1] * x.shape[2]
         y1, s1 = conv1x1_bn_act(x, p["conv1"].reshape(x.shape[-1], -1),
                                 stats_shift=s["bn1"]["mean"])
-        z1 = jax.nn.relu(close("bn1", s1, nhw, y1))
-        y2 = _conv(z1, p["conv2"], stride)
-        nhw2 = y2.shape[0] * y2.shape[1] * y2.shape[2]
-        s2 = _bn_sums(y2, s["bn2"]["mean"])
-        a2, b2, new_s["bn2"] = _bn_from_sums(
-            p["bn2"], s["bn2"], s2, nhw2, shift=s["bn2"]["mean"],
-            momentum=cfg.bn_momentum, eps=cfg.bn_eps,
-            axis_name=cfg.axis_name)
+        a1, b1 = close("bn1", s1, nhw)
+        if stride == 1:
+            # fused 3x3: bn1 normalize+relu on the fly, stats epilogue
+            y2, s2 = conv3x3_bn_act(y1, p["conv2"], a1, b1, relu=True,
+                                    stats_shift=s["bn2"]["mean"])
+            nhw2 = nhw
+        else:
+            # the 3 stride-2 blocks keep the XLA conv (strided slicing in
+            # the shifted-GEMM kernel costs more than the boundary copy)
+            z1 = jax.nn.relu(y1 * a1.astype(y1.dtype)
+                             + b1.astype(y1.dtype))
+            y2 = _conv(z1, p["conv2"], stride)
+            s2 = _bn_sums(y2, s["bn2"]["mean"])
+            nhw2 = y2.shape[0] * y2.shape[1] * y2.shape[2]
+        a2, b2 = close("bn2", s2, nhw2)
         y3, s3 = conv1x1_bn_act(y2, p["conv3"].reshape(y2.shape[-1], -1),
                                 a2, b2, relu=True,
                                 stats_shift=s["bn3"]["mean"])

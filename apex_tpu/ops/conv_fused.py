@@ -40,7 +40,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from apex_tpu.ops._support import cdiv, pallas_interpret, use_pallas
 
-__all__ = ["conv1x1_bn_act"]
+__all__ = ["conv1x1_bn_act", "conv3x3_bn_act"]
 
 _BM_CANDIDATES = (1024, 896, 768, 640, 512, 448, 384, 320, 256, 224, 192,
                   160, 128, 112, 96, 80, 64, 48, 32, 16)
@@ -325,3 +325,305 @@ def conv1x1_bn_act(x, w, a: Optional[jax.Array] = None,
             y, s = _ref_impl(x2, None, None, w, stats_shift,
                              affine=False, relu=False)
     return y.reshape(*lead, n), s
+
+
+# ===========================================================================
+# 3x3 convolution (stride 1, SAME) + input BN-affine/ReLU + stats epilogue
+# ===========================================================================
+#
+# The bottleneck's middle conv as a Pallas kernel so the whole block
+# interior stays in one layout domain (XLA<->Pallas layout copies are what
+# ate the 1x1 kernels' win — PERF.md round 3). Each grid step processes a
+# few whole images: the 3x3 is nine shifted [bn*H*W, K] x [K, N] GEMMs
+# over a zero-padded VMEM copy of the normalized input — no halo exchange
+# between blocks because blocks never split an image. The backward is one
+# pass too: reads (x, dy, y), writes dx, accumulates dW[3,3]/da/db in VMEM.
+
+def _c3_zpad(z, H, W):
+    """[bn, H, W, C] -> [bn, H+2, W+2, C] zero-padded (VMEM)."""
+    return jnp.pad(z, ((0, 0), (1, 1), (1, 1), (0, 0)))
+
+
+def _c3_fwd_kernel(x_ref, a_ref, b_ref, w_ref, c_ref, y_ref, s_ref,
+                   acc_ref, *, affine, relu, H, W, out_dtype):
+    i = pl.program_id(0)
+    nm = pl.num_programs(0)
+
+    @pl.when(i == 0)
+    def _():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    x = x_ref[...]                                   # [bn, H, W, K]
+    bn, _, _, k = x.shape
+    n = w_ref.shape[-1]
+    if affine:
+        z = x.astype(jnp.float32) * a_ref[...] + b_ref[...]
+        if relu:
+            z = jnp.maximum(z, 0.0)
+        z = z.astype(w_ref.dtype)
+    else:
+        z = x.astype(w_ref.dtype)
+    zp = _c3_zpad(z, H, W)
+    acc = jnp.zeros((bn * H * W, n), jnp.float32)
+    for dr in range(3):
+        for dc in range(3):
+            tap = zp[:, dr:dr + H, dc:dc + W, :].reshape(bn * H * W, k)
+            acc += jnp.dot(tap, w_ref[dr, dc],
+                           preferred_element_type=jnp.float32)
+    yc = acc - c_ref[...]
+    acc_ref[0:1, :] += jnp.sum(yc, axis=0, keepdims=True)
+    acc_ref[1:2, :] += jnp.sum(yc * yc, axis=0, keepdims=True)
+    y_ref[...] = acc.reshape(bn, H, W, n).astype(out_dtype)
+
+    @pl.when(i == nm - 1)
+    def _():
+        s_ref[...] = acc_ref[...]
+
+
+def _c3_pick_bn(nimg, H, W, k, n, bwd=False):
+    """Images per grid step under a VMEM budget. The kernel's working set
+    is much larger than the streamed tiles: the padded z copy, the fp32
+    accumulator, and the nine materialized tap slices all live on the
+    Mosaic stack — budget accordingly (measured: ~5.3 MB/image at
+    56x56x64 forward)."""
+    per_img = H * W * (2 * k + 2 * n      # x + y tiles
+                       + 9 * 2 * k        # materialized tap slices
+                       + 4 * n + 4 * k)   # fp32 acc + padded z
+    if bwd:
+        per_img += H * W * (4 * k         # fp32 dzp
+                            + 9 * 2 * n   # dy taps
+                            + 4 * k)      # dg/x32
+    budget = 8 * 1024 * 1024
+    bn = max(1, min(8, budget // max(per_img, 1)))
+    while nimg % bn:
+        bn -= 1
+    return bn
+
+
+def _c3_fwd_pallas(x, a, b, w, shift, *, affine, relu):
+    nimg, H, W, k = x.shape
+    n = w.shape[-1]
+    bn = _c3_pick_bn(nimg, H, W, k, n)
+    grid = (nimg // bn,)
+    a2 = a.reshape(1, k) if affine else jnp.zeros((1, 1), jnp.float32)
+    b2 = b.reshape(1, k) if affine else jnp.zeros((1, 1), jnp.float32)
+    c2 = shift.reshape(1, n)
+    kernel = functools.partial(_c3_fwd_kernel, affine=affine, relu=relu,
+                               H=H, W=W, out_dtype=x.dtype)
+    y, s = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bn, H, W, k), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec(a2.shape, lambda i: (0, 0)),
+            pl.BlockSpec(b2.shape, lambda i: (0, 0)),
+            pl.BlockSpec((3, 3, k, n), lambda i: (0, 0, 0, 0)),
+            pl.BlockSpec((1, n), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bn, H, W, n), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((2, n), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((nimg, H, W, n), x.dtype),
+            jax.ShapeDtypeStruct((2, n), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((2, n), jnp.float32)],
+        interpret=pallas_interpret(),
+    )(x, a2, b2, w, c2)
+    return y, s
+
+
+def _c3_bwd_kernel(x_ref, a_ref, b_ref, w_ref, c_ref, y_ref, dy_ref,
+                   ds_ref, dx_ref, dw_ref, dab_ref, dwacc_ref, dabacc_ref,
+                   *, affine, relu, H, W):
+    i = pl.program_id(0)
+    nm = pl.num_programs(0)
+
+    @pl.when(i == 0)
+    def _():
+        dwacc_ref[...] = jnp.zeros_like(dwacc_ref)
+        if affine:
+            dabacc_ref[...] = jnp.zeros_like(dabacc_ref)
+
+    x = x_ref[...]                                   # [bn, H, W, K]
+    bn, _, _, k = x.shape
+    n = w_ref.shape[-1]
+    # compute the effective cotangent and cast to bf16 in one expression so
+    # the fp32 temporaries die immediately (VMEM stack pressure)
+    dy_c = (dy_ref[...].astype(jnp.float32)
+            + ds_ref[0:1, :].reshape(1, 1, 1, n)
+            + 2.0 * (y_ref[...].astype(jnp.float32)
+                     - c_ref[...].reshape(1, 1, 1, n))
+            * ds_ref[1:2, :].reshape(1, 1, 1, n)).astype(w_ref.dtype)
+    if affine:
+        pre = (x.astype(jnp.float32) * a_ref[...] + b_ref[...])
+        mask = pre > 0.0                              # bool, relu subgrad
+        z = jnp.maximum(pre, 0.0) if relu else pre
+        zb = z.astype(w_ref.dtype)
+    else:
+        zb = x.astype(w_ref.dtype)
+    zp = _c3_zpad(zb, H, W)
+    # wgrad needs a 2D contraction (Mosaic matmul: single contracting
+    # dim); the dgrad dot runs ND (contract the trailing channel dim)
+    dy2 = dy_c.reshape(bn * H * W, n)
+    dzp = jnp.zeros((bn, H + 2, W + 2, k), jnp.float32)
+    for dr in range(3):
+        for dc in range(3):
+            tap = zp[:, dr:dr + H, dc:dc + W, :]
+            dwacc_ref[dr, dc] += jax.lax.dot_general(
+                tap.reshape(bn * H * W, k), dy2, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dtap = jax.lax.dot_general(
+                dy_c, w_ref[dr, dc], (((3,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)   # [bn, H, W, K]
+            # scatter-add is unsupported in Mosaic: accumulate via a
+            # statically-padded add instead
+            dzp = dzp + jnp.pad(
+                dtap, ((0, 0), (dr, 2 - dr), (dc, 2 - dc), (0, 0)))
+    dz = dzp[:, 1:H + 1, 1:W + 1, :]
+    if affine:
+        dg = jnp.where(mask, dz, 0.0) if relu else dz
+        dabacc_ref[0:1, :] += jnp.sum(
+            dg * x.astype(jnp.float32), axis=(0, 1, 2)).reshape(1, k)
+        dabacc_ref[1:2, :] += jnp.sum(dg, axis=(0, 1, 2)).reshape(1, k)
+        dx = dg * a_ref[...]
+    else:
+        dx = dz
+    dx_ref[...] = dx.astype(dx_ref.dtype)
+
+    @pl.when(i == nm - 1)
+    def _():
+        dw_ref[...] = dwacc_ref[...]
+        if affine:
+            dab_ref[...] = dabacc_ref[...]
+
+
+def _c3_bwd_pallas(x, a, b, w, shift, y, dy, ds, *, affine, relu):
+    nimg, H, W, k = x.shape
+    n = w.shape[-1]
+    bn = _c3_pick_bn(nimg, H, W, k, n, bwd=True)
+    grid = (nimg // bn,)
+    a2 = a.reshape(1, k) if affine else jnp.zeros((1, 1), jnp.float32)
+    b2 = b.reshape(1, k) if affine else jnp.zeros((1, 1), jnp.float32)
+    c2 = shift.reshape(1, n)
+    kernel = functools.partial(_c3_bwd_kernel, affine=affine, relu=relu,
+                               H=H, W=W)
+    nab = k if affine else 1
+    dx, dw, dab = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bn, H, W, k), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec(a2.shape, lambda i: (0, 0)),
+            pl.BlockSpec(b2.shape, lambda i: (0, 0)),
+            pl.BlockSpec((3, 3, k, n), lambda i: (0, 0, 0, 0)),
+            pl.BlockSpec((1, n), lambda i: (0, 0)),
+            pl.BlockSpec((bn, H, W, n), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((bn, H, W, n), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((2, n), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bn, H, W, k), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((3, 3, k, n), lambda i: (0, 0, 0, 0)),
+            pl.BlockSpec((2, nab), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((nimg, H, W, k), x.dtype),
+            jax.ShapeDtypeStruct((3, 3, k, n), jnp.float32),
+            jax.ShapeDtypeStruct((2, nab), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((3, 3, k, n), jnp.float32),
+                        pltpu.VMEM((2, nab), jnp.float32)],
+        interpret=pallas_interpret(),
+    )(x, a2, b2, w, c2, y, dy, ds)
+    return dx, dw, dab
+
+
+def _c3_ref_impl(x, a, b, w, shift, *, affine, relu):
+    """XLA composition oracle for the 3x3 kernel."""
+    if affine:
+        z = x.astype(jnp.float32) * a.reshape(1, 1, 1, -1) \
+            + b.reshape(1, 1, 1, -1)
+        if relu:
+            z = jnp.maximum(z, 0.0)
+        z = z.astype(w.dtype)
+    else:
+        z = x.astype(w.dtype)
+    # no preferred_element_type: its f32 output makes the conv's autodiff
+    # transpose mix f32 cotangents with bf16 weights (dtype error); stats
+    # from the materialized-output dtype match the unfused baseline anyway
+    y = jax.lax.conv_general_dilated(
+        z, w, window_strides=(1, 1), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    yc = y.astype(jnp.float32) - shift.reshape(1, 1, 1, -1)
+    s = jnp.stack([jnp.sum(yc, axis=(0, 1, 2)),
+                   jnp.sum(yc * yc, axis=(0, 1, 2))])
+    return y.astype(x.dtype), s
+
+
+@functools.lru_cache(maxsize=None)
+def _make_c3_op(affine: bool, relu: bool):
+    def fwd_impl(x, a, b, w, shift):
+        return _c3_fwd_pallas(x, a, b, w, shift, affine=affine, relu=relu)
+
+    @jax.custom_vjp
+    def op(x, a, b, w, shift):
+        return fwd_impl(x, a, b, w, shift)
+
+    def op_fwd(x, a, b, w, shift):
+        y, s = fwd_impl(x, a, b, w, shift)
+        return (y, s), (x, a, b, w, shift, y)
+
+    def op_bwd(res, cots):
+        x, a, b, w, shift, y = res
+        dy, ds = cots
+        dx, dw, dab = _c3_bwd_pallas(x, a, b, w, shift, y, dy, ds,
+                                     affine=affine, relu=relu)
+        if affine:
+            da = dab[0].astype(a.dtype)
+            db = dab[1].astype(b.dtype)
+        else:
+            da = jnp.zeros_like(a)
+            db = jnp.zeros_like(b)
+        return (dx, da, db, dw.astype(w.dtype), jnp.zeros_like(shift))
+
+    op.defvjp(op_fwd, op_bwd)
+    return op
+
+
+def conv3x3_bn_act(x, w, a: Optional[jax.Array] = None,
+                   b: Optional[jax.Array] = None, *, relu: bool = False,
+                   stats_shift: Optional[jax.Array] = None
+                   ) -> Tuple[jax.Array, jax.Array]:
+    """Fused 3x3 stride-1 SAME conv with input BN-affine/ReLU and output
+    statistics — the :func:`conv1x1_bn_act` contract on ``x: [N, H, W, K]``
+    and ``w: [3, 3, K, N']``. Falls back to the XLA composition off-TPU."""
+    affine = a is not None
+    if not affine and (b is not None or relu):
+        raise ValueError("b/relu require the input affine: pass both a and "
+                         "b, or neither")
+    n = w.shape[-1]
+    if stats_shift is None:
+        stats_shift = jnp.zeros((n,), jnp.float32)
+    stats_shift = jax.lax.stop_gradient(stats_shift.astype(jnp.float32))
+    # the backward keeps the 3x3 weights (bf16) + a fp32 dW accumulator
+    # resident (~54*K*N bytes — excludes the deepest stage's 512x512), and
+    # holds one whole image's working set on the VMEM stack (~12 MB at
+    # 56x56x64 — excludes the widest stage until the kernel grows manual
+    # halo DMAs); outside those bounds the XLA composition is used
+    k = w.shape[-2]
+    fits = (54 * k * n <= (8 << 20)
+            and x.shape[1] * x.shape[2] <= 1024)   # <=32x32 measured bound
+    if use_pallas() and fits:
+        af = a.astype(jnp.float32) if affine else jnp.zeros((1,),
+                                                            jnp.float32)
+        bf = b.astype(jnp.float32) if affine else jnp.zeros((1,),
+                                                            jnp.float32)
+        return _make_c3_op(affine, relu)(x, af, bf, w, stats_shift)
+    if affine:
+        return _c3_ref_impl(x, a.astype(jnp.float32),
+                            b.astype(jnp.float32), w, stats_shift,
+                            affine=True, relu=relu)
+    return _c3_ref_impl(x, None, None, w, stats_shift, affine=False,
+                        relu=False)
