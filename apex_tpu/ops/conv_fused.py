@@ -253,24 +253,28 @@ def _ref_impl(x2, a, b, w, shift, *, affine, relu):
 # custom-VJP wrappers (one per static (affine, relu) combination)
 # ---------------------------------------------------------------------------
 
-@functools.lru_cache(maxsize=None)
-def _make_op(affine: bool, relu: bool):
-    def fwd_impl(x2, a, b, w, shift):
-        return _fwd_pallas(x2, a, b, w, shift, affine=affine, relu=relu)
+def _build_vjp_op(fwd_pallas, bwd_pallas, affine: bool, relu: bool):
+    """Shared custom-VJP scaffolding for the fused conv kernels: primal =
+    ``fwd_pallas``, cotangents (incl. the stats cotangent) routed through
+    ``bwd_pallas``; da/db come back through the [2, K] accumulator, the
+    shift is statistics-driven (zero gradient)."""
+
+    def fwd_impl(x, a, b, w, shift):
+        return fwd_pallas(x, a, b, w, shift, affine=affine, relu=relu)
 
     @jax.custom_vjp
-    def op(x2, a, b, w, shift):
-        return fwd_impl(x2, a, b, w, shift)
+    def op(x, a, b, w, shift):
+        return fwd_impl(x, a, b, w, shift)
 
-    def op_fwd(x2, a, b, w, shift):
-        y, s = fwd_impl(x2, a, b, w, shift)
-        return (y, s), (x2, a, b, w, shift, y)
+    def op_fwd(x, a, b, w, shift):
+        y, s = fwd_impl(x, a, b, w, shift)
+        return (y, s), (x, a, b, w, shift, y)
 
     def op_bwd(res, cots):
-        x2, a, b, w, shift, y = res
+        x, a, b, w, shift, y = res
         dy, ds = cots
-        dx, dw, dab = _bwd_pallas(x2, a, b, w, shift, y, dy, ds,
-                                  affine=affine, relu=relu)
+        dx, dw, dab = bwd_pallas(x, a, b, w, shift, y, dy, ds,
+                                 affine=affine, relu=relu)
         if affine:
             da = dab[0].astype(a.dtype)
             db = dab[1].astype(b.dtype)
@@ -281,6 +285,11 @@ def _make_op(affine: bool, relu: bool):
 
     op.defvjp(op_fwd, op_bwd)
     return op
+
+
+@functools.lru_cache(maxsize=None)
+def _make_op(affine: bool, relu: bool):
+    return _build_vjp_op(_fwd_pallas, _bwd_pallas, affine, relu)
 
 
 def conv1x1_bn_act(x, w, a: Optional[jax.Array] = None,
@@ -564,32 +573,7 @@ def _c3_ref_impl(x, a, b, w, shift, *, affine, relu):
 
 @functools.lru_cache(maxsize=None)
 def _make_c3_op(affine: bool, relu: bool):
-    def fwd_impl(x, a, b, w, shift):
-        return _c3_fwd_pallas(x, a, b, w, shift, affine=affine, relu=relu)
-
-    @jax.custom_vjp
-    def op(x, a, b, w, shift):
-        return fwd_impl(x, a, b, w, shift)
-
-    def op_fwd(x, a, b, w, shift):
-        y, s = fwd_impl(x, a, b, w, shift)
-        return (y, s), (x, a, b, w, shift, y)
-
-    def op_bwd(res, cots):
-        x, a, b, w, shift, y = res
-        dy, ds = cots
-        dx, dw, dab = _c3_bwd_pallas(x, a, b, w, shift, y, dy, ds,
-                                     affine=affine, relu=relu)
-        if affine:
-            da = dab[0].astype(a.dtype)
-            db = dab[1].astype(b.dtype)
-        else:
-            da = jnp.zeros_like(a)
-            db = jnp.zeros_like(b)
-        return (dx, da, db, dw.astype(w.dtype), jnp.zeros_like(shift))
-
-    op.defvjp(op_fwd, op_bwd)
-    return op
+    return _build_vjp_op(_c3_fwd_pallas, _c3_bwd_pallas, affine, relu)
 
 
 def conv3x3_bn_act(x, w, a: Optional[jax.Array] = None,

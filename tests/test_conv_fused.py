@@ -253,3 +253,31 @@ class TestConv3x3Parity:
                 np.testing.assert_allclose(f_, r_, atol=2e-3, rtol=2e-3)
         finally:
             cf._c3_pick_bn = orig
+
+    def test_forward_backward_bf16(self, interpret):
+        """bf16 is the production amp-O2 dtype and the 3x3 kernel has more
+        dtype-sensitive cast points (zb, dy_c, out_dtype, fp32 dzp)."""
+        from apex_tpu.ops.conv_fused import _c3_ref_impl, conv3x3_bn_act
+
+        x, w, a, b, c = self._args(nimg=2, H=6, W=6, k=8, n=16)
+        x, w = x.astype(jnp.bfloat16), w.astype(jnp.bfloat16)
+
+        def f(fn):
+            def g(x, a, b, w):
+                y, s = fn(x, a, b, w)
+                return (jnp.sum(y.astype(jnp.float32) ** 2)
+                        + jnp.sum(s * 1e-3))
+            return g
+
+        fused = f(lambda x, a, b, w: conv3x3_bn_act(
+            x, w, a, b, relu=True, stats_shift=c))
+        ref = f(lambda x, a, b, w: _c3_ref_impl(
+            x, a, b, w, c, affine=True, relu=True))
+        np.testing.assert_allclose(float(fused(x, a, b, w)),
+                                   float(ref(x, a, b, w)), rtol=2e-2)
+        gf = jax.grad(fused, argnums=(0, 1, 2, 3))(x, a, b, w)
+        gr = jax.grad(ref, argnums=(0, 1, 2, 3))(x, a, b, w)
+        for f_, r_ in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(f_, np.float32),
+                                       np.asarray(r_, np.float32),
+                                       atol=0.15, rtol=0.1)
