@@ -62,7 +62,7 @@ def bench_prefill(model, params, batch=8, prompt_len=1024):
         logits, caches = _cached_forward(model, params, caches, prompt, 0)
         return logits[-1], caches
 
-    dt = _time(prefill, params, caches, prompt)
+    dt = _time(prefill, params, caches, prompt, steps=10)
     tps = batch * prompt_len / dt
     print(json.dumps({
         "metric": f"gpt2_124m_prefill_bs{batch}_tokens_per_sec_per_chip",
