@@ -17,7 +17,7 @@ from apex_tpu.models.transformer import (
 from apex_tpu.models.gpt import GPTModel
 from apex_tpu.models.bert import BertModel
 from apex_tpu.models.encoder_decoder import EncoderDecoderModel
-from apex_tpu.models.pipelined import PipelinedGPT
+from apex_tpu.models.pipelined import PipelinedEncoderDecoder, PipelinedGPT
 from apex_tpu.models.generation import decode_step, generate, init_kv_caches
 from apex_tpu.models.resnet import (
     ResNet,
@@ -55,6 +55,7 @@ __all__ = [
     "GPTModel",
     "BertModel",
     "EncoderDecoderModel",
+    "PipelinedEncoderDecoder",
     "PipelinedGPT",
     "generate",
     "decode_step",

@@ -14,7 +14,7 @@ Capability counterpart of the reference's pipelined GPT test fixture
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
 from typing import Any, Dict, Optional
 
 import jax
@@ -42,7 +42,31 @@ from apex_tpu.transformer.pipeline_parallel.schedules import (
 from apex_tpu.models.gpt import lm_head_loss
 from apex_tpu.transformer.tensor_parallel.layers import VocabParallelEmbedding
 
-__all__ = ["PipelinedGPT"]
+__all__ = ["PipelinedGPT", "PipelinedEncoderDecoder"]
+
+
+def _pipeline_stage_rng(rng, tick):
+    """Per-tick dropout stream, decorrelated across pipeline stages (the
+    Megatron RNG-tracker role, ``tensor_parallel/random.py:90-240``).
+    Shared by both pipelined models."""
+    if rng is None:
+        return None
+    from apex_tpu.transformer.parallel_state import (
+        get_pipeline_model_parallel_rank,
+    )
+    rng = jax.random.fold_in(rng, tick)
+    return jax.random.fold_in(rng, get_pipeline_model_parallel_rank())
+
+
+def _tied_head_loss(config, emb, fln, hidden, mb):
+    """Final norm + weight-tied head + vocab-parallel loss for one
+    microbatch — the last-stage tail both pipelined models stream.
+    ``emb``/``fln`` must already carry the pipeline-replication mark."""
+    hidden = _ln(fln, hidden, config.layernorm_epsilon,
+                 config.sequence_parallel, config.axis_name,
+                 config.normalization)
+    return lm_head_loss(emb["word_embeddings"]["weight"], hidden,
+                        mb["labels"], mb.get("loss_mask"), config)
 
 
 @dataclass
@@ -129,24 +153,12 @@ class PipelinedGPT:
         return (hidden, aux) if moe else hidden
 
     def _stage_rng(self, rng, tick):
-        """Per-tick dropout stream, decorrelated across pipeline stages (the
-        Megatron RNG-tracker role, ``tensor_parallel/random.py:90-240``)."""
-        if rng is None:
-            return None
-        from apex_tpu.transformer.parallel_state import (
-            get_pipeline_model_parallel_rank,
-        )
-        rng = jax.random.fold_in(rng, tick)
-        return jax.random.fold_in(rng, get_pipeline_model_parallel_rank())
+        return _pipeline_stage_rng(rng, tick)
 
     def _postprocess(self, params, hidden, mb):
-        c = self.config
-        emb = mark_pipeline_replicated(params["embedding"])
-        fln = mark_pipeline_replicated(params["final_layernorm"])
-        hidden = _ln(fln, hidden, c.layernorm_epsilon,
-                     c.sequence_parallel, c.axis_name, c.normalization)
-        return lm_head_loss(emb["word_embeddings"]["weight"], hidden,
-                            mb["labels"], mb.get("loss_mask"), c)
+        return _tied_head_loss(
+            self.config, mark_pipeline_replicated(params["embedding"]),
+            mark_pipeline_replicated(params["final_layernorm"]), hidden, mb)
 
     # -- schedule -----------------------------------------------------------
 
@@ -195,6 +207,300 @@ class PipelinedGPT:
                 inner = make_pipelined_loss_fn(
                     preprocess, stage, self._postprocess, M, remat=remat,
                     stage_aux=moe)
+            return inner(params, batch)
+
+        return loss_fn
+
+
+def _pad_stage_rows(stages, total_rows: int, *, front: bool):
+    """Pad a ``[rows, ...]`` stage pytree with zero rows up to ``total_rows``.
+
+    The two-section pipeline shards BOTH section's stage arrays over the
+    full pipeline axis (a sharded leading dim must equal the axis size), so
+    each section is zero-padded over the ranks the other section owns. The
+    padding rows are dead weight by construction: ``lax.cond`` routes each
+    rank to its own section, so padded rows never see compute and their
+    grads are exactly zero.
+    """
+    def one(x):
+        pad = total_rows - x.shape[0]
+        if pad == 0:
+            return x
+        z = jnp.zeros((pad,) + x.shape[1:], x.dtype)
+        return jnp.concatenate([z, x] if front else [x, z], axis=0)
+    return jax.tree.map(one, stages)
+
+
+@dataclass
+class PipelinedEncoderDecoder:
+    """T5-style encoder-decoder split over a two-section pipeline.
+
+    Capability counterpart of the reference's ``ModelType.encoder_and_decoder``
+    pipeline: ``pipeline_model_parallel_split_rank`` cuts the pipeline axis
+    into an encoder section (ranks ``< split``) and a decoder section (ranks
+    ``>= split``) — reference ``apex/transformer/parallel_state.py:155-247``
+    (split-rank group construction) and ``pipeline_parallel/schedules/
+    fwd_bwd_pipelining_without_interleaving.py:241-400`` (the enc-dec tensor
+    routing in 1F1B).
+
+    TPU design — a two-stream lock-step carry instead of heterogeneous p2p:
+    the reference sends *different* tensors across the split boundary
+    (encoder hidden inside the encoder section; ``(decoder hidden, encoder
+    output)`` tuples inside the decoder section) with shape-polymorphic p2p.
+    Under the single ``lax.scan`` + ``ppermute`` schedule every inter-stage
+    payload must be one fixed pytree, so the carry is the pair ``(enc_stream,
+    dec_stream)`` from tick 0: encoder ranks advance ``enc_stream`` and pass
+    ``dec_stream`` through untouched; decoder ranks cross-attend the (by
+    then final) ``enc_stream`` and advance ``dec_stream``. ``lax.cond`` on
+    the pipeline rank picks the section, so each rank *computes* only its
+    own section's layers. The extra ppermute payload (the idle stream) is
+    the price of lock-step homogeneity; it buys the same
+    O(pipeline-depth) 1F1B memory bound and XLA-scheduled comms as
+    :class:`PipelinedGPT`, with no shape-polymorphic protocol.
+
+    ``split_rank`` defaults to the value installed by
+    ``initialize_model_parallel(pipeline_model_parallel_split_rank=...)`` —
+    the consumer of ``--pipeline-model-parallel-split-rank``.
+
+    Same restrictions as :class:`~apex_tpu.models.encoder_decoder.
+    EncoderDecoderModel` (no MoE, no context parallelism) plus: no
+    interleaved schedule (the reference's interleaved schedule rejects
+    enc-dec too) and no encoder padding masks (full-length microbatches,
+    as the reference pipeline tests use).
+    """
+
+    config: TransformerConfig
+    pipeline_size: int
+    num_microbatches: int
+    split_rank: Optional[int] = None
+    num_encoder_layers: Optional[int] = None
+
+    def __post_init__(self):
+        c = self.config
+        if c.num_moe_experts:
+            raise NotImplementedError(
+                "MoE (num_moe_experts) is currently wired into GPT models "
+                "only")
+        if c.context_parallel_method:
+            raise NotImplementedError(
+                "context parallelism is decoder-self-attention only; the "
+                "cross-attended encoder output is not sequence-sharded")
+        if self.split_rank is None:
+            from apex_tpu.transformer.parallel_state import (
+                get_pipeline_model_parallel_split_rank,
+            )
+            self.split_rank = get_pipeline_model_parallel_split_rank()
+        if self.split_rank is None:
+            raise ValueError(
+                "PipelinedEncoderDecoder needs a split rank: pass "
+                "split_rank= or initialize_model_parallel("
+                "pipeline_model_parallel_split_rank=...)")
+        S, split = self.pipeline_size, self.split_rank
+        if not 0 < split < S:
+            raise ValueError(
+                f"split_rank ({split}) must leave at least one encoder and "
+                f"one decoder stage: need 0 < split < pipeline_size ({S})")
+        from apex_tpu.transformer.enums import AttnMaskType, LayerType
+        n_enc = (c.num_layers if self.num_encoder_layers is None
+                 else self.num_encoder_layers)
+        n_dec = c.num_layers
+        if n_enc % split:
+            raise ValueError(
+                f"encoder depth ({n_enc}) must divide evenly into the "
+                f"{split} encoder stages")
+        if n_dec % (S - split):
+            raise ValueError(
+                f"decoder depth ({n_dec}) must divide evenly into the "
+                f"{S - split} decoder stages")
+        self._n_enc = n_enc
+        self._enc_cfg = replace(
+            c, attn_mask_type=AttnMaskType.padding, num_layers=n_enc)
+        self._dec_cfg = replace(c, attn_mask_type=AttnMaskType.causal)
+        self.embedding = VocabParallelEmbedding(
+            c.vocab_size, c.hidden_size, init_method=c.init_method(),
+            params_dtype=c.params_dtype, axis_name=c.axis_name)
+        self.enc_layer = ParallelTransformerLayer(self._enc_cfg)
+        self.dec_layer = ParallelTransformerLayer(self._dec_cfg,
+                                                  LayerType.decoder)
+
+    # -- parameters ---------------------------------------------------------
+
+    def init(self, key: jax.Array) -> Dict[str, Any]:
+        c = self.config
+        S, split = self.pipeline_size, self.split_rank
+        k_emb, k_pos, k_enc, k_dec = jax.random.split(key, 4)
+        enc_stacked = jax.vmap(self.enc_layer.init)(
+            jax.random.split(k_enc, self._n_enc))
+        dec_stacked = jax.vmap(self.dec_layer.init)(
+            jax.random.split(k_dec, c.num_layers))
+        enc_stages = _pad_stage_rows(
+            arrange_layers_for_pipeline(enc_stacked, split), S, front=False)
+        dec_stages = _pad_stage_rows(
+            arrange_layers_for_pipeline(dec_stacked, S - split), S,
+            front=True)
+        return {
+            "embedding": {
+                "word_embeddings": self.embedding.init(k_emb),
+                **position_table_params(c, k_pos),
+            },
+            "enc_stages": enc_stages,
+            "dec_stages": dec_stages,
+            "enc_final_layernorm": _ln_params(c.hidden_size, c.params_dtype,
+                                              c.normalization),
+            "dec_final_layernorm": _ln_params(c.hidden_size, c.params_dtype,
+                                              c.normalization),
+        }
+
+    def spec(self) -> Dict[str, Any]:
+        return {
+            "embedding": {
+                "word_embeddings": self.embedding.spec(),
+                **position_table_spec(self.config),
+            },
+            "enc_stages": pipeline_stage_spec(self.enc_layer.spec()),
+            "dec_stages": pipeline_stage_spec(self.dec_layer.spec()),
+            "enc_final_layernorm": _ln_spec(self.config.normalization),
+            "dec_final_layernorm": _ln_spec(self.config.normalization),
+        }
+
+    # -- stage pieces -------------------------------------------------------
+
+    def _run_section(self, layer, chunk_params, hidden, rng, enc_out=None):
+        def one_layer(carry, layer_params):
+            h, idx = carry
+            layer_rng = None if rng is None else jax.random.fold_in(rng, idx)
+            h = layer.apply(layer_params, h, encoder_output=enc_out,
+                            rng=layer_rng, deterministic=rng is None)
+            return (h, idx + 1), None
+        (hidden, _), _ = lax.scan(one_layer, (hidden, 0), chunk_params)
+        return hidden
+
+    def _enc_final_ln(self, fln, enc_h):
+        """``fln`` must already carry the pipeline-replication mark, applied
+        OUTSIDE any rank-routed ``lax.cond``: the mark's backward is a psum
+        over the pipeline axis, and a collective inside a branch only some
+        pipeline ranks take deadlocks the group (the SPMD invariant the
+        reference keeps implicitly by doing its embedding all-reduce outside
+        the schedule, ``parallel_state.py:347-407``)."""
+        c = self.config
+        return _ln(fln, enc_h, c.layernorm_epsilon, c.sequence_parallel,
+                   c.axis_name, c.normalization)
+
+    def _gathered(self, enc_h):
+        """Cross-attention wants the FULL encoder sequence; under SP the
+        carry stays sequence-sharded (fixed shapes) and each decoder stage
+        re-gathers — the standard SP gather-at-consumer pattern."""
+        c = self.config
+        if not c.sequence_parallel:
+            return enc_h
+        from apex_tpu.transformer.tensor_parallel.mappings import (
+            axis_bound,
+            gather_from_sequence_parallel_region,
+        )
+        if not axis_bound(c.axis_name):
+            return enc_h
+        return gather_from_sequence_parallel_region(enc_h, False, c.axis_name)
+
+    def _stage_rng(self, rng, tick, section: int):
+        rng = _pipeline_stage_rng(rng, tick)
+        return None if rng is None else jax.random.fold_in(rng, section)
+
+    def _postprocess(self, params, h, mb):
+        _, dec_h = h
+        return _tied_head_loss(
+            self.config, mark_pipeline_replicated(params["embedding"]),
+            mark_pipeline_replicated(params["dec_final_layernorm"]),
+            dec_h, mb)
+
+    # -- schedule -----------------------------------------------------------
+
+    def make_loss_fn(self, *, remat: bool = True):
+        """Build ``loss_fn(params, microbatched_batch, rng=None) -> scalar``.
+
+        Batch leaves are ``[M, micro_b, ...]`` with keys ``enc_tokens``,
+        ``dec_tokens``, ``labels`` (+ optional ``loss_mask``). Runs inside
+        ``shard_map`` with the pipeline axis bound; with the axis unbound
+        (single device) the two sections run back-to-back per microbatch —
+        numerically the unpipelined :class:`~apex_tpu.models.
+        encoder_decoder.EncoderDecoderModel`.
+        """
+        from apex_tpu.transformer.parallel_state import PIPELINE_AXIS
+        from apex_tpu.transformer.tensor_parallel.mappings import axis_bound
+
+        M = self.num_microbatches
+        split = self.split_rank
+
+        def loss_fn(params, batch, rng=None):
+            deterministic = rng is None
+            pipelined = axis_bound(PIPELINE_AXIS)
+
+            def preprocess(p, mb):
+                emb = mark_pipeline_replicated(p["embedding"])
+                r_e = r_d = None
+                if not deterministic:
+                    r = jax.random.fold_in(rng, mb["_mb"])
+                    r_e, r_d = jax.random.split(r)
+                enc_h = embed_tokens(self.embedding, emb, mb["enc_tokens"],
+                                     self._enc_cfg, rng=r_e,
+                                     deterministic=deterministic)
+                dec_h = embed_tokens(self.embedding, emb, mb["dec_tokens"],
+                                     self._dec_cfg, rng=r_d,
+                                     deterministic=deterministic)
+                return (enc_h, dec_h)
+
+            def stage(p, h, tick):
+                # replication mark hoisted out of the rank-routed branches —
+                # its backward psums over the pipeline axis (see
+                # _enc_final_ln)
+                fln = mark_pipeline_replicated(p["enc_final_layernorm"])
+                enc_h, dec_h = h
+                r_enc = self._stage_rng(rng, tick, 0)
+                r_dec = self._stage_rng(rng, tick, 1)
+                if not pipelined:
+                    # degenerate single-rank path: the full (unsharded)
+                    # [S, ...] stage arrays are visible, so flatten each
+                    # section's REAL rows (row 0 of dec_stages is padding)
+                    # and run whole encoder, boundary norm, whole decoder
+                    # in one stage
+                    enc_local = jax.tree.map(
+                        lambda x: x[:split].reshape((-1,) + x.shape[2:]),
+                        p["enc_stages"])
+                    dec_local = jax.tree.map(
+                        lambda x: x[split:].reshape((-1,) + x.shape[2:]),
+                        p["dec_stages"])
+                    enc_h = self._run_section(self.enc_layer, enc_local,
+                                              enc_h, r_enc)
+                    enc_h = self._enc_final_ln(fln, enc_h)
+                    dec_h = self._run_section(self.dec_layer, dec_local,
+                                              dec_h, r_dec,
+                                              enc_out=self._gathered(enc_h))
+                    return (enc_h, dec_h)
+                enc_local = jax.tree.map(lambda x: x[0], p["enc_stages"])
+                dec_local = jax.tree.map(lambda x: x[0], p["dec_stages"])
+                i = lax.axis_index(PIPELINE_AXIS)
+
+                def enc_branch(h):
+                    enc_h, dec_h = h
+                    enc_h = self._run_section(self.enc_layer, enc_local,
+                                              enc_h, r_enc)
+                    enc_h = lax.cond(i == split - 1,
+                                     lambda e: self._enc_final_ln(fln, e),
+                                     lambda e: e, enc_h)
+                    return (enc_h, dec_h)
+
+                def dec_branch(h):
+                    enc_h, dec_h = h
+                    dec_h = self._run_section(self.dec_layer, dec_local,
+                                              dec_h, r_dec,
+                                              enc_out=self._gathered(enc_h))
+                    return (enc_h, dec_h)
+
+                return lax.cond(i < split, enc_branch, dec_branch, h)
+
+            batch = dict(batch)
+            batch["_mb"] = jnp.arange(M)
+            inner = make_pipelined_loss_fn(
+                preprocess, stage, self._postprocess, M, remat=remat)
             return inner(params, batch)
 
         return loss_fn

@@ -26,6 +26,7 @@ from __future__ import annotations
 from typing import Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec
 
@@ -45,6 +46,12 @@ _NUM_SLICES: int = 1
 _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK: Optional[int] = None
 _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE: Optional[int] = None
 
+# Encoder/decoder two-section pipeline split: pipeline ranks < split run
+# encoder stages, ranks >= split run decoder stages
+# (reference: parallel_state.py:155-247 stores the split rank at group
+# construction; rank predicates :589-668).
+_PIPELINE_MODEL_PARALLEL_SPLIT_RANK: Optional[int] = None
+
 # Test-only world-size overrides (reference exposes the same "fake" setters).
 _FAKE_SIZES: dict = {}
 
@@ -62,6 +69,7 @@ def initialize_model_parallel(
     tensor_model_parallel_size: int = 1,
     pipeline_model_parallel_size: int = 1,
     virtual_pipeline_model_parallel_size: Optional[int] = None,
+    pipeline_model_parallel_split_rank: Optional[int] = None,
     context_parallel_size: int = 1,
     *,
     num_slices: int = 1,
@@ -91,6 +99,7 @@ def initialize_model_parallel(
     used as the slice layout.
     """
     global _MESH, _NUM_SLICES, _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE
+    global _PIPELINE_MODEL_PARALLEL_SPLIT_RANK
     devs = list(devices) if devices is not None else jax.devices()
     n = len(devs)
     tp, pp, cp = tensor_model_parallel_size, pipeline_model_parallel_size, context_parallel_size
@@ -131,8 +140,23 @@ def initialize_model_parallel(
         dev_array = np.array(devs).reshape(dp, pp, cp, tp)
     else:
         dev_array = np.array(devs).reshape(dp, pp, cp, tp)
+    if pipeline_model_parallel_split_rank is not None:
+        if not 0 < pipeline_model_parallel_split_rank < pp:
+            raise ValueError(
+                f"pipeline_model_parallel_split_rank "
+                f"({pipeline_model_parallel_split_rank}) must leave at least "
+                f"one encoder and one decoder stage: need 0 < split < "
+                f"pipeline size ({pp})")
+        if virtual_pipeline_model_parallel_size is not None:
+            # reference parity: the interleaved schedule rejects
+            # encoder_and_decoder (fwd_bwd_pipelining_with_interleaving.py)
+            raise ValueError(
+                "interleaved (virtual) pipelining is not supported with an "
+                "encoder/decoder split — the reference's interleaved "
+                "schedule rejects ModelType.encoder_and_decoder too")
     _MESH = Mesh(dev_array, MESH_AXIS_NAMES)
     _NUM_SLICES = num_slices
+    _PIPELINE_MODEL_PARALLEL_SPLIT_RANK = pipeline_model_parallel_split_rank
     if virtual_pipeline_model_parallel_size is not None:
         _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE = virtual_pipeline_model_parallel_size
     return _MESH
@@ -170,10 +194,12 @@ def destroy_model_parallel() -> None:
     """Reference: ``parallel_state.py:761-792``."""
     global _MESH, _NUM_SLICES, _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK
     global _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE
+    global _PIPELINE_MODEL_PARALLEL_SPLIT_RANK
     _MESH = None
     _NUM_SLICES = 1
     _VIRTUAL_PIPELINE_MODEL_PARALLEL_RANK = None
     _VIRTUAL_PIPELINE_MODEL_PARALLEL_WORLD_SIZE = None
+    _PIPELINE_MODEL_PARALLEL_SPLIT_RANK = None
     _FAKE_SIZES.clear()
 
 
@@ -275,6 +301,60 @@ def get_pipeline_model_parallel_next_rank():
 def get_pipeline_model_parallel_prev_rank():
     rank = get_pipeline_model_parallel_rank()
     return (rank - 1) % get_pipeline_model_parallel_world_size()
+
+
+# ---------------------------------------------------------------------------
+# encoder/decoder split (two-section pipeline) state
+# (reference: parallel_state.py:155-247 split-rank bookkeeping; rank
+# predicates :601-668 is_pipeline_stage_{before,after,at}_split)
+# ---------------------------------------------------------------------------
+
+def get_pipeline_model_parallel_split_rank() -> Optional[int]:
+    """First pipeline rank of the decoder section, or None (decoder-only)."""
+    return _PIPELINE_MODEL_PARALLEL_SPLIT_RANK
+
+
+def set_pipeline_model_parallel_split_rank(rank: Optional[int]) -> None:
+    global _PIPELINE_MODEL_PARALLEL_SPLIT_RANK
+    _PIPELINE_MODEL_PARALLEL_SPLIT_RANK = rank
+
+
+def is_pipeline_stage_before_split(rank=None):
+    """True when the given (default: this) pipeline rank runs encoder
+    stages. With no split configured every stage counts as "before" —
+    reference semantics (``parallel_state.py:601-616``). Inside
+    ``shard_map`` the default rank is traced, so the result may be a traced
+    bool (compose with ``lax.cond``/``jnp.where``)."""
+    if get_pipeline_model_parallel_world_size() == 1:
+        return True
+    if _PIPELINE_MODEL_PARALLEL_SPLIT_RANK is None:
+        return True
+    if rank is None:
+        rank = get_pipeline_model_parallel_rank()
+    return rank < _PIPELINE_MODEL_PARALLEL_SPLIT_RANK
+
+
+def is_pipeline_stage_after_split(rank=None):
+    """True when the given (default: this) pipeline rank runs decoder
+    stages (reference ``parallel_state.py:619-634``)."""
+    if get_pipeline_model_parallel_world_size() == 1:
+        return True
+    if _PIPELINE_MODEL_PARALLEL_SPLIT_RANK is None:
+        return True
+    if rank is None:
+        rank = get_pipeline_model_parallel_rank()
+    return rank >= _PIPELINE_MODEL_PARALLEL_SPLIT_RANK
+
+
+def is_pipeline_stage_at_split():
+    """True on the last encoder stage (its successor starts the decoder) —
+    reference ``parallel_state.py:637-645``."""
+    rank = get_pipeline_model_parallel_rank()
+    before = is_pipeline_stage_before_split(rank)
+    after = is_pipeline_stage_after_split(rank + 1)
+    if isinstance(before, bool) and isinstance(after, bool):
+        return before and after
+    return jnp.logical_and(before, after)
 
 
 # ---------------------------------------------------------------------------

@@ -81,9 +81,9 @@ REFERENCE_DISPOSITIONS: Dict[str, Tuple[str, str]] = {
     "--model-parallel-size": (_W, "deprecated alias of "
                                   "--tensor-model-parallel-size (reference "
                                   "semantics)"),
-    "--pipeline-model-parallel-split-rank": (_W, "encoder/decoder stage "
-                                                 "split for enc-dec "
-                                                 "pipelines"),
+    "--pipeline-model-parallel-split-rank": (
+        _W, "initialize_model_parallel(pipeline_model_parallel_split_rank=) "
+            "-> models.PipelinedEncoderDecoder two-section 1F1B pipeline"),
     "--num-layers-per-virtual-pipeline-stage": (
         _W, "derives virtual_pipeline_model_parallel_size"),
     "--sequence-parallel": (_W, "TransformerConfig.sequence_parallel"),
@@ -290,6 +290,18 @@ _EXTENSION_FLAGS = """--num-query-groups --vocab-size
 --scan-unroll""".split()
 
 
+def _str2bool(v: str) -> bool:
+    """argparse ``type=`` converter for tri-state bool flags: the reference
+    declares these ``type=bool``, under which an explicit ``--onnx-safe
+    False`` parses as True (``bool('False')``); both flags are inert here,
+    so fix the quirk rather than mirroring it (ADVICE r3)."""
+    if v.lower() in ("true", "1", "yes", "y"):
+        return True
+    if v.lower() in ("false", "0", "no", "n"):
+        return False
+    raise argparse.ArgumentTypeError(f"expected a boolean, got {v!r}")
+
+
 def parse_args(extra_args_provider: Optional[Callable] = None,
                defaults: Optional[Dict] = None,
                ignore_unknown_args: bool = False,
@@ -320,7 +332,7 @@ def parse_args(extra_args_provider: Optional[Callable] = None,
     g.add_argument("--apply-residual-connection-post-layernorm",
                    action="store_true")
     g.add_argument("--openai-gelu", action="store_true")
-    g.add_argument("--onnx-safe", type=bool, default=None)
+    g.add_argument("--onnx-safe", type=_str2bool, default=None)
     g.add_argument("--fp32-residual-connection", action="store_true")
     g.add_argument("--attention-softmax-in-fp32", action="store_true")
     g.add_argument("--no-query-key-layer-scaling", action="store_false",
@@ -371,7 +383,7 @@ def parse_args(extra_args_provider: Optional[Callable] = None,
                    action="store_false",
                    dest="scatter_gather_tensors_in_pipeline")
     g.add_argument("--use-cpu-initialization", action="store_true")
-    g.add_argument("--lazy-mpu-init", type=bool, default=None)
+    g.add_argument("--lazy-mpu-init", type=_str2bool, default=None)
     g.add_argument("--cpu-offload", action="store_true")
     g.add_argument("--empty-unused-memory-level", type=int, default=0)
     g.add_argument("--num-slices", type=int, default=1,

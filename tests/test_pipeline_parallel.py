@@ -739,3 +739,248 @@ class TestInterleavedMemory:
         assert big < small * 1.2, (
             f"interleaved temp arena grew {big / small:.2f}x from M=4 "
             f"({small}B) to M=32 ({big}B)")
+
+
+class TestPipelinedEncoderDecoder:
+    """Two-section (encoder|decoder) pipeline vs the unpipelined
+    EncoderDecoderModel — the ``ModelType.encoder_and_decoder`` parity the
+    reference pins in ``test_pipeline_parallel_fwd_bwd.py`` (split-rank
+    construction ``apex/transformer/parallel_state.py:155-247``)."""
+
+    M = 2
+
+    def _data(self, bs=4, s_enc=12, s_dec=16, vocab=128):
+        enc_tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (bs, s_enc), 0, vocab)
+        dec_tokens = jax.random.randint(
+            jax.random.PRNGKey(2), (bs, s_dec), 0, vocab)
+        labels = jax.random.randint(
+            jax.random.PRNGKey(3), (bs, s_dec), 0, vocab)
+        return enc_tokens, dec_tokens, labels
+
+    def _run(self, S=2, split=1, n_enc=2, n_dec=2, tp=1, sp=False):
+        from apex_tpu.models import EncoderDecoderModel, PipelinedEncoderDecoder
+        from apex_tpu.models.pipelined import _pad_stage_rows
+
+        parallel_state.destroy_model_parallel()
+        mesh = parallel_state.initialize_model_parallel(
+            tensor_model_parallel_size=tp, pipeline_model_parallel_size=S,
+            pipeline_model_parallel_split_rank=split)
+        cfg = _gpt_config(num_layers=n_dec, sequence_parallel=sp)
+        ref_model = EncoderDecoderModel(cfg, num_encoder_layers=n_enc)
+        ref_params = ref_model.init(jax.random.PRNGKey(0))
+
+        # split_rank comes from parallel_state — the end-to-end consumer of
+        # --pipeline-model-parallel-split-rank
+        pmodel = PipelinedEncoderDecoder(
+            cfg, pipeline_size=S, num_microbatches=self.M,
+            num_encoder_layers=n_enc)
+        assert pmodel.split_rank == split
+        pparams = {
+            "embedding": ref_params["embedding"],
+            "enc_stages": _pad_stage_rows(
+                arrange_layers_for_pipeline(
+                    ref_params["encoder"]["layers"], split), S, front=False),
+            "dec_stages": _pad_stage_rows(
+                arrange_layers_for_pipeline(
+                    ref_params["decoder"]["layers"], S - split), S,
+                front=True),
+            "enc_final_layernorm": ref_params["encoder"]["final_layernorm"],
+            "dec_final_layernorm": ref_params["decoder"]["final_layernorm"],
+        }
+        enc_tokens, dec_tokens, labels = self._data()
+        mb = split_batch_into_microbatches(
+            {"enc_tokens": enc_tokens, "dec_tokens": dec_tokens,
+             "labels": labels}, self.M)
+
+        loss_fn = pmodel.make_loss_fn()
+        spec = pmodel.spec()
+        run = jax.jit(jax.shard_map(
+            jax.value_and_grad(loss_fn), mesh=mesh,
+            in_specs=(spec, P()),
+            out_specs=(P(), spec),
+            check_vma=False))
+        loss, grads = run(pparams, mb)
+
+        ref_loss, ref_grads = jax.jit(jax.value_and_grad(
+            lambda p: ref_model.apply(p, enc_tokens, dec_tokens, labels)))(
+                ref_params)
+        parallel_state.destroy_model_parallel()
+        return loss, grads, ref_loss, ref_grads, split, S
+
+    def _check(self, loss, grads, ref_loss, ref_grads, split, S):
+        np.testing.assert_allclose(float(loss), float(ref_loss),
+                                   rtol=2e-4, atol=2e-5)
+        # tied-embedding grads psum-synced across stages
+        np.testing.assert_allclose(
+            np.asarray(grads["embedding"]["word_embeddings"]["weight"]),
+            np.asarray(ref_grads["embedding"]["word_embeddings"]["weight"]),
+            rtol=2e-3, atol=2e-5)
+        # encoder layer grads live in rows [:split]; padded rows exactly 0
+        g = np.asarray(grads["enc_stages"]["mlp"]["dense_h_to_4h"]["weight"])
+        ref_g = np.asarray(
+            ref_grads["encoder"]["layers"]["mlp"]["dense_h_to_4h"]["weight"])
+        np.testing.assert_allclose(g[:split].reshape(ref_g.shape), ref_g,
+                                   rtol=2e-3, atol=2e-5)
+        assert np.all(g[split:] == 0)
+        # decoder cross-attention grads live in rows [split:]
+        g = np.asarray(
+            grads["dec_stages"]["inter_attention"]["key_value"]["weight"])
+        ref_g = np.asarray(
+            ref_grads["decoder"]["layers"]["inter_attention"]["key_value"]
+            ["weight"])
+        np.testing.assert_allclose(g[split:].reshape(ref_g.shape), ref_g,
+                                   rtol=2e-3, atol=2e-5)
+        assert np.all(g[:split] == 0)
+        assert np.abs(g[split:]).max() > 0
+        # boundary/final norms
+        for k, sect in (("enc_final_layernorm", "encoder"),
+                        ("dec_final_layernorm", "decoder")):
+            np.testing.assert_allclose(
+                np.asarray(grads[k]["weight"]),
+                np.asarray(ref_grads[sect]["final_layernorm"]["weight"]),
+                rtol=2e-3, atol=2e-5)
+
+    def test_pp2_split1_matches_unpipelined(self):
+        self._check(*self._run(S=2, split=1, n_enc=2, n_dec=2))
+
+    def test_pp4_split2_matches_unpipelined(self):
+        self._check(*self._run(S=4, split=2, n_enc=2, n_dec=4))
+
+    def test_pp4_split1_uneven_sections(self):
+        # 1 encoder stage vs 3 decoder stages: section depths needn't match
+        self._check(*self._run(S=4, split=1, n_enc=2, n_dec=3))
+
+    def test_pp2_tp2_sp_matches_unpipelined(self):
+        # TP+SP inside each stage; decoder stages re-gather the sequence-
+        # sharded encoder stream for cross-attention
+        loss, grads, ref_loss, ref_grads, split, S = self._run(
+            S=2, split=1, n_enc=2, n_dec=2, tp=2, sp=True)
+        np.testing.assert_allclose(float(loss), float(ref_loss),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_single_rank_degenerate_matches_unpipelined(self):
+        """Pipeline axis unbound: sections run back-to-back per microbatch."""
+        from apex_tpu.models import EncoderDecoderModel, PipelinedEncoderDecoder
+
+        parallel_state.destroy_model_parallel()
+        cfg = _gpt_config(num_layers=2)
+        ref_model = EncoderDecoderModel(cfg, num_encoder_layers=2)
+        ref_params = ref_model.init(jax.random.PRNGKey(0))
+        pmodel = PipelinedEncoderDecoder(
+            cfg, pipeline_size=2, num_microbatches=self.M, split_rank=1,
+            num_encoder_layers=2)
+        pparams = pmodel.init(jax.random.PRNGKey(0))
+        # re-use its own init; compare against ref built from those params
+        ref_like = {
+            "embedding": pparams["embedding"],
+            "encoder": {
+                "layers": jax.tree.map(
+                    lambda x: x[:1].reshape((2,) + x.shape[2:]),
+                    pparams["enc_stages"]),
+                "final_layernorm": pparams["enc_final_layernorm"],
+            },
+            "decoder": {
+                "layers": jax.tree.map(
+                    lambda x: x[1:].reshape((2,) + x.shape[2:]),
+                    pparams["dec_stages"]),
+                "final_layernorm": pparams["dec_final_layernorm"],
+            },
+        }
+        enc_tokens, dec_tokens, labels = self._data()
+        mb = split_batch_into_microbatches(
+            {"enc_tokens": enc_tokens, "dec_tokens": dec_tokens,
+             "labels": labels}, self.M)
+        loss = jax.jit(pmodel.make_loss_fn())(pparams, mb)
+        ref_loss = jax.jit(
+            lambda p: ref_model.apply(p, enc_tokens, dec_tokens, labels))(
+                ref_like)
+        np.testing.assert_allclose(float(loss), float(ref_loss),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_dropout_rng_path_runs(self):
+        from apex_tpu.models import PipelinedEncoderDecoder
+
+        parallel_state.destroy_model_parallel()
+        mesh = parallel_state.initialize_model_parallel(
+            pipeline_model_parallel_size=2,
+            pipeline_model_parallel_split_rank=1)
+        cfg = _gpt_config(num_layers=2, hidden_dropout=0.1,
+                          attention_dropout=0.1)
+        pmodel = PipelinedEncoderDecoder(
+            cfg, pipeline_size=2, num_microbatches=self.M,
+            num_encoder_layers=2)
+        pparams = pmodel.init(jax.random.PRNGKey(0))
+        enc_tokens, dec_tokens, labels = self._data()
+        mb = split_batch_into_microbatches(
+            {"enc_tokens": enc_tokens, "dec_tokens": dec_tokens,
+             "labels": labels}, self.M)
+        loss_fn = pmodel.make_loss_fn()
+        spec = pmodel.spec()
+        run = jax.jit(jax.shard_map(
+            lambda p, b, r: loss_fn(p, b, r), mesh=mesh,
+            in_specs=(spec, P(), P()),
+            out_specs=P(), check_vma=False))
+        l1 = float(run(pparams, mb, jax.random.PRNGKey(7)))
+        l2 = float(run(pparams, mb, jax.random.PRNGKey(8)))
+        det = jax.jit(jax.shard_map(
+            lambda p, b: loss_fn(p, b), mesh=mesh,
+            in_specs=(spec, P()), out_specs=P(), check_vma=False))
+        l0 = float(det(pparams, mb))
+        assert np.isfinite([l0, l1, l2]).all()
+        assert l1 != l0 and l1 != l2
+        parallel_state.destroy_model_parallel()
+
+    def test_validation(self):
+        from apex_tpu.models import PipelinedEncoderDecoder
+
+        parallel_state.destroy_model_parallel()
+        cfg = _gpt_config(num_layers=2)
+        with pytest.raises(ValueError, match="split"):
+            PipelinedEncoderDecoder(cfg, pipeline_size=2, num_microbatches=2,
+                                    split_rank=0)
+        with pytest.raises(ValueError, match="split"):
+            PipelinedEncoderDecoder(cfg, pipeline_size=2, num_microbatches=2,
+                                    split_rank=2)
+        with pytest.raises(ValueError, match="split rank"):
+            PipelinedEncoderDecoder(cfg, pipeline_size=2, num_microbatches=2)
+        with pytest.raises(ValueError, match="divide evenly"):
+            PipelinedEncoderDecoder(cfg, pipeline_size=3, num_microbatches=2,
+                                    split_rank=2, num_encoder_layers=3)
+
+
+class TestSplitRankState:
+    def test_predicates_host_side(self):
+        parallel_state.destroy_model_parallel()
+        parallel_state.initialize_model_parallel(
+            pipeline_model_parallel_size=4,
+            pipeline_model_parallel_split_rank=2)
+        assert parallel_state.get_pipeline_model_parallel_split_rank() == 2
+        # host-side (untraced) rank is 0 -> encoder section
+        assert parallel_state.is_pipeline_stage_before_split(0)
+        assert parallel_state.is_pipeline_stage_before_split(1)
+        assert not parallel_state.is_pipeline_stage_before_split(2)
+        assert parallel_state.is_pipeline_stage_after_split(2)
+        assert parallel_state.is_pipeline_stage_after_split(3)
+        assert not parallel_state.is_pipeline_stage_after_split(1)
+        parallel_state.destroy_model_parallel()
+        # no split configured: both predicates pass (reference semantics)
+        parallel_state.initialize_model_parallel(
+            pipeline_model_parallel_size=2)
+        assert parallel_state.get_pipeline_model_parallel_split_rank() is None
+        assert parallel_state.is_pipeline_stage_before_split(1)
+        assert parallel_state.is_pipeline_stage_after_split(0)
+        parallel_state.destroy_model_parallel()
+
+    def test_init_validation(self):
+        parallel_state.destroy_model_parallel()
+        with pytest.raises(ValueError, match="split"):
+            parallel_state.initialize_model_parallel(
+                pipeline_model_parallel_size=2,
+                pipeline_model_parallel_split_rank=2)
+        with pytest.raises(ValueError, match="interleaved"):
+            parallel_state.initialize_model_parallel(
+                pipeline_model_parallel_size=4,
+                virtual_pipeline_model_parallel_size=2,
+                pipeline_model_parallel_split_rank=2)
+        parallel_state.destroy_model_parallel()
