@@ -27,18 +27,43 @@ import jax.numpy as jnp
 
 
 def _sync_moments(x32: jax.Array, reduce_axes, axis_name: Optional[str],
-                  initializing: bool = False):
-    """Return (mean, var) over ``reduce_axes`` and, if given, ``axis_name``."""
-    n_local = 1
-    for a in reduce_axes:
-        n_local *= x32.shape[a]
-    count = jnp.asarray(n_local, jnp.float32)
-    local_sum = jnp.sum(x32, axis=reduce_axes)
+                  initializing: bool = False, sample_mask=None):
+    """Return (mean, var, count) over ``reduce_axes`` and, if given,
+    ``axis_name``.
+
+    ``sample_mask`` (bool/0-1, ``[batch]``) marks which batch rows are real:
+    masked rows contribute neither to the sums nor to the count, so the
+    cross-replica merge is **count-weighted** — the SPMD expression of the
+    reference's unequal per-rank batch sizes (``csrc/welford.cu``
+    ``welford_parallel`` merges (count, mean, M2) triples;
+    ``tests/distributed/synced_batchnorm/two_gpu_test_different_batch_size
+    .py`` pins it). Under shard_map every rank's SHAPES are equal by
+    construction, so ranks with fewer real samples pad and mask.
+    """
     sync = axis_name is not None and not initializing
+    if sample_mask is None:
+        n_local = 1
+        for a in reduce_axes:
+            n_local *= x32.shape[a]
+        count = jnp.asarray(n_local, jnp.float32)
+        w = None
+    else:
+        per_sample = 1
+        for a in reduce_axes:
+            if a % x32.ndim != 0:
+                per_sample *= x32.shape[a]
+        w = sample_mask.reshape((-1,) + (1,) * (x32.ndim - 1)) != 0
+        count = jnp.sum(w.astype(jnp.float32)) * per_sample
+        # where, not multiply: 0 * NaN/Inf in a padded row would poison
+        # the whole batch's statistics
+        x32 = jnp.where(w, x32, 0.0)
+    local_sum = jnp.sum(x32, axis=reduce_axes)
     if sync:
         local_sum = jax.lax.psum(local_sum, axis_name)
         count = jax.lax.psum(count, axis_name)
-    mean = local_sum / count
+    # an all-padded (global) batch has no statistics; guard the 0/0 so it
+    # degrades to zeros instead of NaN-poisoning running stats
+    mean = local_sum / jnp.maximum(count, 1.0)
     # two-pass variance: centering before squaring avoids the catastrophic
     # cancellation of E[x²]-mean² — the stability property the reference's
     # Welford kernels (csrc/welford.cu) exist to provide
@@ -47,10 +72,12 @@ def _sync_moments(x32: jax.Array, reduce_axes, axis_name: Optional[str],
         if a not in [ax % x32.ndim for ax in reduce_axes]:
             shape[a] = x32.shape[a]
     centered = x32 - mean.reshape(shape)
+    if w is not None:
+        centered = jnp.where(w, centered, 0.0)
     sqsum = jnp.sum(centered * centered, axis=reduce_axes)
     if sync:
         sqsum = jax.lax.psum(sqsum, axis_name)
-    var = sqsum / count
+    var = sqsum / jnp.maximum(count, 1.0)
     return mean, var, count
 
 
@@ -74,7 +101,13 @@ class SyncBatchNorm(nn.Module):
     param_dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, x, use_running_stats: bool = False):
+    def __call__(self, x, use_running_stats: bool = False, sample_mask=None):
+        """``sample_mask`` (``[batch]`` bool) marks real rows: padded rows
+        are excluded from the (count-weighted, cross-replica) statistics —
+        how unequal per-rank batch sizes are expressed under SPMD (the
+        reference's ``two_gpu_test_different_batch_size.py`` capability).
+        Masked rows still produce normalized outputs; mask them downstream.
+        """
         c = self.num_features
         if self.channel_last:
             reduce_axes = tuple(range(x.ndim - 1))
@@ -92,7 +125,8 @@ class SyncBatchNorm(nn.Module):
         else:
             mean, var, count = _sync_moments(
                 x32, reduce_axes, self.axis_name,
-                initializing=self.is_initializing())
+                initializing=self.is_initializing(),
+                sample_mask=sample_mask)
             if self.track_running_stats and not self.is_initializing():
                 # unbiased variance for running stats (reference matches
                 # torch BN semantics)

@@ -34,12 +34,18 @@ def bn_init(c: int):
              "var": jnp.ones((c,), jnp.float32)})
 
 
-def bn_sums(x, shift):
+def bn_sums(x, shift, sample_mask=None):
     """Per-channel fp32 ``[2, C]`` shifted sums of NHWC ``x`` over (N, H, W):
     row 0 = ``sum(x - shift)``, row 1 = ``sum((x - shift)^2)``. The cast and
-    subtract fuse into the reduction read — one pass over ``x``."""
+    subtract fuse into the reduction read — one pass over ``x``.
+    ``sample_mask`` (``[N]`` bool) excludes padded batch rows from both sums
+    (pair with the matching ``n`` — see :func:`bn_apply`)."""
     xc = x.astype(jnp.float32) - lax.stop_gradient(
         shift.astype(jnp.float32))
+    if sample_mask is not None:
+        # where, not multiply: 0 * NaN/Inf in a padded row would poison
+        # both sums
+        xc = jnp.where((sample_mask != 0)[:, None, None, None], xc, 0.0)
     return jnp.stack([jnp.sum(xc, axis=(0, 1, 2)),
                       jnp.sum(jnp.square(xc), axis=(0, 1, 2))])
 
@@ -56,9 +62,12 @@ def bn_from_sums(p, s, sums, n, *, shift, momentum: float, eps: float,
         sums = lax.psum(sums, axis_name)
         n = lax.psum(n, axis_name)
     shift = lax.stop_gradient(shift)
-    d = sums[0] / n
+    # guard the 0/0 of an all-padded (global) batch: stats degrade to the
+    # shift/zeros instead of NaN-poisoning the running state
+    n_safe = jnp.maximum(n, 1.0)
+    d = sums[0] / n_safe
     mean = shift + d
-    var = jnp.maximum(sums[1] / n - jnp.square(d), 0.0)
+    var = jnp.maximum(sums[1] / n_safe - jnp.square(d), 0.0)
     new_s = {
         "mean": (1 - momentum) * s["mean"] + momentum * mean,
         # running var uses the unbiased estimate, torch BN semantics
@@ -72,9 +81,16 @@ def bn_from_sums(p, s, sums, n, *, shift, momentum: float, eps: float,
 
 
 def bn_apply(p, s, x, *, train: bool, momentum: float, eps: float,
-             axis_name: Optional[str]):
+             axis_name: Optional[str], sample_mask=None):
     """NHWC batch norm; returns ``(y, new_state)``. With ``axis_name`` bound
     the batch statistics are synchronized across that mesh axis.
+
+    ``sample_mask`` (``[N]`` bool) marks real batch rows: padded rows drop
+    out of the statistics and the count, making the cross-rank merge
+    count-weighted — the SPMD form of the reference's unequal per-rank
+    batches (``csrc/welford.cu`` ``welford_parallel``;
+    ``tests/distributed/synced_batchnorm/two_gpu_test_different_batch_size
+    .py``). Masked rows still get normalized outputs; mask them downstream.
 
     Performance shape (v5e, RN50-sized activations): statistics are ONE
     fused fp32 pass (shifted sum + sum-of-squares reduced together, one
@@ -85,9 +101,13 @@ def bn_apply(p, s, x, *, train: bool, momentum: float, eps: float,
     Welford CUDA kernels make (fp32 stats, fp16 apply; ``csrc/welford.cu``).
     """
     if train:
-        n = x.shape[0] * x.shape[1] * x.shape[2]
-        a, b, new_s = bn_from_sums(p, s, bn_sums(x, s["mean"]), n,
-                                   shift=s["mean"], momentum=momentum,
+        if sample_mask is None:
+            n = x.shape[0] * x.shape[1] * x.shape[2]
+        else:
+            n = (jnp.sum(sample_mask.astype(jnp.float32))
+                 * x.shape[1] * x.shape[2])
+        a, b, new_s = bn_from_sums(p, s, bn_sums(x, s["mean"], sample_mask),
+                                   n, shift=s["mean"], momentum=momentum,
                                    eps=eps, axis_name=axis_name)
     else:
         mean, var, new_s = s["mean"], s["var"], s

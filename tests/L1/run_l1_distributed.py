@@ -1,0 +1,175 @@
+"""Distributed L1 tier: dp=8 convergence traces vs a single-device O0 run.
+
+The reference runs its L1 convergence cross-product under 2-process DDP as
+well as single-GPU (``tests/L1/cross_product_distributed/run.sh`` wraps the
+same ``main_amp.py`` in ``torch.distributed.launch``), plus targeted
+multi-rank regressions (``tests/distributed/amp_master_params``,
+``DDP/ddp_race_condition_test.py``). This runner is that tier for TPU:
+the SAME ResNet training flow as ``run_l1.py``, but sharded dp=8 over the
+8-device virtual CPU mesh — SyncBN statistics over the data axis, psum'd
+gradients, bf16-O2 + dynamic scaler — traced for >=500 iterations and
+diffed against a single-device O0 run of the identical (small) model.
+
+The invariant being proven is the distributed-equivalence one: dp=8 with
+SyncBN + grad-pmean IS the single-device run, up to precision-level drift
+(bf16 vs fp32), so the O0 single-device trace is the comparison baseline
+exactly as in the reference's distributed cross-product.
+
+Sized for CPU feasibility (32px, depth 50, width 32, batch 16): the point is the
+distributed composition, not chip throughput — the single-chip 224px
+traces in ``run_l1.py`` cover depth-at-scale.
+
+Run:
+    python tests/L1/run_l1_distributed.py [--iters 500] [--batch 32]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+TRACE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "traces")
+
+# name -> (opt_level, loss_scale, data-parallel size)
+DIST_CONFIGS = {
+    "dist_o0_fp32_single": ("O0", None, 1),
+    "dist_o2_dp8_syncbn": ("O2", "dynamic", 8),
+}
+
+
+def train_one(name, opt_level, loss_scale, dp, *, iters, batch,
+              image=32, width=32, classes=10, n_images=128, log_every=50):
+    from apex_tpu import amp
+    from apex_tpu.models import ResNet, ResNetConfig
+    from apex_tpu.optimizers import FusedSGD
+    from apex_tpu.transformer import parallel_state
+    from apex_tpu.utils.tree import global_norm
+
+    parallel_state.destroy_model_parallel()
+    mesh = parallel_state.initialize_model_parallel(
+        devices=jax.devices()[:dp])  # pure data-parallel mesh
+
+    amp_state = amp.initialize(opt_level, loss_scale=loss_scale,
+                               half_dtype=jnp.bfloat16)
+    props = amp_state.properties
+    compute = jnp.float32 if opt_level == "O0" else jnp.bfloat16
+    model = ResNet(ResNetConfig(
+        depth=50, num_classes=classes, width=width, compute_dtype=compute,
+        axis_name="data" if dp > 1 else None))
+    params, state = model.init(jax.random.PRNGKey(0))
+    opt = FusedSGD(lr=0.02, momentum=0.9, weight_decay=1e-4,
+                   master_weights=bool(props.master_weights))
+    opt_state = opt.init(params)
+    scaler = amp_state.scaler
+    sstate = amp_state.scaler_states[0]
+
+    xs = jax.random.normal(jax.random.PRNGKey(1),
+                           (n_images, image, image, 3))
+    ys = jax.random.randint(jax.random.PRNGKey(2), (n_images,), 0, classes)
+    n_batches = n_images // batch
+
+    def step_body(params, state, opt_state, sstate, x, y):
+        def loss_fn(p):
+            logits, new_s = model.apply(p, state, x, train=True)
+            logp = jax.nn.log_softmax(logits)
+            return -jnp.mean(logp[jnp.arange(x.shape[0]), y]), new_s
+
+        def scaled(p):
+            loss, new_s = loss_fn(p)
+            return scaler.scale(loss, sstate), (loss, new_s)
+
+        (_, (loss, new_s)), grads = jax.value_and_grad(
+            scaled, has_aux=True)(params)
+        if dp > 1:
+            # DDP: gradient mean over the data axis (scaled grads — the
+            # pmean of per-rank local-mean grads IS the global-batch grad)
+            grads = jax.lax.pmean(grads, "data")
+            loss = jax.lax.pmean(loss, "data")
+        grads, found_inf = scaler.unscale(grads, sstate)
+        gnorm = global_norm(grads)
+        params, opt_state = opt.step(grads, params, opt_state,
+                                     found_inf=found_inf)
+        new_sstate = scaler.update(sstate, found_inf)
+        return (params, new_s, opt_state, new_sstate, loss, gnorm,
+                new_sstate.loss_scale)
+
+    if dp > 1:
+        rep = P()
+        step = jax.jit(jax.shard_map(
+            step_body, mesh=mesh,
+            in_specs=(rep, rep, rep, rep, P("data"), P("data")),
+            out_specs=(rep, rep, rep, rep, rep, rep, rep),
+            check_vma=False))
+    else:
+        step = jax.jit(step_body)
+
+    losses, gnorms, scales = [], [], []
+    t0 = time.time()
+    for i in range(iters):
+        b = i % n_batches
+        x = xs[b * batch:(b + 1) * batch]
+        y = ys[b * batch:(b + 1) * batch]
+        params, state, opt_state, sstate, loss, gnorm, scale = step(
+            params, state, opt_state, sstate, x, y)
+        losses.append(float(loss))
+        gnorms.append(float(gnorm))
+        scales.append(float(scale))
+        if i % log_every == 0 or i == iters - 1:
+            print(f"[{name}] iter {i:4d} loss {losses[-1]:.4f} "
+                  f"gnorm {gnorms[-1]:.3f} scale {scales[-1]:.0f} "
+                  f"({(time.time() - t0) / (i + 1):.2f}s/it)", flush=True)
+    trace = {
+        "config": {"name": name, "opt_level": opt_level,
+                   "loss_scale": loss_scale, "data_parallel_size": dp,
+                   "syncbn": dp > 1, "iters": iters, "batch": batch,
+                   "image": image, "width": width, "depth": 50,
+                   "devices": [str(d) for d in jax.devices()[:dp]]},
+        "wall_seconds": round(time.time() - t0, 1),
+        "loss": losses, "grad_norm": gnorms, "loss_scale": scales,
+    }
+    os.makedirs(TRACE_DIR, exist_ok=True)
+    with open(os.path.join(TRACE_DIR, f"{name}.json"), "w") as f:
+        json.dump(trace, f)
+    parallel_state.destroy_model_parallel()
+    return trace
+
+
+def main():
+    from run_l1 import compare_traces
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=500)
+    ap.add_argument("--batch", type=int, default=16)
+    args = ap.parse_args()
+    traces = {}
+    for name, (ol, ls, dp) in DIST_CONFIGS.items():
+        traces[name] = train_one(name, ol, ls, dp, iters=args.iters,
+                                 batch=args.batch)
+    fails = compare_traces(traces["dist_o2_dp8_syncbn"],
+                           traces["dist_o0_fp32_single"])
+    status = "OK" if not fails else f"FAIL: {fails}"
+    print(f"[compare] dist_o2_dp8_syncbn vs dist_o0_fp32_single: {status}")
+    print("DISTRIBUTED L1", "PASSED" if not fails else "FAILED")
+    return 0 if not fails else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -55,3 +55,38 @@ class TestRecordedTraces:
         L = np.asarray(_load("o0_fp32")["loss"])
         assert np.isfinite(L).all()
         assert L[-25:].mean() < 0.5 * L[:25].mean()
+
+
+@pytest.mark.skipif("dist_o0_fp32_single" not in TRACES
+                    or "dist_o2_dp8_syncbn" not in TRACES,
+                    reason="no recorded distributed L1 traces (run "
+                           "run_l1_distributed.py)")
+class TestDistributedTraces:
+    """The distributed tier (reference
+    ``tests/L1/cross_product_distributed/run.sh``): the dp=8 SyncBN bf16-O2
+    trace must track and converge against its single-device O0 baseline."""
+
+    def test_recorded_at_depth(self):
+        for name in ("dist_o0_fp32_single", "dist_o2_dp8_syncbn"):
+            tr = _load(name)
+            assert tr["config"]["iters"] >= 500, (
+                f"{name} recorded at {tr['config']['iters']} iters (<500)")
+            assert len(tr["loss"]) == tr["config"]["iters"]
+        dist = _load("dist_o2_dp8_syncbn")["config"]
+        assert dist["data_parallel_size"] == 8
+        assert dist["syncbn"] is True
+
+    def test_dp8_tracks_single_device_baseline(self):
+        fails = compare_traces(_load("dist_o2_dp8_syncbn"),
+                               _load("dist_o0_fp32_single"))
+        assert not fails, fails
+
+    def test_equivalence_is_tight_early(self):
+        """dp=8 + SyncBN + grad-pmean vs single device is the SAME
+        computation up to bf16 rounding: the first iterations must agree
+        far tighter than the generic 20% envelope."""
+        import numpy as np
+
+        a = np.asarray(_load("dist_o2_dp8_syncbn")["loss"][:10])
+        b = np.asarray(_load("dist_o0_fp32_single")["loss"][:10])
+        assert (np.abs(a - b) / np.maximum(np.abs(b), 1e-3)).max() < 0.05

@@ -214,3 +214,154 @@ class TestSpecAwareGradSync:
             np.testing.assert_allclose(expert[r], (1.0 + r) / 8.0)
         np.testing.assert_allclose(np.asarray(out["shared"]), 4.5)
         parallel_state.destroy_model_parallel()
+
+
+def test_syncbn_unequal_per_rank_batches(data_mesh):
+    """Count-weighted merge with unequal REAL batch sizes per rank
+    (reference ``tests/distributed/synced_batchnorm/
+    two_gpu_test_different_batch_size.py``): under SPMD every rank's shapes
+    match, so short ranks pad and pass ``sample_mask``; statistics must
+    equal full-batch BN over only the real rows."""
+    mesh = data_mesh
+    n = mesh.shape["data"]
+    per_rank, feat = 4, 6
+    # rank r has (4 - r % 3) real samples: e.g. 4,3,2,4,3,2,... over 8 ranks
+    counts = np.array([per_rank - (r % 3) for r in range(n)])
+    x = jax.random.normal(jax.random.PRNGKey(0),
+                          (n * per_rank, feat)) * 3 + 1
+    mask = np.zeros((n * per_rank,), bool)
+    for r in range(n):
+        mask[r * per_rank: r * per_rank + counts[r]] = True
+    mask_j = jnp.asarray(mask)
+
+    bn = SyncBatchNorm(num_features=feat, axis_name="data", momentum=1.0)
+    variables = bn.init(jax.random.PRNGKey(1), x[:4])
+
+    @jax.shard_map(mesh=mesh, in_specs=(P(), P("data"), P("data")),
+                   out_specs=(P("data"), P()))
+    def run(vars_, xs, m):
+        y, updated = bn.apply(vars_, xs, sample_mask=m,
+                              mutable=["batch_stats"])
+        return y, updated["batch_stats"]
+
+    y, stats = run(variables, x, mask_j)
+    real = np.asarray(x)[mask]
+    mean = real.mean(axis=0)
+    var = real.var(axis=0)
+    # real rows normalized by the count-weighted global stats
+    expect = (real - mean) / np.sqrt(var + 1e-5)
+    np.testing.assert_allclose(np.asarray(y)[mask], expect, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(stats["mean"]), mean, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(stats["var"]),
+                               real.var(axis=0, ddof=1), atol=1e-4)
+
+
+def test_syncbn_unequal_batches_grads(data_mesh):
+    """Gradients through the count-weighted masked SyncBN match the
+    reference computation on only-the-real rows (the grad-parity half of
+    the reference's different-batch-size test)."""
+    mesh = data_mesh
+    n = mesh.shape["data"]
+    per_rank, feat = 2, 4
+    counts = np.array([per_rank if r % 2 == 0 else 1 for r in range(n)])
+    x = jax.random.normal(jax.random.PRNGKey(3),
+                          (n * per_rank, feat)) * 2 - 1
+    mask = np.zeros((n * per_rank,), bool)
+    for r in range(n):
+        mask[r * per_rank: r * per_rank + counts[r]] = True
+    mask_j = jnp.asarray(mask)
+
+    bn = SyncBatchNorm(num_features=feat, axis_name="data", momentum=1.0)
+    variables = bn.init(jax.random.PRNGKey(1), x[:2])
+    tgt = jax.random.normal(jax.random.PRNGKey(4), x.shape)
+
+    @jax.shard_map(mesh=mesh, in_specs=(P("data"), P("data"), P("data")),
+                   out_specs=P("data"), check_vma=False)
+    def grad_x(xs, m, t):
+        def loss(xs):
+            y = bn.apply(variables, xs, sample_mask=m,
+                         mutable=["batch_stats"])[0]
+            # loss over real rows only (masked rows are padding); the /n
+            # compensates psum's transpose summing every rank's unit
+            # cotangent (each rank differentiates the same replicated loss)
+            w = m.astype(jnp.float32)[:, None]
+            return jax.lax.psum(
+                jnp.sum(w * (y - t) ** 2), "data") / jax.lax.axis_size("data")
+        return jax.grad(loss)(xs)
+
+    g = np.asarray(grad_x(x, mask_j, tgt))
+
+    # reference: same loss with only real rows through unmasked global BN
+    real_idx = np.where(mask)[0]
+    xr = jnp.asarray(np.asarray(x)[real_idx])
+    tr = jnp.asarray(np.asarray(tgt)[real_idx])
+
+    def ref_loss(xr):
+        m_ = jnp.mean(xr, axis=0)
+        v_ = jnp.mean((xr - m_) ** 2, axis=0)
+        y = (xr - m_) / jnp.sqrt(v_ + 1e-5)
+        return jnp.sum((y - tr) ** 2)
+
+    g_ref = np.asarray(jax.grad(ref_loss)(xr))
+    np.testing.assert_allclose(g[real_idx], g_ref, atol=1e-4)
+    # padded rows contribute nothing and receive no gradient
+    np.testing.assert_allclose(g[~mask], 0.0, atol=1e-6)
+
+
+def test_bn_apply_sample_mask():
+    """Functional bn_apply counterpart (the vision-model path): masked NHWC
+    rows drop out of the count-weighted stats."""
+    from apex_tpu.utils.batch_norm import bn_apply, bn_init
+
+    x = np.asarray(jax.random.normal(jax.random.PRNGKey(0), (4, 3, 3, 5)),
+                   np.float32) * 2 + 3
+    mask = np.array([True, True, True, False])
+    p, s = bn_init(5)
+    y, new_s = bn_apply(jax.tree.map(jnp.asarray, p),
+                        jax.tree.map(jnp.asarray, s), jnp.asarray(x),
+                        train=True, momentum=1.0, eps=1e-5, axis_name=None,
+                        sample_mask=jnp.asarray(mask))
+    real = x[mask].reshape(-1, 5)
+    mean = real.mean(axis=0)
+    var = real.var(axis=0)
+    np.testing.assert_allclose(np.asarray(new_s["mean"]), mean, atol=1e-5)
+    expect = (x[mask] - mean) / np.sqrt(var + 1e-5)
+    np.testing.assert_allclose(np.asarray(y)[mask], expect, atol=1e-4)
+
+
+def test_syncbn_mask_robust_to_garbage_padding():
+    """Padded rows may hold ANYTHING (uninitialized buffers): NaN/Inf in a
+    masked-out row must not leak into statistics or outputs (where-masking,
+    not multiply — 0*NaN is NaN), and an all-padded batch must degrade to
+    finite stats rather than 0/0."""
+    from apex_tpu.utils.batch_norm import bn_apply, bn_init
+
+    x = np.ones((4, 2, 2, 3), np.float32)
+    x[2:] = np.nan
+    x[3, 0, 0, 0] = np.inf
+    mask = np.array([True, True, False, False])
+    p, s = bn_init(3)
+    p = jax.tree.map(jnp.asarray, p)
+    s = jax.tree.map(jnp.asarray, s)
+    y, new_s = bn_apply(p, s, jnp.asarray(x), train=True, momentum=1.0,
+                        eps=1e-5, axis_name=None,
+                        sample_mask=jnp.asarray(mask))
+    assert np.isfinite(np.asarray(new_s["mean"])).all()
+    assert np.isfinite(np.asarray(new_s["var"])).all()
+    assert np.isfinite(np.asarray(y)[mask]).all()
+
+    # flax module path
+    bn = SyncBatchNorm(num_features=3, momentum=1.0)
+    variables = bn.init(jax.random.PRNGKey(0), jnp.asarray(x))
+    y2, upd = bn.apply(variables, jnp.asarray(x),
+                       sample_mask=jnp.asarray(mask),
+                       mutable=["batch_stats"])
+    assert np.isfinite(np.asarray(upd["batch_stats"]["mean"])).all()
+    assert np.isfinite(np.asarray(y2)[mask]).all()
+
+    # all-padded: finite (degraded) stats, not NaN
+    none = jnp.zeros((4,), bool)
+    y3, new_s3 = bn_apply(p, s, jnp.asarray(x), train=True, momentum=1.0,
+                          eps=1e-5, axis_name=None, sample_mask=none)
+    assert np.isfinite(np.asarray(new_s3["mean"])).all()
+    assert np.isfinite(np.asarray(new_s3["var"])).all()
