@@ -45,15 +45,19 @@ _NEG_INF = -1e30
 # backward recompute, so padded rows contribute nothing to dk/dv.
 _LSE_PAD = 1e30
 
-# Tuned on TPU v5e (fwd+bwd, causal, head_dim 64): (512, 512) is the
-# robust optimum for seqs 1k-4k — smaller blocks lose to grid/DMA
-# overhead, larger k blocks lose VMEM locality in the backward. At long
-# sequence (>= _LONG_SEQ keys) the diagonal-walk reuse flips the trade:
-# (1024, 1024) measures 1.47x faster fwd+bwd at 32k (131ms vs 193ms,
-# PERF.md round 3); (1024, 2048) exceeds the 16MB scoped-vmem budget.
+# Tuned on TPU v5e (fwd+bwd, causal, head_dim 64): (1024, 1024) wins for
+# every key length >= 1024 — in-jit chained microbenches (round 4) measure
+# it 25-30% faster than (512, 512) at s=1k/2k/4k (grid-step overhead and
+# softmax VPU work amortize over bigger blocks) and 1.47x faster at 32k
+# (PERF.md round 3). Round 3's "(512,512) optimum for 1k-4k" was an
+# artifact of dispatch-overhead-polluted timing. Below 1k keys the
+# (512, 512) default stays: call sites clamp blocks to the (rounded)
+# sequence anyway, so the gate's effect is keeping the measured
+# power-of-two tiles rather than unmeasured clamped odd sizes.
+# (1024, 2048) exceeds the 16MB scoped-vmem budget.
 _DEFAULT_BLOCK_Q = 512
 _DEFAULT_BLOCK_K = 512
-_LONG_SEQ = 8192
+_LONG_SEQ = 1024
 _LONG_BLOCK = 1024
 
 
@@ -266,6 +270,21 @@ def _run_fwd(q, k, v, kv_lengths, scale, causal, sq, sk, bq, bk,
 # backward
 # ---------------------------------------------------------------------------
 
+def _recompute_p_ds(q, k, v, do, lse, delta, i, j, *, scale, bq, bk, sk,
+                    kvl, causal, window, q_off, k_off):
+    """The flash-backward block recompute every backward kernel shares:
+    rebuild the (bq, bk) probabilities from the stashed lse and form
+    ``ds = p * (dp - delta)``. Returns ``(p, ds)`` (both fp32)."""
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    s, _ = _mask_block(s, i, j, bq, bk, sk, kvl, causal, window,
+                       q_off, k_off)
+    p = jnp.exp(s - lse)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    return p, p * (dp - delta)
+
+
 def _dq_kernel(offs_ref, kvl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                delta_ref, dq_ref, dq_scr, *, scale, bq, bk, nk, sk, causal,
                window=None, win_grid=None):
@@ -279,21 +298,13 @@ def _dq_kernel(offs_ref, kvl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
     def _step():
-        q = q_ref[0, 0]
         k = k_ref[0, 0]
-        v = v_ref[0, 0]
-        do = do_ref[0, 0]
-        lse = lse_ref[0, 0].reshape(1, bq).T          # (bq, 1)
-        delta = delta_ref[0, 0].reshape(1, bq).T      # (bq, 1)
         kvl = kvl_ref[b] if kvl_ref is not None else None
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        s, _ = _mask_block(s, i, j, bq, bk, sk, kvl, causal, window,
-                           q_off, k_off)
-        p = jnp.exp(s - lse)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
+        _, ds = _recompute_p_ds(
+            q_ref[0, 0], k, v_ref[0, 0], do_ref[0, 0],
+            lse_ref[0, 0].reshape(1, bq).T, delta_ref[0, 0].reshape(1, bq).T,
+            i, j, scale=scale, bq=bq, bk=bk, sk=sk, kvl=kvl, causal=causal,
+            window=window, q_off=q_off, k_off=k_off)
         dq_scr[:] = dq_scr[:] + scale * jax.lax.dot(
             ds.astype(k.dtype), k, preferred_element_type=jnp.float32)
 
@@ -333,23 +344,16 @@ def _dkv_kernel(offs_ref, kvl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
     def _step():
         q = q_ref[0, 0]
-        k = k_ref[0, 0]
-        v = v_ref[0, 0]
         do = do_ref[0, 0]
-        lse = lse_ref[0, 0].reshape(1, bq).T
-        delta = delta_ref[0, 0].reshape(1, bq).T
         kvl = kvl_ref[b] if kvl_ref is not None else None
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        s, _ = _mask_block(s, i, j, bq, bk, sk, kvl, causal, window,
-                           q_off, k_off)
-        p = jnp.exp(s - lse)
+        p, ds = _recompute_p_ds(
+            q, k_ref[0, 0], v_ref[0, 0], do,
+            lse_ref[0, 0].reshape(1, bq).T, delta_ref[0, 0].reshape(1, bq).T,
+            i, j, scale=scale, bq=bq, bk=bk, sk=sk, kvl=kvl, causal=causal,
+            window=window, q_off=q_off, k_off=k_off)
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
         dk_scr[:] = dk_scr[:] + scale * jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -369,11 +373,117 @@ def _dkv_kernel(offs_ref, kvl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
 
 
+def _dqkv_single_kernel(offs_ref, kvl_ref, q_ref, k_ref, v_ref, do_ref,
+                        lse_ref, delta_ref, dq_ref, dk_ref, dv_ref,
+                        dk_scr, dv_scr, *, scale, bq, bk, sk, causal,
+                        window):
+    """Fused one-pass backward for the single-block case (sq <= bq and
+    sk <= bk): s/p are computed ONCE and all three cotangents come out of
+    the same VMEM residency — at short seq the separate dq/dkv kernels
+    each redo the s=qk^T recompute and re-DMA q/k/v/do, and that (not
+    FLOPs) dominates; measured 1.4x faster fwd+bwd at the GPT bench shape
+    (b8 h16 s1024 d64). Grid (batch, kv_heads, group): the trailing dim
+    walks the query heads sharing this K/V head (GQA — grouping lives
+    entirely in the grid/index maps), accumulating dk/dv in scratch and
+    writing dq per head."""
+    b, t = pl.program_id(0), pl.program_id(2)
+    q_off, k_off = offs_ref[0], offs_ref[1]
+
+    @pl.when(t == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    q = q_ref[0, 0]
+    k = k_ref[0, 0]
+    do = do_ref[0, 0]
+    kvl = kvl_ref[b] if kvl_ref is not None else None
+    p, ds = _recompute_p_ds(
+        q, k, v_ref[0, 0], do,
+        lse_ref[0, 0].reshape(1, bq).T, delta_ref[0, 0].reshape(1, bq).T,
+        0, 0, scale=scale, bq=bq, bk=bk, sk=sk, kvl=kvl, causal=causal,
+        window=window, q_off=q_off, k_off=k_off)
+    dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dk_scr[:] = dk_scr[:] + scale * jax.lax.dot_general(
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dq_ref[0, 0] = (scale * jax.lax.dot(
+        ds.astype(k.dtype), k,
+        preferred_element_type=jnp.float32)).astype(dq_ref.dtype)
+
+    @pl.when(t == pl.num_programs(2) - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _wrap_kernel(fn, kv_lengths, **kw):
+    """Bind kernel keywords; with no kv_lengths operand, slot None into the
+    kernel's ``kvl_ref`` position (shared by all backward dispatches)."""
+    if kv_lengths is not None:
+        return functools.partial(fn, **kw)
+    return functools.partial(
+        lambda offs, *r, **k2: fn(offs, None, *r, **k2), **kw)
+
+
+def _run_bwd_single(q, k, v, do, lse, delta, kv_lengths, scale, causal,
+                    sq, sk, bq, bk, group, window, q_off, k_off):
+    """Single-block fused dq/dk/dv dispatch — see _dqkv_single_kernel."""
+    batch, _, sqp, dp = q.shape
+    kv_heads = k.shape[1]
+    kvl_spec = []
+    args = [_offsets(q_off, k_off, sq, sk)]
+    if kv_lengths is not None:
+        kvl_spec = [pl.BlockSpec(memory_space=pltpu.SMEM)]
+        args.append(kv_lengths.astype(jnp.int32))
+    dq, dk, dv = pl.pallas_call(
+        _wrap_kernel(_dqkv_single_kernel, kv_lengths, scale=scale, bq=bq,
+                     bk=bk, sk=sk, causal=causal, window=window),
+        grid=(batch, kv_heads, group),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)] + kvl_spec + [
+            pl.BlockSpec((1, 1, bq, dp),
+                         lambda b, h, t: (b, h * group + t, 0, 0)),  # q
+            pl.BlockSpec((1, 1, bk, dp), lambda b, h, t: (b, h, 0, 0)),  # k
+            pl.BlockSpec((1, 1, bk, dp), lambda b, h, t: (b, h, 0, 0)),  # v
+            pl.BlockSpec((1, 1, bq, dp),
+                         lambda b, h, t: (b, h * group + t, 0, 0)),  # do
+            pl.BlockSpec((1, 1, 1, bq),
+                         lambda b, h, t: (b, h * group + t, 0, 0)),  # lse
+            pl.BlockSpec((1, 1, 1, bq),
+                         lambda b, h, t: (b, h * group + t, 0, 0)),  # delta
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, dp),
+                         lambda b, h, t: (b, h * group + t, 0, 0)),
+            pl.BlockSpec((1, 1, bk, dp), lambda b, h, t: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, bk, dp), lambda b, h, t: (b, h, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((bk, dp), jnp.float32),
+                        pltpu.VMEM((bk, dp), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=pallas_interpret(),
+    )(*args, q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
 def _run_bwd(q, k, v, do, lse, delta, kv_lengths, scale, causal,
              sq, sk, bq, bk, group=1, window=None, q_off=None, k_off=None):
     batch, heads, sqp, dp = q.shape
     kv_heads, skp = k.shape[1], k.shape[2]
     nq, nk = sqp // bq, skp // bk
+    if nq == 1 and nk == 1:
+        # whole problem fits one (bq, bk) tile: fused one-pass backward
+        return _run_bwd_single(q, k, v, do, lse, delta, kv_lengths, scale,
+                               causal, sq, sk, bq, bk, group, window,
+                               q_off, k_off)
     # banded window grids (see _run_fwd)
     win_grid = None
     nk_grid, nq_grid = nk, nq
@@ -403,12 +513,6 @@ def _run_bwd(q, k, v, do, lse, delta, kv_lengths, scale, causal,
         kvl_spec = [pl.BlockSpec(memory_space=pltpu.SMEM)]
         args.append(kv_lengths.astype(jnp.int32))
 
-    def wrap(fn, **kw):
-        if kv_lengths is not None:
-            return functools.partial(fn, **kw)
-        return functools.partial(
-            lambda offs, *r, **k2: fn(offs, None, *r, **k2), **kw)
-
     row_specs = [
         pl.BlockSpec((1, 1, bq, dp), lambda b, h, i, j: (b, h, i, 0)),   # q
         pl.BlockSpec((1, 1, bk, dp),
@@ -420,7 +524,7 @@ def _run_bwd(q, k, v, do, lse, delta, kv_lengths, scale, causal,
         pl.BlockSpec((1, 1, 1, bq), lambda b, h, i, j: (b, h, 0, i)),    # delta
     ]
     dq = pl.pallas_call(
-        wrap(_dq_kernel, scale=scale, bq=bq, bk=bk, nk=nk, sk=sk,
+        _wrap_kernel(_dq_kernel, kv_lengths, scale=scale, bq=bq, bk=bk, nk=nk, sk=sk,
              causal=causal, window=window, win_grid=win_grid),
         grid=(batch, heads, nq, nk_grid),
         in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)] + kvl_spec
@@ -449,7 +553,7 @@ def _run_bwd(q, k, v, do, lse, delta, kv_lengths, scale, causal,
                      lambda b, h, j, t: (b, _qh(h, t), 0, _qi(j, t))),   # delta
     ]
     dk, dv = pl.pallas_call(
-        wrap(_dkv_kernel, scale=scale, bq=bq, bk=bk, nq=nq, sk=sk,
+        _wrap_kernel(_dkv_kernel, kv_lengths, scale=scale, bq=bq, bk=bk, nq=nq, sk=sk,
              causal=causal, group=group, window=window,
              win_grid=win_grid, nq_grid=nq_grid),
         grid=(batch, kv_heads, nk, group * nq_grid),
