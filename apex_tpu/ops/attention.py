@@ -230,11 +230,9 @@ def _run_fwd(q, k, v, kv_lengths, scale, causal, sq, sk, bq, bk,
     if kv_lengths is not None:
         kvl_spec = [pl.BlockSpec(memory_space=pltpu.SMEM)]
         args.append(kv_lengths.astype(jnp.int32))
-    kernel = functools.partial(
-        _fwd_kernel if kv_lengths is not None else
-        (lambda offs, *r, **kw: _fwd_kernel(offs, None, *r, **kw)),
-        scale=scale, bq=bq, bk=bk, nk=nk, sk=sk, causal=causal,
-        window=window, win_grid=win_grid)
+    kernel = _wrap_kernel(_fwd_kernel, kv_lengths, scale=scale, bq=bq,
+                          bk=bk, nk=nk, sk=sk, causal=causal,
+                          window=window, win_grid=win_grid)
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -394,24 +392,40 @@ def _dqkv_single_kernel(offs_ref, kvl_ref, q_ref, k_ref, v_ref, do_ref,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    q = q_ref[0, 0]
-    k = k_ref[0, 0]
-    do = do_ref[0, 0]
-    kvl = kvl_ref[b] if kvl_ref is not None else None
-    p, ds = _recompute_p_ds(
-        q, k, v_ref[0, 0], do,
-        lse_ref[0, 0].reshape(1, bq).T, delta_ref[0, 0].reshape(1, bq).T,
-        0, 0, scale=scale, bq=bq, bk=bk, sk=sk, kvl=kvl, causal=causal,
-        window=window, q_off=q_off, k_off=k_off)
-    dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
-        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    dk_scr[:] = dk_scr[:] + scale * jax.lax.dot_general(
-        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    dq_ref[0, 0] = (scale * jax.lax.dot(
-        ds.astype(k.dtype), k,
-        preferred_element_type=jnp.float32)).astype(dq_ref.dtype)
+    def _step():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        do = do_ref[0, 0]
+        kvl = kvl_ref[b] if kvl_ref is not None else None
+        p, ds = _recompute_p_ds(
+            q, k, v_ref[0, 0], do,
+            lse_ref[0, 0].reshape(1, bq).T,
+            delta_ref[0, 0].reshape(1, bq).T,
+            0, 0, scale=scale, bq=bq, bk=bk, sk=sk, kvl=kvl, causal=causal,
+            window=window, q_off=q_off, k_off=k_off)
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dk_scr[:] = dk_scr[:] + scale * jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dq_ref[0, 0] = (scale * jax.lax.dot(
+            ds.astype(k.dtype), k,
+            preferred_element_type=jnp.float32)).astype(dq_ref.dtype)
+
+    if causal or window is not None:
+        # the fully-masked case (causal future / window far past) must stay
+        # near-free: ring-attention backward hops route here whenever the
+        # chunk fits one block, and cp/2 of them are entirely future
+        keep = _causal_block_skip(0, 0, bq, bk, causal, window,
+                                  q_off, k_off)
+        pl.when(keep)(_step)
+
+        @pl.when(jnp.logical_not(keep))
+        def _zero_dq():
+            dq_ref[0, 0] = jnp.zeros_like(dq_ref[0, 0])
+    else:
+        _step()
 
     @pl.when(t == pl.num_programs(2) - 1)
     def _finish():
