@@ -111,6 +111,29 @@ def generate(model, params, prompt: jax.Array, max_new_tokens: int, *,
     """
     if temperature > 0.0 and rng is None:
         raise ValueError("sampling (temperature > 0) needs rng")
+    # pre-cast fp32 params to the compute dtype ONCE: inside the decode
+    # scan every layer's f32->bf16 weight cast is loop-invariant, but XLA
+    # re-materializes it per step rather than keep both copies live — for
+    # GPT-2 124M that is ~0.3 GB/step of pure cast/copy traffic (profiled:
+    # the 154 MB tied embedding alone re-cast every token). Decode is
+    # inference; bf16 weights are the standard serving precision.
+    c = model.config
+    if c.compute_dtype != jnp.float32:
+        from jax.tree_util import tree_map_with_path
+
+        def cast(path, x):
+            # MoE routers are deliberately read in fp32 (moe.py router
+            # matmul) — rounding them here would let decode pick different
+            # experts than the full forward near top-k boundaries
+            if any("router" in str(getattr(p, "key", p)) for p in path):
+                return x
+            return (x.astype(c.compute_dtype)
+                    if x.dtype == jnp.float32 else x)
+
+        params = tree_map_with_path(cast, params)
+        # the barrier pins the cast params as materialized buffers; without
+        # it XLA sinks the (loop-invariant) casts back into the scan body
+        params = jax.lax.optimization_barrier(params)
     b, prompt_len = prompt.shape
     total = prompt_len + max_new_tokens
     if (model.config.position_embedding_type == "learned"
