@@ -194,6 +194,25 @@ def native_fp8_dot_supported() -> bool:
         return False
 
 
+@functools.lru_cache(maxsize=None)
+def _log_fp8_path_once(native: bool) -> None:
+    """One-time notice of which fp8 dot path auto-probe selected: the
+    native and qdq paths round at different points (documented in
+    fp8_dense), so the same model yields tolerance-level different
+    losses across backends — the user should know which one ran
+    (ADVICE r3). Pass ``native=`` explicitly to pin a path and silence
+    this."""
+    from apex_tpu.utils.logging import get_logger
+
+    # warning level: the repo logger's default threshold — the notice must
+    # reach users with unconfigured logging (it explains tolerance-level
+    # loss differences across backends)
+    get_logger().warning(
+        "fp8_dense auto-probe selected the %s path on this backend "
+        "(native and qdq round at different points; pass native= to pin)",
+        "native-fp8 dot" if native else "qdq simulation")
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
 def _native_fp8_matmul(x, w, xs, ws, recipe):
     """``y = (q(x) @ q(w)) / (xs*ws)`` with the dot running ON the fp8
@@ -270,6 +289,7 @@ def fp8_dense(x: jax.Array, w: jax.Array, state: Dict[str, Any],
     ws = state[w_name]["scale"]
     if native is None:
         native = native_fp8_dot_supported()
+        _log_fp8_path_once(native)
     if native:
         y = _native_fp8_matmul(x, w, xs, ws, recipe)
     else:

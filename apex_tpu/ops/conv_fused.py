@@ -596,6 +596,14 @@ def conv3x3_bn_act(x, w, a: Optional[jax.Array] = None,
     # holds one whole image's working set on the VMEM stack (~12 MB at
     # 56x56x64 — excludes the widest stage until the kernel grows manual
     # halo DMAs); outside those bounds the XLA composition is used
+    # Stats-dtype note (ADVICE r3): the Pallas kernels (here and 1x1) and
+    # _ref_impl reduce statistics from the fp32 GEMM accumulator, while
+    # _c3_ref_impl reduces from the bf16-MATERIALIZED output (its docstring
+    # explains the autodiff dtype constraint). A fused ResNet whose stages
+    # straddle these gates therefore mixes the two sources; the difference
+    # is one bf16 rounding of y before the reduction — below BN's eps in
+    # every parity test — but it IS a per-path difference, gated exactly
+    # here.
     k = w.shape[-2]
     fits = (54 * k * n <= (8 << 20)
             and x.shape[1] * x.shape[2] <= 1024)   # <=32x32 measured bound

@@ -18,8 +18,11 @@ Configs (BASELINE.md / BASELINE.json):
   6. ViT-L/16 + FusedAdam
   7. long-context: GPT at 32k tokens full-causal + 32k/64k sliding-window
      — the reference caps at 16k
-  8. generation: prefill + jitted KV-cache decode tokens/sec (bs 1 / 8)
-  9. headline: GPT-2 124M fused-vs-unfused (printed LAST; the driver
+  8. generation: prefill + decode-ONLY tokens/sec (bs 1 / 8 / 32, each
+     with its share of the weight+KV read-bandwidth bound)
+  9. fp8: native-fp8 dense fwd+bwd vs the same GEMM in bf16 (platform
+     verdict row — v5e runs fp8 operands without fp8 MXU units)
+ 10. headline: GPT-2 124M fused-vs-unfused (printed LAST; the driver
      records the tail line)
 
 MFU is model-FLOPs utilization against the chip's bf16 peak
@@ -112,6 +115,7 @@ def _config_matrix():
     failing config prints an error line instead of killing the run."""
     import benchmarks.bert_lamb as bert
     import benchmarks.dcgan_bf16 as dcgan
+    import benchmarks.fp8_bench as fp8_bench
     import benchmarks.generation_bench as generation
     import benchmarks.gpt_large as gpt_large
     import benchmarks.gpt_tp as gpt_tp
@@ -131,6 +135,7 @@ def _config_matrix():
         ("long_context_64k_window",
          lambda: long_context.main(seq=65536, window=1024)),
         ("generation", lambda: generation.main()),
+        ("fp8_dense", lambda: fp8_bench.main()),
     ]
     for name, fn in configs:
         try:
