@@ -19,14 +19,19 @@ __all__ = ["init_kv_caches", "decode_step", "generate"]
 
 
 def init_kv_caches(model, batch_size: int, max_len: int,
-                   dtype=None) -> Tuple[jax.Array, jax.Array]:
-    """Preallocate stacked caches ``(k, v)``, each
-    ``[num_layers, batch, local_kv_heads, max_len, head_dim]`` — K/V heads
-    (``config.kv_heads``), which under GQA/MQA is ``num_query_groups``, not
-    the query head count.
+                   dtype=None, *, stacked: bool = True):
+    """Preallocate K/V caches. ``stacked=True`` (default): ``(k, v)``, each
+    ``[num_layers, batch, local_kv_heads, max_len, head_dim]`` — the scan
+    form. ``stacked=False``: a LIST of per-layer ``(k, v)`` pairs, each
+    ``[batch, local_kv_heads, max_len, head_dim]`` — the fast decode form
+    (per-layer buffers update in place; scanning over a stacked cache
+    pays full-cache slice/restack copies every step, measured 2.4x slower
+    at bs8 — PERF.md round 4). ``generate()`` uses the list form.
 
-    Inside ``shard_map`` with a bound tensor axis the head count is the
-    TP-local slice (``kv_heads // tp``), matching the per-rank QKV shapes.
+    Heads are K/V heads (``config.kv_heads``), which under GQA/MQA is
+    ``num_query_groups``, not the query head count. Inside ``shard_map``
+    with a bound tensor axis the head count is the TP-local slice
+    (``kv_heads // tp``), matching the per-rank QKV shapes.
     """
     from apex_tpu.transformer.tensor_parallel.mappings import axis_bound
 
@@ -41,7 +46,11 @@ def init_kv_caches(model, batch_size: int, max_len: int,
                 f"tensor-parallel size ({tp}); with GQA/MQA keep "
                 f"num_query_groups a multiple of tp")
         heads //= tp
-    shape = (c.num_layers, batch_size, heads, max_len, c.head_dim)
+    per_layer = (batch_size, heads, max_len, c.head_dim)
+    if not stacked:
+        return [(jnp.zeros(per_layer, dtype), jnp.zeros(per_layer, dtype))
+                for _ in range(c.num_layers)]
+    shape = (c.num_layers,) + per_layer
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
 
@@ -78,12 +87,14 @@ def _cached_forward(model, params, caches, tokens: jax.Array, index):
     return logits.astype(jnp.float32), new_caches
 
 
-def decode_step(model, params, caches, tokens: jax.Array, index) -> Tuple[
-        jax.Array, Tuple[jax.Array, jax.Array]]:
+def decode_step(model, params, caches, tokens: jax.Array, index):
     """One incremental step: ``tokens`` [batch] at position ``index`` ->
-    (fp32 full-vocab logits [batch, V], updated caches). MoE models route
-    drop-free here (single-token steps); see :func:`generate` for the
-    prefill capacity caveat."""
+    (fp32 full-vocab logits [batch, V], updated caches). ``caches`` is
+    either form :func:`init_kv_caches` produces — the stacked ``(k, v)``
+    pair or the per-layer list (the form ``generate()`` decodes with) —
+    and the return matches the input form. MoE models route drop-free
+    here (single-token steps); see :func:`generate` for the prefill
+    capacity caveat."""
     logits, new_caches = _cached_forward(model, params, caches,
                                          tokens[:, None], index)
     return logits[0], new_caches
@@ -146,6 +157,11 @@ def generate(model, params, prompt: jax.Array, max_new_tokens: int, *,
     S = max_len or total
     if S < total:
         raise ValueError(f"max_len {S} < prompt+new tokens {total}")
+    # prefill runs on the STACKED form (its scan traces the layer body
+    # once — the stacked slice/restack tax is paid a single time and the
+    # HLO stays O(1) in depth), then unstacks ONCE into the per-layer
+    # list form for the decode scan, where per-step stacked slicing is
+    # the 2x bottleneck (PERF.md round 4)
     caches = init_kv_caches(model, b, S)
     rng = jax.random.PRNGKey(0) if rng is None else rng
 
@@ -164,6 +180,8 @@ def generate(model, params, prompt: jax.Array, max_new_tokens: int, *,
     # batched prefill: one forward writes all prompt K/V; its last-position
     # logits produce the first generated token
     prefill_logits, caches = _cached_forward(model, params, caches, prompt, 0)
+    ck, cv = caches
+    caches = [(ck[i], cv[i]) for i in range(model.config.num_layers)]
     first = pick_next(prefill_logits[-1], jax.random.fold_in(rng, 0))
     out = out.at[:, prompt_len].set(first)
     done0 = ((first == eos_token) if eos_token is not None

@@ -820,9 +820,53 @@ class ParallelTransformer:
               final_norm=True):
         """Returns ``hidden`` — or ``(hidden, moe_aux_loss)`` (aux summed
         over layers) when the config enables MoE, or ``(hidden, new_caches)``
-        when decoding with ``kv_caches`` (``(k, v)`` stacked ``[L, ...]``)."""
+        when decoding with ``kv_caches`` — either ``(k, v)`` stacked
+        ``[L, ...]`` (scan form) or a LIST of per-layer ``(k, v)`` pairs
+        (unrolled form; ``init_kv_caches(stacked=False)``). The list form
+        is the fast decode path: scanning over a stacked cache pays
+        full-cache slice/restack copies every step (measured 2.4x slower
+        at bs8 — PERF.md round 4), while per-layer buffers update in
+        place."""
         c = self.config
         moe = bool(c.num_moe_experts)
+
+        # a LIST means per-layer (k, v) pairs (the stacked scan form is a
+        # 2-TUPLE of [L, ...] arrays — do not widen this check to tuple)
+        if kv_caches is not None and isinstance(kv_caches, list):
+            if (len(kv_caches) != c.num_layers
+                    or len(kv_caches[0]) != 2
+                    or getattr(kv_caches[0][0], "ndim", 0) != 4):
+                # e.g. a stacked (k, v) pair that became a [k, v] list in a
+                # serialization round-trip would otherwise run SILENTLY
+                # wrong on 2-layer models (each [2, ...] array unpacking
+                # into two per-layer slices of valid shape)
+                raise ValueError(
+                    f"list-form kv_caches must hold num_layers "
+                    f"({c.num_layers}) per-layer (k, v) pairs of "
+                    f"[batch, heads, S, head_dim] arrays; got a "
+                    f"{len(kv_caches)}-element list — a stacked cache is "
+                    f"a (k, v) TUPLE of [L, ...] arrays")
+            # unrolled per-layer cache loop (no remat: decode is inference)
+            h = hidden
+            new_caches = []
+            for idx, layer_cache in enumerate(kv_caches):
+                layer_params = jax.tree.map(lambda x: x[idx],
+                                            params["layers"])
+                layer_rng = (None if rng is None
+                             else jax.random.fold_in(rng, idx))
+                h, new_cache = self.layer.apply(
+                    layer_params, h, encoder_output=encoder_output,
+                    enc_dec_attn_mask=enc_dec_attn_mask,
+                    enc_kv_lengths=enc_kv_lengths,
+                    attention_mask=attention_mask,
+                    kv_lengths=kv_lengths, kv_cache=layer_cache,
+                    cache_index=cache_index, rng=layer_rng,
+                    deterministic=deterministic)
+                new_caches.append(new_cache)
+            if final_norm:
+                h = _ln(params["final_layernorm"], h, c.layernorm_epsilon,
+                        c.sequence_parallel, c.axis_name, c.normalization)
+            return h, new_caches
 
         def one_layer(carry, xs):
             h, aux_sum, idx = carry

@@ -173,3 +173,38 @@ class TestGuards:
             check_vma=False)(params, prompt)
         parallel_state.destroy_model_parallel()
         np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+class TestCacheForms:
+    def test_stacked_and_list_caches_agree(self):
+        """The scan-form (stacked [L,...]) and the fast decode form
+        (per-layer list, PERF.md round 4) must produce identical logits
+        through prefill AND stepwise decode."""
+        from apex_tpu.models.generation import _cached_forward
+
+        model = _model()
+        params = model.init(jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 10), 0, 64)
+        stacked = init_kv_caches(model, 2, 16)
+        listed = init_kv_caches(model, 2, 16, stacked=False)
+        assert isinstance(listed, list) and len(listed) == 2
+        # prefill over 6 tokens, then 4 incremental steps, on both forms
+        l_s, stacked = _cached_forward(model, params, stacked,
+                                       tokens[:, :6], 0)
+        l_l, listed = _cached_forward(model, params, listed,
+                                      tokens[:, :6], 0)
+        np.testing.assert_allclose(np.asarray(l_s), np.asarray(l_l),
+                                   rtol=1e-5, atol=1e-5)
+        for i in range(6, 10):
+            l_s, stacked = decode_step(model, params, stacked,
+                                       tokens[:, i], i)
+            l_l, listed = decode_step(model, params, listed,
+                                      tokens[:, i], i)
+            np.testing.assert_allclose(np.asarray(l_s), np.asarray(l_l),
+                                       rtol=1e-5, atol=1e-5)
+        # cache contents agree leaf-for-leaf
+        for l, (k_l, v_l) in enumerate(listed):
+            np.testing.assert_allclose(np.asarray(stacked[0][l]),
+                                       np.asarray(k_l), atol=1e-6)
+            np.testing.assert_allclose(np.asarray(stacked[1][l]),
+                                       np.asarray(v_l), atol=1e-6)
