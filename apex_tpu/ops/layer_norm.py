@@ -25,7 +25,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from apex_tpu.ops._support import cdiv, min_sublane, pallas_interpret, round_up, use_pallas
+from apex_tpu.ops._support import block_rows, cdiv, min_sublane, pallas_interpret, round_up, use_pallas
 
 _VMEM_BUDGET = 4 * 1024 * 1024  # per-operand block budget, bytes
 
@@ -39,9 +39,9 @@ def _norm_shapes(x, normalized_shape):
 
 
 def _block_rows(h_pad: int, dtype) -> int:
-    sub = min_sublane(dtype)
-    bm = max(sub, min(256, _VMEM_BUDGET // (h_pad * 4)))
-    return round_up(bm, sub)
+    # 512-row cap measured +5% end-to-end on BERT (round 4); constraints
+    # documented in the shared helper
+    return block_rows(h_pad, dtype, vmem_budget=_VMEM_BUDGET)
 
 
 # ---------------------------------------------------------------------------

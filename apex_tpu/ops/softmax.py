@@ -24,15 +24,15 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from apex_tpu.ops._support import cdiv, pallas_interpret, round_up, use_pallas
+from apex_tpu.ops._support import block_rows, cdiv, pallas_interpret, round_up, use_pallas
 
 _MASK_FILL = -10000.0
 _VMEM_BUDGET = 4 * 1024 * 1024
 
 
 def _block_rows(kp: int) -> int:
-    bm = max(8, min(512, _VMEM_BUDGET // (kp * 4)))
-    return round_up(min(bm, 512), 8)
+    # fp32 rows (8-sublane); policy shared with the LN kernels
+    return block_rows(kp, jnp.float32, vmem_budget=_VMEM_BUDGET)
 
 
 # ---------------------------------------------------------------------------
