@@ -57,13 +57,15 @@ def min_sublane(dtype) -> int:
 
 
 def block_rows(h_pad: int, dtype, *, vmem_budget: int = 4 * 1024 * 1024,
-               cap: int = 512) -> int:
+               cap: int = 256) -> int:
     """Row-block size for row-wise kernels (layer norm, softmax): as many
-    rows as a ``vmem_budget``-byte fp32 block allows, capped at ``cap``
-    (512 measured optimal on v5e round 4 — 256 left LN at ~3x its
-    bandwidth roofline on BERT shapes, 1024 exceeds Mosaic's 16 MB
-    scoped-vmem stack in the LN backward: 18.9 MB of live fp32
-    intermediates at (1024, 768)), rounded to the dtype's sublane."""
+    rows as a ``vmem_budget``-byte fp32 block allows, capped at ``cap``,
+    rounded to the dtype's sublane. Cap tuning (v5e, round 4): an
+    interleaved same-process A/B on the BERT step measured 256 vs 512 at
+    77.8 vs 78.4 ms — equal within noise (an apparent +5% for 512 across
+    separate processes was tunnel variance); 1024 exceeds Mosaic's 16 MB
+    scoped-vmem stack in the LN backward (18.9 MB of live fp32
+    intermediates at (1024, 768)). 256 stays."""
     sub = min_sublane(dtype)
     bm = max(sub, min(cap, vmem_budget // (h_pad * 4)))
     return round_up(bm, sub)

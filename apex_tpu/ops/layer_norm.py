@@ -39,8 +39,7 @@ def _norm_shapes(x, normalized_shape):
 
 
 def _block_rows(h_pad: int, dtype) -> int:
-    # 512-row cap measured +5% end-to-end on BERT (round 4); constraints
-    # documented in the shared helper
+    # cap tuning history + constraints documented in the shared helper
     return block_rows(h_pad, dtype, vmem_budget=_VMEM_BUDGET)
 
 

@@ -31,7 +31,10 @@ _VMEM_BUDGET = 4 * 1024 * 1024
 
 
 def _block_rows(kp: int) -> int:
-    # fp32 rows (8-sublane); policy shared with the LN kernels
+    # fp32 rows (8-sublane); policy + cap tuning shared with the LN
+    # kernels (ops/_support.block_rows); softmax's old local copy capped
+    # at 512 — the A/B showed caps 256-512 equivalent, so unifying on the
+    # shared default loses nothing
     return block_rows(kp, jnp.float32, vmem_budget=_VMEM_BUDGET)
 
 
