@@ -4,8 +4,10 @@ The generation capability exceeds the reference (which ships no inference
 utilities); the perf evidence matches (VERDICT r3 item 3). Measures, on
 GPT-2 124M:
 
-  * prefill tokens/sec — one cached forward over a 1024-token prompt
-    (batch 8), the compute-bound phase;
+  * prefill tokens/sec — an in-jit chain of data-dependent cached
+    forwards over 1024-token prompts (batch 8; chaining amortizes the
+    5-20 ms per-call tunnel dispatch that made per-call timing wander
+    25%), the compute-bound phase;
   * decode-only tokens/sec at batch 1 / 8 / 32 — differenced
     generate() timings over identical KV-cache allocations, so prefill,
     dispatch, and fixed scan costs cancel exactly; each row carries its
@@ -67,22 +69,37 @@ def _time(fn, *args, steps=5):
     return best
 
 
-def bench_prefill(model, params, batch=8, prompt_len=1024):
+def bench_prefill(model, params, batch=8, prompt_len=1024, chain=10):
+    """``chain`` prefills inside ONE jit, each data-dependent on the last
+    (its argmax token overwrites the next prompt's first slot): a single
+    ~40 ms prefill pays 5-20 ms of tunnel dispatch per call, which is why
+    per-call timing wandered 150-229k tok/s across round-4 runs; the
+    in-jit chain amortizes dispatch to noise."""
     prompt = jax.random.randint(jax.random.PRNGKey(1), (batch, prompt_len),
                                 0, 50304)
     caches = init_kv_caches(model, batch, prompt_len + 1)
 
     @jax.jit
-    def prefill(params, caches, prompt):
-        logits, caches = _cached_forward(model, params, caches, prompt, 0)
-        return logits[-1], caches
+    def prefill_chain(params, caches, prompt):
+        # caches ride the carry so the KV writes stay live (discarding
+        # them would let XLA DCE ~300 MB of per-prefill cache stores)
+        def body(carry, _):
+            pr, caches = carry
+            logits, caches = _cached_forward(model, params, caches, pr, 0)
+            tok = jnp.argmax(logits[-1], axis=-1).astype(pr.dtype)
+            return (pr.at[:, 0].set(tok % 50304), caches), None
+        (pr, caches), _ = jax.lax.scan(body, (prompt, caches), None,
+                                       length=chain)
+        return pr
 
-    dt = _time(prefill, params, caches, prompt, steps=10)
+    dt = _time(prefill_chain, params, caches, prompt, steps=1) / chain
     tps = batch * prompt_len / dt
     print(json.dumps({
         "metric": f"gpt2_124m_prefill_bs{batch}_tokens_per_sec_per_chip",
         "value": round(tps, 1), "unit": "tokens/sec", "vs_baseline": 1.0,
-        "config": {"prompt_len": prompt_len}}))
+        "config": {"prompt_len": prompt_len,
+                   "method": f"in-jit chain of {chain} data-dependent "
+                             f"prefills (dispatch amortized)"}}))
     return tps
 
 

@@ -1,0 +1,81 @@
+"""User-style demo: T5-style encoder-decoder trained through the
+two-section pipeline (``ModelType.encoder_and_decoder``).
+
+A 4-stage pipeline split at rank 2 (2 encoder + 2 decoder stages) times
+data parallelism on the 8-device virtual CPU mesh, driven by the 1F1B
+schedule with the ``(enc_stream, dec_stream)`` lock-step carry. The task
+is sequence reversal — the decoder must cross-attend the encoder output
+to solve it, so a falling loss demonstrates the full enc-dec dataflow
+through the pipeline.
+
+Run: ``python examples/encdec_pipeline.py``
+"""
+import os
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+
+import jax
+
+# this demo always uses the 8-device VIRTUAL CPU mesh — it needs 8
+# devices for the pp=4 x dp=2 layout; on a real multi-chip TPU slice,
+# drop this line and the XLA_FLAGS override above
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.models import PipelinedEncoderDecoder, TransformerConfig
+from apex_tpu.optimizers import FusedAdam
+from apex_tpu.training import make_train_step
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.pipeline_parallel.utils import (
+    split_batch_into_microbatches,
+)
+
+PP, SPLIT, M = 4, 2, 2
+VOCAB, SEQ = 64, 16
+
+mesh = parallel_state.initialize_model_parallel(
+    pipeline_model_parallel_size=PP,
+    pipeline_model_parallel_split_rank=SPLIT)
+dp = mesh.shape["data"]
+print(f"mesh: pp={PP} (split at {SPLIT}: {SPLIT} enc + {PP - SPLIT} dec "
+      f"stages) x dp={dp}")
+
+cfg = TransformerConfig(
+    num_layers=2, hidden_size=64, num_attention_heads=4, vocab_size=VOCAB,
+    max_position_embeddings=SEQ * 2, hidden_dropout=0.0,
+    attention_dropout=0.0)
+model = PipelinedEncoderDecoder(cfg, pipeline_size=PP, num_microbatches=M,
+                                num_encoder_layers=2)
+params = model.init(jax.random.PRNGKey(0))
+
+# sequence reversal: decoder input is the shifted reversed sequence
+bs = 4 * dp * M
+enc = jax.random.randint(jax.random.PRNGKey(1), (bs, SEQ), 2, VOCAB)
+labels = enc[:, ::-1]
+dec = jnp.concatenate([jnp.ones((bs, 1), enc.dtype), labels[:, :-1]], 1)
+batch = split_batch_into_microbatches(
+    {"enc_tokens": enc, "dec_tokens": dec, "labels": labels}, M)
+bspec = {k: P(None, "data") for k in batch}
+
+opt = FusedAdam(lr=2e-3)
+step = make_train_step(model.make_loss_fn(), opt, mesh, model.spec(), bspec,
+                       opt_state_spec=opt.state_spec(params, model.spec()))
+opt_state = opt.init(params)
+
+losses = []
+for i in range(60):
+    params, opt_state, loss = step(params, opt_state, batch,
+                                   jax.random.PRNGKey(i))
+    losses.append(float(loss))
+    if i % 10 == 0 or i == 59:
+        print(f"iter {i:3d} loss {losses[-1]:.4f}", flush=True)
+
+assert np.isfinite(losses).all()
+assert losses[-1] < losses[0] * 0.5, f"no convergence: {losses[0]:.3f} -> {losses[-1]:.3f}"
+print("CONVERGED OK (decoder learned to read the encoder through the "
+      "pipelined cross-attention)")
+parallel_state.destroy_model_parallel()
