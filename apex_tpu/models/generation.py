@@ -15,18 +15,76 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["init_kv_caches", "decode_step", "generate"]
+__all__ = ["init_kv_caches", "decode_step", "generate",
+           "cast_decode_params", "flatten_decode_caches",
+           "preslice_layer_params"]
+
+
+def cast_decode_params(params, compute_dtype):
+    """Cast fp32 params to the compute dtype ONCE for decoding — except
+    MoE router weights, which stay fp32 (the router matmul reads fp32;
+    rounding them would let decode pick different experts than the full
+    forward near top-k boundaries). Inside a decode scan every layer's
+    f32->bf16 weight cast is loop-invariant, but XLA re-materializes it
+    per step (~0.3 GB/step at GPT-2 124M — the 154 MB tied embedding
+    alone re-cast every token)."""
+    from jax.tree_util import tree_map_with_path
+
+    def cast(path, x):
+        if any("router" in str(getattr(p, "key", p)) for p in path):
+            return x
+        return x.astype(compute_dtype) if x.dtype == jnp.float32 else x
+
+    return tree_map_with_path(cast, params)
+
+
+def flatten_decode_caches(stacked_caches, num_layers: int):
+    """Stacked ``(k, v)`` ``[L, b, h, S, d]`` prefill caches -> the FLAT
+    per-layer list form ``[(k, v)]`` of ``[b, S, h*d]`` — the fast decode
+    form (see :func:`init_kv_caches`)."""
+    ck, cv = stacked_caches
+
+    def fl(x):
+        b, h, s, d = x.shape
+        return x.transpose(0, 2, 1, 3).reshape(b, s, h * d)
+
+    return [(fl(ck[i]), fl(cv[i])) for i in range(num_layers)]
+
+
+def preslice_layer_params(params, num_layers: int):
+    """Pre-slice stacked ``params['transformer']['layers']`` into a
+    per-layer list behind an ``optimization_barrier``: inside a decode
+    scan XLA re-slices (and lays out copies of) the stacked weights
+    EVERY step (~115 us/step at GPT-2 124M bs8 — PERF.md round 5); the
+    barrier pins the slices as buffers so XLA cannot sink them back.
+    No-op when the params are already a list or have no stacked
+    transformer layers."""
+    if "transformer" not in params or "layers" not in params["transformer"]:
+        return params
+    lp = params["transformer"]["layers"]
+    if isinstance(lp, (list, tuple)):
+        return params
+    params = dict(params)
+    params["transformer"] = dict(params["transformer"])
+    params["transformer"]["layers"] = jax.lax.optimization_barrier(
+        [jax.tree.map(lambda x: x[i], lp) for i in range(num_layers)])
+    return params
 
 
 def init_kv_caches(model, batch_size: int, max_len: int,
-                   dtype=None, *, stacked: bool = True):
+                   dtype=None, *, stacked: bool = True, flat: bool = False):
     """Preallocate K/V caches. ``stacked=True`` (default): ``(k, v)``, each
     ``[num_layers, batch, local_kv_heads, max_len, head_dim]`` — the scan
     form. ``stacked=False``: a LIST of per-layer ``(k, v)`` pairs, each
     ``[batch, local_kv_heads, max_len, head_dim]`` — the fast decode form
     (per-layer buffers update in place; scanning over a stacked cache
     pays full-cache slice/restack copies every step, measured 2.4x slower
-    at bs8 — PERF.md round 4). ``generate()`` uses the list form.
+    at bs8 — PERF.md round 4). ``stacked=False, flat=True``: the per-layer
+    pairs are FLAT ``[batch, max_len, local_kv_heads * head_dim]`` — the
+    fastest decode form (the 4D carry's minor dim is head_dim = half a
+    128-lane tile, so XLA pads the cache 2x and reads it at ~50% HBM
+    bandwidth; the flat minor dim stays full-lane — PERF.md round 5).
+    ``generate()`` uses the flat list form.
 
     Heads are K/V heads (``config.kv_heads``), which under GQA/MQA is
     ``num_query_groups``, not the query head count. Inside ``shard_map``
@@ -48,8 +106,12 @@ def init_kv_caches(model, batch_size: int, max_len: int,
         heads //= tp
     per_layer = (batch_size, heads, max_len, c.head_dim)
     if not stacked:
+        if flat:
+            per_layer = (batch_size, max_len, heads * c.head_dim)
         return [(jnp.zeros(per_layer, dtype), jnp.zeros(per_layer, dtype))
                 for _ in range(c.num_layers)]
+    if flat:
+        raise ValueError("flat=True is a per-layer (stacked=False) form")
     shape = (c.num_layers,) + per_layer
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
@@ -122,29 +184,14 @@ def generate(model, params, prompt: jax.Array, max_new_tokens: int, *,
     """
     if temperature > 0.0 and rng is None:
         raise ValueError("sampling (temperature > 0) needs rng")
-    # pre-cast fp32 params to the compute dtype ONCE: inside the decode
-    # scan every layer's f32->bf16 weight cast is loop-invariant, but XLA
-    # re-materializes it per step rather than keep both copies live — for
-    # GPT-2 124M that is ~0.3 GB/step of pure cast/copy traffic (profiled:
-    # the 154 MB tied embedding alone re-cast every token). Decode is
-    # inference; bf16 weights are the standard serving precision.
+    # pre-cast fp32 params to the compute dtype ONCE (decode is inference;
+    # bf16 weights are the standard serving precision). The barrier pins
+    # the cast params as materialized buffers; without it XLA sinks the
+    # (loop-invariant) casts back into the scan body.
     c = model.config
     if c.compute_dtype != jnp.float32:
-        from jax.tree_util import tree_map_with_path
-
-        def cast(path, x):
-            # MoE routers are deliberately read in fp32 (moe.py router
-            # matmul) — rounding them here would let decode pick different
-            # experts than the full forward near top-k boundaries
-            if any("router" in str(getattr(p, "key", p)) for p in path):
-                return x
-            return (x.astype(c.compute_dtype)
-                    if x.dtype == jnp.float32 else x)
-
-        params = tree_map_with_path(cast, params)
-        # the barrier pins the cast params as materialized buffers; without
-        # it XLA sinks the (loop-invariant) casts back into the scan body
-        params = jax.lax.optimization_barrier(params)
+        params = jax.lax.optimization_barrier(
+            cast_decode_params(params, c.compute_dtype))
     b, prompt_len = prompt.shape
     total = prompt_len + max_new_tokens
     if (model.config.position_embedding_type == "learned"
@@ -180,8 +227,11 @@ def generate(model, params, prompt: jax.Array, max_new_tokens: int, *,
     # batched prefill: one forward writes all prompt K/V; its last-position
     # logits produce the first generated token
     prefill_logits, caches = _cached_forward(model, params, caches, prompt, 0)
-    ck, cv = caches
-    caches = [(ck[i], cv[i]) for i in range(model.config.num_layers)]
+    # unstack ONCE into the FLAT per-layer list form for the decode scan
+    # ([b, S, h*d] keeps the cache minor dim full-lane) and pre-slice the
+    # stacked layer params outside it (PERF.md round 5)
+    caches = flatten_decode_caches(caches, c.num_layers)
+    params = preslice_layer_params(params, c.num_layers)
     first = pick_next(prefill_logits[-1], jax.random.fold_in(rng, 0))
     out = out.at[:, prompt_len].set(first)
     done0 = ((first == eos_token) if eos_token is not None

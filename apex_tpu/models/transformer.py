@@ -531,6 +531,109 @@ class ParallelAttention:
                          model_parallel_region=True, axis_name=c.axis_name)
         return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
 
+    def _flat_cache_attention(self, params, q, k, v, ck, cv, cache_index,
+                              attention_mask, kv_lengths, rng,
+                              deterministic):
+        """Incremental decode over a FLAT ``[b, S, kvh*dh]`` cache pair.
+
+        Same semantics as the 4D cached path (causal/prefix mask over the
+        padded cache, sliding window, ``kv_lengths``, GQA grouping,
+        dropout) but the cache keeps heads*head_dim fused as the minor
+        dimension so reads and the one-row write stay full-lane, and the
+        single-token path reads both cache streams through MXU GEMMs so
+        XLA's layout assignment has no reason to re-lay the carry (see
+        the in-branch comments; the per-head view is a bitcast —
+        ``reshape`` splitting the minor dim).
+        ``q``/``k``/``v`` arrive as ``[b, local_heads, s, dh]``.
+        """
+        c = self.config
+        dh = c.head_dim
+        b, hl, s, _ = q.shape
+        kvh = k.shape[1]
+        kf = k.transpose(0, 2, 1, 3).reshape(b, s, kvh * dh)
+        vf = v.transpose(0, 2, 1, 3).reshape(b, s, kvh * dh)
+        ck = lax.dynamic_update_slice(ck, kf.astype(ck.dtype),
+                                      (0, cache_index, 0))
+        cv = lax.dynamic_update_slice(cv, vf.astype(cv.dtype),
+                                      (0, cache_index, 0))
+        S = ck.shape[1]
+        # identical mask to the 4D cached branch: query i of the slice may
+        # see slots j <= cache_index + i, within the window and (varlen)
+        # below the row's valid length
+        slots = jnp.arange(S)[None, None, None, :]
+        allowed_up_to = cache_index + jnp.arange(s)[None, None, :, None]
+        invalid = slots > allowed_up_to
+        if c.sliding_window is not None:
+            invalid = jnp.logical_or(
+                invalid, slots <= allowed_up_to - c.sliding_window)
+        if kv_lengths is not None:
+            invalid = jnp.logical_or(
+                invalid, slots >= kv_lengths[:, None, None, None])
+        mask = (invalid if attention_mask is None
+                else jnp.logical_or(attention_mask, invalid))
+        inv_scale = jnp.sqrt(
+            jnp.asarray(c.head_dim, jnp.float32)).astype(q.dtype)
+        g = hl // kvh
+        if s == 1:
+            # single-token fast path. The per-head einsum formulation lets
+            # XLA's layout assignment put the SEQUENCE dim minor on the
+            # cache carry (the softmax's preference propagates backward),
+            # which turns the one-row cache write into a full-cache copy
+            # every step (measured 0.5 ms/step at 124M bs8). Instead BOTH
+            # cache streams go through MXU GEMMs:
+            #   scores = K_flat @ Qblock  — one GEMM per batch, where
+            #     Qblock [kvh*dh, hl] holds each query head's vector in its
+            #     K/V head's row block and zeros elsewhere, so the cache is
+            #     read as contiguous full-lane [S, kvh*dh] rows (the 12x
+            #     redundant MACs are free — decode is bandwidth-bound);
+            #   ctx = probs @ V_flat — every (head, V column) pair, each
+            #     head's own dh block kept by a static selector.
+            # Neither expression gives XLA a reason to re-lay the carry.
+            q2 = q[:, :, 0, :]                            # [b, hl, dh]
+            q_tiled = jnp.tile(q2.transpose(0, 2, 1), (1, kvh, 1))
+            frow = jnp.arange(kvh * dh)[:, None]
+            jcol = jnp.arange(hl)[None, :]
+            blockmask = (frow // dh == jcol // g).astype(q.dtype)
+            qblock = q_tiled * blockmask                  # [b, kvh*dh, hl]
+            scores = jnp.einsum("bsf,bfh->bsh", ck.astype(q.dtype),
+                                qblock) / inv_scale       # [b, S, hl]
+            neg = jnp.asarray(-1e30, jnp.float32)
+            invalid1 = jnp.swapaxes(mask[:, 0], 1, 2)     # [b|1, S, 1]
+            sf = jnp.where(invalid1, neg, scores.astype(jnp.float32))
+            sf = sf - jnp.max(sf, axis=1, keepdims=True)
+            e = jnp.exp(sf)
+            probs = (e / jnp.sum(e, axis=1, keepdims=True)).astype(q.dtype)
+            probs = _dropout(probs, c.attention_dropout, rng, deterministic,
+                             model_parallel_region=True,
+                             axis_name=c.axis_name)
+            # context as a second MXU GEMM over the flat V (an elementwise
+            # broadcast-multiply-reduce here makes XLA lay the V carry
+            # S-minor, reintroducing the full-cache-copy write): compute
+            # every (query head, V column) pair, then keep each head's own
+            # dh block — kvh x redundant MACs, still free on the MXU
+            ctx_big = jnp.einsum("bsh,bsf->bhf", probs,
+                                 cv.astype(q.dtype))      # [b, hl, kvh*dh]
+            sel = (jnp.arange(kvh)[None, :]
+                   == (jnp.arange(hl) // g)[:, None]).astype(q.dtype)
+            ctx = jnp.einsum("bjkd,jk->bjd",
+                             ctx_big.reshape(b, hl, kvh, dh), sel)
+            ctx = ctx.reshape(b, hl * dh)[None]           # [1, b, hl*dh]
+            out = self.dense.apply(params["dense"], ctx)
+            return out, (ck, cv)
+        K4 = ck.reshape(b, S, kvh, dh).astype(q.dtype)
+        V4 = cv.reshape(b, S, kvh, dh).astype(q.dtype)
+        qg = q.reshape(b, kvh, g, s, dh)
+        scores = jnp.einsum("bhgqd,bkhd->bhgqk", qg, K4) / inv_scale
+        scores = scores.reshape(b, hl, s, S)
+        probs = self.scale_mask_softmax(scores, mask)
+        probs = _dropout(probs, c.attention_dropout, rng, deterministic,
+                         model_parallel_region=True, axis_name=c.axis_name)
+        pg = probs.astype(V4.dtype).reshape(b, kvh, g, s, S)
+        ctx = jnp.einsum("bhgqk,bkhd->bhgqd", pg, V4).reshape(b, hl, s, dh)
+        ctx = ctx.transpose(2, 0, 1, 3).reshape(s, b, hl * dh)
+        out = self.dense.apply(params["dense"], ctx)
+        return out, (ck, cv)
+
     def apply(self, params, hidden, *, encoder_output=None,
               attention_mask=None, kv_lengths=None, kv_cache=None,
               cache_index=None, rng=None, deterministic=True):
@@ -601,6 +704,18 @@ class ParallelAttention:
                     "kv_cache is for self-attention decode; cross-attention "
                     "K/V are static — precompute them once instead")
             ck, cv = kv_cache
+            if ck.ndim == 3:
+                # FLAT decode cache [b, S, local_kv_heads*dh]: with the 4D
+                # [b, h, S, d] carry XLA picks a layout whose minor dim is
+                # head_dim (64) — half a 128-lane tile — so the cache is
+                # physically padded 2x and every decode-attention read runs
+                # at ~50% HBM bandwidth; the flat form keeps the minor dim
+                # at h*d (>= 128) and the whole cache stream full-lane
+                # (PERF.md round 5: bs8 decode 10.4k -> 13.8k tok/s)
+                out, new_cache = self._flat_cache_attention(
+                    params, q, k, v, ck, cv, cache_index, attention_mask,
+                    kv_lengths, rng, deterministic)
+                return out, new_cache
             ck = lax.dynamic_update_slice(
                 ck, k.astype(ck.dtype), (0, 0, cache_index, 0))
             cv = lax.dynamic_update_slice(
@@ -834,24 +949,37 @@ class ParallelTransformer:
         # 2-TUPLE of [L, ...] arrays — do not widen this check to tuple)
         if kv_caches is not None and isinstance(kv_caches, list):
             if (len(kv_caches) != c.num_layers
+                    # entries must be (k, v) PAIRS: a stacked (k, v) pair
+                    # that became a [k, v] list in a serialization
+                    # round-trip would otherwise run SILENTLY wrong on
+                    # 2-layer models — each [2, ...] ARRAY entry unpacks
+                    # into two per-layer slices of valid shape, so the
+                    # entry type check (not just the lengths) is what
+                    # actually catches it
+                    or not isinstance(kv_caches[0], (tuple, list))
                     or len(kv_caches[0]) != 2
-                    or getattr(kv_caches[0][0], "ndim", 0) != 4):
-                # e.g. a stacked (k, v) pair that became a [k, v] list in a
-                # serialization round-trip would otherwise run SILENTLY
-                # wrong on 2-layer models (each [2, ...] array unpacking
-                # into two per-layer slices of valid shape)
+                    or getattr(kv_caches[0][0], "ndim", 0) not in (3, 4)):
                 raise ValueError(
                     f"list-form kv_caches must hold num_layers "
                     f"({c.num_layers}) per-layer (k, v) pairs of "
-                    f"[batch, heads, S, head_dim] arrays; got a "
+                    f"[batch, heads, S, head_dim] (or flat "
+                    f"[batch, S, heads*head_dim]) arrays; got a "
                     f"{len(kv_caches)}-element list — a stacked cache is "
                     f"a (k, v) TUPLE of [L, ...] arrays")
             # unrolled per-layer cache loop (no remat: decode is inference)
             h = hidden
             new_caches = []
+            layers_p = params["layers"]
             for idx, layer_cache in enumerate(kv_caches):
-                layer_params = jax.tree.map(lambda x: x[idx],
-                                            params["layers"])
+                # a list/tuple of per-layer pytrees skips the in-loop slice
+                # of the stacked params: inside a decode scan XLA re-slices
+                # (and lays out copies of) the stacked weights EVERY step
+                # (~115 us/step at GPT-2 124M bs8 — PERF.md round 5);
+                # generate() pre-slices once outside the scan
+                layer_params = (layers_p[idx]
+                                if isinstance(layers_p, (list, tuple))
+                                else jax.tree.map(lambda x: x[idx],
+                                                  layers_p))
                 layer_rng = (None if rng is None
                              else jax.random.fold_in(rng, idx))
                 h, new_cache = self.layer.apply(

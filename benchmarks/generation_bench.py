@@ -8,10 +8,12 @@ GPT-2 124M:
     forwards over 1024-token prompts (batch 8; chaining amortizes the
     5-20 ms per-call tunnel dispatch that made per-call timing wander
     25%), the compute-bound phase;
-  * decode-only tokens/sec at batch 1 / 8 / 32 — differenced
-    generate() timings over identical KV-cache allocations, so prefill,
-    dispatch, and fixed scan costs cancel exactly; each row carries its
-    fraction of the weight+KV read-bandwidth bound (decode reads every
+  * decode-only tokens/sec at batch 1 / 8 / 32 — ONE jitted scan of
+    pure decode steps over a cache prefilled outside the timed region
+    (round 4 differenced two separately-dispatched generate() calls;
+    dispatch noise ADDS in a difference and inflated bs1 past the
+    physical bound — see bench_decode); each row carries its fraction
+    of the weight+KV read-bandwidth bound (decode reads every
     parameter once per token).
 
 Usage: ``PYTHONPATH=/root/repo:/root/.axon_site python benchmarks/generation_bench.py``
@@ -41,7 +43,9 @@ def _hbm_bw():
     return None
 
 from apex_tpu.models import GPTModel, TransformerConfig
-from apex_tpu.models.generation import generate, init_kv_caches
+from apex_tpu.models.generation import (
+    cast_decode_params, decode_step, flatten_decode_caches, generate,
+    init_kv_caches, preslice_layer_params)
 from apex_tpu.models.generation import _cached_forward  # prefill phase
 
 
@@ -120,37 +124,74 @@ def _decode_read_bytes(model, batch, cache_tokens):
     return param_bytes + kv_bytes
 
 
-def bench_decode(model, params, batch, prompt_len=128):
-    """Decode-only tokens/sec by DIFFERENCING two generate() lengths: the
-    prefill, host dispatch, and fixed scan overheads cancel in
-    (t_long - t_short) / (n_long - n_short), leaving the pure per-token
-    decode rate (ADVICE r3: the old decode_* metric divided by a wall time
-    that included a 128-token prefill)."""
+def bench_decode(model, params, batch, prompt_len=128, chain=None):
+    """Decode-only tokens/sec from ONE jitted ``lax.scan`` of pure decode
+    steps over an already-prefilled cache.
+
+    Round 4 differenced two separately-dispatched ``generate()`` calls; the
+    5-20 ms per-dispatch tunnel noise does not cancel in a difference — it
+    adds — and the driver's bs1 capture came out at 104.6% of the physical
+    read bound (VERDICT r4). Here the prefill runs once OUTSIDE the timed
+    region, and the timed program is a single dispatch scanning ``chain``
+    data-dependent decode steps (each argmax token feeds the next step).
+    Dispatch overhead is amortized over the whole chain and biases the
+    throughput LOW, so the reported pct_of_read_bw_bound cannot exceed 1 by
+    construction. Write positions cycle inside the cache's decode window so
+    the chain length (dispatch amortization) is independent of the cache
+    size (kept at round 4's S=288 for row comparability); every step does
+    identical work — one dynamic_update_slice + attention over the full
+    static cache per layer."""
+    c = model.config
+    S = prompt_len + 160                     # same allocation as round 4
+    chain = chain or {1: 2048, 8: 1024}.get(batch, 512)
     prompt = jax.random.randint(jax.random.PRNGKey(1), (batch, prompt_len),
                                 0, 50304)
-    n_short, n_long = 32, 160
-    # identical cache allocation for both runs: decode attention walks the
-    # full static cache each step, so differencing only cancels the shared
-    # phases if both runs use the same S
-    S = prompt_len + n_long
-    gen_s = jax.jit(lambda p, pr: generate(model, p, pr, n_short, max_len=S))
-    gen_l = jax.jit(lambda p, pr: generate(model, p, pr, n_long, max_len=S))
-    t_s = _time(gen_s, params, prompt, steps=3)
-    t_l = _time(gen_l, params, prompt, steps=3)
-    dt_tok = (t_l - t_s) / (n_long - n_short)        # sec per decode step
-    tps = batch / dt_tok
-    # roofline: decode is read-bound; mid-generation cache occupancy
-    cache_tokens = prompt_len + (n_short + n_long) // 2
+    # serving precision: generate()'s own one-time pre-cast (keeps MoE
+    # routers fp32), materialized outside the timed jit
+    if c.compute_dtype != jnp.float32:
+        params = cast_decode_params(params, c.compute_dtype)
+
+    @jax.jit
+    def prefill(params, caches, prompt):
+        logits, caches = _cached_forward(model, params, caches, prompt, 0)
+        first = jnp.argmax(logits[-1], axis=-1).astype(prompt.dtype)
+        return caches, first
+
+    caches, first = prefill(params, init_kv_caches(model, batch, S), prompt)
+    # generate()'s decode form: FLAT per-layer caches + pre-sliced layer
+    # params (the SAME helpers generate() uses, materialized outside the
+    # timed jit)
+    caches = flatten_decode_caches(caches, c.num_layers)
+    params = preslice_layer_params(params, c.num_layers)
+    # write indices cycle through [prompt_len, S): after one pass the cache
+    # is fully occupied, so steady-state steps read the full S-slot buffer
+    idx = prompt_len + (jnp.arange(chain) % (S - prompt_len))
+
+    @jax.jit
+    def decode_chain(params, caches, tok):
+        def body(carry, i):
+            caches, tok = carry
+            logits, caches = decode_step(model, params, caches, tok, i)
+            return (caches, jnp.argmax(logits, -1).astype(tok.dtype)), None
+        (caches, tok), _ = jax.lax.scan(body, (caches, tok), idx)
+        return tok, caches                   # tok first: cheap sync fetch
+
+    dt = _time(decode_chain, params, caches, first, steps=2) / chain
+    tps = batch / dt
     bw = _hbm_bw()
     row = {
         "metric": f"gpt2_124m_decode_bs{batch}_tokens_per_sec_per_chip",
         "value": round(tps, 1), "unit": "tokens/sec", "vs_baseline": 1.0,
         "config": {"prompt_len": prompt_len, "decode_only": True,
                    "cache_len": S,
-                   "method": f"differenced generate({n_long}) - "
-                             f"generate({n_short})"}}
+                   "method": f"in-jit scan of {chain} decode steps over a "
+                             f"prefilled cache (single dispatch; overhead "
+                             f"biases tok/s low => pct_of_bound <= 1 by "
+                             f"construction)"}}
     if bw is not None:
-        bound_steps = bw / _decode_read_bytes(model, batch, cache_tokens)
+        # the attention physically reads all S cache slots every step (full
+        # static buffer + mask), so the bound counts the full cache
+        bound_steps = bw / _decode_read_bytes(model, batch, S)
         row["pct_of_read_bw_bound"] = round(tps / (batch * bound_steps), 3)
         row["config"]["hbm_bw_gbps"] = round(bw / 1e9)
     print(json.dumps(row))

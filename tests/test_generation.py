@@ -208,3 +208,62 @@ class TestCacheForms:
                                        np.asarray(k_l), atol=1e-6)
             np.testing.assert_allclose(np.asarray(stacked[1][l]),
                                        np.asarray(v_l), atol=1e-6)
+
+    def test_flat_caches_agree(self):
+        """The FLAT [b, S, h*d] decode form (PERF.md round 5) must match
+        the 4D list form through prefill and stepwise decode."""
+        from apex_tpu.models.generation import _cached_forward
+
+        model = _model()
+        params = model.init(jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 10), 0, 64)
+        listed = init_kv_caches(model, 2, 16, stacked=False)
+        flat = init_kv_caches(model, 2, 16, stacked=False, flat=True)
+        assert flat[0][0].ndim == 3
+        l_l, listed = _cached_forward(model, params, listed,
+                                      tokens[:, :6], 0)
+        l_f, flat = _cached_forward(model, params, flat, tokens[:, :6], 0)
+        np.testing.assert_allclose(np.asarray(l_l), np.asarray(l_f),
+                                   rtol=1e-5, atol=1e-5)
+        for i in range(6, 10):
+            l_l, listed = decode_step(model, params, listed,
+                                      tokens[:, i], i)
+            l_f, flat = decode_step(model, params, flat, tokens[:, i], i)
+            np.testing.assert_allclose(np.asarray(l_l), np.asarray(l_f),
+                                       rtol=1e-5, atol=1e-5)
+
+    def test_flat_cache_kv_lengths_masks_padding(self):
+        """kv_lengths must mask pad slots on the FLAT path exactly as on
+        the 4D path (the flat branch initially dropped it — r5 review)."""
+        model = _model()
+        params = model.init(jax.random.PRNGKey(0))
+        c = model.config
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, 64)
+        kvl = jnp.array([5, 8], jnp.int32)
+        emb = model.embedding.apply(
+            params["embedding"]["word_embeddings"], tokens)
+        hidden = emb.transpose(1, 0, 2)[:1]      # decode one position
+        outs = {}
+        flat_cache0 = None
+        layer0 = jax.tree.map(lambda x: x[0],
+                              params["transformer"]["layers"])
+        from apex_tpu.models.generation import _cached_forward
+        for name, flat in (("4d", False), ("flat", True)):
+            caches = init_kv_caches(model, 2, 8, stacked=False, flat=flat)
+            # prefill the cache with 8 tokens' K/V, then attend one query
+            # with kv_lengths = [5, 8]: row 0 must ignore slots 5..7
+            _, caches = _cached_forward(model, params, caches, tokens, 0)
+            if flat:
+                flat_cache0 = caches[0]
+            out, _ = model.transformer.layer.attention.apply(
+                layer0["self_attention"], hidden, kv_cache=caches[0],
+                cache_index=7, kv_lengths=kvl)
+            outs[name] = np.asarray(out)
+        np.testing.assert_allclose(outs["4d"], outs["flat"],
+                                   rtol=1e-5, atol=1e-5)
+        # and kv_lengths actually changes the result (masking is live)
+        out_nolen, _ = model.transformer.layer.attention.apply(
+            layer0["self_attention"], hidden, kv_cache=flat_cache0,
+            cache_index=7, kv_lengths=None)
+        assert not np.allclose(outs["flat"], np.asarray(out_nolen),
+                               atol=1e-6)
