@@ -170,17 +170,22 @@ class BertModel:
                 pooled @ params["binary_head"]["classifier"]["weight"].T
                 + params["binary_head"]["classifier"]["bias"])
 
-        h = hidden.astype(jnp.float32)
-        h = h @ params["lm_head"]["dense"]["weight"].T.astype(jnp.float32) \
-            + params["lm_head"]["dense"]["bias"]
+        # LM head in the compute dtype (matches lm_head_loss in gpt.py:
+        # bf16 on the MXU runs ~4x fp32 and halves the [s, b, V] logits
+        # footprint; the CE upcasts internally). Round 5: this head ran
+        # entirely in fp32 — the 8192x768x30528 GEMM pair alone was ~12 ms
+        # of the 74 ms BERT step.
+        h = hidden.astype(c.compute_dtype)
+        h = h @ params["lm_head"]["dense"]["weight"].T.astype(
+            c.compute_dtype) \
+            + params["lm_head"]["dense"]["bias"].astype(c.compute_dtype)
         h = jax.nn.gelu(h, approximate=True)
         h = _ln(params["lm_head"]["layernorm"], h, c.layernorm_epsilon,
                 norm=c.normalization)
         logits = linear_with_grad_accumulation_and_async_allreduce(
-            h,
-            params["embedding"]["word_embeddings"]["weight"].astype(
-                jnp.float32),
-            None,
+            h.astype(c.compute_dtype),
+            params["embedding"]["word_embeddings"]["weight"],
+            None,  # callee casts weight to x.dtype (amp-O2 rule)
             sequence_parallel_enabled=False,  # already gathered above
             axis_name=c.axis_name)
         if lm_labels is None:

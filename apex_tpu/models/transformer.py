@@ -308,12 +308,18 @@ def _ln(params, x, eps, sequence_parallel=False, axis_name=TENSOR_AXIS,
         # norm runs on sequence shards; psum the param grads (reference
         # layer_norm.py:26-99 ``sequence_parallel_enabled`` marking)
         w = mark_sequence_parallel_parameter(w, axis_name)
+    # out_dtype=x.dtype: the consumer (QKV/MLP GEMM, residual add) runs in
+    # the compute dtype, so promote-to-fp32 output (bf16 x, fp32 norm
+    # params) would write 2x the bytes only for a convert to follow —
+    # measured ~3 ms/step of fp32 LN writes + converts on BERT (round 5)
     if norm == "rmsnorm":
-        return fused_rms_norm_affine(x, w, (x.shape[-1],), eps)
+        return fused_rms_norm_affine(x, w, (x.shape[-1],), eps,
+                                     out_dtype=x.dtype)
     b = params["bias"]
     if sequence_parallel:
         b = mark_sequence_parallel_parameter(b, axis_name)
-    return fused_layer_norm_affine(x, w, b, (x.shape[-1],), eps)
+    return fused_layer_norm_affine(x, w, b, (x.shape[-1],), eps,
+                                   out_dtype=x.dtype)
 
 
 @dataclass

@@ -116,6 +116,101 @@ def _causal_block_skip(i, j, bq, bk, causal, window, q_off, k_off):
 # forward
 # ---------------------------------------------------------------------------
 
+def _fwd_single_kernel(offs_ref, kvl_ref, q_ref, k_ref, v_ref, o_ref,
+                       lse_ref, *, scale, bq, bk, sk, causal, window,
+                       need_mask):
+    """One-pass forward for the single-block case (sq <= bq and sk <= bk):
+    plain max/exp/sum softmax with no m/l/acc scratch, no online-softmax
+    rescale, and — when ``need_mask`` is statically False (non-causal, no
+    window/varlen, keys unpadded) — no mask arithmetic at all. At short
+    sequence the general kernel's per-grid-step bookkeeping dominates:
+    BERT-shape (16,12,512,64) fwd measured 468 us against a 65 us FLOP
+    bound, almost all of it scratch init + masking + rescale overhead
+    across 192 one-block cells (round 5); this kernel removes it."""
+    b = pl.program_id(0)
+    q_off, k_off = offs_ref[0], offs_ref[1]
+
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if need_mask:
+            kvl = kvl_ref[b] if kvl_ref is not None else None
+            s, valid = _mask_block(s, 0, 0, bq, bk, sk, kvl, causal, window,
+                                   q_off, k_off)
+            m = jnp.max(s, axis=1, keepdims=True)
+            p = jnp.where(valid, jnp.exp(s - m), 0.0)
+        else:
+            m = jnp.max(s, axis=1, keepdims=True)
+            p = jnp.exp(s - m)
+        l = jnp.sum(p, axis=1, keepdims=True)
+        o = jax.lax.dot(p.astype(v.dtype), v,
+                        preferred_element_type=jnp.float32)
+        o = o * jnp.where(l > 0, 1.0 / l, 0.0)
+        o_ref[0, 0] = o.astype(o_ref.dtype)
+        lse = jnp.where(l > 0, m + jnp.log(l), _LSE_PAD)
+        lse_ref[0, 0] = jnp.broadcast_to(lse.T, lse_ref.shape[2:])
+
+    if causal or window is not None:
+        # fully-masked chunks (ring hops entirely in the causal future)
+        # stay near-free, mirroring _dqkv_single_kernel
+        keep = _causal_block_skip(0, 0, bq, bk, causal, window,
+                                  q_off, k_off)
+        pl.when(keep)(_compute)
+
+        @pl.when(jnp.logical_not(keep))
+        def _masked_out():
+            o_ref[0, 0] = jnp.zeros_like(o_ref[0, 0])
+            lse_ref[0, 0] = jnp.full_like(lse_ref[0, 0], _LSE_PAD)
+    else:
+        _compute()
+
+
+def _single_need_mask(causal, window, kv_lengths, skp, sk):
+    """Whether a single-block kernel needs mask arithmetic at all. Shared
+    by the fwd and bwd dispatches — they MUST agree or the backward
+    recompute diverges from the forward silently."""
+    return (causal or window is not None or kv_lengths is not None
+            or skp != sk)
+
+
+def _run_fwd_single(q, k, v, kv_lengths, scale, causal, sq, sk, bq, bk,
+                    group, window, q_off, k_off):
+    """Single-block forward dispatch — see _fwd_single_kernel."""
+    batch, heads, sqp, dp = q.shape
+    need_mask = _single_need_mask(causal, window, kv_lengths, k.shape[2], sk)
+    kvl_spec = []
+    args = [_offsets(q_off, k_off, sq, sk)]
+    if kv_lengths is not None:
+        kvl_spec = [pl.BlockSpec(memory_space=pltpu.SMEM)]
+        args.append(kv_lengths.astype(jnp.int32))
+    o, lse = pl.pallas_call(
+        _wrap_kernel(_fwd_single_kernel, kv_lengths, scale=scale, bq=bq,
+                     bk=bk, sk=sk, causal=causal, window=window,
+                     need_mask=need_mask),
+        grid=(batch, heads),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)] + kvl_spec + [
+            pl.BlockSpec((1, 1, bq, dp), lambda b, h: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, bk, dp), lambda b, h: (b, h // group, 0, 0)),
+            pl.BlockSpec((1, 1, bk, dp), lambda b, h: (b, h // group, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, dp), lambda b, h: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, 1, bq), lambda b, h: (b, h, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((batch, heads, sqp, dp), q.dtype),
+            jax.ShapeDtypeStruct((batch, heads, 1, sqp), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel")),
+        interpret=pallas_interpret(),
+    )(*args, q, k, v)
+    return o, lse[:, :, 0, :]
+
+
 def _win_j_base(i, bq, bk, qoff_static, window):
     """First k-block that can intersect q-block ``i``'s window band (static
     offsets only — the banded-grid fast path for sliding windows)."""
@@ -208,6 +303,11 @@ def _run_fwd(q, k, v, kv_lengths, scale, causal, sq, sk, bq, bk,
     batch, heads, sqp, dp = q.shape
     skp = k.shape[2]
     nq, nk = sqp // bq, skp // bk
+    if nq == 1 and nk == 1:
+        # whole problem fits one (bq, bk) tile: one-pass kernel, no
+        # online-softmax machinery (see _fwd_single_kernel)
+        return _run_fwd_single(q, k, v, kv_lengths, scale, causal, sq, sk,
+                               bq, bk, group, window, q_off, k_off)
     # banded grid for sliding windows with STATIC offsets (the plain flash
     # path): only the ~(window+bq)/bk k-blocks near the diagonal are walked,
     # making windowed attention O(s*window) in grid steps too, not just in
@@ -269,14 +369,18 @@ def _run_fwd(q, k, v, kv_lengths, scale, causal, sq, sk, bq, bk,
 # ---------------------------------------------------------------------------
 
 def _recompute_p_ds(q, k, v, do, lse, delta, i, j, *, scale, bq, bk, sk,
-                    kvl, causal, window, q_off, k_off):
+                    kvl, causal, window, q_off, k_off, need_mask=True):
     """The flash-backward block recompute every backward kernel shares:
     rebuild the (bq, bk) probabilities from the stashed lse and form
-    ``ds = p * (dp - delta)``. Returns ``(p, ds)`` (both fp32)."""
+    ``ds = p * (dp - delta)``. Returns ``(p, ds)`` (both fp32).
+    ``need_mask=False`` (statically all-valid block: non-causal, no
+    window/varlen, keys unpadded) skips the mask arithmetic — at short
+    sequence it is a measurable share of the kernel (round 5)."""
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
-    s, _ = _mask_block(s, i, j, bq, bk, sk, kvl, causal, window,
-                       q_off, k_off)
+    if need_mask:
+        s, _ = _mask_block(s, i, j, bq, bk, sk, kvl, causal, window,
+                           q_off, k_off)
     p = jnp.exp(s - lse)
     dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
@@ -374,7 +478,7 @@ def _dkv_kernel(offs_ref, kvl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 def _dqkv_single_kernel(offs_ref, kvl_ref, q_ref, k_ref, v_ref, do_ref,
                         lse_ref, delta_ref, dq_ref, dk_ref, dv_ref,
                         dk_scr, dv_scr, *, scale, bq, bk, sk, causal,
-                        window):
+                        window, need_mask=True):
     """Fused one-pass backward for the single-block case (sq <= bq and
     sk <= bk): s/p are computed ONCE and all three cotangents come out of
     the same VMEM residency — at short seq the separate dq/dkv kernels
@@ -402,7 +506,7 @@ def _dqkv_single_kernel(offs_ref, kvl_ref, q_ref, k_ref, v_ref, do_ref,
             lse_ref[0, 0].reshape(1, bq).T,
             delta_ref[0, 0].reshape(1, bq).T,
             0, 0, scale=scale, bq=bq, bk=bk, sk=sk, kvl=kvl, causal=causal,
-            window=window, q_off=q_off, k_off=k_off)
+            window=window, q_off=q_off, k_off=k_off, need_mask=need_mask)
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -447,6 +551,7 @@ def _run_bwd_single(q, k, v, do, lse, delta, kv_lengths, scale, causal,
     """Single-block fused dq/dk/dv dispatch — see _dqkv_single_kernel."""
     batch, _, sqp, dp = q.shape
     kv_heads = k.shape[1]
+    need_mask = _single_need_mask(causal, window, kv_lengths, k.shape[2], sk)
     kvl_spec = []
     args = [_offsets(q_off, k_off, sq, sk)]
     if kv_lengths is not None:
@@ -454,7 +559,8 @@ def _run_bwd_single(q, k, v, do, lse, delta, kv_lengths, scale, causal,
         args.append(kv_lengths.astype(jnp.int32))
     dq, dk, dv = pl.pallas_call(
         _wrap_kernel(_dqkv_single_kernel, kv_lengths, scale=scale, bq=bq,
-                     bk=bk, sk=sk, causal=causal, window=window),
+                     bk=bk, sk=sk, causal=causal, window=window,
+                     need_mask=need_mask),
         grid=(batch, kv_heads, group),
         in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)] + kvl_spec + [
             pl.BlockSpec((1, 1, bq, dp),

@@ -273,25 +273,37 @@ def _bwd_jnp(dy2, x2, mean, invvar, w, h, is_rms, has_bias):
 # public functional API (mirrors apex/normalization/fused_layer_norm.py)
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _norm(x, weight, bias, normalized_shape, eps, is_rms, memory_efficient):
-    y, _, _ = _norm_fwd_impl(x, weight, bias, normalized_shape, eps, is_rms)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _norm(x, weight, bias, normalized_shape, eps, is_rms, memory_efficient,
+          out_dtype):
+    y, _, _ = _norm_fwd_impl(x, weight, bias, normalized_shape, eps, is_rms,
+                             out_dtype)
     return y
 
 
-def _norm_fwd_impl(x, weight, bias, normalized_shape, eps, is_rms):
+def _norm_fwd_impl(x, weight, bias, normalized_shape, eps, is_rms,
+                   out_dtype=None):
     m, h, _ = _norm_shapes(x, normalized_shape)
     x2 = x.reshape(m, h)
-    out_dtype = x.dtype if weight is None else jnp.promote_types(x.dtype, weight.dtype)
-    if out_dtype == jnp.float64:
-        out_dtype = jnp.float32
+    if out_dtype is None:
+        # default: promote semantics (bf16 x + fp32 weight -> fp32 out);
+        # callers that immediately consume the output in the compute dtype
+        # pass out_dtype=x.dtype so the kernel writes half the bytes and
+        # no downstream convert materializes (round 5: each transformer
+        # LN wrote a 25 MB fp32 tensor a GEMM then re-cast to bf16)
+        out_dtype = (x.dtype if weight is None
+                     else jnp.promote_types(x.dtype, weight.dtype))
+        if out_dtype == jnp.float64:
+            out_dtype = jnp.float32
     fwd = _fwd_pallas if use_pallas() else _fwd_jnp
     y, mean, invvar = fwd(x2, weight, bias, h, eps, is_rms, out_dtype)
     return y.reshape(x.shape), mean, invvar
 
 
-def _norm_vjp_fwd(x, weight, bias, normalized_shape, eps, is_rms, memory_efficient):
-    y, mean, invvar = _norm_fwd_impl(x, weight, bias, normalized_shape, eps, is_rms)
+def _norm_vjp_fwd(x, weight, bias, normalized_shape, eps, is_rms,
+                  memory_efficient, out_dtype):
+    y, mean, invvar = _norm_fwd_impl(x, weight, bias, normalized_shape, eps,
+                                     is_rms, out_dtype)
     # zero-size marker carrying x's dtype (x itself may not be saved)
     x_dtype_marker = jnp.zeros((0,), x.dtype)
     if memory_efficient:
@@ -301,7 +313,8 @@ def _norm_vjp_fwd(x, weight, bias, normalized_shape, eps, is_rms, memory_efficie
     return y, (x, y, mean, invvar, weight, bias, x_dtype_marker)
 
 
-def _norm_vjp_bwd(normalized_shape, eps, is_rms, memory_efficient, res, dy):
+def _norm_vjp_bwd(normalized_shape, eps, is_rms, memory_efficient,
+                  out_dtype, res, dy):
     x_dtype = res[-1].dtype
     res = res[:-1]
     if memory_efficient:
@@ -335,26 +348,33 @@ _norm.defvjp(_norm_vjp_fwd, _norm_vjp_bwd)
 
 
 def fused_layer_norm_affine(x, weight, bias, normalized_shape, eps: float = 1e-5,
-                            memory_efficient: bool = False):
-    """Reference: ``fused_layer_norm_affine`` (``fused_layer_norm.py:194-204``)."""
-    return _norm(x, weight, bias, _as_shape(normalized_shape), eps, False, memory_efficient)
+                            memory_efficient: bool = False, out_dtype=None):
+    """Reference: ``fused_layer_norm_affine`` (``fused_layer_norm.py:194-204``).
+    ``out_dtype=None`` keeps promote semantics; pass ``x.dtype`` when the
+    consumer runs in the compute dtype anyway (halves the kernel's write
+    bytes under mixed precision — see _norm_fwd_impl)."""
+    return _norm(x, weight, bias, _as_shape(normalized_shape), eps, False,
+                 memory_efficient, out_dtype)
 
 
 def fused_layer_norm(x, normalized_shape, eps: float = 1e-5,
-                     memory_efficient: bool = False):
+                     memory_efficient: bool = False, out_dtype=None):
     """Non-affine variant (``fused_layer_norm.py:207-214``)."""
-    return _norm(x, None, None, _as_shape(normalized_shape), eps, False, memory_efficient)
+    return _norm(x, None, None, _as_shape(normalized_shape), eps, False,
+                 memory_efficient, out_dtype)
 
 
 def fused_rms_norm_affine(x, weight, normalized_shape, eps: float = 1e-5,
-                          memory_efficient: bool = False):
+                          memory_efficient: bool = False, out_dtype=None):
     """Reference: ``fused_rms_norm_affine`` (``fused_layer_norm.py:217-227``)."""
-    return _norm(x, weight, None, _as_shape(normalized_shape), eps, True, memory_efficient)
+    return _norm(x, weight, None, _as_shape(normalized_shape), eps, True,
+                 memory_efficient, out_dtype)
 
 
 def fused_rms_norm(x, normalized_shape, eps: float = 1e-5,
-                   memory_efficient: bool = False):
-    return _norm(x, None, None, _as_shape(normalized_shape), eps, True, memory_efficient)
+                   memory_efficient: bool = False, out_dtype=None):
+    return _norm(x, None, None, _as_shape(normalized_shape), eps, True,
+                 memory_efficient, out_dtype)
 
 
 def _as_shape(s) -> Tuple[int, ...]:
