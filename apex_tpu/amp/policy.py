@@ -185,6 +185,17 @@ def master_params(opt_state) -> list:
     the optimizer's ``state_dict``."""
     if isinstance(opt_state, dict) and "master" in opt_state:
         return jax.tree_util.tree_leaves(opt_state["master"])
+    if isinstance(opt_state, dict) and "master_rem" in opt_state:
+        # DistributedFusedAdam(store_param_remainders=True): the master is
+        # SPLIT — the params hold its top 16 bits, the state only the
+        # int16 remainder, so there is no standalone fp32 buffer to hand
+        # out and silently returning [] would misreport an O2-style run
+        raise ValueError(
+            "this optimizer state stores masters as bf16-param + int16 "
+            "remainder (store_param_remainders=True); reconstruct them "
+            "with DistributedFusedAdam._master_from_remainder(param_shard, "
+            "state['master_rem']) — there is no standalone fp32 master "
+            "buffer to return")
     master = getattr(opt_state, "master_params", None)   # FP16OptimizerState
     if master is not None:
         return jax.tree_util.tree_leaves(master)

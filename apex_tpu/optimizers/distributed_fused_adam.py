@@ -120,7 +120,8 @@ class DistributedFusedAdam(FusedAdam):
     handles_grad_sync = True
 
     def __init__(self, lr: float = 1e-3, *, num_shards: Optional[int] = None,
-                 axis_name: str = DATA_AXIS, **adam_kw):
+                 axis_name: str = DATA_AXIS, gather_dtype=None,
+                 store_param_remainders: bool = False, **adam_kw):
         adam_kw.pop("master_weights", None)
         super().__init__(lr=lr, master_weights=True, **adam_kw)
         if num_shards is None:
@@ -130,6 +131,33 @@ class DistributedFusedAdam(FusedAdam):
                           else 1)
         self.num_shards = num_shards
         self.axis_name = axis_name
+        # all-gather precision (reference: params move fp16 by default,
+        # e5m2 uint8 with e5m2_allgather=True —
+        # distributed_fused_lamb.py:105,340,389; XLA does NOT compress
+        # collectives, so gathering the fp32 master shard doubles the
+        # reference's gather bytes). None = automatic: when every param
+        # leaf is a 16-bit float, gather in that dtype — lossless
+        # end-to-end because the gathered values are cast to the leaf
+        # dtype anyway and the cast commutes with all_gather; mixed or
+        # fp32 leaves keep the fp32 gather. Pass jnp.float8_e5m2 for the
+        # reference's compressed-allgather analog (lossy, opt-in).
+        self.gather_dtype = gather_dtype
+        # store fp32 masters as (bf16 param image + signed 16-bit
+        # remainder): halves resident master bytes when params are bf16
+        # (reference distributed_fused_adam.py:251-267,429-458)
+        self.store_param_remainders = store_param_remainders
+        if (store_param_remainders and gather_dtype is not None
+                and jnp.dtype(gather_dtype) != jnp.dtype(jnp.bfloat16)):
+            # a lossy gather would hand the next step a param image that
+            # is NOT the one the stored remainder was split against —
+            # the reconstructed master's top 16 bits would be silently
+            # wrong every step
+            raise ValueError(
+                "store_param_remainders requires the bf16 param image to "
+                "round-trip through the all-gather exactly; "
+                f"gather_dtype={jnp.dtype(gather_dtype).name} would "
+                "degrade it (leave gather_dtype unset — it resolves to "
+                "bfloat16 for all-bf16 params)")
         self._segment_cache: dict = {}
 
     # -- flat buffer layout --------------------------------------------------
@@ -204,6 +232,66 @@ class DistributedFusedAdam(FusedAdam):
             off += n
         return jax.tree_util.tree_unflatten(treedef, out)
 
+    # -- gather precision / master storage -----------------------------------
+
+    def _resolve_gather_dtype(self, params):
+        """See ``gather_dtype`` in ``__init__``."""
+        if self.gather_dtype is not None:
+            return jnp.dtype(self.gather_dtype)
+        dts = {jnp.dtype(l.dtype) for l in jax.tree_util.tree_leaves(params)}
+        if len(dts) == 1:
+            (dt,) = dts
+            if jnp.issubdtype(dt, jnp.floating) and dt.itemsize == 2:
+                return dt
+        return jnp.dtype(jnp.float32)
+
+    def _gather_params(self, new_p, params, sharded):
+        """All-gather the updated shard in the gather dtype, unflatten to
+        param dtypes. ``new_p`` may already be the bf16 param image (the
+        ``store_param_remainders`` path)."""
+        if not sharded:
+            return self._unflatten_local(new_p, params)
+        gd = self._resolve_gather_dtype(params)
+        # (the CPU backend legalizes bf16 collectives back to f32 in its
+        # post-optimization HLO — a backend artifact; TPU gathers bf16
+        # natively, which is the wire-bytes win this knob exists for)
+        return self._unflatten_local(
+            lax.all_gather(new_p.astype(gd), self.axis_name, tiled=True),
+            params)
+
+    def _param_shard_flat(self, params, chunk: int, sharded: bool):
+        """This rank's [chunk] slice of the flattened (fp32) params."""
+        flat = self._flatten_local(params)
+        if sharded:
+            flat = lax.dynamic_slice(
+                flat, (lax.axis_index(self.axis_name) * chunk,), (chunk,))
+        return flat
+
+    @staticmethod
+    def _master_from_remainder(p_img: jax.Array, rem_i16: jax.Array):
+        """fp32 master = (bf16 param image bits << 16) + signed remainder
+        (reference ``store_param_remainders``,
+        distributed_fused_adam.py:251-267: the bf16-visible param supplies
+        the top 16 bits, the optimizer state only the bottom 16)."""
+        hi = lax.bitcast_convert_type(p_img.astype(jnp.bfloat16), jnp.uint16)
+        bits = ((hi.astype(jnp.uint32) << 16)
+                + rem_i16.astype(jnp.int32).astype(jnp.uint32))
+        return lax.bitcast_convert_type(bits, jnp.float32)
+
+    @staticmethod
+    def _remainder_split(master: jax.Array):
+        """(bf16 param image, int16 remainder). The image uses round-HALF-UP
+        to bf16 (``(bits + 0x8000) >> 16``) so the remainder is always in
+        [-32768, 32767] and round-trips through int16 exactly; NaN masters
+        (inf grads) are not split faithfully — the ``found_inf`` guard keeps
+        them out of state."""
+        bits = lax.bitcast_convert_type(master, jnp.uint32)
+        hi = ((bits + jnp.uint32(0x8000)) >> 16).astype(jnp.uint16)
+        img = lax.bitcast_convert_type(hi, jnp.bfloat16)
+        rem_u = (bits - (hi.astype(jnp.uint32) << 16)) & jnp.uint32(0xFFFF)
+        rem = lax.bitcast_convert_type(rem_u.astype(jnp.uint16), jnp.int16)
+        return img, rem
+
     # -- public API ----------------------------------------------------------
 
     def init(self, params, param_spec=None) -> dict:
@@ -218,15 +306,30 @@ class DistributedFusedAdam(FusedAdam):
         axes = self._model_axis_sizes()
         names, sizes = list(axes.keys()), list(axes.values())
         dp = self.num_shards
+        if self.store_param_remainders:
+            bad = [jnp.dtype(l.dtype)
+                   for l in jax.tree_util.tree_leaves(params)
+                   if jnp.dtype(l.dtype) != jnp.dtype(jnp.bfloat16)]
+            if bad:
+                raise ValueError(
+                    "store_param_remainders needs every param leaf in "
+                    f"bfloat16 (the params carry the master's top 16 bits); "
+                    f"found {sorted(set(map(str, bad)))}")
 
         if not names:
             master = self._flatten_local(params).reshape(dp, -1)
-            return {
+            state = {
                 "step": jnp.zeros((), jnp.int32),
-                "master": master,
                 "exp_avg": jnp.zeros_like(master),
                 "exp_avg_sq": jnp.zeros_like(master),
             }
+            if self.store_param_remainders:
+                # params ARE the initial masters (bf16-exact), so the
+                # remainder starts at zero
+                state["master_rem"] = jnp.zeros(master.shape, jnp.int16)
+            else:
+                state["master"] = master
+            return state
 
         from apex_tpu.transformer import parallel_state
         from jax.sharding import NamedSharding
@@ -282,18 +385,23 @@ class DistributedFusedAdam(FusedAdam):
             return seg.reshape((1,) + (1,) * len(sizes) + (chunk,))
 
         master = jax.make_array_from_callback(shape, sharding, cb)
-        return {
+        state = {
             "step": jnp.zeros((), jnp.int32),
-            "master": master,
             "exp_avg": jnp.zeros_like(master),      # sharding-preserving
             "exp_avg_sq": jnp.zeros_like(master),
         }
+        if self.store_param_remainders:
+            state["master_rem"] = jnp.zeros_like(master, dtype=jnp.int16)
+        else:
+            state["master"] = master
+        return state
 
     def state_spec(self, params, param_spec=None):
         names = list(self._model_axis_sizes().keys())
         p = PartitionSpec(self.axis_name, *names, None)
-        return {"step": PartitionSpec(), "master": p, "exp_avg": p,
-                "exp_avg_sq": p}
+        spec = {"step": PartitionSpec(), "exp_avg": p, "exp_avg_sq": p}
+        spec["master_rem" if self.store_param_remainders else "master"] = p
+        return spec
 
     def _sync_grads(self, grads, grad_scale) -> Tuple[jax.Array, bool]:
         """Shared sharded-gradient prologue: validate the bound axis,
@@ -331,8 +439,13 @@ class DistributedFusedAdam(FusedAdam):
         lr = self.lr if lr is None else lr
         g_local, sharded = self._sync_grads(grads, grad_scale)
 
-        shard_shape = state["master"].shape
-        p_local = state["master"].reshape(-1)
+        shard_shape = state["exp_avg"].shape
+        if self.store_param_remainders:
+            p_img = self._param_shard_flat(params, g_local.shape[0], sharded)
+            rem_old = state["master_rem"].reshape(-1)
+            p_local = self._master_from_remainder(p_img, rem_old)
+        else:
+            p_local = state["master"].reshape(-1)
         slots = {"exp_avg": state["exp_avg"].reshape(-1),
                  "exp_avg_sq": state["exp_avg_sq"].reshape(-1)}
         step = state["step"] + 1
@@ -351,17 +464,26 @@ class DistributedFusedAdam(FusedAdam):
                 lambda n, o: jnp.where(found_inf, o, n), new_slots, slots)
             step = jnp.where(found_inf, state["step"], step)
 
-        if sharded:
-            # params come back via all-gather (reference: all-gather params
-            # after the sharded step)
-            full = lax.all_gather(new_p, self.axis_name, tiled=True)
-        else:
-            full = new_p
-        new_params = self._unflatten_local(full, params)
         new_state = {
             "step": step,
-            "master": new_p.reshape(shard_shape),
             "exp_avg": new_slots["exp_avg"].reshape(shard_shape),
             "exp_avg_sq": new_slots["exp_avg_sq"].reshape(shard_shape),
         }
+        if self.store_param_remainders:
+            # split the updated master; the bf16 image is what gets
+            # gathered (gathering a separately-rounded cast could disagree
+            # with the stored remainder at round-to-nearest ties). No
+            # extra found_inf guard needed: new_p was already reverted to
+            # p_local above, and re-splitting the reverted master
+            # reproduces (p_img, rem_old) bit-exactly (round-half-up is
+            # the exact inverse of the reconstruction).
+            img, rem = self._remainder_split(new_p)
+            new_state["master_rem"] = rem.reshape(shard_shape)
+            gather_src = img
+        else:
+            new_state["master"] = new_p.reshape(shard_shape)
+            gather_src = new_p
+        # params come back via all-gather in the gather dtype (reference:
+        # fp16 / e5m2 all-gather after the sharded step)
+        new_params = self._gather_params(gather_src, params, sharded)
         return new_params, new_state

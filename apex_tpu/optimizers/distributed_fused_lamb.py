@@ -3,9 +3,12 @@
 TPU-native counterpart of ``apex/contrib/optimizers/distributed_fused_lamb.py``
 (``DistributedFusedLAMB`` at ``:24-108``): NVLAMB with reduce-scattered
 gradients, sharded fp32 master/moment state, and an all-gather of updated
-params — the reference's reduce-scatter+all-reduce NCCL pipeline and e5m2
-compressed all-gather collapse onto one ``psum_scatter`` / ``all_gather``
-pair over the data mesh axis (compression is XLA's transfer-layer concern).
+params over the data mesh axis. Gather precision is the optimizer's choice,
+not the transfer layer's (XLA does not compress collectives): the inherited
+``gather_dtype`` moves params in the 16-bit param dtype by default when the
+leaves allow it, and ``gather_dtype=jnp.float8_e5m2`` is the analog of the
+reference's ``e5m2_allgather=True`` compressed all-gather
+(``distributed_fused_lamb.py:105,340,389``).
 
 What makes sharded LAMB harder than sharded Adam: the trust ratio needs
 *per-parameter-tensor* norms ``||p|| / ||update||``, but each rank holds only
@@ -47,12 +50,13 @@ class DistributedFusedLAMB(DistributedFusedAdam):
                  weight_decay: float = 0.01, adam_w_mode: bool = True,
                  grad_averaging: bool = True, max_grad_norm: float = 1.0,
                  trust_clip: bool = False, always_adapt: bool = False,
-                 weight_decay_mask=None):
+                 weight_decay_mask=None, gather_dtype=None):
         super().__init__(lr=lr, num_shards=num_shards, axis_name=axis_name,
                          bias_correction=bias_correction, betas=betas,
                          eps=eps, adam_w_mode=adam_w_mode,
                          weight_decay=weight_decay,
-                         weight_decay_mask=weight_decay_mask)
+                         weight_decay_mask=weight_decay_mask,
+                         gather_dtype=gather_dtype)
         self.grad_averaging = grad_averaging
         self.max_grad_norm = max_grad_norm
         self.trust_clip = trust_clip
@@ -141,9 +145,7 @@ class DistributedFusedLAMB(DistributedFusedAdam):
                               new_v)
             step_c = jnp.where(found_inf, state["step"], step_c)
 
-        full = (lax.all_gather(new_p, self.axis_name, tiled=True)
-                if sharded else new_p)
-        new_params = self._unflatten_local(full, params)
+        new_params = self._gather_params(new_p, params, sharded)
         new_state = {
             "step": step_c,
             "master": new_p.reshape(shard_shape),

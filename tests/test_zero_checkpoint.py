@@ -151,6 +151,93 @@ class TestDistributedFusedAdamSharded:
             z_p, ref_p)
 
 
+class TestGatherDtypeAndRemainders:
+    """Reduced-precision param all-gather + bf16-remainder master storage
+    (reference ``distributed_fused_lamb.py:105,340`` fp16/e5m2 gather,
+    ``distributed_fused_adam.py:251-267`` store_param_remainders)."""
+
+    def _train_bf16(self, optimizer, steps=100):
+        parallel_state.destroy_model_parallel()
+        mesh = parallel_state.initialize_model_parallel()
+        params = jax.tree.map(lambda x: x.astype(jnp.bfloat16), _params())
+        spec = {"w1": P(), "b1": P(), "w2": P()}
+
+        def loss_fn(p, batch, rng):
+            p32 = jax.tree.map(lambda x: x.astype(jnp.float32), p)
+            h = jnp.tanh(batch["x"] @ p32["w1"] + p32["b1"])
+            return jnp.mean((h @ p32["w2"] - batch["y"]) ** 2)
+
+        opt_state = optimizer.init(params, spec)
+        step = make_train_step(
+            loss_fn, optimizer, mesh, spec,
+            {"x": P("data"), "y": P("data")},
+            opt_state_spec=optimizer.state_spec(params, spec))
+        x = jax.random.normal(jax.random.PRNGKey(1), (16, 16))
+        y = jax.random.normal(jax.random.PRNGKey(2), (16, 4))
+        p, s = params, opt_state
+        losses = []
+        for _ in range(steps):
+            p, s, loss = step(p, s, {"x": x, "y": y}, None)
+            losses.append(float(loss))
+        parallel_state.destroy_model_parallel()
+        return losses, jax.device_get(p), s
+
+    def test_bf16_gather_matches_fp32_gather(self):
+        """Auto gather dtype (bf16 for all-bf16 params) is LOSSLESS vs an
+        explicit fp32 gather: the gathered values are cast to the leaf
+        dtype anyway, and the cast commutes with all_gather."""
+        a_losses, a_p, _ = self._train_bf16(
+            DistributedFusedAdam(lr=1e-2, num_shards=8))
+        b_losses, b_p, _ = self._train_bf16(
+            DistributedFusedAdam(lr=1e-2, num_shards=8,
+                                 gather_dtype=jnp.float32))
+        np.testing.assert_allclose(a_losses, b_losses, rtol=1e-6)
+        jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a, np.float32), np.asarray(b, np.float32)), a_p, b_p)
+
+    def test_fp32_params_default_to_fp32_gather(self):
+        opt = DistributedFusedAdam(lr=1e-2, num_shards=8)
+        assert opt._resolve_gather_dtype(_params()) == jnp.float32
+        bf16 = jax.tree.map(lambda x: x.astype(jnp.bfloat16), _params())
+        assert opt._resolve_gather_dtype(bf16) == jnp.bfloat16
+        mixed = dict(bf16, w1=_params()["w1"])
+        assert opt._resolve_gather_dtype(mixed) == jnp.float32
+
+    def test_store_param_remainders_matches_master_mode(self):
+        """(bf16 image + int16 remainder) storage follows the fp32-master
+        trajectory; differences are bounded by round-half-up vs
+        round-nearest-even 1-ulp ties in the gathered image."""
+        a_losses, _, a_s = self._train_bf16(
+            DistributedFusedAdam(lr=1e-2, num_shards=8))
+        b_losses, _, b_s = self._train_bf16(
+            DistributedFusedAdam(lr=1e-2, num_shards=8,
+                                 store_param_remainders=True))
+        np.testing.assert_allclose(a_losses, b_losses, rtol=2e-2, atol=1e-4)
+        assert "master" not in b_s
+        assert b_s["master_rem"].dtype == jnp.int16
+        # reconstruction is exact: master == image<<16 + remainder
+        opt = DistributedFusedAdam(lr=1e-2, num_shards=1)
+        m = jnp.asarray([1.0000123, -3.5e-4, 2.75, 0.0, 1e30], jnp.float32)
+        img, rem = opt._remainder_split(m)
+        np.testing.assert_array_equal(
+            np.asarray(opt._master_from_remainder(
+                img.astype(jnp.float32), rem)), np.asarray(m))
+
+    def test_remainders_reject_non_bf16(self):
+        opt = DistributedFusedAdam(lr=1e-2, num_shards=1,
+                                   store_param_remainders=True)
+        with pytest.raises(ValueError, match="bfloat16"):
+            opt.init(_params())
+
+    def test_e5m2_gather_converges(self):
+        """The reference's e5m2_allgather analog: lossy, but training still
+        converges on the toy problem."""
+        losses, _, _ = self._train_bf16(
+            DistributedFusedAdam(lr=1e-2, num_shards=8,
+                                 gather_dtype=jnp.float8_e5m2), steps=60)
+        assert losses[-1] < losses[0] * 0.5
+
+
 class TestCheckpoint:
     def test_roundtrip(self, tmp_path):
         from apex_tpu.checkpoint import load_checkpoint, save_checkpoint
