@@ -154,9 +154,8 @@ def decode_step(model, params, caches, tokens: jax.Array, index):
     (fp32 full-vocab logits [batch, V], updated caches). ``caches`` is
     either form :func:`init_kv_caches` produces — the stacked ``(k, v)``
     pair or the per-layer list (the form ``generate()`` decodes with) —
-    and the return matches the input form. MoE models route drop-free
-    here (single-token steps); see :func:`generate` for the prefill
-    capacity caveat."""
+    and the return matches the input form. MoE models route drop-free on
+    the cache path (prefill and decode; see :func:`generate`)."""
     logits, new_caches = _cached_forward(model, params, caches,
                                          tokens[:, None], index)
     return logits[0], new_caches
@@ -174,13 +173,16 @@ def generate(model, params, prompt: jax.Array, max_new_tokens: int, *,
     finished rows (they keep emitting ``eos_token``). Fully jittable; decode
     runs as one ``lax.scan``.
 
-    MoE capacity caveat: single-token decode steps route drop-free, but the
-    batched cached **prefill** uses factor-based expert capacity
-    (``moe_capacity_factor``) — so decode-vs-full-forward logit parity for
-    MoE models holds exactly only when the prefill drops no tokens (choose
-    ``moe_capacity_factor`` generously, e.g. ``num_experts``, for exact
-    parity; training-default factors may drop prompt tokens and shift
-    logits slightly).
+    MoE models route DROP-FREE on the whole generation path — batched
+    prefill and single-token decode alike (round 5; factor-based capacity
+    drops are a training-time load-balancing trade) — so cached logits
+    match the drop-free serving forward
+    (``model.apply(..., moe_drop_free=True)``) at ANY
+    ``moe_capacity_factor``: no capacity-induced divergence remains. (As
+    in any MoE system, a router whose top-k gap for some token is below
+    the numerical noise between two differently-shaped computations can
+    still flip that token's expert; trained routers are confident,
+    random-init ones are not.)
     """
     if temperature > 0.0 and rng is None:
         raise ValueError("sampling (temperature > 0) needs rng")

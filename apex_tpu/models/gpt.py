@@ -137,18 +137,24 @@ class GPTModel:
         loss_mask: Optional[jax.Array] = None,
         rng: Optional[jax.Array] = None,
         deterministic: bool = True,
+        moe_drop_free: Optional[bool] = None,
     ):
         """tokens/labels/loss_mask: ``[batch, seq]``.
 
         With ``labels`` returns the scalar masked-mean LM loss (the
         reference's loss path through ``vocab_parallel_cross_entropy``);
         otherwise returns vocab-parallel logits ``[s, b, vocab/tp]``.
+        ``moe_drop_free=True`` routes MoE layers without capacity drops —
+        the serving forward that matches ``generate()``'s cached logits
+        exactly at ANY ``moe_capacity_factor`` (the generation path itself
+        always routes drop-free); default (None) keeps the factor-based
+        training routing.
         """
         rngs = (None, None) if rng is None else tuple(jax.random.split(rng))
         hidden = self._embed(params, tokens, rngs[0], deterministic)
         hidden = self.transformer.apply(
             params["transformer"], hidden, rng=rngs[1],
-            deterministic=deterministic)
+            deterministic=deterministic, moe_drop_free=moe_drop_free)
         moe_aux = None
         if self.config.num_moe_experts:
             hidden, moe_aux = hidden

@@ -824,7 +824,8 @@ class ParallelTransformerLayer:
     def apply(self, params, hidden, *, encoder_output=None,
               enc_dec_attn_mask=None, enc_kv_lengths=None,
               attention_mask=None, kv_lengths=None, kv_cache=None,
-              cache_index=None, rng=None, deterministic=True):
+              cache_index=None, rng=None, deterministic=True,
+              moe_drop_free=None):
         """``encoder_output`` (decoder layers) must be the FULL encoder
         sequence ``[s_enc, b, h]`` — under sequence parallelism gather it
         first (``gather_from_sequence_parallel_region``), as
@@ -880,14 +881,22 @@ class ParallelTransformerLayer:
         if c.num_moe_experts:
             moe_rng = (None if rngs[1] is None
                        else jax.random.fold_in(rngs[1], 1))
-            # drop-free capacity only for single-token decode steps (tiny
-            # per-step token counts make factor-based capacity drop tokens
-            # batch-size-dependently); batched prefill keeps the factor rule
-            # — cap = tokens there would blow dispatch up to [T, E, T]
+            # drop-free routing on the whole generation path (prefill AND
+            # single-token decode) and wherever the caller asks
+            # (moe_drop_free=True = the serving forward): factor-based
+            # capacity drops are a TRAINING load-balancing trade, and a
+            # capacity prefill would disagree with the drop-free decode
+            # steps it seeds (round 5; the round-4 caveat in generate()).
+            # Cost model: E/top_k x the routed FLOPs either way; above 512
+            # tokens SwitchMLP switches to its dense per-expert scan
+            # (O(T*ffn) memory — the cap=T one-hot machinery is quadratic
+            # in T), below it the one-shot capacity dispatch.
+            if moe_drop_free is None:
+                moe_drop_free = kv_cache is not None
             mlp_out, aux = self.mlp.apply(
                 params["mlp"], x.astype(c.compute_dtype),
                 rng=moe_rng, deterministic=deterministic,
-                drop_free=kv_cache is not None and x.shape[0] == 1)
+                drop_free=moe_drop_free)
         else:
             mlp_out = self.mlp.apply(params["mlp"], x.astype(c.compute_dtype))
             aux = None
@@ -938,7 +947,7 @@ class ParallelTransformer:
               enc_dec_attn_mask=None, enc_kv_lengths=None,
               attention_mask=None, kv_lengths=None, kv_caches=None,
               cache_index=None, rng=None, deterministic=True,
-              final_norm=True):
+              final_norm=True, moe_drop_free=None):
         """Returns ``hidden`` — or ``(hidden, moe_aux_loss)`` (aux summed
         over layers) when the config enables MoE, or ``(hidden, new_caches)``
         when decoding with ``kv_caches`` — either ``(k, v)`` stacked
@@ -995,7 +1004,8 @@ class ParallelTransformer:
                     attention_mask=attention_mask,
                     kv_lengths=kv_lengths, kv_cache=layer_cache,
                     cache_index=cache_index, rng=layer_rng,
-                    deterministic=deterministic)
+                    deterministic=deterministic,
+                    moe_drop_free=moe_drop_free)
                 new_caches.append(new_cache)
             if final_norm:
                 h = _ln(params["final_layernorm"], h, c.layernorm_epsilon,
@@ -1018,7 +1028,8 @@ class ParallelTransformer:
                     attention_mask=attention_mask,
                     kv_lengths=kv_lengths, kv_cache=layer_cache,
                     cache_index=cache_index, rng=layer_rng,
-                    deterministic=deterministic)
+                    deterministic=deterministic,
+                    moe_drop_free=moe_drop_free)
                 if layer_cache is not None:
                     return out        # (h, new_cache)
                 return out if moe else (out, jnp.zeros((), jnp.float32))

@@ -112,6 +112,42 @@ def _causal_block_skip(i, j, bq, bk, causal, window, q_off, k_off):
     return keep
 
 
+def _causal_block_full(i, j, bq, bk, causal, q_off, k_off):
+    """True when EVERY element of block (i, j) is causally valid (the
+    block sits entirely on/below the diagonal): its mask arithmetic —
+    two iotas, compares, selects over bq x bk elements — can be skipped.
+    At long sequence almost every live block is interior (32k at
+    (1024,1024): 496 of 528), and the mask was ~4 of the ~9 VPU ops per
+    softmax element (round 5). Callers must separately establish that no
+    window/varlen/key-padding mask applies."""
+    if not causal:
+        return True
+    return j * bk + bk - 1 + k_off <= i * bq + q_off
+
+
+def _when_blocks(step, keep, i, j, bq, bk, causal, window, have_kvl, pad,
+                 q_off, k_off):
+    """The one block-dispatch gate every flash kernel (fwd/dq/dkv) shares:
+    ``step(masked)`` returns the kernel-body thunk with or without mask
+    arithmetic; live interior causal blocks run the unmasked variant (see
+    :func:`_causal_block_full`), everything else the masked one, and
+    ``keep`` (the caller's :func:`_causal_block_skip`, possibly clamped
+    for banded grids) gates liveness. Single-sourced so forward and
+    backward masking can never desynchronize."""
+    if causal or window is not None:
+        if causal and window is None and not have_kvl and not pad:
+            full = _causal_block_full(i, j, bq, bk, causal, q_off, k_off)
+            pl.when(jnp.logical_and(keep, full))(step(False))
+            pl.when(jnp.logical_and(keep, jnp.logical_not(full)))(
+                step(True))
+        else:
+            pl.when(keep)(step(True))
+    elif have_kvl or pad:
+        step(True)()
+    else:
+        step(False)()
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
@@ -240,37 +276,39 @@ def _fwd_kernel(offs_ref, kvl_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    def _step():
-        q = q_ref[0, 0]
-        k = k_ref[0, 0]
-        v = v_ref[0, 0]
-        kvl = kvl_ref[b] if kvl_ref is not None else None
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        s, valid = _mask_block(s, i, j, bq, bk, sk, kvl, causal, window,
-                               q_off, k_off)
-        m_prev = m_scr[:, :1]
-        l_prev = l_scr[:, :1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
-        l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
-        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot(
-            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
-        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
-        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+    def _step(masked):
+        def go():
+            q = q_ref[0, 0]
+            k = k_ref[0, 0]
+            v = v_ref[0, 0]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            if masked:
+                kvl = kvl_ref[b] if kvl_ref is not None else None
+                s, valid = _mask_block(s, i, j, bq, bk, sk, kvl, causal,
+                                       window, q_off, k_off)
+            m_prev = m_scr[:, :1]
+            l_prev = l_scr[:, :1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+            alpha = jnp.exp(m_prev - m_new)
+            p = (jnp.where(valid, jnp.exp(s - m_new), 0.0) if masked
+                 else jnp.exp(s - m_new))
+            l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+            acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot(
+                p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+            m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+            l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+        return go
 
-    if causal or window is not None:
-        keep = _causal_block_skip(i, j, bq, bk, causal, window,
-                                  q_off, k_off)
-        if win_grid is not None:
-            # banded grid can run past the last real k-block at the bottom
-            # rows; those steps are skipped (their DMA is clipped in the
-            # index maps)
-            keep = jnp.logical_and(keep, j <= nk - 1)
-        pl.when(keep)(_step)
-    else:
-        _step()
+    keep = _causal_block_skip(i, j, bq, bk, causal, window, q_off, k_off)
+    if win_grid is not None:
+        # banded grid can run past the last real k-block at the bottom
+        # rows; those steps are skipped (their DMA is clipped in the
+        # index maps)
+        keep = jnp.logical_and(keep, j <= nk - 1)
+    _when_blocks(_step, keep, i, j, bq, bk, causal, window,
+                 kvl_ref is not None, nk * bk != sk, q_off, k_off)
 
     @pl.when(jl == pl.num_programs(3) - 1)
     def _finish():
@@ -399,25 +437,26 @@ def _dq_kernel(offs_ref, kvl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    def _step():
-        k = k_ref[0, 0]
-        kvl = kvl_ref[b] if kvl_ref is not None else None
-        _, ds = _recompute_p_ds(
-            q_ref[0, 0], k, v_ref[0, 0], do_ref[0, 0],
-            lse_ref[0, 0].reshape(1, bq).T, delta_ref[0, 0].reshape(1, bq).T,
-            i, j, scale=scale, bq=bq, bk=bk, sk=sk, kvl=kvl, causal=causal,
-            window=window, q_off=q_off, k_off=k_off)
-        dq_scr[:] = dq_scr[:] + scale * jax.lax.dot(
-            ds.astype(k.dtype), k, preferred_element_type=jnp.float32)
+    def _step(masked):
+        def go():
+            k = k_ref[0, 0]
+            kvl = kvl_ref[b] if kvl_ref is not None else None
+            _, ds = _recompute_p_ds(
+                q_ref[0, 0], k, v_ref[0, 0], do_ref[0, 0],
+                lse_ref[0, 0].reshape(1, bq).T,
+                delta_ref[0, 0].reshape(1, bq).T,
+                i, j, scale=scale, bq=bq, bk=bk, sk=sk, kvl=kvl,
+                causal=causal, window=window, q_off=q_off, k_off=k_off,
+                need_mask=masked)
+            dq_scr[:] = dq_scr[:] + scale * jax.lax.dot(
+                ds.astype(k.dtype), k, preferred_element_type=jnp.float32)
+        return go
 
-    if causal or window is not None:
-        keep = _causal_block_skip(i, j, bq, bk, causal, window,
-                                  q_off, k_off)
-        if win_grid is not None:
-            keep = jnp.logical_and(keep, j <= nk - 1)
-        pl.when(keep)(_step)
-    else:
-        _step()
+    keep = _causal_block_skip(i, j, bq, bk, causal, window, q_off, k_off)
+    if win_grid is not None:
+        keep = jnp.logical_and(keep, j <= nk - 1)
+    _when_blocks(_step, keep, i, j, bq, bk, causal, window,
+                 kvl_ref is not None, nk * bk != sk, q_off, k_off)
 
     @pl.when(jl == pl.num_programs(3) - 1)
     def _finish():
@@ -444,30 +483,32 @@ def _dkv_kernel(offs_ref, kvl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    def _step():
-        q = q_ref[0, 0]
-        do = do_ref[0, 0]
-        kvl = kvl_ref[b] if kvl_ref is not None else None
-        p, ds = _recompute_p_ds(
-            q, k_ref[0, 0], v_ref[0, 0], do,
-            lse_ref[0, 0].reshape(1, bq).T, delta_ref[0, 0].reshape(1, bq).T,
-            i, j, scale=scale, bq=bq, bk=bk, sk=sk, kvl=kvl, causal=causal,
-            window=window, q_off=q_off, k_off=k_off)
-        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
-            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        dk_scr[:] = dk_scr[:] + scale * jax.lax.dot_general(
-            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+    def _step(masked):
+        def go():
+            q = q_ref[0, 0]
+            do = do_ref[0, 0]
+            kvl = kvl_ref[b] if kvl_ref is not None else None
+            p, ds = _recompute_p_ds(
+                q, k_ref[0, 0], v_ref[0, 0], do,
+                lse_ref[0, 0].reshape(1, bq).T,
+                delta_ref[0, 0].reshape(1, bq).T,
+                i, j, scale=scale, bq=bq, bk=bk, sk=sk, kvl=kvl,
+                causal=causal, window=window, q_off=q_off, k_off=k_off,
+                need_mask=masked)
+            dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+                p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dk_scr[:] = dk_scr[:] + scale * jax.lax.dot_general(
+                ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        return go
 
-    if causal or window is not None:
-        keep = _causal_block_skip(i, j, bq, bk, causal, window,
-                                  q_off, k_off)
-        if win_grid is not None:
-            keep = jnp.logical_and(keep, i <= nq - 1)
-        pl.when(keep)(_step)
-    else:
-        _step()
+    keep = _causal_block_skip(i, j, bq, bk, causal, window, q_off, k_off)
+    if win_grid is not None:
+        keep = jnp.logical_and(keep, i <= nq - 1)
+    _when_blocks(_step, keep, i, j, bq, bk, causal, window,
+                 kvl_ref is not None, pl.num_programs(2) * bk != sk,
+                 q_off, k_off)
 
     @pl.when(t == pl.num_programs(3) - 1)
     def _finish():

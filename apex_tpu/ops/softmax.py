@@ -32,9 +32,11 @@ _VMEM_BUDGET = 4 * 1024 * 1024
 
 def _block_rows(kp: int) -> int:
     # fp32 rows (8-sublane); policy + cap tuning shared with the LN
-    # kernels (ops/_support.block_rows); softmax's old local copy capped
-    # at 512 — the A/B showed caps 256-512 equivalent, so unifying on the
-    # shared default loses nothing
+    # kernels (ops/_support.block_rows). Cap 256 vs 512 measured ON THE
+    # SOFTMAX KERNEL itself (round 5, interleaved same-process A/B,
+    # fwd+bwd at 8192 rows x k=1024/2048): equal within 0.5% at both
+    # key lengths, so unifying on the shared 256 default loses nothing
+    # (ADVICE r4 flagged that the earlier A/B was LN-only).
     return block_rows(kp, jnp.float32, vmem_budget=_VMEM_BUDGET)
 
 

@@ -129,10 +129,18 @@ class SyncBatchNorm(nn.Module):
                 sample_mask=sample_mask)
             if self.track_running_stats and not self.is_initializing():
                 # unbiased variance for running stats (reference matches
-                # torch BN semantics)
+                # torch BN semantics); a fully-masked global batch
+                # (count == 0) must be a true no-op on the running stats —
+                # the count guard zeroes mean/var, and blending those in
+                # would decay the stats toward 0 (ADVICE r4)
                 unbiased = var * count / jnp.maximum(count - 1.0, 1.0)
-                ra_mean.value = (1 - self.momentum) * ra_mean.value + self.momentum * mean
-                ra_var.value = (1 - self.momentum) * ra_var.value + self.momentum * unbiased
+                keep = count > 0
+                ra_mean.value = jnp.where(
+                    keep, (1 - self.momentum) * ra_mean.value
+                    + self.momentum * mean, ra_mean.value)
+                ra_var.value = jnp.where(
+                    keep, (1 - self.momentum) * ra_var.value
+                    + self.momentum * unbiased, ra_var.value)
 
         shape = [1] * x.ndim
         ch_axis = x.ndim - 1 if self.channel_last else 1

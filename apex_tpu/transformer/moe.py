@@ -165,6 +165,19 @@ class SwitchMLP:
         tokens = s * b
         x2d = x.reshape(tokens, h)
         weights, experts, aux = self._route(params, x2d, rng, deterministic)
+        if drop_free and tokens > 512:
+            # DENSE drop-free evaluation for batched token counts (round
+            # 5): the capacity machinery with cap = tokens builds
+            # [T, E, T] dispatch/combine one-hots — QUADRATIC in tokens
+            # (a 32k-token 64-expert prefill would need ~275 GB) — and
+            # computes every buffer slot anyway. Scanning local experts
+            # over all tokens pays the same E/top_k FLOP blowup with
+            # O(T * ffn) memory; under EP each rank runs its local
+            # experts and one psum replaces both all_to_alls. Small
+            # token counts (single-token decode) keep the one-shot
+            # capacity dispatch below.
+            y = self._dense_drop_free(params, x2d, weights, experts)
+            return y.reshape(s, b, h).astype(x.dtype), aux
         cap = tokens if drop_free else self._capacity(tokens)
 
         # position of each token within its expert's capacity buffer, one
@@ -225,3 +238,58 @@ class SwitchMLP:
         y = jnp.einsum("tec,ech->th", combine.astype(jnp.float32),
                        out.astype(jnp.float32))
         return y.reshape(s, b, h).astype(x.dtype), aux
+
+    def _dense_drop_free(self, params, x2d, weights, experts):
+        """Every local expert processes every token; per-token routing
+        weights combine the results (exactly the drop-free capacity math,
+        without its [T, E, cap] one-hots). Returns fp32 ``[T, h]``."""
+        c = self.config
+        tokens, h = x2d.shape
+        wte = jnp.zeros((tokens, c.num_experts), jnp.float32)
+        for k in range(c.top_k):
+            wte = wte + (jax.nn.one_hot(experts[:, k], c.num_experts,
+                                        dtype=jnp.float32)
+                         * weights[:, k:k + 1].astype(jnp.float32))
+        ep = (lax.axis_size(c.expert_axis)
+              if c.expert_axis and axis_bound(c.expert_axis) else 1)
+        if ep > 1:
+            divide(c.num_experts, ep)
+            # the token batch is SHARDED along the expert axis (EP rides
+            # DP), so shard-local partials must not be psum'd as-is (each
+            # rank's rows are DIFFERENT tokens — the capacity path handles
+            # this with its all_to_all pair): gather every rank's tokens
+            # and routing weights, let the local experts process the full
+            # set, psum the partial outputs, then slice this rank's rows
+            # back out
+            e_local = c.num_experts // ep
+            idx = lax.axis_index(c.expert_axis)
+            x2d = lax.all_gather(x2d, c.expert_axis, axis=0, tiled=True)
+            wte = lax.all_gather(wte, c.expert_axis, axis=0, tiled=True)
+            wte = lax.dynamic_slice(
+                wte, (jnp.int32(0), idx * e_local),
+                (x2d.shape[0], e_local))
+        cd = c.compute_dtype
+        xc = x2d.astype(cd)
+
+        def one_expert(y, ew):
+            if c.gated:
+                w_in, w_out, b_out, w_col = ew
+                hm = xc @ w_in.astype(cd)
+            else:
+                w_in, b_in, w_out, b_out, w_col = ew
+                hm = xc @ w_in.astype(cd) + b_in.astype(cd)
+            hm = apply_activation(hm, c.activation)
+            oe = hm @ w_out.astype(cd) + b_out.astype(cd)
+            return y + w_col[:, None] * oe.astype(jnp.float32), None
+
+        if c.gated:
+            xs = (params["w_in"], params["w_out"], params["b_out"], wte.T)
+        else:
+            xs = (params["w_in"], params["b_in"], params["w_out"],
+                  params["b_out"], wte.T)
+        y, _ = lax.scan(one_expert,
+                        jnp.zeros((x2d.shape[0], h), jnp.float32), xs)
+        if ep > 1:
+            y = lax.psum(y, c.expert_axis)
+            y = lax.dynamic_slice_in_dim(y, idx * tokens, tokens, axis=0)
+        return y

@@ -24,25 +24,20 @@ import numpy as np
 from apex_tpu.amp import fp8
 
 M, K, N = 8192, 1024, 4096
-ITERS = 50
-
-
-def _time(run, *args):
-    out = run(*args)
-    np.asarray(jax.tree.leaves(out)[0]).ravel()[0]
-    best = float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        out = run(*args)
-        np.asarray(jax.tree.leaves(out)[0]).ravel()[0]
-        best = min(best, (time.perf_counter() - t0) / ITERS)
-    return best
+ITERS = 150   # ~400 ms/chain: 5-20 ms tunnel dispatch amortizes to <5%
+              # per endpoint; interleaved windows tighten the RATIO to ~3%
+              # (round 4's 50-iter chain had +-15% noise and a verdict
+              # range that excluded the driver's own capture — VERDICT r4)
 
 
 def main():
     x = jax.random.normal(jax.random.PRNGKey(0), (M, K), jnp.bfloat16)
     w = jax.random.normal(jax.random.PRNGKey(1), (K, N), jnp.bfloat16)
     state = fp8.init_fp8_state(("x", "w"))
+    # probe, don't assume (ADVICE r4: the row hardcoded True; on a backend
+    # without the native dot the bench just crashed and the recorded claim
+    # would be wrong if copied to another platform)
+    native = bool(fp8.native_fp8_dot_supported())
 
     # sum(y^2): the cotangent is 2y, a real data-dependent matrix — a
     # plain sum(y) makes dL/dy all-ones, which XLA folds into reductions
@@ -52,7 +47,7 @@ def main():
     # re-quantized each iteration, as in real training), and the
     # delayed-scaling amax updates remain in the timed program.
     def fp8_loss(x, w, state):
-        y, state = fp8.fp8_dense(x, w, state, native=True)
+        y, state = fp8.fp8_dense(x, w, state, native=native)
         y32 = y.astype(jnp.float32)
         return jnp.sum(y32 * y32), state
 
@@ -84,16 +79,31 @@ def main():
         carry, _ = jax.lax.scan(body, (x, w), None, length=ITERS)
         return carry[0]
 
-    t8 = _time(run_fp8, x, w, state)
-    tb = _time(run_bf16, x, w)
+    def _one(run, *args):
+        t0 = time.perf_counter()
+        out = run(*args)
+        np.asarray(jax.tree.leaves(out)[0]).ravel()[0]
+        return (time.perf_counter() - t0) / ITERS
+
+    # warmup both, then INTERLEAVE the timing windows (A,B,A,B...): slow
+    # tunnel drift hits both configs equally, so the best-of ratio is
+    # pinned far tighter than two separate best-of-3 blocks
+    _one(run_fp8, x, w, state)
+    _one(run_bf16, x, w)
+    t8 = tb = float("inf")
+    for _ in range(4):
+        t8 = min(t8, _one(run_fp8, x, w, state))
+        tb = min(tb, _one(run_bf16, x, w))
     flops = 3 * 2 * M * K * N            # fwd + dx + dw matmuls
     print(json.dumps({
-        "metric": "fp8_dense_native_fwd_bwd_tflops",
+        "metric": ("fp8_dense_native_fwd_bwd_tflops" if native
+                   else "fp8_dense_qdq_fwd_bwd_tflops"),
         "value": round(flops / t8 / 1e12, 1), "unit": "TFLOP/s",
         "vs_baseline": round(tb / t8, 3),
-        "config": {"shape": [M, K, N],
-                   "native_fp8_dot_supported": True,
-                   "baseline": "same GEMM chain in bf16",
+        "config": {"shape": [M, K, N], "iters": ITERS,
+                   "native_fp8_dot_supported": native,
+                   "baseline": "same GEMM chain in bf16 (interleaved "
+                               "windows)",
                    "note": "v5e MXU executes fp8 operands without fp8 "
                            "units; vs_baseline < 1 means fp8 costs time "
                            "on this generation"}}))

@@ -38,15 +38,15 @@ class TestDecodeStep:
                 rtol=2e-4, atol=2e-4)
 
     def test_moe_cached_logits_match_full_forward(self):
-        # capacity_factor = num_experts -> no capacity drops, so the routed
-        # expert outputs are identical between the batched full forward and
-        # the per-token decode steps (drop patterns otherwise differ with
-        # the per-call token count)
-        model = _model(num_moe_experts=4, moe_capacity_factor=4.0,
-                       moe_top_k=2)
+        # TRAINING-DEFAULT capacity factor (1.25): the cache path routes
+        # drop-free (round 5), and the matching baseline is the drop-free
+        # serving forward — parity is unconditional in the factor, where
+        # round 4 needed capacity_factor = num_experts to avoid drops
+        model = _model(num_moe_experts=4, moe_top_k=2)
+        assert model.config.moe_capacity_factor == 1.25  # the default
         params = model.init(jax.random.PRNGKey(0))
         tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, 64)
-        full = model.apply(params, tokens)
+        full = model.apply(params, tokens, moe_drop_free=True)
         caches = init_kv_caches(model, 2, 12)
         for i in range(8):
             logits, caches = decode_step(model, params, caches,
@@ -54,6 +54,13 @@ class TestDecodeStep:
             np.testing.assert_allclose(
                 np.asarray(logits), np.asarray(full[i]).astype(np.float32),
                 rtol=2e-4, atol=2e-4)
+        # the prefill (cached, batched) agrees with the decode steps too
+        from apex_tpu.models.generation import _cached_forward
+        caches2 = init_kv_caches(model, 2, 12)
+        pre, _ = _cached_forward(model, params, caches2, tokens, 0)
+        np.testing.assert_allclose(np.asarray(pre),
+                                   np.asarray(full).astype(np.float32),
+                                   rtol=2e-4, atol=2e-4)
 
     def test_moe_generate_runs(self):
         model = _model(num_moe_experts=4, moe_capacity_factor=4.0)

@@ -104,6 +104,57 @@ class TestDense:
         assert float(jnp.sum(jnp.abs(g["w_in"]))) > 0
 
 
+class TestDenseDropFree:
+    """The >512-token drop-free path (round 5): dense per-expert scan with
+    O(T*ffn) memory instead of the [T, E, cap] one-hots (quadratic at
+    cap = tokens — the review's 32k/64-expert prefill example is ~275 GB)."""
+
+    def test_matches_dropless_capacity_path(self):
+        # capacity_factor = E/top_k => cap = tokens on the factor path too,
+        # so both paths are drop-free and must agree
+        cfg = _cfg(num_experts=4, top_k=2, capacity_factor=2.0)
+        moe = SwitchMLP(cfg)
+        params = moe.init(jax.random.PRNGKey(0))
+        x = _x(s=48, b=16)                      # 768 tokens > 512 gate
+        y_dense, aux_d = jax.jit(
+            lambda p, x: moe.apply(p, x, drop_free=True))(params, x)
+        y_cap, aux_c = jax.jit(lambda p, x: moe.apply(p, x))(params, x)
+        np.testing.assert_allclose(np.asarray(y_dense), np.asarray(y_cap),
+                                   rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(float(aux_d), float(aux_c), rtol=1e-5)
+
+    def test_ep_dense_drop_free_matches_unsharded(self):
+        """Tokens SHARDED over the expert axis (EP rides DP — each rank
+        holds different tokens): the dense path must gather tokens before
+        its expert scan and slice its shard back after the psum; a
+        shard-local psum would silently sum different ranks' tokens (r5
+        review). Per-rank tokens exceed the 512 dense gate."""
+        parallel_state.destroy_model_parallel()
+        mesh = parallel_state.initialize_model_parallel()   # data = 8
+        dense = SwitchMLP(_cfg(top_k=2, expert_axis=None))
+        ep = SwitchMLP(_cfg(top_k=2, expert_axis="data"))
+        params = dense.init(jax.random.PRNGKey(0))
+        x = _x(s=80, b=64)                # 5120 tokens = 640/rank > 512
+        y_ref, _ = dense.apply(params, x, drop_free=True)
+        y, _ = jax.jit(jax.shard_map(
+            lambda p, x: ep.apply(p, x, drop_free=True), mesh=mesh,
+            in_specs=(ep.spec(), P(None, "data")),
+            out_specs=(P(None, "data"), P()), check_vma=False))(params, x)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref),
+                                   rtol=2e-5, atol=2e-6)
+        parallel_state.destroy_model_parallel()
+
+    def test_gated_activation_dense_path(self):
+        cfg = _cfg(num_experts=4, top_k=1, activation="swiglu")
+        moe = SwitchMLP(cfg)
+        params = moe.init(jax.random.PRNGKey(0))
+        assert "b_in" not in params             # gated experts bias-free
+        x = _x(s=48, b=16)
+        y, aux = jax.jit(
+            lambda p, x: moe.apply(p, x, drop_free=True))(params, x)
+        assert np.isfinite(np.asarray(y)).all()
+
+
 class TestExpertParallel:
     def test_ep_matches_dense(self):
         """EP over the data axis == dense dispatch, same params/inputs."""

@@ -308,6 +308,31 @@ def test_syncbn_unequal_batches_grads(data_mesh):
     np.testing.assert_allclose(g[~mask], 0.0, atol=1e-6)
 
 
+def test_syncbn_all_masked_batch_is_noop_on_running_stats():
+    """A fully-padded global batch must leave batch_stats untouched —
+    unguarded, the momentum blend decays them toward the count-guard's
+    zero mean/var (ADVICE r4)."""
+    from apex_tpu.parallel import SyncBatchNorm
+
+    bn = SyncBatchNorm(num_features=3, axis_name=None, momentum=0.5)
+    x = jnp.ones((4, 3)) * 2.0
+    variables = bn.init(jax.random.PRNGKey(0), x)
+    # one real step moves the stats off their init values
+    _, v1 = bn.apply(variables, x, sample_mask=jnp.ones((4,), bool),
+                     mutable=["batch_stats"])
+    stats1 = jax.tree.map(np.asarray, v1["batch_stats"])
+    assert stats1["mean"][0] != 0.0
+    # an all-masked step is a no-op
+    _, v2 = bn.apply({"params": variables["params"],
+                      "batch_stats": v1["batch_stats"]}, x,
+                     sample_mask=jnp.zeros((4,), bool),
+                     mutable=["batch_stats"])
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        stats1, jax.tree.map(np.asarray, v2["batch_stats"]))
+
+
 def test_bn_apply_sample_mask():
     """Functional bn_apply counterpart (the vision-model path): masked NHWC
     rows drop out of the count-weighted stats."""
