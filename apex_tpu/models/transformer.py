@@ -36,6 +36,8 @@ from jax.sharding import PartitionSpec
 
 from apex_tpu.ops import (
     flash_attention,
+    flash_attention_packed,
+    packed_attention_supported,
     fused_layer_norm_affine,
     fused_rms_norm_affine,
 )
@@ -667,6 +669,24 @@ class ParallelAttention:
                     f"block = {block}); num_query_groups ({c.kv_heads}) "
                     f"must be divisible by the tensor-parallel size")
             local_groups = qkv.shape[-1] // block
+            # layout-native fast path: feed the packed projection straight
+            # to the attention kernel and get ctx back in [s, b, h*dh] —
+            # no [b,h,s,dh] transposes in either direction, and the VJP
+            # emits the packed dqkv cotangent the wgrad GEMM wants (at
+            # 355M the transposes + cotangent reassembly were ~18 ms of a
+            # 202 ms step — PERF.md round 5)
+            if (kv_cache is None and attention_mask is None
+                    and c.position_embedding_type != "rope"
+                    and not c.context_parallel_method
+                    and (deterministic or c.attention_dropout == 0.0)
+                    and packed_attention_supported(s, local_groups, qpg,
+                                                   dh)):
+                ctx = flash_attention_packed(
+                    qkv, queries_per_group=qpg, head_dim=dh,
+                    causal=c.attn_mask_type == AttnMaskType.causal,
+                    kv_lengths=kv_lengths,
+                    sliding_window=c.sliding_window)
+                return self.dense.apply(params["dense"], ctx)
             qkv = qkv.reshape(s, b, local_groups, qpg + 2, dh)
             q = qkv[:, :, :, :qpg].reshape(s, b, local_groups * qpg, dh)
             k = qkv[:, :, :, qpg]

@@ -16,7 +16,11 @@ from apex_tpu.ops.cross_entropy import (
     softmax_cross_entropy_loss,
     SoftmaxCrossEntropyLoss,
 )
-from apex_tpu.ops.attention import flash_attention
+from apex_tpu.ops.attention import (
+    flash_attention,
+    flash_attention_packed,
+    packed_attention_supported,
+)
 from apex_tpu.ops.ring_attention import ring_attention, ulysses_attention
 from apex_tpu.ops.rope import (
     fused_rope,
@@ -41,6 +45,8 @@ __all__ = [
     "fused_rope_thd",
     "fused_rope_2d",
     "flash_attention",
+    "flash_attention_packed",
+    "packed_attention_supported",
     "ring_attention",
     "ulysses_attention",
 ]

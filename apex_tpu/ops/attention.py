@@ -38,7 +38,9 @@ from jax.experimental.pallas import tpu as pltpu
 
 from apex_tpu.ops._support import pallas_interpret, round_up, use_pallas
 
-__all__ = ["flash_attention", "flash_chunk_fwd", "flash_chunk_bwd"]
+__all__ = ["flash_attention", "flash_attention_packed",
+           "packed_attention_supported", "flash_chunk_fwd",
+           "flash_chunk_bwd"]
 
 _NEG_INF = -1e30
 # lse sentinel for fully-masked (padding) query rows: exp(s - BIG) == 0 in the
@@ -576,6 +578,311 @@ def _dqkv_single_kernel(offs_ref, kvl_ref, q_ref, k_ref, v_ref, do_ref,
     def _finish():
         dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# packed-QKV path (layout-native single-block attention)
+# ---------------------------------------------------------------------------
+# The fused QKV projection emits [s, b, G*(qpg+2)*d] with each group's
+# columns ordered q_0..q_{qpg-1} | k | v. The kernels here consume that
+# buffer DIRECTLY (flattened to [s, b*W], one contiguous column block per
+# grid cell) and the backward writes dqkv back in the same packed layout —
+# so the [s,b,..] <-> [b,h,s,d] transposes around the attention call and
+# the [s,b,h,3,d]-minor cotangent reassembly disappear entirely. At 355M
+# those copies were ~18 ms of a 202 ms step (PERF.md round 5); a strided/
+# contiguous DMA A/B measured the layout-native reads at parity with the
+# [b,h,s,d] blocks (428 vs 445 us/call at b8 h16 s1024 d64). Single-block
+# only (s <= 1024, s % 128 == 0): the (s, s) fp32 logits of one cell must
+# fit VMEM, which is also the regime where the copies dominate (at 32k the
+# O(s) copies vanish next to O(s^2) attention work).
+
+
+def packed_geometry(num_groups: int, qpg: int, head_dim: int):
+    """Choose groups-per-cell so both the per-cell qkv slab and the output
+    slab are 128-lane aligned. Returns (gpc, in_w, out_w) or None when no
+    alignment exists (then callers fall back to the 4D path)."""
+    for gpc in (1, 2):
+        if num_groups % gpc:
+            continue
+        in_w = gpc * (qpg + 2) * head_dim
+        out_w = gpc * qpg * head_dim
+        if in_w % 128 == 0 and out_w % 128 == 0:
+            return gpc, in_w, out_w
+    return None
+
+
+def _packed_supported(s, num_groups, qpg, head_dim):
+    return (s % 128 == 0 and s <= 1024 and head_dim % 8 == 0
+            and packed_geometry(num_groups, qpg, head_dim) is not None)
+
+
+def _fwd_packed_kernel(kvl_ref, qkv_ref, o_ref, lse_ref, *, scale, s, d,
+                       qpg, gpc, causal, window, need_mask):
+    """One grid cell = ``gpc`` whole K/V groups of one batch row. Slices are
+    static column offsets into the packed slab; per-head math is the same
+    one-pass softmax as :func:`_fwd_single_kernel` (sq == sk == s, offsets
+    0 — a self-attention block is never fully masked, so no skip gate)."""
+    b = pl.program_id(0)
+    for g in range(gpc):
+        base = g * (qpg + 2) * d
+        k = qkv_ref[:, base + qpg * d: base + (qpg + 1) * d]
+        v = qkv_ref[:, base + (qpg + 1) * d: base + (qpg + 2) * d]
+        for j in range(qpg):
+            q = qkv_ref[:, base + j * d: base + (j + 1) * d]
+            sm = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32
+                                     ) * scale
+            if need_mask:
+                kvl = kvl_ref[b] if kvl_ref is not None else None
+                sm, valid = _mask_block(sm, 0, 0, s, s, s, kvl, causal,
+                                        window, 0, 0)
+                m = jnp.max(sm, axis=1, keepdims=True)
+                p = jnp.where(valid, jnp.exp(sm - m), 0.0)
+            else:
+                m = jnp.max(sm, axis=1, keepdims=True)
+                p = jnp.exp(sm - m)
+            l = jnp.sum(p, axis=1, keepdims=True)
+            o = jax.lax.dot(p.astype(v.dtype), v,
+                            preferred_element_type=jnp.float32)
+            o = o * jnp.where(l > 0, 1.0 / l, 0.0)
+            h = g * qpg + j
+            o_ref[:, h * d:(h + 1) * d] = o.astype(o_ref.dtype)
+            lse = jnp.where(l > 0, m + jnp.log(l), _LSE_PAD)
+            lse_ref[0, h] = lse.reshape(1, s)
+
+
+def _dqkv_packed_kernel(kvl_ref, qkv_ref, do_ref, o_ref, lse_ref,
+                        dqkv_ref, *, scale, s, d, qpg, gpc, causal, window,
+                        need_mask):
+    """Fused one-pass backward writing dq/dk/dv straight into the packed
+    [s, cell-width] layout. dK/dV accumulate over the cell's query group in
+    registers (the whole group lives in one cell by construction). delta
+    (rowwise do . o) is computed in-kernel from the o block — as an XLA
+    pre-pass it cost ~107 us/layer of separate HBM traffic at 355M."""
+    b = pl.program_id(0)
+    for g in range(gpc):
+        base = g * (qpg + 2) * d
+        k = qkv_ref[:, base + qpg * d: base + (qpg + 1) * d]
+        v = qkv_ref[:, base + (qpg + 1) * d: base + (qpg + 2) * d]
+        dk_acc = jnp.zeros((s, d), jnp.float32)
+        dv_acc = jnp.zeros((s, d), jnp.float32)
+        for j in range(qpg):
+            q = qkv_ref[:, base + j * d: base + (j + 1) * d]
+            h = g * qpg + j
+            do = do_ref[:, h * d:(h + 1) * d]
+            delta = jnp.sum(do.astype(jnp.float32)
+                            * o_ref[:, h * d:(h + 1) * d].astype(
+                                jnp.float32),
+                            axis=1, keepdims=True)
+            kvl = kvl_ref[b] if kvl_ref is not None else None
+            p, ds = _recompute_p_ds(
+                q, k, v, do,
+                lse_ref[0, h].reshape(1, s).T,
+                delta,
+                0, 0, scale=scale, bq=s, bk=s, sk=s, kvl=kvl,
+                causal=causal, window=window, q_off=0, k_off=0,
+                need_mask=need_mask)
+            dq = scale * jax.lax.dot(ds.astype(k.dtype), k,
+                                     preferred_element_type=jnp.float32)
+            dqkv_ref[:, base + j * d: base + (j + 1) * d] = \
+                dq.astype(dqkv_ref.dtype)
+            dv_acc = dv_acc + jax.lax.dot_general(
+                p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dk_acc = dk_acc + scale * jax.lax.dot_general(
+                ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        dqkv_ref[:, base + qpg * d: base + (qpg + 1) * d] = \
+            dk_acc.astype(dqkv_ref.dtype)
+        dqkv_ref[:, base + (qpg + 1) * d: base + (qpg + 2) * d] = \
+            dv_acc.astype(dqkv_ref.dtype)
+
+
+def _run_fwd_packed(qkv2, kv_lengths, *, scale, s, batch, W, d, qpg, gpc,
+                    heads, causal, window):
+    """qkv2: [s, batch*W]; returns (o2 [s, batch*heads*d], lse [b,H,1,s])."""
+    _, in_w, out_w = packed_geometry(W // ((qpg + 2) * d), qpg, d)
+    n_cells = W // in_w
+    hpc = gpc * qpg
+    need_mask = causal or window is not None or kv_lengths is not None
+    kvl_spec = []
+    args = []
+    if kv_lengths is not None:
+        kvl_spec = [pl.BlockSpec(memory_space=pltpu.SMEM)]
+        args.append(kv_lengths.astype(jnp.int32))
+    o, lse = pl.pallas_call(
+        _wrap_kernel_nooffs(_fwd_packed_kernel, kv_lengths, scale=scale,
+                            s=s, d=d, qpg=qpg, gpc=gpc, causal=causal,
+                            window=window, need_mask=need_mask),
+        grid=(batch, n_cells),
+        in_specs=kvl_spec + [
+            pl.BlockSpec((s, in_w), lambda b, c: (0, b * n_cells + c)),
+        ],
+        out_specs=[
+            pl.BlockSpec((s, out_w), lambda b, c: (0, b * n_cells + c)),
+            pl.BlockSpec((1, hpc, 1, s), lambda b, c: (b, c, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((s, batch * heads * d), qkv2.dtype),
+            jax.ShapeDtypeStruct((batch, heads, 1, s), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel")),
+        interpret=pallas_interpret(),
+    )(*args, qkv2)
+    return o, lse
+
+
+def _run_bwd_packed(qkv2, do2, o2, lse, kv_lengths, *, scale, s, batch,
+                    W, d, qpg, gpc, heads, causal, window):
+    _, in_w, out_w = packed_geometry(W // ((qpg + 2) * d), qpg, d)
+    n_cells = W // in_w
+    hpc = gpc * qpg
+    need_mask = causal or window is not None or kv_lengths is not None
+    kvl_spec = []
+    args = []
+    if kv_lengths is not None:
+        kvl_spec = [pl.BlockSpec(memory_space=pltpu.SMEM)]
+        args.append(kv_lengths.astype(jnp.int32))
+    return pl.pallas_call(
+        _wrap_kernel_nooffs(_dqkv_packed_kernel, kv_lengths, scale=scale,
+                            s=s, d=d, qpg=qpg, gpc=gpc, causal=causal,
+                            window=window, need_mask=need_mask),
+        grid=(batch, n_cells),
+        in_specs=kvl_spec + [
+            pl.BlockSpec((s, in_w), lambda b, c: (0, b * n_cells + c)),
+            pl.BlockSpec((s, out_w), lambda b, c: (0, b * n_cells + c)),
+            pl.BlockSpec((s, out_w), lambda b, c: (0, b * n_cells + c)),
+            pl.BlockSpec((1, hpc, 1, s), lambda b, c: (b, c, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((s, in_w), lambda b, c: (0, b * n_cells + c)),
+        out_shape=jax.ShapeDtypeStruct(qkv2.shape, qkv2.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel")),
+        interpret=pallas_interpret(),
+    )(*args, qkv2, do2, o2, lse)
+
+
+def _wrap_kernel_nooffs(fn, kv_lengths, **kw):
+    """Like :func:`_wrap_kernel` for the packed kernels (no offsets
+    operand: sq == sk == s, offsets statically zero)."""
+    if kv_lengths is not None:
+        return functools.partial(fn, **kw)
+    return functools.partial(lambda *r, **k2: fn(None, *r, **k2), **kw)
+
+
+def _packed_unpack(qkv, qpg, d):
+    """[s, b, G*(qpg+2)*d] -> q/k/v in [b, h, s, d] (reference path)."""
+    s, b, W = qkv.shape
+    g = W // ((qpg + 2) * d)
+    qkv5 = qkv.reshape(s, b, g, qpg + 2, d)
+    q = qkv5[:, :, :, :qpg].reshape(s, b, g * qpg, d)
+    k = qkv5[:, :, :, qpg]
+    v = qkv5[:, :, :, qpg + 1]
+    return (t.transpose(1, 2, 0, 3) for t in (q, k, v))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6))
+def _flash_packed(qkv, kv_lengths, scale, causal, window, qpg, d):
+    o, _ = _flash_packed_fwd_impl(qkv, kv_lengths, scale, causal, window,
+                                  qpg, d)
+    return o
+
+
+def _packed_geom_of(qkv, qpg, d):
+    s, b, W = qkv.shape
+    g = W // ((qpg + 2) * d)
+    gpc, _, _ = packed_geometry(g, qpg, d)
+    return s, b, W, g, gpc, g * qpg
+
+
+def _flash_packed_fwd_impl(qkv, kv_lengths, scale, causal, window, qpg, d):
+    s, b, W, g, gpc, heads = _packed_geom_of(qkv, qpg, d)
+    o2, lse = _run_fwd_packed(
+        qkv.reshape(s, b * W), kv_lengths, scale=scale, s=s, batch=b, W=W,
+        d=d, qpg=qpg, gpc=gpc, heads=heads, causal=causal, window=window)
+    return o2.reshape(s, b, heads * d), lse
+
+
+def _flash_packed_vjp_fwd(qkv, kv_lengths, scale, causal, window, qpg, d):
+    o, lse = _flash_packed_fwd_impl(qkv, kv_lengths, scale, causal, window,
+                                    qpg, d)
+    return o, (qkv, kv_lengths, o, lse)
+
+
+def _flash_packed_vjp_bwd(scale, causal, window, qpg, d, res, do):
+    qkv, kv_lengths, o, lse = res
+    s, b, W, g, gpc, heads = _packed_geom_of(qkv, qpg, d)
+    dqkv = _run_bwd_packed(
+        qkv.reshape(s, b * W), do.reshape(s, b * heads * d),
+        o.reshape(s, b * heads * d), lse,
+        kv_lengths, scale=scale, s=s, batch=b, W=W, d=d, qpg=qpg, gpc=gpc,
+        heads=heads, causal=causal, window=window)
+    dkvl = (None if kv_lengths is None
+            else np.zeros(kv_lengths.shape, dtype=jax.dtypes.float0))
+    return dqkv.reshape(s, b, W), dkvl
+
+
+_flash_packed.defvjp(_flash_packed_vjp_fwd, _flash_packed_vjp_bwd)
+
+
+def flash_attention_packed(
+    qkv: jax.Array,
+    *,
+    queries_per_group: int,
+    head_dim: int,
+    causal: bool = False,
+    softmax_scale: Optional[float] = None,
+    kv_lengths: Optional[jax.Array] = None,
+    sliding_window: Optional[int] = None,
+) -> jax.Array:
+    """Self-attention over a packed QKV projection, layout-native.
+
+    Args:
+      qkv: ``[s, b, G*(qpg+2)*head_dim]`` — the fused QKV projection output,
+        each group's columns ordered ``q_0..q_{qpg-1} | k | v`` (the
+        ``ParallelAttention`` convention). GQA/MQA falls out of ``G``/
+        ``qpg``; MHA is ``qpg == 1``.
+      queries_per_group: query heads per K/V group (``qpg``).
+
+    Returns ``[s, b, G*qpg*head_dim]`` context in model layout — no
+    [b,h,s,d] transposes on either side of the kernel, and the VJP emits
+    the packed ``dqkv`` cotangent directly (see the section comment).
+    Callers must pre-check :func:`packed_attention_supported`.
+    """
+    s, b, W = qkv.shape
+    qpg, d = queries_per_group, head_dim
+    g = W // ((qpg + 2) * d)
+    if W != g * (qpg + 2) * d:
+        raise ValueError(f"packed width {W} is not a multiple of the group "
+                         f"block {(qpg + 2) * d}")
+    if sliding_window is not None and not causal:
+        raise ValueError("sliding_window requires causal attention")
+    scale = float(softmax_scale if softmax_scale is not None
+                  else 1.0 / np.sqrt(d))
+    if not use_pallas():
+        q, k, v = _packed_unpack(qkv, qpg, d)
+        ctx = _mha_reference(q, k, v, kv_lengths, scale, causal,
+                             sliding_window)
+        return ctx.transpose(2, 0, 1, 3).reshape(s, b, g * qpg * d)
+    if not _packed_supported(s, g, qpg, d):
+        raise ValueError(
+            f"packed attention unsupported for s={s}, groups={g}, "
+            f"qpg={qpg}, d={d} — gate on packed_attention_supported()")
+    return _flash_packed(qkv, kv_lengths, scale, causal, sliding_window,
+                         qpg, d)
+
+
+def packed_attention_supported(s: int, num_groups: int,
+                               queries_per_group: int,
+                               head_dim: int) -> bool:
+    """Whether :func:`flash_attention_packed` has a kernel for this shape
+    (callers fall back to the [b,h,s,d] path otherwise). The pure-XLA
+    reference path accepts anything; this predicate is about the Pallas
+    geometry: 128-lane-aligned cells, one (s, s) block in VMEM."""
+    if not use_pallas():
+        return True
+    return _packed_supported(s, num_groups, queries_per_group, head_dim)
 
 
 def _wrap_kernel(fn, kv_lengths, **kw):

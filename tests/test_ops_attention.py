@@ -263,3 +263,97 @@ class TestBandedWindowGrid:
         np.testing.assert_allclose(np.asarray(out, np.float32),
                                    np.asarray(ref, np.float32),
                                    rtol=2e-2, atol=2e-2)
+
+
+# ---------------------------------------------------------------------------
+# packed-QKV (layout-native) path
+# ---------------------------------------------------------------------------
+
+from apex_tpu.ops.attention import (  # noqa: E402
+    flash_attention_packed,
+    packed_attention_supported,
+    packed_geometry,
+)
+
+
+def _pack_qkv(q, k, v, qpg, d):
+    """[b,h,s,d] triple -> the ParallelAttention packed [s, b, W] layout
+    (per group: q_0..q_{qpg-1} | k | v along the column dim)."""
+    b, h, s, _ = q.shape
+    g = h // qpg
+    q5 = q.transpose(2, 0, 1, 3).reshape(s, b, g, qpg, d)
+    k5 = k.transpose(2, 0, 1, 3)[:, :, :, None]
+    v5 = v.transpose(2, 0, 1, 3)[:, :, :, None]
+    return jnp.concatenate([q5, k5, v5], axis=3).reshape(s, b, -1)
+
+
+class TestPackedQKV:
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("g,qpg", [(4, 1), (2, 2), (1, 4)])
+    def test_fwd_bwd_matches_unpacked(self, causal, g, qpg):
+        s, b, d = 128, 2, 64
+        h = g * qpg
+        q = _rand((b, h, s, d), seed=11)
+        k = _rand((b, g, s, d), seed=12)
+        v = _rand((b, g, s, d), seed=13)
+        qkv = _pack_qkv(q, k, v, qpg, d)
+
+        def packed_loss(qkv):
+            o = flash_attention_packed(qkv, queries_per_group=qpg,
+                                       head_dim=d, causal=causal)
+            return jnp.sum(o.astype(jnp.float32) ** 2), o
+
+        def ref_loss(qkv):
+            # unpack exactly as the packed kernel sees it
+            qkv5 = qkv.reshape(s, b, g, qpg + 2, d)
+            qq = qkv5[:, :, :, :qpg].reshape(s, b, h, d).transpose(1, 2, 0, 3)
+            kk = qkv5[:, :, :, qpg].transpose(1, 2, 0, 3)
+            vv = qkv5[:, :, :, qpg + 1].transpose(1, 2, 0, 3)
+            o4 = _mha_reference(qq, kk, vv, None, 1.0 / np.sqrt(d), causal)
+            o = o4.transpose(2, 0, 1, 3).reshape(s, b, h * d)
+            return jnp.sum(o.astype(jnp.float32) ** 2), o
+
+        (_, op), gp = jax.value_and_grad(packed_loss, has_aux=True)(qkv)
+        (_, orf), gr = jax.value_and_grad(ref_loss, has_aux=True)(qkv)
+        np.testing.assert_allclose(np.asarray(op), np.asarray(orf),
+                                   rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(np.asarray(gp), np.asarray(gr),
+                                   rtol=2e-3, atol=2e-3)
+
+    def test_varlen_and_window(self):
+        s, b, g, qpg, d = 256, 3, 2, 1, 64
+        qkv = _rand((s, b, g * (qpg + 2) * d), seed=21)
+        kvl = jnp.asarray([256, 100, 3], jnp.int32)
+        for kwargs in ({"kv_lengths": kvl},
+                       {"causal": True, "sliding_window": 50}):
+            def packed_loss(qkv):
+                o = flash_attention_packed(qkv, queries_per_group=qpg,
+                                           head_dim=d, **kwargs)
+                return jnp.sum(o.astype(jnp.float32) ** 2)
+
+            def ref_loss(qkv):
+                qkv5 = qkv.reshape(s, b, g, qpg + 2, d)
+                qq = qkv5[:, :, :, 0].transpose(1, 2, 0, 3)
+                kk = qkv5[:, :, :, 1].transpose(1, 2, 0, 3)
+                vv = qkv5[:, :, :, 2].transpose(1, 2, 0, 3)
+                o4 = _mha_reference(qq, kk, vv, kwargs.get("kv_lengths"),
+                                    1.0 / np.sqrt(d),
+                                    kwargs.get("causal", False),
+                                    kwargs.get("sliding_window"))
+                return jnp.sum(o4.astype(jnp.float32) ** 2)
+
+            gp = jax.grad(packed_loss)(qkv)
+            gr = jax.grad(ref_loss)(qkv)
+            np.testing.assert_allclose(np.asarray(gp), np.asarray(gr),
+                                       rtol=2e-3, atol=2e-3)
+
+    def test_geometry_gate(self):
+        # d=64, qpg odd -> two groups per cell; odd group count unsupported
+        assert packed_geometry(16, 1, 64) == (2, 384, 128)
+        assert packed_geometry(3, 1, 64) is None
+        assert packed_geometry(4, 2, 64) == (1, 256, 128)
+        assert packed_geometry(2, 1, 128) == (1, 384, 128)
+        # s gating: 128-multiples up to 1024 only
+        assert packed_attention_supported(1024, 16, 1, 64)
+        assert not packed_attention_supported(1000, 16, 1, 64)
+        assert not packed_attention_supported(2048, 16, 1, 64)
