@@ -518,6 +518,132 @@ def _dkv_kernel(offs_ref, kvl_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
 
 
+def _dqkv_fused_kernel(offs_ref, kvl_ref, dq_in_ref, q_ref, k_ref, v_ref,
+                       do_ref, lse_ref, delta_ref, dq_ref, dk_ref, dv_ref,
+                       dk_scr, dv_scr, *, scale, bq, bk, nq, sk, causal,
+                       group=1, window=None):
+    """Fused multi-block backward: ONE pass over the (j, t=(g, i)) grid
+    computes dq, dk and dv together. The separate dq/dkv kernels each
+    redo the s = qk^T recompute, the exp, the mask arithmetic and the
+    dp = do.v^T matmul, and re-DMA every operand block — at 32k that
+    duplication was ~30% of the whole backward (PERF.md round 5). Here
+    dk/dv accumulate in scratch over the inner t sweep exactly as in
+    :func:`_dkv_kernel`, while dq blocks accumulate across the OUTER j
+    dim through an fp32 buffer aliased input->output: each step reads its
+    dq block, adds this j's contribution (or passes it through unchanged
+    for causally dead blocks — every step must write its window), and
+    writes it back. Correctness of the read-modify-write needs every
+    consecutive grid step to touch a DIFFERENT dq window (else the input
+    window is not re-fetched and a contribution is lost): guaranteed by
+    the dispatch condition nq >= 2 with no banded-window grid (the
+    banded clamp can revisit the same window; those shapes keep the
+    two-kernel path)."""
+    b, j, t = pl.program_id(0), pl.program_id(2), pl.program_id(3)
+    i = t % nq
+    q_off, k_off = offs_ref[0], offs_ref[1]
+
+    @pl.when(t == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def _step(masked):
+        def go():
+            q = q_ref[0, 0]
+            k = k_ref[0, 0]
+            do = do_ref[0, 0]
+            kvl = kvl_ref[b] if kvl_ref is not None else None
+            p, ds = _recompute_p_ds(
+                q, k, v_ref[0, 0], do,
+                lse_ref[0, 0].reshape(1, bq).T,
+                delta_ref[0, 0].reshape(1, bq).T,
+                i, j, scale=scale, bq=bq, bk=bk, sk=sk, kvl=kvl,
+                causal=causal, window=window, q_off=q_off, k_off=k_off,
+                need_mask=masked)
+            dq_ref[0, 0] = dq_in_ref[0, 0] + scale * jax.lax.dot(
+                ds.astype(k.dtype), k, preferred_element_type=jnp.float32)
+            dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+                p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dk_scr[:] = dk_scr[:] + scale * jax.lax.dot_general(
+                ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        return go
+
+    keep = _causal_block_skip(i, j, bq, bk, causal, window, q_off, k_off)
+    _when_blocks(_step, keep, i, j, bq, bk, causal, window,
+                 kvl_ref is not None, pl.num_programs(2) * bk != sk,
+                 q_off, k_off)
+    if causal or window is not None:
+        # dead blocks contribute nothing but MUST still write their dq
+        # window (an unwritten window would flush stale VMEM on the next
+        # index change)
+        @pl.when(jnp.logical_not(keep))
+        def _passthrough():
+            dq_ref[0, 0] = dq_in_ref[0, 0]
+
+    @pl.when(t == pl.num_programs(3) - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _run_bwd_fused(q, k, v, do, lse, delta, kv_lengths, scale, causal,
+                   sq, sk, bq, bk, group, window, q_off, k_off):
+    """Dispatch for :func:`_dqkv_fused_kernel` (win_grid-free multi-block
+    shapes). Returns (dq fp32, dk, dv)."""
+    batch, heads, sqp, dp = q.shape
+    kv_heads, skp = k.shape[1], k.shape[2]
+    nq, nk = sqp // bq, skp // bk
+
+    def _qh(h, t):
+        return h * group + t // nq
+
+    kvl_spec = []
+    args = [_offsets(q_off, k_off, sq, sk)]
+    if kv_lengths is not None:
+        kvl_spec = [pl.BlockSpec(memory_space=pltpu.SMEM)]
+        args.append(kv_lengths.astype(jnp.int32))
+    dq_zero = jnp.zeros(q.shape, jnp.float32)
+    qi_spec = pl.BlockSpec((1, 1, bq, dp),
+                           lambda b, h, j, t: (b, _qh(h, t), t % nq, 0))
+    dq, dk, dv = pl.pallas_call(
+        _wrap_kernel(_dqkv_fused_kernel, kv_lengths, scale=scale, bq=bq,
+                     bk=bk, nq=nq, sk=sk, causal=causal, group=group,
+                     window=window),
+        grid=(batch, kv_heads, nk, group * nq),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)] + kvl_spec + [
+            qi_spec,                                                # dq acc
+            qi_spec,                                                # q
+            pl.BlockSpec((1, 1, bk, dp), lambda b, h, j, t: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, bk, dp), lambda b, h, j, t: (b, h, j, 0)),
+            qi_spec,                                                # do
+            pl.BlockSpec((1, 1, 1, bq),
+                         lambda b, h, j, t: (b, _qh(h, t), 0, t % nq)),
+            pl.BlockSpec((1, 1, 1, bq),
+                         lambda b, h, j, t: (b, _qh(h, t), 0, t % nq)),
+        ],
+        out_specs=[
+            qi_spec,
+            pl.BlockSpec((1, 1, bk, dp), lambda b, h, j, t: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, bk, dp), lambda b, h, j, t: (b, h, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, jnp.float32),
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((bk, dp), jnp.float32),
+                        pltpu.VMEM((bk, dp), jnp.float32)],
+        input_output_aliases={len(kvl_spec) + 1: 0},
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary",
+                                 "arbitrary")),
+        interpret=pallas_interpret(),
+    )(*args, dq_zero, q, k, v, do, lse, delta)
+    return dq.astype(q.dtype), dk, dv
+
+
 def _dqkv_single_kernel(offs_ref, kvl_ref, q_ref, k_ref, v_ref, do_ref,
                         lse_ref, delta_ref, dq_ref, dk_ref, dv_ref,
                         dk_scr, dv_scr, *, scale, bq, bk, sk, causal,
@@ -698,10 +824,12 @@ def _dqkv_packed_kernel(kvl_ref, qkv_ref, do_ref, o_ref, lse_ref,
             dv_acc.astype(dqkv_ref.dtype)
 
 
-def _run_fwd_packed(qkv2, kv_lengths, *, scale, s, batch, W, d, qpg, gpc,
+def _run_fwd_packed(qkv2, kv_lengths, *, scale, s, batch, W, d, qpg, geom,
                     heads, causal, window):
-    """qkv2: [s, batch*W]; returns (o2 [s, batch*heads*d], lse [b,H,1,s])."""
-    _, in_w, out_w = packed_geometry(W // ((qpg + 2) * d), qpg, d)
+    """qkv2: [s, batch*W]; returns (o2 [s, batch*heads*d], lse [b,H,1,s]).
+    ``geom`` is packed_geometry's (gpc, in_w, out_w) — the ONE source of
+    the cell widths the BlockSpecs and kernel loop bounds share."""
+    gpc, in_w, out_w = geom
     n_cells = W // in_w
     hpc = gpc * qpg
     need_mask = causal or window is not None or kv_lengths is not None
@@ -734,8 +862,8 @@ def _run_fwd_packed(qkv2, kv_lengths, *, scale, s, batch, W, d, qpg, gpc,
 
 
 def _run_bwd_packed(qkv2, do2, o2, lse, kv_lengths, *, scale, s, batch,
-                    W, d, qpg, gpc, heads, causal, window):
-    _, in_w, out_w = packed_geometry(W // ((qpg + 2) * d), qpg, d)
+                    W, d, qpg, geom, heads, causal, window):
+    gpc, in_w, out_w = geom
     n_cells = W // in_w
     hpc = gpc * qpg
     need_mask = causal or window is not None or kv_lengths is not None
@@ -792,15 +920,15 @@ def _flash_packed(qkv, kv_lengths, scale, causal, window, qpg, d):
 def _packed_geom_of(qkv, qpg, d):
     s, b, W = qkv.shape
     g = W // ((qpg + 2) * d)
-    gpc, _, _ = packed_geometry(g, qpg, d)
-    return s, b, W, g, gpc, g * qpg
+    gpc, in_w, out_w = packed_geometry(g, qpg, d)
+    return s, b, W, g, (gpc, in_w, out_w), g * qpg
 
 
 def _flash_packed_fwd_impl(qkv, kv_lengths, scale, causal, window, qpg, d):
-    s, b, W, g, gpc, heads = _packed_geom_of(qkv, qpg, d)
+    s, b, W, g, geom, heads = _packed_geom_of(qkv, qpg, d)
     o2, lse = _run_fwd_packed(
         qkv.reshape(s, b * W), kv_lengths, scale=scale, s=s, batch=b, W=W,
-        d=d, qpg=qpg, gpc=gpc, heads=heads, causal=causal, window=window)
+        d=d, qpg=qpg, geom=geom, heads=heads, causal=causal, window=window)
     return o2.reshape(s, b, heads * d), lse
 
 
@@ -812,11 +940,11 @@ def _flash_packed_vjp_fwd(qkv, kv_lengths, scale, causal, window, qpg, d):
 
 def _flash_packed_vjp_bwd(scale, causal, window, qpg, d, res, do):
     qkv, kv_lengths, o, lse = res
-    s, b, W, g, gpc, heads = _packed_geom_of(qkv, qpg, d)
+    s, b, W, g, geom, heads = _packed_geom_of(qkv, qpg, d)
     dqkv = _run_bwd_packed(
         qkv.reshape(s, b * W), do.reshape(s, b * heads * d),
         o.reshape(s, b * heads * d), lse,
-        kv_lengths, scale=scale, s=s, batch=b, W=W, d=d, qpg=qpg, gpc=gpc,
+        kv_lengths, scale=scale, s=s, batch=b, W=W, d=d, qpg=qpg, geom=geom,
         heads=heads, causal=causal, window=window)
     dkvl = (None if kv_lengths is None
             else np.zeros(kv_lengths.shape, dtype=jax.dtypes.float0))
@@ -959,6 +1087,19 @@ def _run_bwd(q, k, v, do, lse, delta, kv_lengths, scale, causal,
         win_grid = sk - sq
         nk_grid = min(nk, (bq + window - 2) // bk + 2)
         nq_grid = min(nq, (bk + window - 2) // bq + 2)
+    if win_grid is None and nq >= 2 and not pallas_interpret():
+        # fused one-pass dq/dk/dv (see _dqkv_fused_kernel); the banded
+        # window grid and nq == 1 keep the two-kernel path — their block
+        # revisit patterns break the aliased dq accumulation's
+        # distinct-consecutive-windows requirement. Interpret mode also
+        # keeps the two-kernel path: the interpreter reads inputs
+        # functionally, so input_output_aliases does not feed a step's
+        # dq write back to later steps (the accumulation is a compiled
+        # Mosaic window-DMA mechanism); hardware parity is pinned by
+        # TestFusedMultiblockBackward under APEX_TPU_TEST_TPU=1.
+        return _run_bwd_fused(q, k, v, do, lse, delta, kv_lengths, scale,
+                              causal, sq, sk, bq, bk, group, window,
+                              q_off, k_off)
 
     def _kj(i, j):
         if win_grid is None:

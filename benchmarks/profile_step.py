@@ -73,14 +73,18 @@ def profile_op_table(run_once, *, iters=3, device_substr="TPU",
     return sorted(((v[0], v[1], k) for k, v in acc.items()), reverse=True)
 
 
+# Buckets match the OPTIMIZED-HLO op names the xplane records (Pallas
+# kernels all lower to closed_call/custom-call "tpu_custom_call" — the
+# Python kernel function name does NOT appear, so per-kernel attribution
+# needs the output-shape signatures, as the PERF.md round-5 analyses do).
 _GROUPS = [
-    ("attention-kernel", re.compile(
-        r"fwd_single_kernel|fwd_kernel|dq_kernel|dkv_kernel|dqkv_single"
-        r"|custom-call.*flash|attn", re.I)),
-    ("layer/rms-norm", re.compile(r"norm_kernel|layer_norm|rms", re.I)),
-    ("gemm", re.compile(r"^(dot|convolution)|fusion.*dot", re.I)),
-    ("copy/transpose", re.compile(r"^(copy|transpose|bitcast)", re.I)),
-    ("elementwise-fusion", re.compile(r"^(fusion|add|multiply|select)", re.I)),
+    ("pallas-kernel", re.compile(r"%(closed_call|custom-call)", re.I)),
+    ("gemm+epilogue", re.compile(r"%(convolution|dot)|"
+                                 r"%[a-z_]*(convolution|dot)[a-z_]*_fusion",
+                                 re.I)),
+    ("fusion", re.compile(r"fusion", re.I)),
+    ("copy/transpose/reshape", re.compile(
+        r"%(copy|transpose|bitcast|reshape|slice)", re.I)),
     ("other", re.compile(r".")),
 ]
 

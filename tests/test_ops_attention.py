@@ -357,3 +357,74 @@ class TestPackedQKV:
         assert packed_attention_supported(1024, 16, 1, 64)
         assert not packed_attention_supported(1000, 16, 1, 64)
         assert not packed_attention_supported(2048, 16, 1, 64)
+
+
+class TestFusedMultiblockBackward:
+    """The fused one-pass dq/dk/dv kernel (non-banded nq >= 2 shapes) —
+    small explicit blocks force real multi-block grids so the aliased
+    fp32 dq accumulation, dead-block passthrough and scratch flushes run
+    for every grid transition the dispatch condition allows.
+
+    The fused kernel's dq accumulation is a compiled Mosaic window-DMA
+    mechanism that the Pallas interpreter cannot model (it reads inputs
+    functionally, ignoring input_output_aliases), so under the default
+    interpret-mode suite these shapes take the two-kernel path and this
+    class pins THAT parity; under ``APEX_TPU_TEST_TPU=1`` on hardware the
+    same tests compile and pin the fused kernel itself."""
+
+    def _grads(self, q, k, v, kvl=None, causal=True, bq=128, bk=128):
+        def loss(fn):
+            return lambda q, k, v: jnp.sum(
+                fn(q, k, v).astype(jnp.float32) ** 2)
+        g_new = jax.grad(loss(lambda q, k, v: flash_attention(
+            q, k, v, causal=causal, kv_lengths=kvl,
+            block_q=bq, block_k=bk)), argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss(lambda q, k, v: _mha_reference(
+            q, k, v, kvl, 1.0 / np.sqrt(q.shape[-1]), causal)),
+            argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_new, g_ref):
+            np.testing.assert_allclose(np.asarray(a, np.float32),
+                                       np.asarray(b, np.float32),
+                                       rtol=2e-3, atol=2e-3)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_2x2_grid(self, causal):
+        # nq = nk = 2: dq blocks revisited across the outer j dim — the
+        # aliased read-modify-write accumulation path
+        q = _rand((2, 3, 256, 64), seed=31)
+        k = _rand((2, 3, 256, 64), seed=32)
+        v = _rand((2, 3, 256, 64), seed=33)
+        self._grads(q, k, v, causal=causal)
+
+    def test_causal_dead_blocks_4x4(self):
+        # nq = nk = 4: 6 of 16 blocks are causally dead — their steps
+        # must pass dq through unchanged (a dropped write loses a j
+        # contribution; a stale write corrupts a neighbor block)
+        q = _rand((1, 2, 512, 64), seed=34)
+        k = _rand((1, 2, 512, 64), seed=35)
+        v = _rand((1, 2, 512, 64), seed=36)
+        self._grads(q, k, v, causal=True)
+
+    def test_gqa_group_sweep(self):
+        # grouped heads extend the inner t sweep; dk/dv scratch must
+        # accumulate across the whole (g, i) walk before flushing
+        q = _rand((2, 4, 256, 64), seed=37)
+        k = _rand((2, 2, 256, 64), seed=38)
+        v = _rand((2, 2, 256, 64), seed=39)
+        self._grads(q, k, v, causal=True)
+
+    def test_varlen(self):
+        q = _rand((2, 2, 256, 64), seed=40)
+        k = _rand((2, 2, 256, 64), seed=41)
+        v = _rand((2, 2, 256, 64), seed=42)
+        self._grads(q, k, v, causal=False,
+                    kvl=jnp.asarray([200, 37], jnp.int32))
+
+    def test_cross_shapes(self):
+        # sq != sk, including the nk == 1 single-j fused case and the
+        # nq == 1 shape that must take the two-kernel fallback
+        for sq, sk in [(256, 512), (384, 128), (128, 512)]:
+            q = _rand((1, 2, sq, 64), seed=43 + sq)
+            k = _rand((1, 2, sk, 64), seed=44 + sk)
+            v = _rand((1, 2, sk, 64), seed=45 + sk)
+            self._grads(q, k, v, causal=True)
