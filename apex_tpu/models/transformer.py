@@ -676,16 +676,21 @@ class ParallelAttention:
             # 355M the transposes + cotangent reassembly were ~18 ms of a
             # 202 ms step — PERF.md round 5)
             if (kv_cache is None and attention_mask is None
-                    and c.position_embedding_type != "rope"
                     and not c.context_parallel_method
                     and (deterministic or c.attention_dropout == 0.0)
                     and packed_attention_supported(s, local_groups, qpg,
                                                    dh)):
+                freqs = None
+                if c.position_embedding_type == "rope":
+                    # positions start at 0: no cache (gated above) and no
+                    # bound context axis (CP gated above)
+                    freqs = rope_freqs(0, s, c.rotary_dim, c.rope_theta)
                 ctx = flash_attention_packed(
                     qkv, queries_per_group=qpg, head_dim=dh,
                     causal=c.attn_mask_type == AttnMaskType.causal,
                     kv_lengths=kv_lengths,
-                    sliding_window=c.sliding_window)
+                    sliding_window=c.sliding_window,
+                    rope_freqs=freqs)
                 return self.dense.apply(params["dense"], ctx)
             qkv = qkv.reshape(s, b, local_groups, qpg + 2, dh)
             q = qkv[:, :, :, :qpg].reshape(s, b, local_groups * qpg, dh)

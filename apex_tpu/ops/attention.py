@@ -742,19 +742,42 @@ def _packed_supported(s, num_groups, qpg, head_dim):
             and packed_geometry(num_groups, qpg, head_dim) is not None)
 
 
-def _fwd_packed_kernel(kvl_ref, qkv_ref, o_ref, lse_ref, *, scale, s, d,
-                       qpg, gpc, causal, window, need_mask):
+def _rope_block(t, cos, sin, rot):
+    """Rotate-half RoPE over the first ``rot`` columns of a (s, d) block
+    (Megatron ``concat(f, f)`` convention: the sin/cos halves repeat, so
+    the inverse is the same map with ``-sin`` — pass negated sin). ``cos``/
+    ``sin`` are fp32 (s, d) with cos=1/sin=0 past ``rot``."""
+    tf = t.astype(jnp.float32)
+    half = jnp.concatenate([-tf[:, rot // 2: rot], tf[:, : rot // 2]],
+                           axis=1)
+    if rot < t.shape[1]:
+        half = jnp.concatenate(
+            [half, jnp.zeros((t.shape[0], t.shape[1] - rot), jnp.float32)],
+            axis=1)
+    return (tf * cos + half * sin).astype(t.dtype)
+
+
+def _fwd_packed_kernel(kvl_ref, rope_refs, qkv_ref, o_ref, lse_ref, *,
+                       scale, s, d, qpg, gpc, causal, window, need_mask,
+                       rot=0):
     """One grid cell = ``gpc`` whole K/V groups of one batch row. Slices are
     static column offsets into the packed slab; per-head math is the same
     one-pass softmax as :func:`_fwd_single_kernel` (sq == sk == s, offsets
-    0 — a self-attention block is never fully masked, so no skip gate)."""
+    0 — a self-attention block is never fully masked, so no skip gate).
+    ``rot > 0``: apply RoPE to the q/k slices in-kernel (the packed layout
+    has no pre-kernel [s,b,h,d] view to rotate)."""
     b = pl.program_id(0)
     for g in range(gpc):
         base = g * (qpg + 2) * d
         k = qkv_ref[:, base + qpg * d: base + (qpg + 1) * d]
         v = qkv_ref[:, base + (qpg + 1) * d: base + (qpg + 2) * d]
+        if rot:
+            k = _rope_block(k, rope_refs[0][...], rope_refs[1][...], rot)
         for j in range(qpg):
             q = qkv_ref[:, base + j * d: base + (j + 1) * d]
+            if rot:
+                q = _rope_block(q, rope_refs[0][...], rope_refs[1][...],
+                                rot)
             sm = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                      preferred_element_type=jnp.float32
                                      ) * scale
@@ -777,23 +800,33 @@ def _fwd_packed_kernel(kvl_ref, qkv_ref, o_ref, lse_ref, *, scale, s, d,
             lse_ref[0, h] = lse.reshape(1, s)
 
 
-def _dqkv_packed_kernel(kvl_ref, qkv_ref, do_ref, o_ref, lse_ref,
+def _dqkv_packed_kernel(kvl_ref, rope_refs, qkv_ref, do_ref, o_ref, lse_ref,
                         dqkv_ref, *, scale, s, d, qpg, gpc, causal, window,
-                        need_mask):
+                        need_mask, rot=0):
     """Fused one-pass backward writing dq/dk/dv straight into the packed
     [s, cell-width] layout. dK/dV accumulate over the cell's query group in
     registers (the whole group lives in one cell by construction). delta
     (rowwise do . o) is computed in-kernel from the o block — as an XLA
-    pre-pass it cost ~107 us/layer of separate HBM traffic at 355M."""
+    pre-pass it cost ~107 us/layer of separate HBM traffic at 355M.
+    ``rot > 0``: the recompute rotates q/k exactly as the forward did, and
+    the emitted dq/dk are un-rotated (RoPE is skew-orthogonal per row:
+    inverse = same map with -sin) so the cotangent matches the RAW packed
+    projection output."""
     b = pl.program_id(0)
+    if rot:
+        cos, sin = rope_refs[0][...], rope_refs[1][...]
     for g in range(gpc):
         base = g * (qpg + 2) * d
         k = qkv_ref[:, base + qpg * d: base + (qpg + 1) * d]
         v = qkv_ref[:, base + (qpg + 1) * d: base + (qpg + 2) * d]
+        if rot:
+            k = _rope_block(k, cos, sin, rot)
         dk_acc = jnp.zeros((s, d), jnp.float32)
         dv_acc = jnp.zeros((s, d), jnp.float32)
         for j in range(qpg):
             q = qkv_ref[:, base + j * d: base + (j + 1) * d]
+            if rot:
+                q = _rope_block(q, cos, sin, rot)
             h = g * qpg + j
             do = do_ref[:, h * d:(h + 1) * d]
             delta = jnp.sum(do.astype(jnp.float32)
@@ -810,6 +843,8 @@ def _dqkv_packed_kernel(kvl_ref, qkv_ref, do_ref, o_ref, lse_ref,
                 need_mask=need_mask)
             dq = scale * jax.lax.dot(ds.astype(k.dtype), k,
                                      preferred_element_type=jnp.float32)
+            if rot:
+                dq = _rope_block(dq, cos, -sin, rot)
             dqkv_ref[:, base + j * d: base + (j + 1) * d] = \
                 dq.astype(dqkv_ref.dtype)
             dv_acc = dv_acc + jax.lax.dot_general(
@@ -818,17 +853,20 @@ def _dqkv_packed_kernel(kvl_ref, qkv_ref, do_ref, o_ref, lse_ref,
             dk_acc = dk_acc + scale * jax.lax.dot_general(
                 ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
+        if rot:
+            dk_acc = _rope_block(dk_acc, cos, -sin, rot)
         dqkv_ref[:, base + qpg * d: base + (qpg + 1) * d] = \
             dk_acc.astype(dqkv_ref.dtype)
         dqkv_ref[:, base + (qpg + 1) * d: base + (qpg + 2) * d] = \
             dv_acc.astype(dqkv_ref.dtype)
 
 
-def _run_fwd_packed(qkv2, kv_lengths, *, scale, s, batch, W, d, qpg, geom,
-                    heads, causal, window):
+def _run_fwd_packed(qkv2, kv_lengths, rope, *, scale, s, batch, W, d, qpg,
+                    geom, heads, causal, window):
     """qkv2: [s, batch*W]; returns (o2 [s, batch*heads*d], lse [b,H,1,s]).
     ``geom`` is packed_geometry's (gpc, in_w, out_w) — the ONE source of
-    the cell widths the BlockSpecs and kernel loop bounds share."""
+    the cell widths the BlockSpecs and kernel loop bounds share. ``rope``:
+    None or (cos, sin) fp32 [s, d] (padded past the rotary dim)."""
     gpc, in_w, out_w = geom
     n_cells = W // in_w
     hpc = gpc * qpg
@@ -838,10 +876,16 @@ def _run_fwd_packed(qkv2, kv_lengths, *, scale, s, batch, W, d, qpg, geom,
     if kv_lengths is not None:
         kvl_spec = [pl.BlockSpec(memory_space=pltpu.SMEM)]
         args.append(kv_lengths.astype(jnp.int32))
+    rot = 0
+    if rope is not None:
+        rot = int(rope[2])
+        kvl_spec = kvl_spec + [pl.BlockSpec((s, d), lambda b, c: (0, 0))] * 2
+        args += [rope[0], rope[1]]
     o, lse = pl.pallas_call(
-        _wrap_kernel_nooffs(_fwd_packed_kernel, kv_lengths, scale=scale,
+        _wrap_kernel_nooffs(_fwd_packed_kernel, kv_lengths, rope,
+                            scale=scale,
                             s=s, d=d, qpg=qpg, gpc=gpc, causal=causal,
-                            window=window, need_mask=need_mask),
+                            window=window, need_mask=need_mask, rot=rot),
         grid=(batch, n_cells),
         in_specs=kvl_spec + [
             pl.BlockSpec((s, in_w), lambda b, c: (0, b * n_cells + c)),
@@ -861,8 +905,8 @@ def _run_fwd_packed(qkv2, kv_lengths, *, scale, s, batch, W, d, qpg, geom,
     return o, lse
 
 
-def _run_bwd_packed(qkv2, do2, o2, lse, kv_lengths, *, scale, s, batch,
-                    W, d, qpg, geom, heads, causal, window):
+def _run_bwd_packed(qkv2, do2, o2, lse, kv_lengths, rope, *, scale, s,
+                    batch, W, d, qpg, geom, heads, causal, window):
     gpc, in_w, out_w = geom
     n_cells = W // in_w
     hpc = gpc * qpg
@@ -872,10 +916,16 @@ def _run_bwd_packed(qkv2, do2, o2, lse, kv_lengths, *, scale, s, batch,
     if kv_lengths is not None:
         kvl_spec = [pl.BlockSpec(memory_space=pltpu.SMEM)]
         args.append(kv_lengths.astype(jnp.int32))
+    rot = 0
+    if rope is not None:
+        rot = int(rope[2])
+        kvl_spec = kvl_spec + [pl.BlockSpec((s, d), lambda b, c: (0, 0))] * 2
+        args += [rope[0], rope[1]]
     return pl.pallas_call(
-        _wrap_kernel_nooffs(_dqkv_packed_kernel, kv_lengths, scale=scale,
+        _wrap_kernel_nooffs(_dqkv_packed_kernel, kv_lengths, rope,
+                            scale=scale,
                             s=s, d=d, qpg=qpg, gpc=gpc, causal=causal,
-                            window=window, need_mask=need_mask),
+                            window=window, need_mask=need_mask, rot=rot),
         grid=(batch, n_cells),
         in_specs=kvl_spec + [
             pl.BlockSpec((s, in_w), lambda b, c: (0, b * n_cells + c)),
@@ -891,12 +941,23 @@ def _run_bwd_packed(qkv2, do2, o2, lse, kv_lengths, *, scale, s, batch,
     )(*args, qkv2, do2, o2, lse)
 
 
-def _wrap_kernel_nooffs(fn, kv_lengths, **kw):
+def _wrap_kernel_nooffs(fn, kv_lengths, rope, **kw):
     """Like :func:`_wrap_kernel` for the packed kernels (no offsets
-    operand: sq == sk == s, offsets statically zero)."""
-    if kv_lengths is not None:
-        return functools.partial(fn, **kw)
-    return functools.partial(lambda *r, **k2: fn(None, *r, **k2), **kw)
+    operand: sq == sk == s, offsets statically zero). Slots None into the
+    kernel's ``kvl_ref``/``rope_refs`` positions for absent operands."""
+    have_kvl = kv_lengths is not None
+
+    def wrapped(*refs, **k2):
+        idx = 0
+        kvl = None
+        if have_kvl:
+            kvl, idx = refs[0], 1
+        rope_refs = None
+        if rope is not None:
+            rope_refs, idx = (refs[idx], refs[idx + 1]), idx + 2
+        return fn(kvl, rope_refs, *refs[idx:], **k2)
+
+    return functools.partial(wrapped, **kw)
 
 
 def _packed_unpack(qkv, qpg, d):
@@ -910,10 +971,11 @@ def _packed_unpack(qkv, qpg, d):
     return (t.transpose(1, 2, 0, 3) for t in (q, k, v))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6))
-def _flash_packed(qkv, kv_lengths, scale, causal, window, qpg, d):
-    o, _ = _flash_packed_fwd_impl(qkv, kv_lengths, scale, causal, window,
-                                  qpg, d)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash_packed(qkv, kv_lengths, rope_cos, rope_sin, scale, causal,
+                  window, qpg, d, rot):
+    o, _ = _flash_packed_fwd_impl(qkv, kv_lengths, rope_cos, rope_sin,
+                                  scale, causal, window, qpg, d, rot)
     return o
 
 
@@ -924,31 +986,43 @@ def _packed_geom_of(qkv, qpg, d):
     return s, b, W, g, (gpc, in_w, out_w), g * qpg
 
 
-def _flash_packed_fwd_impl(qkv, kv_lengths, scale, causal, window, qpg, d):
+def _rope_tuple(rope_cos, rope_sin, rot):
+    return None if rot == 0 else (rope_cos, rope_sin, rot)
+
+
+def _flash_packed_fwd_impl(qkv, kv_lengths, rope_cos, rope_sin, scale,
+                           causal, window, qpg, d, rot):
     s, b, W, g, geom, heads = _packed_geom_of(qkv, qpg, d)
     o2, lse = _run_fwd_packed(
-        qkv.reshape(s, b * W), kv_lengths, scale=scale, s=s, batch=b, W=W,
+        qkv.reshape(s, b * W), kv_lengths, _rope_tuple(rope_cos, rope_sin,
+                                                       rot),
+        scale=scale, s=s, batch=b, W=W,
         d=d, qpg=qpg, geom=geom, heads=heads, causal=causal, window=window)
     return o2.reshape(s, b, heads * d), lse
 
 
-def _flash_packed_vjp_fwd(qkv, kv_lengths, scale, causal, window, qpg, d):
-    o, lse = _flash_packed_fwd_impl(qkv, kv_lengths, scale, causal, window,
-                                    qpg, d)
-    return o, (qkv, kv_lengths, o, lse)
+def _flash_packed_vjp_fwd(qkv, kv_lengths, rope_cos, rope_sin, scale,
+                          causal, window, qpg, d, rot):
+    o, lse = _flash_packed_fwd_impl(qkv, kv_lengths, rope_cos, rope_sin,
+                                    scale, causal, window, qpg, d, rot)
+    return o, (qkv, kv_lengths, rope_cos, rope_sin, o, lse)
 
 
-def _flash_packed_vjp_bwd(scale, causal, window, qpg, d, res, do):
-    qkv, kv_lengths, o, lse = res
+def _flash_packed_vjp_bwd(scale, causal, window, qpg, d, rot, res, do):
+    qkv, kv_lengths, rope_cos, rope_sin, o, lse = res
     s, b, W, g, geom, heads = _packed_geom_of(qkv, qpg, d)
     dqkv = _run_bwd_packed(
         qkv.reshape(s, b * W), do.reshape(s, b * heads * d),
         o.reshape(s, b * heads * d), lse,
-        kv_lengths, scale=scale, s=s, batch=b, W=W, d=d, qpg=qpg, geom=geom,
+        kv_lengths, _rope_tuple(rope_cos, rope_sin, rot),
+        scale=scale, s=s, batch=b, W=W, d=d, qpg=qpg, geom=geom,
         heads=heads, causal=causal, window=window)
     dkvl = (None if kv_lengths is None
             else np.zeros(kv_lengths.shape, dtype=jax.dtypes.float0))
-    return dqkv.reshape(s, b, W), dkvl
+    # rope tables are position constants (zero cotangent)
+    dcos = None if rope_cos is None else jnp.zeros_like(rope_cos)
+    dsin = None if rope_sin is None else jnp.zeros_like(rope_sin)
+    return dqkv.reshape(s, b, W), dkvl, dcos, dsin
 
 
 _flash_packed.defvjp(_flash_packed_vjp_fwd, _flash_packed_vjp_bwd)
@@ -963,6 +1037,7 @@ def flash_attention_packed(
     softmax_scale: Optional[float] = None,
     kv_lengths: Optional[jax.Array] = None,
     sliding_window: Optional[int] = None,
+    rope_freqs: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Self-attention over a packed QKV projection, layout-native.
 
@@ -977,6 +1052,13 @@ def flash_attention_packed(
     [b,h,s,d] transposes on either side of the kernel, and the VJP emits
     the packed ``dqkv`` cotangent directly (see the section comment).
     Callers must pre-check :func:`packed_attention_supported`.
+
+    ``rope_freqs``: optional RoPE angles for positions 0..s-1 (shape
+    ``[s, rot_dim]`` or the ``[s, 1, 1, rot_dim]``
+    :func:`~apex_tpu.ops.fused_rope` layout, Megatron concat(f, f)
+    convention, rot_dim even): q and k are rotated IN-KERNEL — the packed
+    layout never materializes a pre-kernel [s,b,h,d] view to rotate — and
+    the VJP un-rotates dq/dk so the cotangent matches the raw projection.
     """
     s, b, W = qkv.shape
     qpg, d = queries_per_group, head_dim
@@ -988,8 +1070,25 @@ def flash_attention_packed(
         raise ValueError("sliding_window requires causal attention")
     scale = float(softmax_scale if softmax_scale is not None
                   else 1.0 / np.sqrt(d))
+    rot = 0
+    cos = sin = None
+    if rope_freqs is not None:
+        f = rope_freqs.reshape(s, -1).astype(jnp.float32)
+        rot = f.shape[-1]
+        if rot % 2 or rot > d:
+            raise ValueError(f"rotary dim {rot} must be even and <= "
+                             f"head_dim {d}")
+        pad = ((0, 0), (0, d - rot))
+        cos = jnp.pad(jnp.cos(f), pad, constant_values=1.0)
+        sin = jnp.pad(jnp.sin(f), pad)
     if not use_pallas():
         q, k, v = _packed_unpack(qkv, qpg, d)
+        if rot:
+            from apex_tpu.ops.rope import fused_rope
+            f4 = rope_freqs.reshape(s, 1, 1, rot)
+            # rope expects [s, b, h, d]
+            q = fused_rope(q.transpose(2, 0, 1, 3), f4).transpose(1, 2, 0, 3)
+            k = fused_rope(k.transpose(2, 0, 1, 3), f4).transpose(1, 2, 0, 3)
         ctx = _mha_reference(q, k, v, kv_lengths, scale, causal,
                              sliding_window)
         return ctx.transpose(2, 0, 1, 3).reshape(s, b, g * qpg * d)
@@ -997,8 +1096,8 @@ def flash_attention_packed(
         raise ValueError(
             f"packed attention unsupported for s={s}, groups={g}, "
             f"qpg={qpg}, d={d} — gate on packed_attention_supported()")
-    return _flash_packed(qkv, kv_lengths, scale, causal, sliding_window,
-                         qpg, d)
+    return _flash_packed(qkv, kv_lengths, cos, sin, scale, causal,
+                         sliding_window, qpg, d, rot)
 
 
 def packed_attention_supported(s: int, num_groups: int,

@@ -245,11 +245,6 @@ class SwitchMLP:
         without its [T, E, cap] one-hots). Returns fp32 ``[T, h]``."""
         c = self.config
         tokens, h = x2d.shape
-        wte = jnp.zeros((tokens, c.num_experts), jnp.float32)
-        for k in range(c.top_k):
-            wte = wte + (jax.nn.one_hot(experts[:, k], c.num_experts,
-                                        dtype=jnp.float32)
-                         * weights[:, k:k + 1].astype(jnp.float32))
         ep = (lax.axis_size(c.expert_axis)
               if c.expert_axis and axis_bound(c.expert_axis) else 1)
         if ep > 1:
@@ -258,13 +253,24 @@ class SwitchMLP:
             # DP), so shard-local partials must not be psum'd as-is (each
             # rank's rows are DIFFERENT tokens — the capacity path handles
             # this with its all_to_all pair): gather every rank's tokens
-            # and routing weights, let the local experts process the full
-            # set, psum the partial outputs, then slice this rank's rows
-            # back out
+            # and routing decisions, let the local experts process the
+            # full set, psum the partial outputs, then slice this rank's
+            # rows back out. The compact [T, k] weights/experts move over
+            # the interconnect (E/(2k)x less than the dense [T, E] wte,
+            # which is pure local compute built post-gather).
             e_local = c.num_experts // ep
             idx = lax.axis_index(c.expert_axis)
             x2d = lax.all_gather(x2d, c.expert_axis, axis=0, tiled=True)
-            wte = lax.all_gather(wte, c.expert_axis, axis=0, tiled=True)
+            weights = lax.all_gather(weights, c.expert_axis, axis=0,
+                                     tiled=True)
+            experts = lax.all_gather(experts, c.expert_axis, axis=0,
+                                     tiled=True)
+        wte = jnp.zeros((x2d.shape[0], c.num_experts), jnp.float32)
+        for k in range(c.top_k):
+            wte = wte + (jax.nn.one_hot(experts[:, k], c.num_experts,
+                                        dtype=jnp.float32)
+                         * weights[:, k:k + 1].astype(jnp.float32))
+        if ep > 1:
             wte = lax.dynamic_slice(
                 wte, (jnp.int32(0), idx * e_local),
                 (x2d.shape[0], e_local))

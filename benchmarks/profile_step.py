@@ -73,18 +73,21 @@ def profile_op_table(run_once, *, iters=3, device_substr="TPU",
     return sorted(((v[0], v[1], k) for k, v in acc.items()), reverse=True)
 
 
-# Buckets match the OPTIMIZED-HLO op names the xplane records (Pallas
-# kernels all lower to closed_call/custom-call "tpu_custom_call" — the
-# Python kernel function name does NOT appear, so per-kernel attribution
-# needs the output-shape signatures, as the PERF.md round-5 analyses do).
+# Buckets keyed on the HLO INSTRUCTION NAME (the `%name =` token — XLA
+# names instructions after their opcode / fused pattern) plus the
+# custom_call_target marker for Pallas: the xplane op text is the FULL
+# instruction, where `%` prefixes instruction and operand NAMES, not
+# opcodes, so matching the whole text would hit operand names like
+# `%copy` inside unrelated instructions. The Python kernel function name
+# never appears — per-kernel attribution needs output-shape signatures,
+# as the PERF.md round-5 analyses do.
 _GROUPS = [
-    ("pallas-kernel", re.compile(r"%(closed_call|custom-call)", re.I)),
-    ("gemm+epilogue", re.compile(r"%(convolution|dot)|"
-                                 r"%[a-z_]*(convolution|dot)[a-z_]*_fusion",
+    ("gemm+epilogue", re.compile(r"^(convolution|dot)|"
+                                 r"(convolution|dot)[a-z_]*_fusion",
                                  re.I)),
     ("fusion", re.compile(r"fusion", re.I)),
     ("copy/transpose/reshape", re.compile(
-        r"%(copy|transpose|bitcast|reshape|slice)", re.I)),
+        r"^(copy|transpose|bitcast|reshape|slice)", re.I)),
     ("other", re.compile(r".")),
 ]
 
@@ -93,8 +96,13 @@ def group_rows(rows):
     """Bucket an op table into coarse classes -> {class: total_us}."""
     out = defaultdict(float)
     for us, _, name in rows:
+        if ('custom_call_target="tpu_custom_call"' in name
+                or " custom-call(" in name):
+            out["pallas-kernel"] += us
+            continue
+        iname = name.split(" = ")[0].lstrip("%")
         for gname, pat in _GROUPS:
-            if pat.search(name):
+            if pat.search(iname):
                 out[gname] += us
                 break
     return dict(sorted(out.items(), key=lambda kv: -kv[1]))

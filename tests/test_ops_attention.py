@@ -428,3 +428,44 @@ class TestFusedMultiblockBackward:
             k = _rand((1, 2, sk, 64), seed=44 + sk)
             v = _rand((1, 2, sk, 64), seed=45 + sk)
             self._grads(q, k, v, causal=True)
+
+
+class TestPackedRope:
+    """In-kernel RoPE on the packed path vs rotate-then-flash on the 4D
+    path — forward and the un-rotated dqkv cotangent, full and partial
+    rotary dims."""
+
+    @pytest.mark.parametrize("rot", [64, 32])
+    def test_rope_parity(self, rot):
+        from apex_tpu.ops.rope import fused_rope
+        s, b, g, qpg, d = 128, 2, 4, 1, 64
+        qkv = _rand((s, b, g * (qpg + 2) * d), seed=51)
+        inv = 1.0 / 10000.0 ** (np.arange(0, rot, 2, dtype=np.float32)
+                                / rot)
+        f = np.arange(s, dtype=np.float32)[:, None] * inv[None, :]
+        freqs = jnp.asarray(np.concatenate([f, f], axis=-1))   # [s, rot]
+
+        def packed_loss(qkv):
+            o = flash_attention_packed(qkv, queries_per_group=qpg,
+                                       head_dim=d, causal=True,
+                                       rope_freqs=freqs)
+            return jnp.sum(o.astype(jnp.float32) ** 2), o
+
+        def ref_loss(qkv):
+            qkv5 = qkv.reshape(s, b, g, qpg + 2, d)
+            qq = qkv5[:, :, :, 0]                        # [s, b, g, d]
+            kk = qkv5[:, :, :, 1]
+            vv = qkv5[:, :, :, 2].transpose(1, 2, 0, 3)
+            f4 = freqs.reshape(s, 1, 1, rot)
+            qq = fused_rope(qq, f4).transpose(1, 2, 0, 3)
+            kk = fused_rope(kk, f4).transpose(1, 2, 0, 3)
+            o4 = _mha_reference(qq, kk, vv, None, 1.0 / np.sqrt(d), True)
+            o = o4.transpose(2, 0, 1, 3).reshape(s, b, g * d)
+            return jnp.sum(o.astype(jnp.float32) ** 2), o
+
+        (_, op), gp = jax.value_and_grad(packed_loss, has_aux=True)(qkv)
+        (_, orf), gr = jax.value_and_grad(ref_loss, has_aux=True)(qkv)
+        np.testing.assert_allclose(np.asarray(op), np.asarray(orf),
+                                   rtol=2e-3, atol=2e-3)
+        np.testing.assert_allclose(np.asarray(gp), np.asarray(gr),
+                                   rtol=5e-3, atol=5e-3)
