@@ -675,15 +675,17 @@ class ParallelAttention:
             # emits the packed dqkv cotangent the wgrad GEMM wants (at
             # 355M the transposes + cotangent reassembly were ~18 ms of a
             # 202 ms step — PERF.md round 5)
-            if (kv_cache is None and attention_mask is None
+            if (kv_cache is None and cache_index is None
+                    and attention_mask is None
                     and not c.context_parallel_method
                     and (deterministic or c.attention_dropout == 0.0)
                     and packed_attention_supported(s, local_groups, qpg,
                                                    dh)):
                 freqs = None
                 if c.position_embedding_type == "rope":
-                    # positions start at 0: no cache (gated above) and no
-                    # bound context axis (CP gated above)
+                    # positions start at 0: no cache offset (cache_index
+                    # gated above) and no bound context axis (CP gated
+                    # above)
                     freqs = rope_freqs(0, s, c.rotary_dim, c.rope_theta)
                 ctx = flash_attention_packed(
                     qkv, queries_per_group=qpg, head_dim=dh,

@@ -738,7 +738,12 @@ def packed_geometry(num_groups: int, qpg: int, head_dim: int):
 
 
 def _packed_supported(s, num_groups, qpg, head_dim):
-    return (s % 128 == 0 and s <= 1024 and head_dim % 8 == 0
+    # any s up to 1024: rows pad to the 8-sublane multiple inside
+    # flash_attention_packed (padded keys masked via kv_lengths; padded
+    # query rows sliced off), and Mosaic handles the ragged lane extents
+    # of the (s, s) logits block correctly (verified on hardware at
+    # s=200/520 — reductions respect logical shapes)
+    return (round_up(s, 8) <= 1024 and head_dim % 8 == 0
             and packed_geometry(num_groups, qpg, head_dim) is not None)
 
 
@@ -1096,8 +1101,21 @@ def flash_attention_packed(
         raise ValueError(
             f"packed attention unsupported for s={s}, groups={g}, "
             f"qpg={qpg}, d={d} — gate on packed_attention_supported()")
-    return _flash_packed(qkv, kv_lengths, cos, sin, scale, causal,
-                         sliding_window, qpg, d, rot)
+    sp = round_up(s, 8)
+    if sp != s:
+        # pad rows to the sublane multiple; padded KEY slots are masked
+        # via kv_lengths (a padded QUERY row then holds a real softmax
+        # over the true keys — harmless: its rows are sliced off, and in
+        # the VJP its do rows are zero so it contributes nothing)
+        qkv = jnp.pad(qkv, ((0, sp - s), (0, 0), (0, 0)))
+        kv_lengths = (jnp.full((b,), s, jnp.int32) if kv_lengths is None
+                      else kv_lengths)
+        if cos is not None:
+            cos = jnp.pad(cos, ((0, sp - s), (0, 0)), constant_values=1.0)
+            sin = jnp.pad(sin, ((0, sp - s), (0, 0)))
+    out = _flash_packed(qkv, kv_lengths, cos, sin, scale, causal,
+                        sliding_window, qpg, d, rot)
+    return out[:s] if sp != s else out
 
 
 def packed_attention_supported(s: int, num_groups: int,

@@ -96,11 +96,12 @@ def group_rows(rows):
     """Bucket an op table into coarse classes -> {class: total_us}."""
     out = defaultdict(float)
     for us, _, name in rows:
+        iname = name.split(" = ")[0].lstrip("%")
         if ('custom_call_target="tpu_custom_call"' in name
-                or " custom-call(" in name):
+                or " custom-call(" in name
+                or iname.startswith("closed_call")):
             out["pallas-kernel"] += us
             continue
-        iname = name.split(" = ")[0].lstrip("%")
         for gname, pat in _GROUPS:
             if pat.search(iname):
                 out[gname] += us

@@ -347,15 +347,47 @@ class TestPackedQKV:
             np.testing.assert_allclose(np.asarray(gp), np.asarray(gr),
                                        rtol=2e-3, atol=2e-3)
 
+    @pytest.mark.parametrize("s,causal", [(100, True), (197, False)])
+    def test_ragged_s_pads_internally(self, s, causal):
+        # ViT-class lengths (197 = 196 patches + CLS): rows pad to the
+        # sublane multiple, padded keys masked via kv_lengths, padded
+        # query rows sliced off
+        b, g, qpg, d = 2, 4, 1, 64
+        qkv = _rand((s, b, g * (qpg + 2) * d), seed=61)
+
+        def packed_loss(qkv):
+            o = flash_attention_packed(qkv, queries_per_group=qpg,
+                                       head_dim=d, causal=causal)
+            assert o.shape == (s, b, g * qpg * d)
+            return jnp.sum(o.astype(jnp.float32) ** 2), o
+
+        def ref_loss(qkv):
+            qkv5 = qkv.reshape(s, b, g, qpg + 2, d)
+            qq = qkv5[:, :, :, 0].transpose(1, 2, 0, 3)
+            kk = qkv5[:, :, :, 1].transpose(1, 2, 0, 3)
+            vv = qkv5[:, :, :, 2].transpose(1, 2, 0, 3)
+            o4 = _mha_reference(qq, kk, vv, None, 1.0 / np.sqrt(d), causal)
+            o = o4.transpose(2, 0, 1, 3).reshape(s, b, g * d)
+            return jnp.sum(o.astype(jnp.float32) ** 2), o
+
+        (_, op), gp = jax.value_and_grad(packed_loss, has_aux=True)(qkv)
+        (_, orf), gr = jax.value_and_grad(ref_loss, has_aux=True)(qkv)
+        np.testing.assert_allclose(np.asarray(op), np.asarray(orf),
+                                   rtol=2e-3, atol=2e-3)
+        np.testing.assert_allclose(np.asarray(gp), np.asarray(gr),
+                                   rtol=2e-3, atol=2e-3)
+
     def test_geometry_gate(self):
         # d=64, qpg odd -> two groups per cell; odd group count unsupported
         assert packed_geometry(16, 1, 64) == (2, 384, 128)
         assert packed_geometry(3, 1, 64) is None
         assert packed_geometry(4, 2, 64) == (1, 256, 128)
         assert packed_geometry(2, 1, 128) == (1, 384, 128)
-        # s gating: 128-multiples up to 1024 only
+        # s gating: anything up to 1024 (ragged s pads to the sublane
+        # multiple internally); beyond that the (s, s) block leaves VMEM
         assert packed_attention_supported(1024, 16, 1, 64)
-        assert not packed_attention_supported(1000, 16, 1, 64)
+        assert packed_attention_supported(1000, 16, 1, 64)
+        assert packed_attention_supported(197, 16, 1, 64)
         assert not packed_attention_supported(2048, 16, 1, 64)
 
 
