@@ -40,7 +40,13 @@ def _sync_moments(x32: jax.Array, reduce_axes, axis_name: Optional[str],
     .py`` pins it). Under shard_map every rank's SHAPES are equal by
     construction, so ranks with fewer real samples pad and mask.
     """
-    sync = axis_name is not None and not initializing
+    from apex_tpu.transformer.tensor_parallel.mappings import axis_bound
+
+    # outside shard_map the axis is unbound and the collectives degrade to
+    # the identity — the same single-program convention as the TP mappings
+    # (a convert_syncbn_model'd module then runs standalone for debugging)
+    sync = (axis_name is not None and not initializing
+            and axis_bound(axis_name))
     if sample_mask is None:
         n_local = 1
         for a in reduce_axes:
@@ -90,7 +96,9 @@ class SyncBatchNorm(nn.Module):
     NHWC fast path — on TPU NHWC is the native conv layout anyway).
     """
 
-    num_features: int
+    # None: inferred from the input's channel axis at call time (the
+    # convert_syncbn_model path — flax BatchNorm carries no static width)
+    num_features: Optional[int] = None
     eps: float = 1e-5
     momentum: float = 0.1
     affine: bool = True
@@ -99,6 +107,10 @@ class SyncBatchNorm(nn.Module):
     axis_name: Optional[str] = None
     fuse_relu: bool = False
     param_dtype: Any = jnp.float32
+    # True (default): running var stores the UNBIASED estimator (torch /
+    # reference apex semantics). convert_syncbn_model sets False to
+    # preserve flax BatchNorm's biased-batch-variance eval behavior.
+    unbiased_running_var: bool = True
 
     @nn.compact
     def __call__(self, x, use_running_stats: bool = False, sample_mask=None):
@@ -108,11 +120,14 @@ class SyncBatchNorm(nn.Module):
         reference's ``two_gpu_test_different_batch_size.py`` capability).
         Masked rows still produce normalized outputs; mask them downstream.
         """
-        c = self.num_features
         if self.channel_last:
             reduce_axes = tuple(range(x.ndim - 1))
+            c = (self.num_features if self.num_features is not None
+                 else x.shape[-1])
         else:
             reduce_axes = (0,) + tuple(range(2, x.ndim))
+            c = (self.num_features if self.num_features is not None
+                 else x.shape[1])
 
         ra_mean = self.variable("batch_stats", "mean",
                                 lambda: jnp.zeros((c,), jnp.float32))
@@ -133,7 +148,8 @@ class SyncBatchNorm(nn.Module):
                 # (count == 0) must be a true no-op on the running stats —
                 # the count guard zeroes mean/var, and blending those in
                 # would decay the stats toward 0 (ADVICE r4)
-                unbiased = var * count / jnp.maximum(count - 1.0, 1.0)
+                unbiased = (var * count / jnp.maximum(count - 1.0, 1.0)
+                            if self.unbiased_running_var else var)
                 keep = count > 0
                 ra_mean.value = jnp.where(
                     keep, (1 - self.momentum) * ra_mean.value
@@ -156,13 +172,99 @@ class SyncBatchNorm(nn.Module):
         return y.astype(x.dtype)
 
 
-def convert_syncbn_model(module: nn.Module, axis_name: Optional[str] = None) -> nn.Module:
-    """Parity stub for ``apex.parallel.convert_syncbn_model``
-    (``apex/parallel/__init__.py:21-77``). flax modules are immutable; models
-    in this framework take a ``norm`` factory instead — see
-    ``apex_tpu.models.resnet`` for the pattern. Raises with guidance."""
-    raise NotImplementedError(
-        "flax modules are declarative: construct the model with "
-        "SyncBatchNorm (e.g. ResNet(norm=SyncBatchNorm, axis_name=...)) "
-        "instead of converting after the fact."
+def _syncbn_of(bn: nn.BatchNorm, axis_name: Optional[str]) -> "SyncBatchNorm":
+    """Map one ``flax.linen.BatchNorm`` to an equivalent SyncBatchNorm.
+    Collection/param names match (params scale/bias, batch_stats mean/var)
+    and the module ``name`` is preserved, so an existing train state keeps
+    working on the converted model. flax's ``momentum`` is the EMA
+    RETENTION (ra = m*ra + (1-m)*new); ours is the torch-style update
+    weight — hence ``1 - momentum``."""
+    if bn.axis != -1:
+        raise NotImplementedError(
+            f"convert_syncbn_model: BatchNorm over axis {bn.axis}; only the "
+            f"trailing channel axis (-1) maps onto SyncBatchNorm")
+    if bn.use_scale != bn.use_bias:
+        raise NotImplementedError(
+            "convert_syncbn_model: BatchNorm with use_scale != use_bias has "
+            "no SyncBatchNorm equivalent (affine is a single flag)")
+    if bn.use_running_average:
+        raise NotImplementedError(
+            "convert_syncbn_model: use_running_average=True is an eval-mode "
+            "module; pass use_running_stats=True at call time instead")
+    defaults = nn.BatchNorm(use_running_average=False)
+    if (bn.scale_init is not defaults.scale_init
+            or bn.bias_init is not defaults.bias_init):
+        raise NotImplementedError(
+            "convert_syncbn_model: custom scale_init/bias_init are not "
+            "representable on SyncBatchNorm (params are transferred, so "
+            "initializers only matter for fresh init — init the original "
+            "model and convert, or drop the custom initializers)")
+    # a BatchNorm that already syncs over its own axis_name keeps that
+    # axis unless the converter names one explicitly — dropping it would
+    # silently de-synchronize the statistics
+    sync_axis = axis_name if axis_name is not None else bn.axis_name
+    return SyncBatchNorm(
+        num_features=None,                    # inferred at call time
+        eps=bn.epsilon,
+        momentum=1.0 - bn.momentum,
+        affine=bn.use_scale,
+        channel_last=True,
+        axis_name=sync_axis,
+        # flax stores the BIASED batch variance in its running stats
+        # (torch — and this module's default — stores unbiased): preserve
+        # the SOURCE module's eval-mode behavior
+        unbiased_running_var=False,
+        name=bn.name,
     )
+
+
+def convert_syncbn_model(module: nn.Module,
+                         axis_name: Optional[str] = None) -> nn.Module:
+    """Functional analog of ``apex.parallel.convert_syncbn_model``
+    (``apex/parallel/__init__.py:21-77``): return a copy of ``module`` with
+    every ``flax.linen.BatchNorm`` replaced by :class:`SyncBatchNorm`
+    synchronizing over ``axis_name``.
+
+    The reference walks ``named_children`` of a mutable torch module tree;
+    the flax equivalent rebuilds the (frozen) dataclass tree, converting
+    submodules held in dataclass fields, lists/tuples and dicts. Converted
+    modules keep their names and the flax BN param/collection layout
+    (params ``scale``/``bias``, batch_stats ``mean``/``var``), so existing
+    parameters transfer unchanged. Limitation (inherent to flax):
+    submodules constructed inside ``setup()``/``@nn.compact`` bodies are
+    not dataclass fields and cannot be rewritten from outside — models in
+    this framework take a norm factory for that case (see
+    ``apex_tpu.models.resnet``)."""
+    import dataclasses
+    from collections.abc import Mapping
+
+    def conv(v):
+        if isinstance(v, nn.BatchNorm):
+            return _syncbn_of(v, axis_name)
+        if isinstance(v, nn.Module) and dataclasses.is_dataclass(v):
+            updates = {}
+            for f in dataclasses.fields(v):
+                if f.name in ("parent", "name") or not f.init:
+                    continue
+                val = getattr(v, f.name)
+                nv = conv(val)
+                if nv is not val:
+                    updates[f.name] = nv
+            if not updates:
+                return v
+            return v.clone(**updates)
+        if isinstance(v, (list, tuple)):
+            items = [conv(x) for x in v]
+            if all(a is b for a, b in zip(items, v)):
+                return v
+            if hasattr(v, "_fields"):          # NamedTuple
+                return type(v)(*items)
+            return type(v)(items)
+        if isinstance(v, Mapping):
+            items = {k: conv(x) for k, x in v.items()}
+            if all(items[k] is v[k] for k in v):
+                return v
+            return type(v)(items)              # preserves FrozenDict etc.
+        return v
+
+    return conv(module)
