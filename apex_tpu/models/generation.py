@@ -38,16 +38,19 @@ def cast_decode_params(params, compute_dtype):
     return tree_map_with_path(cast, params)
 
 
-def flatten_decode_caches(stacked_caches, num_layers: int):
-    """Stacked ``(k, v)`` ``[L, b, h, S, d]`` prefill caches -> the FLAT
-    per-layer list form ``[(k, v)]`` of ``[b, S, h*d]`` — the fast decode
-    form (see :func:`init_kv_caches`)."""
-    ck, cv = stacked_caches
+def flatten_decode_caches(caches, num_layers: int):
+    """Prefill caches -> the FLAT per-layer list form ``[(k, v)]`` of
+    ``[b, S, h*d]`` — the fast decode form (see :func:`init_kv_caches`).
+    Accepts the stacked ``(k, v)`` ``[L, b, h, S, d]`` pair or the
+    per-layer list of 4D ``(k, v)`` pairs."""
 
     def fl(x):
         b, h, s, d = x.shape
         return x.transpose(0, 2, 1, 3).reshape(b, s, h * d)
 
+    if isinstance(caches, list):
+        return [(fl(k), fl(v)) for k, v in caches]
+    ck, cv = caches
     return [(fl(ck[i]), fl(cv[i])) for i in range(num_layers)]
 
 
@@ -126,9 +129,13 @@ def _gather_vocab(logits: jax.Array, axis_name: str) -> jax.Array:
     return logits
 
 
-def _cached_forward(model, params, caches, tokens: jax.Array, index):
+def _cached_forward(model, params, caches, tokens: jax.Array, index,
+                    last_only: bool = False):
     """Run ``tokens`` [batch, s] occupying cache slots [index, index+s) ->
-    (fp32 full-vocab logits [s, batch, V], new caches)."""
+    (fp32 full-vocab logits [s, batch, V], new caches). ``last_only``:
+    compute the LM head for the FINAL position only (returns [1, b, V]) —
+    a 1024-token prefill otherwise materializes [s, b, V] fp32 logits
+    (1.65 GB at GPT-2 vocab) of which sampling reads one row."""
     c = model.config
     emb_p = params["embedding"]
     s = tokens.shape[1]
@@ -143,6 +150,8 @@ def _cached_forward(model, params, caches, tokens: jax.Array, index):
     hidden, new_caches = model.transformer.apply(
         params["transformer"], hidden, kv_caches=caches, cache_index=index)
     from apex_tpu.models.gpt import lm_head_loss
+    if last_only:
+        hidden = hidden[-1:]
     logits = lm_head_loss(
         emb_p["word_embeddings"]["weight"], hidden, None, None, c)
     logits = _gather_vocab(logits, c.axis_name)
@@ -206,12 +215,12 @@ def generate(model, params, prompt: jax.Array, max_new_tokens: int, *,
     S = max_len or total
     if S < total:
         raise ValueError(f"max_len {S} < prompt+new tokens {total}")
-    # prefill runs on the STACKED form (its scan traces the layer body
-    # once — the stacked slice/restack tax is paid a single time and the
-    # HLO stays O(1) in depth), then unstacks ONCE into the per-layer
-    # list form for the decode scan, where per-step stacked slicing is
-    # the 2x bottleneck (PERF.md round 4)
-    caches = init_kv_caches(model, b, S)
+    # prefill runs on the per-layer LIST form (unrolled layer loop): the
+    # stacked form's scan re-slices and re-stacks the whole [L, ...]
+    # cache every layer (~2 ms of a ~20 ms 124M bs8 prefill — PERF.md
+    # round 5); the deeper unrolled HLO is a one-time compile cost
+    caches = init_kv_caches(model, b, S, stacked=False)
+    params = preslice_layer_params(params, c.num_layers)
     rng = jax.random.PRNGKey(0) if rng is None else rng
 
     out = jnp.zeros((b, total), prompt.dtype)
@@ -228,12 +237,11 @@ def generate(model, params, prompt: jax.Array, max_new_tokens: int, *,
 
     # batched prefill: one forward writes all prompt K/V; its last-position
     # logits produce the first generated token
-    prefill_logits, caches = _cached_forward(model, params, caches, prompt, 0)
-    # unstack ONCE into the FLAT per-layer list form for the decode scan
-    # ([b, S, h*d] keeps the cache minor dim full-lane) and pre-slice the
-    # stacked layer params outside it (PERF.md round 5)
+    prefill_logits, caches = _cached_forward(model, params, caches, prompt,
+                                             0, last_only=True)
+    # convert ONCE into the FLAT per-layer form for the decode scan
+    # ([b, S, h*d] keeps the cache minor dim full-lane — PERF.md round 5)
     caches = flatten_decode_caches(caches, c.num_layers)
-    params = preslice_layer_params(params, c.num_layers)
     first = pick_next(prefill_logits[-1], jax.random.fold_in(rng, 0))
     out = out.at[:, prompt_len].set(first)
     done0 = ((first == eos_token) if eos_token is not None

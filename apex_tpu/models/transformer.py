@@ -753,8 +753,23 @@ class ParallelAttention:
                 ck, k.astype(ck.dtype), (0, 0, cache_index, 0))
             cv = lax.dynamic_update_slice(
                 cv, v.astype(cv.dtype), (0, 0, cache_index, 0))
-            k, v = ck.astype(q.dtype), cv.astype(q.dtype)
             new_cache = (ck, cv)
+            if (isinstance(cache_index, int) and cache_index == 0
+                    and attention_mask is None and kv_lengths is None
+                    and (deterministic or c.attention_dropout == 0.0)):
+                # PREFILL fast path (statically at slot 0): queries occupy
+                # cache slots [0, s), so attention over the populated
+                # prefix is plain causal flash — the empty tail slots
+                # never enter the kernel, and the [s, S]-mask einsum path
+                # below (built for mid-cache offsets) is skipped entirely
+                ctx = flash_attention(
+                    q, ck[:, :, :s].astype(q.dtype),
+                    cv[:, :, :s].astype(q.dtype), causal=True,
+                    sliding_window=c.sliding_window)
+                ctx = ctx.transpose(2, 0, 1, 3).reshape(
+                    s, b, local_heads * dh)
+                return self.dense.apply(params["dense"], ctx), new_cache
+            k, v = ck.astype(q.dtype), cv.astype(q.dtype)
             # per-query causal+prefix mask over the padded cache: query i of
             # the slice may see slots j <= cache_index + i (the dispatcher's
             # offset-causal tril assumes queries sit at the cache END, which

@@ -199,6 +199,11 @@ def _syncbn_of(bn: nn.BatchNorm, axis_name: Optional[str]) -> "SyncBatchNorm":
             "representable on SyncBatchNorm (params are transferred, so "
             "initializers only matter for fresh init — init the original "
             "model and convert, or drop the custom initializers)")
+    if bn.axis_index_groups is not None:
+        raise NotImplementedError(
+            "convert_syncbn_model: axis_index_groups subgroup sync has no "
+            "SyncBatchNorm field — run the module under a sub-axis of the "
+            "mesh instead (docs/parallel.md, process-group subsets)")
     # a BatchNorm that already syncs over its own axis_name keeps that
     # axis unless the converter names one explicitly — dropping it would
     # silently de-synchronize the statistics

@@ -81,7 +81,10 @@ def bench_prefill(model, params, batch=8, prompt_len=1024, chain=10):
     in-jit chain amortizes dispatch to noise."""
     prompt = jax.random.randint(jax.random.PRNGKey(1), (batch, prompt_len),
                                 0, 50304)
-    caches = init_kv_caches(model, batch, prompt_len + 1)
+    # per-layer LIST caches + pre-sliced params: generate()'s prefill form
+    # (the stacked scan re-slices/restacks the whole cache every layer)
+    caches = init_kv_caches(model, batch, prompt_len + 1, stacked=False)
+    params = preslice_layer_params(params, model.config.num_layers)
 
     @jax.jit
     def prefill_chain(params, caches, prompt):
@@ -89,7 +92,8 @@ def bench_prefill(model, params, batch=8, prompt_len=1024, chain=10):
         # them would let XLA DCE ~300 MB of per-prefill cache stores)
         def body(carry, _):
             pr, caches = carry
-            logits, caches = _cached_forward(model, params, caches, pr, 0)
+            logits, caches = _cached_forward(model, params, caches, pr, 0,
+                                             last_only=True)
             tok = jnp.argmax(logits[-1], axis=-1).astype(pr.dtype)
             return (pr.at[:, 0].set(tok % 50304), caches), None
         (pr, caches), _ = jax.lax.scan(body, (prompt, caches), None,
@@ -153,7 +157,8 @@ def bench_decode(model, params, batch, prompt_len=128, chain=None):
 
     @jax.jit
     def prefill(params, caches, prompt):
-        logits, caches = _cached_forward(model, params, caches, prompt, 0)
+        logits, caches = _cached_forward(model, params, caches, prompt, 0,
+                                         last_only=True)
         first = jnp.argmax(logits[-1], axis=-1).astype(prompt.dtype)
         return caches, first
 
