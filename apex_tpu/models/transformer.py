@@ -675,10 +675,12 @@ class ParallelAttention:
             # emits the packed dqkv cotangent the wgrad GEMM wants (at
             # 355M the transposes + cotangent reassembly were ~18 ms of a
             # 202 ms step — PERF.md round 5)
+            drop_active = (not deterministic
+                           and c.attention_dropout > 0.0)
             if (kv_cache is None and cache_index is None
                     and attention_mask is None
                     and not c.context_parallel_method
-                    and (deterministic or c.attention_dropout == 0.0)
+                    and (not drop_active or rng is not None)
                     and packed_attention_supported(s, local_groups, qpg,
                                                    dh)):
                 freqs = None
@@ -687,12 +689,23 @@ class ParallelAttention:
                     # gated above) and no bound context axis (CP gated
                     # above)
                     freqs = rope_freqs(0, s, c.rotary_dim, c.rope_theta)
+                seed = None
+                if drop_active:
+                    # Megatron RNG semantics: attention dropout lives in a
+                    # model-parallel region — each TP rank draws its own
+                    # mask (same convention as _dropout)
+                    dkey = model_parallel_rng_key(rng, c.axis_name)
+                    seed = jax.random.randint(
+                        dkey, (1,), -2**31, 2**31 - 1, jnp.int32)
                 ctx = flash_attention_packed(
                     qkv, queries_per_group=qpg, head_dim=dh,
                     causal=c.attn_mask_type == AttnMaskType.causal,
                     kv_lengths=kv_lengths,
                     sliding_window=c.sliding_window,
-                    rope_freqs=freqs)
+                    rope_freqs=freqs,
+                    dropout_rate=(c.attention_dropout if drop_active
+                                  else 0.0),
+                    dropout_seed=seed)
                 return self.dense.apply(params["dense"], ctx)
             qkv = qkv.reshape(s, b, local_groups, qpg + 2, dh)
             q = qkv[:, :, :, :qpg].reshape(s, b, local_groups * qpg, dh)

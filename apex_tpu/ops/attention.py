@@ -409,13 +409,18 @@ def _run_fwd(q, k, v, kv_lengths, scale, causal, sq, sk, bq, bk,
 # ---------------------------------------------------------------------------
 
 def _recompute_p_ds(q, k, v, do, lse, delta, i, j, *, scale, bq, bk, sk,
-                    kvl, causal, window, q_off, k_off, need_mask=True):
+                    kvl, causal, window, q_off, k_off, need_mask=True,
+                    keep=None, inv_keep=1.0):
     """The flash-backward block recompute every backward kernel shares:
     rebuild the (bq, bk) probabilities from the stashed lse and form
     ``ds = p * (dp - delta)``. Returns ``(p, ds)`` (both fp32).
     ``need_mask=False`` (statically all-valid block: non-causal, no
     window/varlen, keys unpadded) skips the mask arithmetic — at short
-    sequence it is a measurable share of the kernel (round 5)."""
+    sequence it is a measurable share of the kernel (round 5).
+    ``keep``/``inv_keep``: attention-dropout mask regenerated from the
+    forward's seed — dp is masked+rescaled BEFORE the ds identity, which
+    stays exact because delta = do.o already sums the DROPPED probs (the
+    same softmax-jacobian algebra as the dropout-free case)."""
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
     if need_mask:
@@ -424,6 +429,8 @@ def _recompute_p_ds(q, k, v, do, lse, delta, i, j, *, scale, bq, bk, sk,
     p = jnp.exp(s - lse)
     dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
+    if keep is not None:
+        dp = jnp.where(keep, dp * inv_keep, 0.0)
     return p, p * (dp - delta)
 
 
@@ -747,6 +754,48 @@ def _packed_supported(s, num_groups, qpg, head_dim):
             and packed_geometry(num_groups, qpg, head_dim) is not None)
 
 
+def _drop_combo(b, head):
+    """The ONE (batch, global-head) -> hash-key mapping every dropout
+    mask shares: forward kernel, backward regeneration, XLA fallback and
+    the parity test all call this — a drifted copy would make the
+    backward regenerate a different mask than the forward applied, with
+    no error raised. Stride 4096 bounds heads per model."""
+    return b * 4096 + head
+
+
+def _hash_keep(seed, combo, shape, rate):
+    """Deterministic per-position dropout keep-mask: a murmur3-style
+    integer hash of (seed, combo, row, col) in pure elementwise uint32
+    math. The forward kernel, the backward's regeneration, interpret
+    mode and the XLA fallback therefore produce BIT-IDENTICAL masks —
+    unlike the Mosaic PRNG, whose bit-to-position assignment is not
+    stable across differently-compiled kernels (measured: a mask
+    extracted by a second kernel with the same seed differed). This is
+    how the backward re-derives the forward's mask without storing s^2
+    bytes (the reference fmha stores a philox offset for the same
+    purpose). ``combo`` folds (batch, global head) — scalar in-kernel,
+    broadcastable array on the XLA path. Keep probability = 1 - rate.
+    The row/col position keys are THIN (s,1)/(1,s) iotas combined by one
+    broadcasting op — two full-tile (s,s) uint32 iotas plus the hash
+    chain exceeded the 16 MB scoped-vmem stack by 2.4 MB in the s=1024
+    backward kernel."""
+    ones = tuple(1 for _ in shape[:-2])
+    r = jax.lax.broadcasted_iota(jnp.uint32, ones + (shape[-2], 1),
+                                 len(shape) - 2)
+    c = jax.lax.broadcasted_iota(jnp.uint32, ones + (1, shape[-1]),
+                                 len(shape) - 1)
+    k = (jnp.asarray(seed).astype(jnp.uint32)
+         + jnp.asarray(combo).astype(jnp.uint32) * jnp.uint32(0x27D4EB2F))
+    x = (r * jnp.uint32(0x9E3779B1) + k) ^ (c * jnp.uint32(0x85EBCA77))
+    x = x ^ (x >> jnp.uint32(16))
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> jnp.uint32(15))
+    x = x * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> jnp.uint32(16))
+    thresh = jnp.uint32(min(int(rate * 2.0 ** 32), 2 ** 32 - 1))
+    return x >= thresh
+
+
 def _rope_block(t, cos, sin, rot):
     """Rotate-half RoPE over the first ``rot`` columns of a (s, d) block
     (Megatron ``concat(f, f)`` convention: the sin/cos halves repeat, so
@@ -762,16 +811,21 @@ def _rope_block(t, cos, sin, rot):
     return (tf * cos + half * sin).astype(t.dtype)
 
 
-def _fwd_packed_kernel(kvl_ref, rope_refs, qkv_ref, o_ref, lse_ref, *,
+def _fwd_packed_kernel(kvl_ref, rope_refs, seed_ref, qkv_ref, o_ref,
+                       lse_ref, *,
                        scale, s, d, qpg, gpc, causal, window, need_mask,
-                       rot=0):
+                       rot=0, rate=0.0):
     """One grid cell = ``gpc`` whole K/V groups of one batch row. Slices are
     static column offsets into the packed slab; per-head math is the same
     one-pass softmax as :func:`_fwd_single_kernel` (sq == sk == s, offsets
     0 — a self-attention block is never fully masked, so no skip gate).
     ``rot > 0``: apply RoPE to the q/k slices in-kernel (the packed layout
-    has no pre-kernel [s,b,h,d] view to rotate)."""
+    has no pre-kernel [s,b,h,d] view to rotate). ``rate > 0``: attention
+    dropout on the (normalized) probabilities with an in-kernel PRNG mask
+    (torch semantics: softmax, then dropout, then @v — the 1/l
+    normalization commutes with the positionwise mask)."""
     b = pl.program_id(0)
+    cell = pl.program_id(1)
     for g in range(gpc):
         base = g * (qpg + 2) * d
         k = qkv_ref[:, base + qpg * d: base + (qpg + 1) * d]
@@ -796,18 +850,24 @@ def _fwd_packed_kernel(kvl_ref, rope_refs, qkv_ref, o_ref, lse_ref, *,
                 m = jnp.max(sm, axis=1, keepdims=True)
                 p = jnp.exp(sm - m)
             l = jnp.sum(p, axis=1, keepdims=True)
+            h = g * qpg + j
+            if rate > 0.0:
+                keep = _hash_keep(seed_ref[0],
+                                  _drop_combo(b, cell * (gpc * qpg) + h),
+                                  p.shape, rate)
+                p = jnp.where(keep, p * (1.0 / (1.0 - rate)), 0.0)
             o = jax.lax.dot(p.astype(v.dtype), v,
                             preferred_element_type=jnp.float32)
             o = o * jnp.where(l > 0, 1.0 / l, 0.0)
-            h = g * qpg + j
             o_ref[:, h * d:(h + 1) * d] = o.astype(o_ref.dtype)
             lse = jnp.where(l > 0, m + jnp.log(l), _LSE_PAD)
             lse_ref[0, h] = lse.reshape(1, s)
 
 
-def _dqkv_packed_kernel(kvl_ref, rope_refs, qkv_ref, do_ref, o_ref, lse_ref,
+def _dqkv_packed_kernel(kvl_ref, rope_refs, seed_ref, qkv_ref, do_ref,
+                        o_ref, lse_ref,
                         dqkv_ref, *, scale, s, d, qpg, gpc, causal, window,
-                        need_mask, rot=0):
+                        need_mask, rot=0, rate=0.0):
     """Fused one-pass backward writing dq/dk/dv straight into the packed
     [s, cell-width] layout. dK/dV accumulate over the cell's query group in
     registers (the whole group lives in one cell by construction). delta
@@ -816,8 +876,11 @@ def _dqkv_packed_kernel(kvl_ref, rope_refs, qkv_ref, do_ref, o_ref, lse_ref,
     ``rot > 0``: the recompute rotates q/k exactly as the forward did, and
     the emitted dq/dk are un-rotated (RoPE is skew-orthogonal per row:
     inverse = same map with -sin) so the cotangent matches the RAW packed
-    projection output."""
+    projection output. ``rate > 0``: the dropout keep-mask is regenerated
+    from the forward's (seed, batch, cell, head) coordinates — nothing is
+    stored."""
     b = pl.program_id(0)
+    cell = pl.program_id(1)
     if rot:
         cos, sin = rope_refs[0][...], rope_refs[1][...]
     for g in range(gpc):
@@ -839,19 +902,27 @@ def _dqkv_packed_kernel(kvl_ref, rope_refs, qkv_ref, do_ref, o_ref, lse_ref,
                                 jnp.float32),
                             axis=1, keepdims=True)
             kvl = kvl_ref[b] if kvl_ref is not None else None
+            keep = (None if rate == 0.0
+                    else _hash_keep(seed_ref[0],
+                                    _drop_combo(b, cell * (gpc * qpg) + h),
+                                    (s, s), rate))
             p, ds = _recompute_p_ds(
                 q, k, v, do,
                 lse_ref[0, h].reshape(1, s).T,
                 delta,
                 0, 0, scale=scale, bq=s, bk=s, sk=s, kvl=kvl,
                 causal=causal, window=window, q_off=0, k_off=0,
-                need_mask=need_mask)
+                need_mask=need_mask, keep=keep,
+                inv_keep=1.0 / (1.0 - rate) if rate else 1.0)
             dq = scale * jax.lax.dot(ds.astype(k.dtype), k,
                                      preferred_element_type=jnp.float32)
             if rot:
                 dq = _rope_block(dq, cos, -sin, rot)
             dqkv_ref[:, base + j * d: base + (j + 1) * d] = \
                 dq.astype(dqkv_ref.dtype)
+            if keep is not None:
+                # dV flows through the DROPPED probabilities
+                p = jnp.where(keep, p * (1.0 / (1.0 - rate)), 0.0)
             dv_acc = dv_acc + jax.lax.dot_general(
                 p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
@@ -866,8 +937,8 @@ def _dqkv_packed_kernel(kvl_ref, rope_refs, qkv_ref, do_ref, o_ref, lse_ref,
             dv_acc.astype(dqkv_ref.dtype)
 
 
-def _run_fwd_packed(qkv2, kv_lengths, rope, *, scale, s, batch, W, d, qpg,
-                    geom, heads, causal, window):
+def _run_fwd_packed(qkv2, kv_lengths, rope, drop, *, scale, s, batch, W,
+                    d, qpg, geom, heads, causal, window):
     """qkv2: [s, batch*W]; returns (o2 [s, batch*heads*d], lse [b,H,1,s]).
     ``geom`` is packed_geometry's (gpc, in_w, out_w) — the ONE source of
     the cell widths the BlockSpecs and kernel loop bounds share. ``rope``:
@@ -886,11 +957,18 @@ def _run_fwd_packed(qkv2, kv_lengths, rope, *, scale, s, batch, W, d, qpg,
         rot = int(rope[2])
         kvl_spec = kvl_spec + [pl.BlockSpec((s, d), lambda b, c: (0, 0))] * 2
         args += [rope[0], rope[1]]
+    rate = 0.0
+    if drop is not None:
+        rate = float(drop[1])
+        kvl_spec = kvl_spec + [pl.BlockSpec(memory_space=pltpu.SMEM)]
+        args.append(drop[0])
     o, lse = pl.pallas_call(
         _wrap_kernel_nooffs(_fwd_packed_kernel, kv_lengths, rope,
+                            dropout=drop is not None,
                             scale=scale,
                             s=s, d=d, qpg=qpg, gpc=gpc, causal=causal,
-                            window=window, need_mask=need_mask, rot=rot),
+                            window=window, need_mask=need_mask, rot=rot,
+                            rate=rate),
         grid=(batch, n_cells),
         in_specs=kvl_spec + [
             pl.BlockSpec((s, in_w), lambda b, c: (0, b * n_cells + c)),
@@ -910,8 +988,8 @@ def _run_fwd_packed(qkv2, kv_lengths, rope, *, scale, s, batch, W, d, qpg,
     return o, lse
 
 
-def _run_bwd_packed(qkv2, do2, o2, lse, kv_lengths, rope, *, scale, s,
-                    batch, W, d, qpg, geom, heads, causal, window):
+def _run_bwd_packed(qkv2, do2, o2, lse, kv_lengths, rope, drop, *, scale,
+                    s, batch, W, d, qpg, geom, heads, causal, window):
     gpc, in_w, out_w = geom
     n_cells = W // in_w
     hpc = gpc * qpg
@@ -926,11 +1004,18 @@ def _run_bwd_packed(qkv2, do2, o2, lse, kv_lengths, rope, *, scale, s,
         rot = int(rope[2])
         kvl_spec = kvl_spec + [pl.BlockSpec((s, d), lambda b, c: (0, 0))] * 2
         args += [rope[0], rope[1]]
+    rate = 0.0
+    if drop is not None:
+        rate = float(drop[1])
+        kvl_spec = kvl_spec + [pl.BlockSpec(memory_space=pltpu.SMEM)]
+        args.append(drop[0])
     return pl.pallas_call(
         _wrap_kernel_nooffs(_dqkv_packed_kernel, kv_lengths, rope,
+                            dropout=drop is not None,
                             scale=scale,
                             s=s, d=d, qpg=qpg, gpc=gpc, causal=causal,
-                            window=window, need_mask=need_mask, rot=rot),
+                            window=window, need_mask=need_mask, rot=rot,
+                            rate=rate),
         grid=(batch, n_cells),
         in_specs=kvl_spec + [
             pl.BlockSpec((s, in_w), lambda b, c: (0, b * n_cells + c)),
@@ -946,10 +1031,11 @@ def _run_bwd_packed(qkv2, do2, o2, lse, kv_lengths, rope, *, scale, s,
     )(*args, qkv2, do2, o2, lse)
 
 
-def _wrap_kernel_nooffs(fn, kv_lengths, rope, **kw):
+def _wrap_kernel_nooffs(fn, kv_lengths, rope, dropout=False, **kw):
     """Like :func:`_wrap_kernel` for the packed kernels (no offsets
     operand: sq == sk == s, offsets statically zero). Slots None into the
-    kernel's ``kvl_ref``/``rope_refs`` positions for absent operands."""
+    kernel's ``kvl_ref``/``rope_refs``/``seed_ref`` positions for absent
+    operands."""
     have_kvl = kv_lengths is not None
 
     def wrapped(*refs, **k2):
@@ -960,7 +1046,10 @@ def _wrap_kernel_nooffs(fn, kv_lengths, rope, **kw):
         rope_refs = None
         if rope is not None:
             rope_refs, idx = (refs[idx], refs[idx + 1]), idx + 2
-        return fn(kvl, rope_refs, *refs[idx:], **k2)
+        seed_ref = None
+        if dropout:
+            seed_ref, idx = refs[idx], idx + 1
+        return fn(kvl, rope_refs, seed_ref, *refs[idx:], **k2)
 
     return functools.partial(wrapped, **kw)
 
@@ -976,11 +1065,12 @@ def _packed_unpack(qkv, qpg, d):
     return (t.transpose(1, 2, 0, 3) for t in (q, k, v))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
-def _flash_packed(qkv, kv_lengths, rope_cos, rope_sin, scale, causal,
-                  window, qpg, d, rot):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11))
+def _flash_packed(qkv, kv_lengths, rope_cos, rope_sin, seed, scale, causal,
+                  window, qpg, d, rot, rate):
     o, _ = _flash_packed_fwd_impl(qkv, kv_lengths, rope_cos, rope_sin,
-                                  scale, causal, window, qpg, d, rot)
+                                  seed, scale, causal, window, qpg, d, rot,
+                                  rate)
     return o
 
 
@@ -995,39 +1085,49 @@ def _rope_tuple(rope_cos, rope_sin, rot):
     return None if rot == 0 else (rope_cos, rope_sin, rot)
 
 
-def _flash_packed_fwd_impl(qkv, kv_lengths, rope_cos, rope_sin, scale,
-                           causal, window, qpg, d, rot):
+def _drop_tuple(seed, rate):
+    return None if rate == 0.0 else (seed, rate)
+
+
+def _flash_packed_fwd_impl(qkv, kv_lengths, rope_cos, rope_sin, seed,
+                           scale, causal, window, qpg, d, rot, rate):
     s, b, W, g, geom, heads = _packed_geom_of(qkv, qpg, d)
     o2, lse = _run_fwd_packed(
         qkv.reshape(s, b * W), kv_lengths, _rope_tuple(rope_cos, rope_sin,
                                                        rot),
+        _drop_tuple(seed, rate),
         scale=scale, s=s, batch=b, W=W,
         d=d, qpg=qpg, geom=geom, heads=heads, causal=causal, window=window)
     return o2.reshape(s, b, heads * d), lse
 
 
-def _flash_packed_vjp_fwd(qkv, kv_lengths, rope_cos, rope_sin, scale,
-                          causal, window, qpg, d, rot):
+def _flash_packed_vjp_fwd(qkv, kv_lengths, rope_cos, rope_sin, seed, scale,
+                          causal, window, qpg, d, rot, rate):
     o, lse = _flash_packed_fwd_impl(qkv, kv_lengths, rope_cos, rope_sin,
-                                    scale, causal, window, qpg, d, rot)
-    return o, (qkv, kv_lengths, rope_cos, rope_sin, o, lse)
+                                    seed, scale, causal, window, qpg, d,
+                                    rot, rate)
+    return o, (qkv, kv_lengths, rope_cos, rope_sin, seed, o, lse)
 
 
-def _flash_packed_vjp_bwd(scale, causal, window, qpg, d, rot, res, do):
-    qkv, kv_lengths, rope_cos, rope_sin, o, lse = res
+def _flash_packed_vjp_bwd(scale, causal, window, qpg, d, rot, rate, res,
+                          do):
+    qkv, kv_lengths, rope_cos, rope_sin, seed, o, lse = res
     s, b, W, g, geom, heads = _packed_geom_of(qkv, qpg, d)
     dqkv = _run_bwd_packed(
         qkv.reshape(s, b * W), do.reshape(s, b * heads * d),
         o.reshape(s, b * heads * d), lse,
         kv_lengths, _rope_tuple(rope_cos, rope_sin, rot),
+        _drop_tuple(seed, rate),
         scale=scale, s=s, batch=b, W=W, d=d, qpg=qpg, geom=geom,
         heads=heads, causal=causal, window=window)
     dkvl = (None if kv_lengths is None
             else np.zeros(kv_lengths.shape, dtype=jax.dtypes.float0))
-    # rope tables are position constants (zero cotangent)
+    # rope tables / dropout seed are constants (zero cotangent)
     dcos = None if rope_cos is None else jnp.zeros_like(rope_cos)
     dsin = None if rope_sin is None else jnp.zeros_like(rope_sin)
-    return dqkv.reshape(s, b, W), dkvl, dcos, dsin
+    dseed = (None if seed is None
+             else np.zeros(seed.shape, dtype=jax.dtypes.float0))
+    return dqkv.reshape(s, b, W), dkvl, dcos, dsin, dseed
 
 
 _flash_packed.defvjp(_flash_packed_vjp_fwd, _flash_packed_vjp_bwd)
@@ -1043,6 +1143,8 @@ def flash_attention_packed(
     kv_lengths: Optional[jax.Array] = None,
     sliding_window: Optional[int] = None,
     rope_freqs: Optional[jax.Array] = None,
+    dropout_rate: float = 0.0,
+    dropout_seed: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Self-attention over a packed QKV projection, layout-native.
 
@@ -1064,6 +1166,16 @@ def flash_attention_packed(
     convention, rot_dim even): q and k are rotated IN-KERNEL — the packed
     layout never materializes a pre-kernel [s,b,h,d] view to rotate — and
     the VJP un-rotates dq/dk so the cotangent matches the raw projection.
+
+    ``dropout_rate``/``dropout_seed``: attention dropout on the softmax
+    probabilities (torch semantics; the reference fmha capability),
+    applied in-kernel from a position-deterministic integer hash mask
+    (:func:`_hash_keep`) that the backward REGENERATES from the same
+    (seed, batch, head, position) coordinates — no s^2 mask bytes are
+    stored, and the Pallas kernels, interpret mode and the pure-XLA
+    fallback all drop the SAME positions for a given seed.
+    ``dropout_seed`` is an int32 ``[1]`` array; the caller derives it
+    from its PRNG key (distinct per layer/step as desired).
     """
     s, b, W = qkv.shape
     qpg, d = queries_per_group, head_dim
@@ -1086,6 +1198,11 @@ def flash_attention_packed(
         pad = ((0, 0), (0, d - rot))
         cos = jnp.pad(jnp.cos(f), pad, constant_values=1.0)
         sin = jnp.pad(jnp.sin(f), pad)
+    if dropout_rate < 0.0 or dropout_rate >= 1.0:
+        raise ValueError(f"dropout_rate must be in [0, 1), got "
+                         f"{dropout_rate}")
+    if dropout_rate > 0.0 and dropout_seed is None:
+        raise ValueError("dropout_rate > 0 needs a dropout_seed")
     if not use_pallas():
         q, k, v = _packed_unpack(qkv, qpg, d)
         if rot:
@@ -1095,7 +1212,9 @@ def flash_attention_packed(
             q = fused_rope(q.transpose(2, 0, 1, 3), f4).transpose(1, 2, 0, 3)
             k = fused_rope(k.transpose(2, 0, 1, 3), f4).transpose(1, 2, 0, 3)
         ctx = _mha_reference(q, k, v, kv_lengths, scale, causal,
-                             sliding_window)
+                             sliding_window,
+                             dropout_rate=dropout_rate,
+                             dropout_seed=dropout_seed)
         return ctx.transpose(2, 0, 1, 3).reshape(s, b, g * qpg * d)
     if not _packed_supported(s, g, qpg, d):
         raise ValueError(
@@ -1113,8 +1232,10 @@ def flash_attention_packed(
         if cos is not None:
             cos = jnp.pad(cos, ((0, sp - s), (0, 0)), constant_values=1.0)
             sin = jnp.pad(sin, ((0, sp - s), (0, 0)))
-    out = _flash_packed(qkv, kv_lengths, cos, sin, scale, causal,
-                        sliding_window, qpg, d, rot)
+    seed = (None if dropout_rate == 0.0
+            else dropout_seed.reshape((1,)).astype(jnp.int32))
+    out = _flash_packed(qkv, kv_lengths, cos, sin, seed, scale, causal,
+                        sliding_window, qpg, d, rot, float(dropout_rate))
     return out[:s] if sp != s else out
 
 
@@ -1513,7 +1634,8 @@ def flash_chunk_bwd(q, k, v, do, lse, delta, *, q_start, k_start,
 # reference (XLA) path
 # ---------------------------------------------------------------------------
 
-def _mha_reference(q, k, v, kv_lengths, scale, causal, window=None):
+def _mha_reference(q, k, v, kv_lengths, scale, causal, window=None,
+                   dropout_rate=0.0, dropout_seed=None):
     sq, sk = q.shape[2], k.shape[2]
     if k.shape[1] != q.shape[1]:     # GQA/MQA: broadcast the K/V heads
         group = q.shape[1] // k.shape[1]
@@ -1535,6 +1657,14 @@ def _mha_reference(q, k, v, kv_lengths, scale, causal, window=None):
     # fully-masked rows (empty batch elements / kv_lengths == 0) get zero
     # output + zero grads, matching the Pallas path's l == 0 guard
     p = jnp.where(jnp.any(valid, axis=-1, keepdims=True), p, 0.0)
+    if dropout_rate > 0.0:
+        b, h = p.shape[0], p.shape[1]
+        combo = _drop_combo(
+            jnp.arange(b, dtype=jnp.uint32)[:, None, None, None],
+            jnp.arange(h, dtype=jnp.uint32)[None, :, None, None])
+        keep = _hash_keep(jnp.asarray(dropout_seed).reshape(()), combo,
+                          p.shape, dropout_rate)
+        p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
     return jnp.einsum("bhqk,bhkd->bhqd", p,
                       v.astype(jnp.float32)).astype(q.dtype)
 

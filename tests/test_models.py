@@ -138,6 +138,30 @@ class TestGPT:
                          rng=jax.random.PRNGKey(2), deterministic=False)
         assert float(l1) != float(l2)
 
+    def test_attention_dropout_on_packed_path(self):
+        # attention dropout rides the packed kernels (in-kernel hash
+        # mask); must be seed-reproducible, seed-sensitive, trainable,
+        # and no-op when deterministic
+        cfg = small_config(attention_dropout=0.3)
+        model = GPTModel(cfg)
+        params = model.init(jax.random.PRNGKey(0))
+        b = _batch()
+
+        def loss(p, key):
+            return model.apply(p, b["tokens"], b["labels"], rng=key,
+                               deterministic=False)
+
+        l1 = loss(params, jax.random.PRNGKey(1))
+        l1b = loss(params, jax.random.PRNGKey(1))
+        l2 = loss(params, jax.random.PRNGKey(2))
+        ld = model.apply(params, b["tokens"], b["labels"])
+        np.testing.assert_allclose(float(l1), float(l1b))   # reproducible
+        assert float(l1) != float(l2)                       # seed-sensitive
+        assert float(l1) != float(ld)                       # dropout active
+        g = jax.grad(loss)(params, jax.random.PRNGKey(1))
+        assert all(bool(jnp.all(jnp.isfinite(x)))
+                   for x in jax.tree.leaves(g))
+
 
 class TestBert:
     def _bert(self, **kw):
