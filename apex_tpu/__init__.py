@@ -12,6 +12,7 @@ A from-scratch reimplementation of the *capabilities* of NVIDIA Apex
 - ``apex_tpu.contrib``        — optional extensions (focal loss, group norm, transducer, …)
 - ``apex_tpu.native``         — C++ host runtime (flatten/bucketing/staging pool/queues)
 - ``apex_tpu.data``           — prefetching host→device pipeline on the native queue
+- ``apex_tpu.resilience``     — fault-tolerant training driver (watchdog, rollback, retrying checkpoints)
 
 Where the reference dispatches CUDA kernels through pybind11 extensions
 (``setup.py:110-860``), this package dispatches Pallas TPU kernels with pure-XLA
@@ -31,6 +32,7 @@ from apex_tpu import normalization
 from apex_tpu import ops
 from apex_tpu import optimizers
 from apex_tpu import parallel
+from apex_tpu import resilience
 from apex_tpu import rnn
 from apex_tpu import transformer
 from apex_tpu.utils.logging import get_logger, RankInfoFormatter
@@ -51,6 +53,7 @@ __all__ = [
     "ops",
     "optimizers",
     "parallel",
+    "resilience",
     "rnn",
     "transformer",
     "get_logger",

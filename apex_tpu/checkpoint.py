@@ -21,7 +21,8 @@ so the whole train state round-trips through one call pair.
 from __future__ import annotations
 
 import os
-from typing import Any, Optional
+import time
+from typing import Any, Callable, List, Optional, Tuple
 
 import jax
 
@@ -29,6 +30,8 @@ __all__ = [
     "save_checkpoint",
     "load_checkpoint",
     "CheckpointManager",
+    "RetryingCheckpointManager",
+    "CheckpointSaveError",
 ]
 
 
@@ -97,25 +100,174 @@ class CheckpointManager:
                 save_interval_steps=save_interval_steps),
         )
 
-    def save(self, step: int, state: Any) -> bool:
+    def save(self, step: int, state: Any, *, force: bool = False) -> bool:
+        """``force=True`` bypasses ``save_interval_steps`` gating (and
+        overwrites an existing step) — the emergency-save path."""
         import orbax.checkpoint as ocp
-        saved = self._mgr.save(step, args=ocp.args.StandardSave(state))
+        saved = self._mgr.save(step, args=ocp.args.StandardSave(state),
+                               force=force)
         return saved
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
+    def all_steps(self) -> List[int]:
+        """Committed checkpoint steps, ascending. Uncommitted (killed
+        mid-write) step directories are excluded by orbax's atomicity
+        protocol, so everything listed here finished its write."""
+        return sorted(self._mgr.all_steps())
+
     def restore(self, template: Any):
-        import orbax.checkpoint as ocp
         step = self._mgr.latest_step()
         if step is None:
             return None
-        state = self._mgr.restore(
+        return step, self.restore_step(step, template)
+
+    def restore_step(self, step: int, template: Any) -> Any:
+        import orbax.checkpoint as ocp
+        return self._mgr.restore(
             step, args=ocp.args.StandardRestore(_as_restore_target(template)))
-        return step, state
+
+    def delete(self, step: int) -> None:
+        self._mgr.delete(step)
 
     def wait_until_finished(self) -> None:
         self._mgr.wait_until_finished()
 
     def close(self) -> None:
         self._mgr.close()
+
+
+class CheckpointSaveError(RuntimeError):
+    """A checkpoint save failed after exhausting its retry budget."""
+
+
+class RetryingCheckpointManager:
+    """Fault-tolerant wrapper over :class:`CheckpointManager` (the
+    storage-robustness slice of TorchTitan-style resilient checkpointing):
+
+    - ``save`` retries with exponential backoff — flaky storage must not
+      kill a training run over a transient error;
+    - ``restore_latest`` / ``restore_before`` treat a failed restore as a
+      corrupt checkpoint and fall back to the next-older step (optionally
+      deleting the corrupt one so it is never picked again);
+    - atomicity itself comes from orbax's commit protocol (a save killed
+      mid-write never becomes a listed step) — this layer adds recovery
+      for the committed-but-unreadable case (bit rot, truncated shards).
+
+    ``before_save`` is a hook called as ``before_save(step)`` at the top of
+    every save *attempt*; raising from it fails that attempt. It exists for
+    deterministic fault injection
+    (:class:`apex_tpu.testing_faults.FaultInjector`) but any callable works.
+
+    ``telemetry`` counts ``save_attempts`` / ``save_retries`` /
+    ``save_failures`` / ``restore_fallbacks`` / ``deleted_corrupt`` for the
+    structured failure logs.
+    """
+
+    def __init__(self, manager: CheckpointManager, *, max_retries: int = 3,
+                 backoff_base: float = 0.5, backoff_max: float = 8.0,
+                 delete_corrupt: bool = True,
+                 before_save: Optional[Callable[[int], None]] = None):
+        self.manager = manager
+        self.max_retries = int(max_retries)
+        self.backoff_base = float(backoff_base)
+        self.backoff_max = float(backoff_max)
+        self.delete_corrupt = bool(delete_corrupt)
+        self.before_save = before_save
+        self.telemetry = {"save_attempts": 0, "save_retries": 0,
+                          "save_failures": 0, "restore_fallbacks": 0,
+                          "deleted_corrupt": 0}
+
+    # -- save --------------------------------------------------------------
+    def save(self, step: int, state: Any, *, force: bool = False,
+             raise_on_failure: bool = False) -> bool:
+        """Save with retries. Returns True once a save attempt commits,
+        False when the step was gated by ``save_interval_steps`` or (with
+        ``raise_on_failure=False``) every retry failed — a failed periodic
+        save is logged and counted, not fatal; the caller keeps training
+        and the next interval tries again."""
+        from apex_tpu.utils.logging import get_logger, log_event
+
+        log = get_logger(__name__)
+        delay = self.backoff_base
+        last_err: Optional[BaseException] = None
+        for attempt in range(self.max_retries + 1):
+            self.telemetry["save_attempts"] += 1
+            try:
+                if self.before_save is not None:
+                    self.before_save(step)
+                if force or attempt > 0:
+                    # orbax force= only bypasses interval gating — an
+                    # existing step still raises StepAlreadyExists. A
+                    # forced save (emergency, retry, re-save after
+                    # rollback) replaces it.
+                    try:
+                        if step in self.manager.all_steps():
+                            self.manager.delete(step)
+                    except Exception:  # noqa: BLE001
+                        pass
+                # retries force: the failed attempt may have registered the
+                # step, and interval gating must not swallow the retry
+                saved = self.manager.save(step, state,
+                                          force=force or attempt > 0)
+                # surface async write errors here, inside the retry loop
+                self.manager.wait_until_finished()
+                return saved
+            except Exception as e:  # noqa: BLE001 — storage errors are varied
+                last_err = e
+                if attempt < self.max_retries:
+                    self.telemetry["save_retries"] += 1
+                    log_event(log, "checkpoint_save_retry", step=step,
+                              attempt=attempt, error=repr(e))
+                    if delay > 0:
+                        time.sleep(min(delay, self.backoff_max))
+                    delay *= 2.0
+        self.telemetry["save_failures"] += 1
+        log_event(log, "checkpoint_save_failed", step=step,
+                  retries=self.max_retries, error=repr(last_err),
+                  level="error")
+        if raise_on_failure:
+            raise CheckpointSaveError(
+                f"checkpoint save at step {step} failed after "
+                f"{self.max_retries} retries") from last_err
+        return False
+
+    # -- restore -----------------------------------------------------------
+    def restore_latest(self, template: Any) -> Optional[Tuple[int, Any]]:
+        """Restore the newest readable checkpoint, walking older on
+        corruption. Returns ``(step, state)`` or None when nothing is
+        restorable."""
+        return self.restore_before(None, template)
+
+    def restore_before(self, step_exclusive: Optional[int],
+                       template: Any) -> Optional[Tuple[int, Any]]:
+        """Like :meth:`restore_latest` but only considers steps strictly
+        below ``step_exclusive`` — the rollback path's "newest checkpoint
+        from before the poisoned window"."""
+        from apex_tpu.utils.logging import get_logger, log_event
+
+        log = get_logger(__name__)
+        steps = self.manager.all_steps()
+        if step_exclusive is not None:
+            steps = [s for s in steps if s < step_exclusive]
+        for step in reversed(steps):
+            try:
+                return step, self.manager.restore_step(step, template)
+            except Exception as e:  # noqa: BLE001 — corruption is varied
+                self.telemetry["restore_fallbacks"] += 1
+                log_event(log, "checkpoint_restore_fallback", step=step,
+                          error=repr(e))
+                if self.delete_corrupt:
+                    try:
+                        self.manager.delete(step)
+                        self.telemetry["deleted_corrupt"] += 1
+                    except Exception:  # noqa: BLE001
+                        pass  # unreadable AND undeletable: just skip it
+        return None
+
+    def wait_until_finished(self) -> None:
+        self.manager.wait_until_finished()
+
+    def close(self) -> None:
+        self.manager.close()

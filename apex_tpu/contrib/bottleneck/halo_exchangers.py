@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from apex_tpu.transformer.tensor_parallel.mappings import axis_bound
+from apex_tpu.transformer.tensor_parallel.mappings import axis_bound, axis_size
 
 __all__ = ["halo_exchange_1d", "HaloExchanger"]
 
@@ -40,7 +40,7 @@ def halo_exchange_1d(x: jax.Array, halo: int, *, dim: int = 1,
     if not axis_bound(axis_name):
         zeros = jnp.zeros_like(lax.slice_in_dim(x, 0, halo, axis=dim))
         return jnp.concatenate([zeros, x, zeros], axis=dim)
-    size = lax.axis_size(axis_name)
+    size = axis_size(axis_name)
     rank = lax.axis_index(axis_name)
     top = lax.slice_in_dim(x, 0, halo, axis=dim)
     bottom = lax.slice_in_dim(x, x.shape[dim] - halo, x.shape[dim], axis=dim)

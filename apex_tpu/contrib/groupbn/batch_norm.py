@@ -22,7 +22,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec
 
-from apex_tpu.transformer.tensor_parallel.mappings import axis_bound
+from apex_tpu.transformer.tensor_parallel.mappings import axis_bound, axis_size
 
 __all__ = ["BatchNorm2d_NHWC"]
 
@@ -66,7 +66,7 @@ class BatchNorm2d_NHWC:
                     self.bn_group_axis):
                 # sync Welford-style stats across the group (reference IPC
                 # peer reduction -> one psum over the axis)
-                group = lax.axis_size(self.bn_group_axis)
+                group = axis_size(self.bn_group_axis)
                 if group != self.bn_group:
                     from apex_tpu.transformer.parallel_state import (
                         UndersizedMeshError,

@@ -15,6 +15,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from apex_tpu.utils.sharding import axis_size
+
 __all__ = ["init_kv_caches", "decode_step", "generate",
            "cast_decode_params", "flatten_decode_caches",
            "preslice_layer_params"]
@@ -100,7 +102,7 @@ def init_kv_caches(model, batch_size: int, max_len: int,
     dtype = dtype or c.compute_dtype
     heads = c.kv_heads                     # == query heads unless GQA/MQA
     if axis_bound(c.axis_name):
-        tp = lax.axis_size(c.axis_name)
+        tp = axis_size(c.axis_name)
         if heads % tp:
             raise ValueError(
                 f"kv heads ({heads}) must be divisible by the "

@@ -49,6 +49,7 @@ from apex_tpu.transformer.tensor_parallel.layers import (
     RowParallelLinear,
 )
 from apex_tpu.transformer.tensor_parallel.mappings import (
+    axis_size,
     mark_sequence_parallel_parameter,
 )
 from apex_tpu.transformer.tensor_parallel.random import model_parallel_rng_key
@@ -264,7 +265,7 @@ def embed_tokens(embedding, emb_params, tokens, config, *, tokentype_params=None
             # out-of-range starts, so overlong sequences must be rejected
             # loudly here (the unsharded path fails with a shape error
             # instead).
-            cp = lax.axis_size(c.context_axis)
+            cp = axis_size(c.context_axis)
             if cp * s_local > c.max_position_embeddings:
                 raise ValueError(
                     f"global sequence length ({cp} context shards x "
@@ -466,7 +467,7 @@ class ParallelAttention:
             from apex_tpu.transformer.tensor_parallel.mappings import (
                 axis_bound,
             )
-            cp_sz = (lax.axis_size(c.context_axis)
+            cp_sz = (axis_size(c.context_axis)
                      if axis_bound(c.context_axis) else 1)
             if k.shape[1] % cp_sz:
                 # GQA under Ulysses needs kv_heads divisible by cp for the
@@ -644,9 +645,17 @@ class ParallelAttention:
 
     def apply(self, params, hidden, *, encoder_output=None,
               attention_mask=None, kv_lengths=None, kv_cache=None,
-              cache_index=None, rng=None, deterministic=True):
+              cache_index=None, rng=None, deterministic=True,
+              dropout_seed=None):
         """hidden: [s(, shard), b, h] -> [s(, shard), b, h]; cross-attention
         reads K/V from ``encoder_output`` [s_enc, b, h].
+
+        ``dropout_seed`` (scalar/``(1,)`` i32) overrides the packed path's
+        in-kernel attention-dropout hash seed — the transformer stack
+        passes a per-layer offset of ONE base draw so masks are
+        structurally distinct across layers (independent 32-bit draws per
+        layer collide at ~L^2/2^33 per step and would then share a mask).
+        The XLA/bernoulli dropout paths key on ``rng`` and ignore it.
 
         Incremental decoding: pass ``kv_cache=(k, v)`` (``[b, local_kv_heads,
         S_max, dh]`` each — K/V heads, i.e. ``num_query_groups`` under
@@ -691,12 +700,16 @@ class ParallelAttention:
                     freqs = rope_freqs(0, s, c.rotary_dim, c.rope_theta)
                 seed = None
                 if drop_active:
-                    # Megatron RNG semantics: attention dropout lives in a
-                    # model-parallel region — each TP rank draws its own
-                    # mask (same convention as _dropout)
-                    dkey = model_parallel_rng_key(rng, c.axis_name)
-                    seed = jax.random.randint(
-                        dkey, (1,), -2**31, 2**31 - 1, jnp.int32)
+                    if dropout_seed is not None:
+                        seed = jnp.asarray(dropout_seed,
+                                           jnp.int32).reshape(1)
+                    else:
+                        # Megatron RNG semantics: attention dropout lives
+                        # in a model-parallel region — each TP rank draws
+                        # its own mask (same convention as _dropout)
+                        dkey = model_parallel_rng_key(rng, c.axis_name)
+                        seed = jax.random.randint(
+                            dkey, (1,), -2**31, 2**31 - 1, jnp.int32)
                 ctx = flash_attention_packed(
                     qkv, queries_per_group=qpg, head_dim=dh,
                     causal=c.attn_mask_type == AttnMaskType.causal,
@@ -880,7 +893,7 @@ class ParallelTransformerLayer:
               enc_dec_attn_mask=None, enc_kv_lengths=None,
               attention_mask=None, kv_lengths=None, kv_cache=None,
               cache_index=None, rng=None, deterministic=True,
-              moe_drop_free=None):
+              moe_drop_free=None, attention_seed=None):
         """``encoder_output`` (decoder layers) must be the FULL encoder
         sequence ``[s_enc, b, h]`` — under sequence parallelism gather it
         first (``gather_from_sequence_parallel_region``), as
@@ -902,7 +915,8 @@ class ParallelTransformerLayer:
             params["self_attention"], x.astype(c.compute_dtype),
             attention_mask=attention_mask, kv_lengths=kv_lengths,
             kv_cache=kv_cache, cache_index=cache_index,
-            rng=rngs[2], deterministic=deterministic)
+            rng=rngs[2], deterministic=deterministic,
+            dropout_seed=attention_seed)
         new_cache = None
         if kv_cache is not None:
             attn_out, new_cache = attn_out
@@ -1015,6 +1029,27 @@ class ParallelTransformer:
         c = self.config
         moe = bool(c.num_moe_experts)
 
+        # attention-dropout seeds: ONE base draw per step, offset per layer
+        # by an odd constant (injective mod 2^32) — masks are structurally
+        # distinct across layers, where independent per-layer 32-bit draws
+        # collide (and then share a mask) at ~L^2/2^33 per step. The base
+        # key folds num_layers so it never collides with the per-layer
+        # fold_in(rng, idx) stream below; model_parallel_rng_key keeps the
+        # per-TP-rank distinctness of the in-attention derivation.
+        attn_seed_base = None
+        if (rng is not None and not deterministic
+                and c.attention_dropout > 0.0):
+            skey = model_parallel_rng_key(
+                jax.random.fold_in(rng, c.num_layers), c.axis_name)
+            attn_seed_base = jax.random.randint(
+                skey, (1,), -2 ** 31, 2 ** 31 - 1, jnp.int32)
+
+        def _attn_seed(idx):
+            if attn_seed_base is None:
+                return None
+            golden = jnp.int32(-1640531527)  # 0x9E3779B9, odd
+            return attn_seed_base + jnp.int32(idx) * golden
+
         # a LIST means per-layer (k, v) pairs (the stacked scan form is a
         # 2-TUPLE of [L, ...] arrays — do not widen this check to tuple)
         if kv_caches is not None and isinstance(kv_caches, list):
@@ -1060,7 +1095,8 @@ class ParallelTransformer:
                     kv_lengths=kv_lengths, kv_cache=layer_cache,
                     cache_index=cache_index, rng=layer_rng,
                     deterministic=deterministic,
-                    moe_drop_free=moe_drop_free)
+                    moe_drop_free=moe_drop_free,
+                    attention_seed=_attn_seed(idx))
                 new_caches.append(new_cache)
             if final_norm:
                 h = _ln(params["final_layernorm"], h, c.layernorm_epsilon,
@@ -1084,7 +1120,8 @@ class ParallelTransformer:
                     kv_lengths=kv_lengths, kv_cache=layer_cache,
                     cache_index=cache_index, rng=layer_rng,
                     deterministic=deterministic,
-                    moe_drop_free=moe_drop_free)
+                    moe_drop_free=moe_drop_free,
+                    attention_seed=_attn_seed(idx))
                 if layer_cache is not None:
                     return out        # (h, new_cache)
                 return out if moe else (out, jnp.zeros((), jnp.float32))

@@ -37,6 +37,17 @@ def pallas_interpret() -> bool:
     return pallas_mode() == "interpret"
 
 
+def tpu_compiler_params(**kwargs):
+    """``pltpu.CompilerParams`` across jax versions (0.4.x spells it
+    ``TPUCompilerParams``; the fields used here are identical)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        cls = pltpu.TPUCompilerParams
+    return cls(**kwargs)
+
+
 def round_up(x: int, multiple: int) -> int:
     return ((x + multiple - 1) // multiple) * multiple
 

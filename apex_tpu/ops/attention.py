@@ -36,7 +36,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from apex_tpu.ops._support import pallas_interpret, round_up, use_pallas
+from apex_tpu.ops._support import (pallas_interpret, round_up,
+                                   tpu_compiler_params, use_pallas)
 
 __all__ = ["flash_attention", "flash_attention_packed",
            "packed_attention_supported", "flash_chunk_fwd",
@@ -242,7 +243,7 @@ def _run_fwd_single(q, k, v, kv_lengths, scale, causal, sq, sk, bq, bk,
             jax.ShapeDtypeStruct((batch, heads, sqp, dp), q.dtype),
             jax.ShapeDtypeStruct((batch, heads, 1, sqp), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel")),
         interpret=pallas_interpret(),
     )(*args, q, k, v)
@@ -396,7 +397,7 @@ def _run_fwd(q, k, v, kv_lengths, scale, causal, sq, sk, bq, bk,
             pltpu.VMEM((bq, 128), jnp.float32),
             pltpu.VMEM((bq, dp), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=pallas_interpret(),
@@ -602,6 +603,20 @@ def _run_bwd_fused(q, k, v, do, lse, delta, kv_lengths, scale, causal,
     batch, heads, sqp, dp = q.shape
     kv_heads, skp = k.shape[1], k.shape[2]
     nq, nk = sqp // bq, skp // bk
+    # machine-check of the aliased dq read-modify-write precondition
+    # (_dqkv_fused_kernel: every consecutive grid step must touch a
+    # DISTINCT dq window, guaranteed by nq >= 2 with no banded-window
+    # grid). The default CI suite runs interpret mode, which never takes
+    # this path — so the invariant must hold by construction, not by
+    # suite coverage; a dispatcher change that violates it fails loudly
+    # here instead of corrupting gradients.
+    banded = window is not None and q_off is None and k_off is None
+    if nq < 2 or banded:
+        raise AssertionError(
+            f"_run_bwd_fused dispatched outside its precondition "
+            f"(nq={nq}, banded_window_grid={banded}): the aliased dq "
+            f"accumulation requires nq >= 2 and a non-banded grid — "
+            f"these shapes must keep the two-kernel backward")
 
     def _qh(h, t):
         return h * group + t // nq
@@ -643,7 +658,7 @@ def _run_bwd_fused(q, k, v, do, lse, delta, kv_lengths, scale, causal,
         scratch_shapes=[pltpu.VMEM((bk, dp), jnp.float32),
                         pltpu.VMEM((bk, dp), jnp.float32)],
         input_output_aliases={len(kvl_spec) + 1: 0},
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary",
                                  "arbitrary")),
         interpret=pallas_interpret(),
@@ -725,9 +740,12 @@ def _dqkv_single_kernel(offs_ref, kvl_ref, q_ref, k_ref, v_ref, do_ref,
 # those copies were ~18 ms of a 202 ms step (PERF.md round 5); a strided/
 # contiguous DMA A/B measured the layout-native reads at parity with the
 # [b,h,s,d] blocks (428 vs 445 us/call at b8 h16 s1024 d64). Single-block
-# only (s <= 1024, s % 128 == 0): the (s, s) fp32 logits of one cell must
-# fit VMEM, which is also the regime where the copies dominate (at 32k the
-# O(s) copies vanish next to O(s^2) attention work).
+# only — any s with round_up(s, 8) <= 1024 (see _packed_supported: ragged
+# lengths pad to the sublane multiple internally, padded keys masked via
+# kv_lengths) — because the (s, s) fp32 logits of one cell must fit VMEM,
+# which is also the regime where the copies dominate (at 32k the O(s)
+# copies vanish next to O(s^2) attention work). RoPE and attention dropout
+# run in-kernel on this path (rot/rate kernel params below).
 
 
 def packed_geometry(num_groups: int, qpg: int, head_dim: int):
@@ -981,7 +999,7 @@ def _run_fwd_packed(qkv2, kv_lengths, rope, drop, *, scale, s, batch, W,
             jax.ShapeDtypeStruct((s, batch * heads * d), qkv2.dtype),
             jax.ShapeDtypeStruct((batch, heads, 1, s), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel")),
         interpret=pallas_interpret(),
     )(*args, qkv2)
@@ -1025,7 +1043,7 @@ def _run_bwd_packed(qkv2, do2, o2, lse, kv_lengths, rope, drop, *, scale,
         ],
         out_specs=pl.BlockSpec((s, in_w), lambda b, c: (0, b * n_cells + c)),
         out_shape=jax.ShapeDtypeStruct(qkv2.shape, qkv2.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel")),
         interpret=pallas_interpret(),
     )(*args, qkv2, do2, o2, lse)
@@ -1301,7 +1319,7 @@ def _run_bwd_single(q, k, v, do, lse, delta, kv_lengths, scale, causal,
         ],
         scratch_shapes=[pltpu.VMEM((bk, dp), jnp.float32),
                         pltpu.VMEM((bk, dp), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=pallas_interpret(),
     )(*args, q, k, v, do, lse, delta)
@@ -1379,7 +1397,7 @@ def _run_bwd(q, k, v, do, lse, delta, kv_lengths, scale, causal,
         out_specs=pl.BlockSpec((1, 1, bq, dp), lambda b, h, i, j: (b, h, i, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, dp), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=pallas_interpret(),
@@ -1416,7 +1434,7 @@ def _run_bwd(q, k, v, do, lse, delta, kv_lengths, scale, causal,
         ],
         scratch_shapes=[pltpu.VMEM((bk, dp), jnp.float32),
                         pltpu.VMEM((bk, dp), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=pallas_interpret(),

@@ -41,6 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from apex_tpu.ops._support import pallas_interpret
 from apex_tpu.ops.attention import (
     _LSE_PAD,
     flash_attention,
@@ -48,7 +49,7 @@ from apex_tpu.ops.attention import (
     flash_chunk_fwd,
 )
 from apex_tpu.transformer.parallel_state import CONTEXT_AXIS
-from apex_tpu.transformer.tensor_parallel.mappings import axis_bound
+from apex_tpu.transformer.tensor_parallel.mappings import axis_bound, axis_size
 
 __all__ = ["ring_attention", "ulysses_attention"]
 
@@ -78,7 +79,7 @@ def _ring(q, k, v, kv_lengths, causal, window, scale, axis_name):
 
 
 def _ring_fwd_impl(q, k, v, kv_lengths, causal, window, scale, axis_name):
-    cp = lax.axis_size(axis_name)
+    cp = axis_size(axis_name)
     rank = lax.axis_index(axis_name)
     sc = q.shape[2]
     q_start = rank * sc
@@ -98,10 +99,19 @@ def _ring_fwd_impl(q, k, v, kv_lengths, causal, window, scale, axis_name):
         o, lse = _merge(o, lse, o_j, lse_j)
         return (kc, vc, o, lse), None
 
-    (_, _, o, lse), _ = lax.scan(
-        hop, (k, v, o0.astype(jnp.float32),
-              jnp.where(lse0 > _PAD_THRESH, -jnp.inf, lse0)),
-        jnp.arange(1, cp))
+    init = (k, v, o0.astype(jnp.float32),
+            jnp.where(lse0 > _PAD_THRESH, -jnp.inf, lse0))
+    if pallas_interpret():
+        # interpret-mode emulation (CPU tests): an interpret pallas_call
+        # inside a scan body trips XLA's SPMD partitioner (a PartitionId
+        # reaches it through the scan); cp is static, so unroll — compile
+        # time/temp memory only matter on the scan path real HW takes
+        carry = init
+        for t in range(1, cp):
+            carry, _ = hop(carry, t)
+        _, _, o, lse = carry
+    else:
+        (_, _, o, lse), _ = lax.scan(hop, init, jnp.arange(1, cp))
     return o.astype(q.dtype), lse
 
 
@@ -118,7 +128,7 @@ def _ring_vjp_fwd(q, k, v, kv_lengths, causal, window, scale, axis_name):
 
 def _ring_vjp_bwd(causal, window, scale, axis_name, res, do):
     q, k, v, kv_lengths, o, lse = res
-    cp = lax.axis_size(axis_name)
+    cp = axis_size(axis_name)
     rank = lax.axis_index(axis_name)
     sc = q.shape[2]
     q_start = rank * sc
@@ -144,11 +154,16 @@ def _ring_vjp_bwd(causal, window, scale, axis_name, res, do):
         kc, vc, dk, dv = _rotate((kc, vc, dk, dv), axis_name, cp)
         return (kc, vc, dk, dv, dq), None
 
-    zeros_kv = jnp.zeros(k.shape, jnp.float32)
-    (kc, vc, dk, dv, dq), _ = lax.scan(
-        hop, (k, v, zeros_kv, jnp.zeros(v.shape, jnp.float32),
-              jnp.zeros(q.shape, jnp.float32)),
-        jnp.arange(cp - 1))
+    init = (k, v, jnp.zeros(k.shape, jnp.float32),
+            jnp.zeros(v.shape, jnp.float32), jnp.zeros(q.shape, jnp.float32))
+    if pallas_interpret():
+        # unrolled under interpret-mode emulation — see _ring_fwd_impl
+        carry = init
+        for t in range(cp - 1):
+            carry, _ = hop(carry, t)
+        kc, vc, dk, dv, dq = carry
+    else:
+        (kc, vc, dk, dv, dq), _ = lax.scan(hop, init, jnp.arange(cp - 1))
     # final chunk: accumulate, then rotate ONLY the accumulators home — the
     # K/V chunks' last rotation would be discarded traffic
     dq_j, dk_j, dv_j = chunk_bwd(kc, vc, (rank - (cp - 1)) % cp)
@@ -193,7 +208,7 @@ def ring_attention(
     """
     if sliding_window is not None and not causal:
         raise ValueError("sliding_window requires causal attention")
-    if not axis_bound(axis_name) or lax.axis_size(axis_name) == 1:
+    if not axis_bound(axis_name) or axis_size(axis_name) == 1:
         return flash_attention(q, k, v, causal=causal,
                                softmax_scale=softmax_scale,
                                kv_lengths=kv_lengths,
@@ -221,12 +236,12 @@ def ulysses_attention(
     Requires ``heads % cp == 0``. Layouts as :func:`ring_attention`;
     ``kv_lengths``/``sliding_window`` apply to the full gathered sequence.
     """
-    if not axis_bound(axis_name) or lax.axis_size(axis_name) == 1:
+    if not axis_bound(axis_name) or axis_size(axis_name) == 1:
         return flash_attention(q, k, v, causal=causal,
                                softmax_scale=softmax_scale,
                                kv_lengths=kv_lengths,
                                sliding_window=sliding_window)
-    cp = lax.axis_size(axis_name)
+    cp = axis_size(axis_name)
     if q.shape[1] % cp:
         raise ValueError(
             f"ulysses_attention needs heads ({q.shape[1]}) divisible by the "

@@ -44,7 +44,7 @@ from apex_tpu.transformer.parallel_state import (
     PIPELINE_AXIS,
     TENSOR_AXIS,
 )
-from apex_tpu.transformer.tensor_parallel.mappings import axis_bound
+from apex_tpu.transformer.tensor_parallel.mappings import axis_bound, axis_size
 
 __all__ = ["DistributedFusedAdam"]
 
@@ -408,12 +408,12 @@ class DistributedFusedAdam(FusedAdam):
         flatten + unscale local grads, reduce-scatter (mean) to this rank's
         shard. Returns ``(g_local, sharded)``."""
         if axis_bound(self.axis_name):
-            axis_size = lax.axis_size(self.axis_name)  # static at trace time
-            if axis_size != self.num_shards:
+            bound_size = axis_size(self.axis_name)  # static at trace time
+            if bound_size != self.num_shards:
                 raise ValueError(
                     f"{type(self).__name__} was built with num_shards="
                     f"{self.num_shards} but the bound '{self.axis_name}' "
-                    f"axis has size {axis_size}; gradients would silently "
+                    f"axis has size {bound_size}; gradients would silently "
                     "desynchronize. Construct the optimizer after "
                     "initialize_model_parallel() (or pass num_shards).")
         sharded = axis_bound(self.axis_name) and self.num_shards > 1

@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.transformer import parallel_state
+from apex_tpu.utils.sharding import axis_size
 
 
 def all_reduce_gradients(
@@ -36,7 +37,7 @@ def all_reduce_gradients(
     inserts the reduction — but ``shard_map`` training steps need it, exactly
     where the reference needed NCCL allreduce.
     """
-    world = jax.lax.axis_size(axis_name)
+    world = axis_size(axis_name)
 
     def _reduce(g):
         orig_dtype = g.dtype

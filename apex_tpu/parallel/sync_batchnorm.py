@@ -204,6 +204,20 @@ def _syncbn_of(bn: nn.BatchNorm, axis_name: Optional[str]) -> "SyncBatchNorm":
             "convert_syncbn_model: axis_index_groups subgroup sync has no "
             "SyncBatchNorm field — run the module under a sub-axis of the "
             "mesh instead (docs/parallel.md, process-group subsets)")
+    if bn.dtype is not None:
+        raise NotImplementedError(
+            "convert_syncbn_model: BatchNorm dtype overrides the compute/"
+            "output dtype; SyncBatchNorm always computes statistics in "
+            "fp32 and returns the input dtype, so a non-default dtype "
+            "cannot be honored — drop it (fp32 stats subsume it) or keep "
+            "the flax module")
+    if getattr(bn, "use_fast_variance", True) is not True:
+        raise NotImplementedError(
+            "convert_syncbn_model: use_fast_variance has no SyncBatchNorm "
+            "field — its variance is always the two-pass centered form "
+            "(the csrc/welford.cu stability property), which is the "
+            "use_fast_variance=False math; drop the flag from the source "
+            "module")
     # a BatchNorm that already syncs over its own axis_name keeps that
     # axis unless the converter names one explicitly — dropping it would
     # silently de-synchronize the statistics
@@ -215,6 +229,7 @@ def _syncbn_of(bn: nn.BatchNorm, axis_name: Optional[str]) -> "SyncBatchNorm":
         affine=bn.use_scale,
         channel_last=True,
         axis_name=sync_axis,
+        param_dtype=bn.param_dtype,
         # flax stores the BIASED batch variance in its running stats
         # (torch — and this module's default — stores unbiased): preserve
         # the SOURCE module's eval-mode behavior

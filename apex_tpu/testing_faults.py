@@ -1,0 +1,122 @@
+"""Deterministic fault injection for the resilience layer.
+
+The reference's robustness machinery (amp's skip-step loop, AutoResume) is
+only ever exercised by real faults on real clusters; here every recovery
+path of :mod:`apex_tpu.resilience` is driven in tier-1 CPU tests by a
+scripted :class:`FaultInjector`:
+
+- **NaN gradients** — scheduled step calls get their batch poisoned to NaN,
+  which propagates to NaN loss/grads exactly as a numeric blow-up would
+  (the scaler sees ``found_inf``, the optimizer skips, the watchdog counts);
+- **checkpoint write failures** — scheduled save steps raise ``IOError``
+  from the save hook for the first N attempts, exercising the
+  retry/backoff loop (N < retry budget) or terminal save failure
+  (N >= budget);
+- **simulated preemption** — a scheduled step call reports "preempt now",
+  driving the same emergency-save-and-exit flow as a real SIGTERM;
+- **post-commit corruption** — :func:`corrupt_checkpoint` garbles a
+  committed step directory on disk (bit rot / a writer killed after the
+  data write raced the commit), so restore must fall back to an older step.
+
+Fault schedules key on the injector's own **call counter** (one tick per
+train-step invocation), not on the training-state step number: after a
+rollback the re-run of the same state steps proceeds clean, modelling
+transient faults — a schedule keyed on state steps would re-trip forever.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Dict, Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["FaultInjector", "StepFaults", "poison_batch",
+           "corrupt_checkpoint"]
+
+
+@dataclass
+class StepFaults:
+    """What the injector wants done to one train-step invocation."""
+    call: int
+    nan_grads: bool = False
+    preempt: bool = False
+
+
+def poison_batch(batch: Any) -> Any:
+    """NaN every floating leaf of ``batch`` — the injected fault that turns
+    into NaN gradients through the model's own backward pass."""
+    return jax.tree_util.tree_map(
+        lambda x: (jnp.full_like(x, jnp.nan)
+                   if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+                   else x),
+        batch)
+
+
+def corrupt_checkpoint(directory: str, step: int) -> int:
+    """Overwrite every file of a *committed* orbax step directory with
+    garbage, simulating storage corruption that the commit protocol cannot
+    catch. Returns the number of files garbled (0 means the step directory
+    was not found — a test bug, assert on it)."""
+    step_dir = os.path.join(os.path.abspath(os.fspath(directory)), str(step))
+    count = 0
+    for root, _, files in os.walk(step_dir):
+        for name in files:
+            with open(os.path.join(root, name), "wb") as f:
+                f.write(b"corrupt")
+            count += 1
+    return count
+
+
+class FaultInjector:
+    """Scripted fault schedule for :func:`apex_tpu.resilience.run_training`.
+
+    Args:
+      nan_grad_calls: call indices (0-based ticks of the train-step loop)
+        whose batch is poisoned to NaN.
+      preempt_at_call: first call index at which the injector reports a
+        preemption (the driver then emergency-saves and exits cleanly).
+      save_failures: ``{checkpoint_step: n}`` — the save hook raises
+        ``IOError`` for the first ``n`` attempts at that step.
+    """
+
+    def __init__(self, *, nan_grad_calls: Iterable[int] = (),
+                 preempt_at_call: Optional[int] = None,
+                 save_failures: Optional[Dict[int, int]] = None):
+        self.nan_grad_calls = frozenset(int(c) for c in nan_grad_calls)
+        self.preempt_at_call = preempt_at_call
+        self._save_failures = dict(save_failures or {})
+        self._call = 0
+        self.log = []  # list[StepFaults] — what actually fired, for tests
+
+    # -- train-step loop ---------------------------------------------------
+    def begin_step(self) -> StepFaults:
+        """Advance the call counter and report this invocation's faults."""
+        call = self._call
+        self._call += 1
+        faults = StepFaults(
+            call=call,
+            nan_grads=call in self.nan_grad_calls,
+            preempt=(self.preempt_at_call is not None
+                     and call >= self.preempt_at_call),
+        )
+        if faults.nan_grads or faults.preempt:
+            self.log.append(faults)
+        return faults
+
+    @property
+    def calls(self) -> int:
+        return self._call
+
+    # -- checkpoint layer --------------------------------------------------
+    def before_checkpoint_save(self, step: int) -> None:
+        """Hook for ``RetryingCheckpointManager(before_save=...)``: fail the
+        first scheduled ``n`` attempts at ``step``."""
+        remaining = self._save_failures.get(step, 0)
+        if remaining > 0:
+            self._save_failures[step] = remaining - 1
+            raise IOError(
+                f"injected checkpoint write failure at step {step} "
+                f"({remaining - 1} failures remaining)")

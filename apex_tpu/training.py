@@ -43,6 +43,7 @@ def sync_data_parallel_grads(grads, axis_names: Sequence[str],
     convention of the replicated leaves.
     """
     from apex_tpu.utils.sharding import (
+        axis_size,
         bound_axes,
         broadcast_spec,
         spec_axis_names,
@@ -61,7 +62,7 @@ def sync_data_parallel_grads(grads, axis_names: Sequence[str],
             g = lax.pmean(g, rest)
         for a in axes:
             if a in used:
-                g = g / lax.axis_size(a)
+                g = g / axis_size(a)
         return g
 
     g_leaves, treedef = jax.tree_util.tree_flatten(grads)
@@ -139,7 +140,9 @@ def make_train_step(
         # 1, and all collective regions no-op behind axis_bound() guards.
         return jax.jit(per_rank, donate_argnums=(0, 1) if donate else ())
 
-    sharded = jax.shard_map(
+    from apex_tpu.utils.sharding import shard_map
+
+    sharded = shard_map(
         per_rank,
         mesh=mesh,
         in_specs=(param_spec, opt_state_spec, batch_spec, PartitionSpec()),

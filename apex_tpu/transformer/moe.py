@@ -33,7 +33,7 @@ from jax import lax
 from jax.sharding import PartitionSpec
 
 from apex_tpu.transformer.parallel_state import DATA_AXIS
-from apex_tpu.transformer.tensor_parallel.mappings import axis_bound
+from apex_tpu.transformer.tensor_parallel.mappings import axis_bound, axis_size
 from apex_tpu.transformer.tensor_parallel.utils import divide
 from apex_tpu.utils.activations import (
     apply_activation,
@@ -204,7 +204,7 @@ class SwitchMLP:
         # dense MXU op instead of data-dependent scatters)
         buffers = jnp.einsum("tec,th->ech", dispatch, x2d)
 
-        ep = (lax.axis_size(c.expert_axis)
+        ep = (axis_size(c.expert_axis)
               if c.expert_axis and axis_bound(c.expert_axis) else 1)
         if ep > 1:
             divide(c.num_experts, ep)    # validate E % ep == 0
@@ -245,7 +245,7 @@ class SwitchMLP:
         without its [T, E, cap] one-hots). Returns fp32 ``[T, h]``."""
         c = self.config
         tokens, h = x2d.shape
-        ep = (lax.axis_size(c.expert_axis)
+        ep = (axis_size(c.expert_axis)
               if c.expert_axis and axis_bound(c.expert_axis) else 1)
         if ep > 1:
             divide(c.num_experts, ep)

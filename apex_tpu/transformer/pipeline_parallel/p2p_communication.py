@@ -21,7 +21,7 @@ import jax
 from jax import lax
 
 from apex_tpu.transformer.parallel_state import PIPELINE_AXIS
-from apex_tpu.transformer.tensor_parallel.mappings import axis_bound
+from apex_tpu.transformer.tensor_parallel.mappings import axis_bound, axis_size
 
 __all__ = [
     "send_forward",
@@ -51,7 +51,7 @@ def ring_shift(x: Any, *, reverse: bool = False,
     """
     if not axis_bound(axis_name):
         return x
-    size = lax.axis_size(axis_name)
+    size = axis_size(axis_name)
     if size == 1:
         return x
     perm = _perm_prev(size) if reverse else _perm_next(size)

@@ -49,7 +49,7 @@ from jax import lax
 
 from apex_tpu.transformer.parallel_state import PIPELINE_AXIS
 from apex_tpu.transformer.pipeline_parallel.p2p_communication import ring_shift
-from apex_tpu.transformer.tensor_parallel.mappings import axis_bound
+from apex_tpu.transformer.tensor_parallel.mappings import axis_bound, axis_size
 
 __all__ = [
     "make_pipelined_loss_fn",
@@ -82,7 +82,7 @@ def _zero_cotangent(batch):
 
 def _axis_info(axis_name: str):
     pipelined = axis_bound(axis_name)
-    S = lax.axis_size(axis_name) if pipelined else 1
+    S = axis_size(axis_name) if pipelined else 1
     i = lax.axis_index(axis_name) if pipelined else 0
     return pipelined, S, i
 

@@ -18,7 +18,7 @@ from apex_tpu.transformer.pipeline_parallel._timers import Timers
 from apex_tpu.transformer.pipeline_parallel.microbatches import (
     build_num_microbatches_calculator,
 )
-from apex_tpu.transformer.tensor_parallel.mappings import axis_bound
+from apex_tpu.transformer.tensor_parallel.mappings import axis_bound, axis_size
 
 __all__ = [
     "setup_microbatch_calculator",
@@ -140,7 +140,7 @@ def calc_params_l2_norm(params: Any, *, tensor_axis: str = "tensor",
         sq = sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves)
         return jnp.sqrt(sq)
 
-    size = lax.axis_size(tensor_axis)
+    size = axis_size(tensor_axis)
     if shared_specs is None:
         shared_flags = jax.tree.map(lambda _: False, params)
     else:

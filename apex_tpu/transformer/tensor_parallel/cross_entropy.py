@@ -26,14 +26,14 @@ import jax.numpy as jnp
 from jax import lax
 
 from apex_tpu.transformer.parallel_state import TENSOR_AXIS
-from apex_tpu.transformer.tensor_parallel.mappings import axis_bound
+from apex_tpu.transformer.tensor_parallel.mappings import axis_bound, axis_size
 
 __all__ = ["vocab_parallel_cross_entropy"]
 
 
 def _tp(axis_name):
     if axis_bound(axis_name):
-        return lax.axis_index(axis_name), lax.axis_size(axis_name), True
+        return lax.axis_index(axis_name), axis_size(axis_name), True
     return 0, 1, False
 
 

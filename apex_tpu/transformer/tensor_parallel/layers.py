@@ -46,6 +46,7 @@ from jax.sharding import PartitionSpec
 from apex_tpu.transformer.parallel_state import TENSOR_AXIS
 from apex_tpu.transformer.tensor_parallel.mappings import (
     axis_bound,
+    axis_size,
     copy_to_tensor_model_parallel_region,
     mark_sequence_parallel_parameter,
     gather_from_sequence_parallel_region,
@@ -72,7 +73,7 @@ def _default_init() -> Callable:
 def _tp_info(axis_name: str) -> Tuple[Any, int]:
     """(rank, size) of the tensor axis; (0, 1) outside shard_map."""
     if axis_bound(axis_name):
-        return lax.axis_index(axis_name), lax.axis_size(axis_name)
+        return lax.axis_index(axis_name), axis_size(axis_name)
     return 0, 1
 
 

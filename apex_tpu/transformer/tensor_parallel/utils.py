@@ -6,6 +6,7 @@ import jax
 from jax import lax
 
 from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+from apex_tpu.utils.sharding import axis_size
 
 __all__ = [
     "ensure_divisibility",
@@ -32,7 +33,7 @@ def split_tensor_into_1d_equal_chunks(x: jax.Array, axis_name: str = TENSOR_AXIS
     Must run inside ``shard_map`` with ``axis_name`` bound.
     """
     flat = x.reshape(-1)
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     rank = lax.axis_index(axis_name)
     chunk = flat.shape[0] // n
     return lax.dynamic_slice_in_dim(flat, rank * chunk, chunk, axis=0)

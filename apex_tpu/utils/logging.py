@@ -37,6 +37,28 @@ class RankInfoFormatter(logging.Formatter):
 _LOGGER_NAME = "apex_tpu"
 
 
+def log_event(logger: logging.Logger, event: str, *, level: str = "warning",
+              **fields) -> str:
+    """Structured failure/recovery telemetry: one ``logfmt``-style line
+    (``event=<name> key=value ...``) per incident, machine-greppable by
+    event name. The resilience layer routes every skip/rollback/retry/
+    preemption incident through here (the counters in
+    ``TrainingResult.telemetry`` aggregate the same incidents), the way the
+    reference's RankInfoFormatter gives every record a parseable rank
+    prefix. Returns the formatted line (callers embed it in exceptions).
+    """
+    parts = [f"event={event}"]
+    for k in sorted(fields):
+        v = fields[k]
+        v = f"{v:.6g}" if isinstance(v, float) else str(v)
+        if any(c.isspace() for c in v):
+            v = '"' + v.replace('"', "'") + '"'
+        parts.append(f"{k}={v}")
+    line = " ".join(parts)
+    logger.log(getattr(logging, level.upper(), logging.WARNING), "%s", line)
+    return line
+
+
 def get_logger(name: str = _LOGGER_NAME) -> logging.Logger:
     logger = logging.getLogger(name)
     if not getattr(logger, "_apex_tpu_configured", False):

@@ -42,6 +42,7 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 
 import jax.numpy as jnp
+from apex_tpu.utils.sharding import shard_map
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
@@ -113,7 +114,7 @@ def train_one(name, opt_level, loss_scale, dp, *, iters, batch,
 
     if dp > 1:
         rep = P()
-        step = jax.jit(jax.shard_map(
+        step = jax.jit(shard_map(
             step_body, mesh=mesh,
             in_specs=(rep, rep, rep, rep, P("data"), P("data")),
             out_specs=(rep, rep, rep, rep, rep, rep, rep),

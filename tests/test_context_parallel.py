@@ -21,6 +21,7 @@ os.environ.setdefault("APEX_TPU_FORCE_PALLAS", "interpret")
 from apex_tpu.models import GPTModel, TransformerConfig  # noqa: E402
 from apex_tpu.ops import flash_attention, ring_attention, ulysses_attention  # noqa: E402
 from apex_tpu.transformer import parallel_state  # noqa: E402
+from apex_tpu.utils.sharding import shard_map  # noqa: E402
 
 
 def _qkv(b=2, h=4, s=32, d=16, key=0):
@@ -53,13 +54,13 @@ def _run_cp(fn, q, k, v, cp, causal):
         # yields exactly the global-sum gradients; value is ref/cp
         return jax.lax.pmean(jnp.sum(o * w), "context")
 
-    grads = jax.jit(jax.shard_map(
+    grads = jax.jit(shard_map(
         jax.value_and_grad(attn_loss, argnums=(0, 1, 2)), mesh=mesh,
         in_specs=(P(None, None, "context"),) * 3,
         out_specs=(P(), (P(None, None, "context"),) * 3),
         check_vma=False))
     loss, (dq, dk, dv) = grads(q, k, v)
-    out = jax.jit(jax.shard_map(
+    out = jax.jit(shard_map(
         lambda q, k, v: fn(q, k, v, causal=causal), mesh=mesh,
         in_specs=(P(None, None, "context"),) * 3,
         out_specs=P(None, None, "context"),
@@ -123,7 +124,7 @@ class TestUlyssesAttention:
             context_parallel_size=4)
         q, k, v = _qkv(h=2)  # 2 heads, cp=4 -> invalid
         with pytest.raises(ValueError, match="divisible"):
-            jax.jit(jax.shard_map(
+            jax.jit(shard_map(
                 lambda q, k, v: ulysses_attention(q, k, v), mesh=mesh,
                 in_specs=(P(None, None, "context"),) * 3,
                 out_specs=P(None, None, "context"),
@@ -155,7 +156,7 @@ class TestGPTContextParallel:
             loss = cp_model.apply(p, tokens, labels)
             return jax.lax.pmean(loss, "context")
 
-        loss = jax.jit(jax.shard_map(
+        loss = jax.jit(shard_map(
             per_rank, mesh=mesh,
             in_specs=(ref_model.spec(), P(None, "context"),
                       P(None, "context")),
@@ -175,7 +176,7 @@ class TestRingVarlenWindowGQA:
         parallel_state.destroy_model_parallel()
         mesh = parallel_state.initialize_model_parallel(
             context_parallel_size=cp)
-        out = jax.jit(jax.shard_map(
+        out = jax.jit(shard_map(
             lambda q, k, v: ring_attention(q, k, v, causal=True, **kw),
             mesh=mesh, in_specs=(P(None, None, "context"),) * 3,
             out_specs=P(None, None, "context"),
@@ -220,7 +221,7 @@ class TestRingVarlenWindowGQA:
         parallel_state.destroy_model_parallel()
         mesh = parallel_state.initialize_model_parallel(
             context_parallel_size=4)
-        loss, grads = jax.jit(jax.shard_map(
+        loss, grads = jax.jit(shard_map(
             run(lambda q, k, v: ring_attention(q, k, v, causal=True,
                                                sliding_window=11), True),
             mesh=mesh, in_specs=(P(None, None, "context"),) * 3,
@@ -266,7 +267,7 @@ class TestRingMemory:
             return flash_attention(q, kg, vg, causal=False)
 
         def temp(fn):
-            f = jax.jit(jax.shard_map(
+            f = jax.jit(shard_map(
                 fn, mesh=mesh, in_specs=(P(None, None, "context"),) * 3,
                 out_specs=P(None, None, "context"), check_vma=False))
             ma = f.lower(q, k, v).compile().memory_analysis()

@@ -19,6 +19,7 @@ from jax.sharding import PartitionSpec as P
 os.environ.setdefault("APEX_TPU_FORCE_PALLAS", "interpret")
 
 from apex_tpu.transformer import parallel_state  # noqa: E402
+from apex_tpu.utils.sharding import shard_map  # noqa: E402
 
 
 def _t(x):
@@ -271,7 +272,7 @@ class TestGroupBN:
             y, _ = bn.apply(params, state, x, training=True)
             return y
 
-        y = jax.jit(jax.shard_map(per_rank, mesh=mesh, in_specs=P("data"),
+        y = jax.jit(shard_map(per_rank, mesh=mesh, in_specs=P("data"),
                                   out_specs=P("data"),
                                   check_vma=False))(x)
         # group-synced stats == full-batch BN
@@ -295,7 +296,7 @@ class TestBottleneck:
         ref = ref_block.apply(params, x)
 
         sp = SpatialBottleneck(C, 4, C, spatial_axis="context")
-        out = jax.jit(jax.shard_map(
+        out = jax.jit(shard_map(
             lambda p, x: sp.apply(p, x), mesh=mesh,
             in_specs=(ref_block.spec(), P(None, "context")),
             out_specs=P(None, "context"),

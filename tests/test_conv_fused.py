@@ -135,6 +135,7 @@ class TestResNetFusedParity:
         cfg = ResNetConfig(depth=50, num_classes=8, fused_conv=fused)
         return ResNet(cfg)
 
+    @pytest.mark.slow
     def test_model_parity(self, interpret):
         m_f, m_u = self._build(True), self._build(False)
         params, state = m_u.init(jax.random.PRNGKey(0))

@@ -18,6 +18,7 @@ from apex_tpu.models.transformer import (
     TransformerConfig,
 )
 from apex_tpu.transformer.enums import AttnMaskType, AttnType, LayerType
+from apex_tpu.utils.sharding import shard_map
 
 
 def _cfg(**kw):
@@ -93,6 +94,7 @@ class TestDecoderLayer:
         params = layer.init(jax.random.PRNGKey(0))
         assert "inter_attention" not in params
 
+    @pytest.mark.slow
     def test_decoder_stack_grads(self):
         model = ParallelTransformer(_cfg(), LayerType.decoder)
         params = model.init(jax.random.PRNGKey(0))
@@ -190,6 +192,7 @@ class TestEncoderDecoderModel:
         assert logits.shape == (6, 2, 64)
 
     @pytest.mark.parametrize("sp", [False, True])
+    @pytest.mark.slow
     def test_tensor_parallel_matches_single_rank(self, sp):
         """TP(+SP) sharded run == unsharded reference — exercises the
         encoder-output gather before cross-attention under a bound axis."""
@@ -214,7 +217,7 @@ class TestEncoderDecoderModel:
             def loss_fn(p):
                 return model.apply(p, enc_t, dec_t, labels)
 
-            out = jax.shard_map(
+            out = shard_map(
                 jax.value_and_grad(loss_fn), mesh=mesh,
                 in_specs=(model.spec(),),
                 out_specs=(P(), model.spec()), check_vma=False)(params)

@@ -16,6 +16,7 @@ from jax.sharding import PartitionSpec as P
 
 from apex_tpu.amp import fp8
 from apex_tpu.transformer import parallel_state
+from apex_tpu.utils.sharding import shard_map
 
 
 class TestRecipe:
@@ -112,7 +113,7 @@ class TestAmaxReductionMesh:
         # pipeline stage: stage 0 sees max 4, stage 1 sees max 16
         x = jnp.asarray([[[1.0, 4.0], [2.0, 16.0]],
                          [[3.0, 2.0], [8.0, 1.0]]])   # [dp, pp, tp]
-        scales = jax.jit(jax.shard_map(
+        scales = jax.jit(shard_map(
             per_rank, mesh=mesh,
             in_specs=P("data", "pipeline", "tensor"),
             out_specs=P("data", "pipeline", "tensor"),

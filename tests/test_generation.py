@@ -12,6 +12,7 @@ import pytest
 
 from apex_tpu.models import GPTModel, TransformerConfig
 from apex_tpu.models.generation import decode_step, generate, init_kv_caches
+from apex_tpu.utils.sharding import shard_map
 
 
 def _model(**kw):
@@ -23,6 +24,7 @@ def _model(**kw):
 
 
 class TestDecodeStep:
+    @pytest.mark.slow
     def test_cached_logits_match_full_forward(self):
         model = _model()
         params = model.init(jax.random.PRNGKey(0))
@@ -37,6 +39,7 @@ class TestDecodeStep:
                 np.asarray(logits), np.asarray(full[i]).astype(np.float32),
                 rtol=2e-4, atol=2e-4)
 
+    @pytest.mark.slow
     def test_moe_cached_logits_match_full_forward(self):
         # TRAINING-DEFAULT capacity factor (1.25): the cache path routes
         # drop-free (round 5), and the matching baseline is the drop-free
@@ -78,6 +81,7 @@ class TestDecodeStep:
 
 
 class TestGenerate:
+    @pytest.mark.slow
     def test_greedy_matches_stepwise_argmax(self):
         model = _model()
         params = model.init(jax.random.PRNGKey(0))
@@ -102,6 +106,7 @@ class TestGenerate:
         out = f(params, prompt)
         assert out.shape == (1, 7)
 
+    @pytest.mark.slow
     def test_sampling_reproducible_and_varied(self):
         model = _model()
         params = model.init(jax.random.PRNGKey(0))
@@ -116,6 +121,7 @@ class TestGenerate:
                       temperature=1.0, rng=jax.random.PRNGKey(8))
         assert not np.array_equal(np.asarray(o1), np.asarray(o3))
 
+    @pytest.mark.slow
     def test_top_k_restricts_support(self):
         model = _model()
         params = model.init(jax.random.PRNGKey(0))
@@ -159,6 +165,7 @@ class TestGuards:
         with pytest.raises(ValueError, match="max_position_embeddings"):
             generate(model, params, prompt, max_new_tokens=10)
 
+    @pytest.mark.slow
     def test_tp_generation_matches_single_rank(self):
         """Greedy generation under TP == unsharded (full-vocab argmax after
         the vocab all-gather)."""
@@ -174,7 +181,7 @@ class TestGuards:
         parallel_state.destroy_model_parallel()
         mesh = parallel_state.initialize_model_parallel(
             tensor_model_parallel_size=2)
-        out = jax.shard_map(
+        out = shard_map(
             lambda p, t: generate(model, p, t, max_new_tokens=5),
             mesh=mesh, in_specs=(model.spec(), P()), out_specs=P(),
             check_vma=False)(params, prompt)
@@ -183,6 +190,7 @@ class TestGuards:
 
 
 class TestCacheForms:
+    @pytest.mark.slow
     def test_stacked_and_list_caches_agree(self):
         """The scan-form (stacked [L,...]) and the fast decode form
         (per-layer list, PERF.md round 4) must produce identical logits
@@ -216,6 +224,7 @@ class TestCacheForms:
             np.testing.assert_allclose(np.asarray(stacked[1][l]),
                                        np.asarray(v_l), atol=1e-6)
 
+    @pytest.mark.slow
     def test_flat_caches_agree(self):
         """The FLAT [b, S, h*d] decode form (PERF.md round 5) must match
         the 4D list form through prefill and stepwise decode."""

@@ -16,6 +16,7 @@ import pytest
 
 from apex_tpu.models import GPTModel, TransformerConfig
 from apex_tpu.models.generation import decode_step, init_kv_caches
+from apex_tpu.utils.sharding import shard_map
 
 
 def _cfg(**kw):
@@ -134,13 +135,13 @@ def test_tp_exceeding_groups_fails_fast():
         params = model.init(jax.random.PRNGKey(0))
         tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, 64)
         with pytest.raises(ValueError, match="divisible"):
-            jax.jit(jax.shard_map(
+            jax.jit(shard_map(
                 lambda p, t: model.apply(p, t), mesh=mesh,
                 in_specs=(model.spec(), jax.sharding.PartitionSpec()),
                 out_specs=jax.sharding.PartitionSpec(),
                 check_vma=False))(params, tokens)
         with pytest.raises(ValueError, match="divisible"):
-            jax.jit(jax.shard_map(
+            jax.jit(shard_map(
                 lambda: init_kv_caches(model, 2, 16), mesh=mesh,
                 in_specs=(), out_specs=jax.sharding.PartitionSpec(),
                 check_vma=False))()

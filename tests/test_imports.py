@@ -28,7 +28,9 @@ MODULES = [
     "apex_tpu.optimizers",
     "apex_tpu.parallel",
     "apex_tpu.parallel.multiproc",
+    "apex_tpu.resilience",
     "apex_tpu.rnn",
+    "apex_tpu.testing_faults",
     "apex_tpu.training",
     "apex_tpu.transformer",
     "apex_tpu.transformer.amp",

@@ -23,6 +23,10 @@ from apex_tpu.models import ResNet, ResNetConfig
 from apex_tpu.optimizers import FusedAdam, FusedSGD
 from apex_tpu.utils.tree import global_norm
 
+# L1 by name and by nature: a convergence sweep (~15-25s per matrix point
+# on CPU) — the slow tier, not the tier-1 quick gate
+pytestmark = pytest.mark.slow
+
 STEPS = 12
 
 

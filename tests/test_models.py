@@ -21,6 +21,7 @@ from apex_tpu.models import BertModel, GPTModel, TransformerConfig  # noqa: E402
 from apex_tpu.optimizers import FusedAdam  # noqa: E402
 from apex_tpu.training import make_train_step  # noqa: E402
 from apex_tpu.transformer import parallel_state  # noqa: E402
+from apex_tpu.utils.sharding import shard_map  # noqa: E402
 
 
 def small_config(**kw):
@@ -83,6 +84,7 @@ class TestGPT:
         losses, _ = _train(tp=1, sp=False, steps=5)
         assert losses[-1] < losses[0]
 
+    @pytest.mark.slow
     @pytest.mark.parametrize("tp,sp", [(2, False), (2, True), (4, True)])
     def test_tensor_parallel_matches_single_rank(self, tp, sp):
         # same seeds -> sharded training must reproduce the unsharded run
@@ -94,6 +96,7 @@ class TestGPT:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=5e-5, rtol=5e-5)
 
+    @pytest.mark.slow
     def test_recompute_matches_plain(self):
         ref_losses, _ = _train(tp=1, sp=False)
         rc_losses, _ = _train(tp=1, sp=False, recompute=True)
@@ -117,6 +120,7 @@ class TestGPT:
             np.testing.assert_allclose(np.asarray(a_), np.asarray(b_),
                                        atol=1e-6, rtol=1e-5)
 
+    @pytest.mark.slow
     def test_selective_recompute_and_unroll_match_plain(self):
         """'selective' remat policy (save dots, recompute elementwise) and
         an unrolled layer scan are pure schedule changes — numerics must
@@ -162,6 +166,38 @@ class TestGPT:
         assert all(bool(jnp.all(jnp.isfinite(x)))
                    for x in jax.tree.leaves(g))
 
+    def test_attention_dropout_seed_layer_distinct(self):
+        # the stack derives per-layer seeds as base + layer*GOLDEN (one
+        # base draw, odd-constant offset) so masks are STRUCTURALLY
+        # distinct across layers — two independent 32-bit draws could
+        # collide and share a mask. The attention module must honor the
+        # explicit dropout_seed: same seed → identical mask, the next
+        # layer's offset seed → a different mask over identical inputs.
+        from apex_tpu.models.transformer import ParallelAttention
+
+        # head_dim 64 / 2 groups: packed_geometry aligns (gpc=2, in_w=384)
+        # so the in-kernel hash-dropout path actually engages — the seed
+        # override is dead weight on the XLA fallback, and y0 == y0b below
+        # (equal under DIFFERENT rng) certifies the packed path was taken
+        cfg = small_config(attention_dropout=0.3, hidden_size=128,
+                           num_attention_heads=2)
+        attn = ParallelAttention(cfg)
+        params = attn.init(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(3),
+                              (16, 8, cfg.hidden_size), jnp.float32)
+        base = jnp.asarray([12345], jnp.int32)
+        golden = jnp.int32(-1640531527)
+        y0 = attn.apply(params, x, rng=jax.random.PRNGKey(1),
+                        deterministic=False, dropout_seed=base)
+        y0b = attn.apply(params, x, rng=jax.random.PRNGKey(2),
+                         deterministic=False, dropout_seed=base)
+        y1 = attn.apply(params, x, rng=jax.random.PRNGKey(1),
+                        deterministic=False, dropout_seed=base + golden)
+        # the override fully determines the mask (rng is irrelevant)...
+        np.testing.assert_array_equal(np.asarray(y0), np.asarray(y0b))
+        # ...and the layer-offset seed draws a different mask
+        assert bool(jnp.any(y0 != y1))
+
 
 class TestBert:
     def _bert(self, **kw):
@@ -191,6 +227,7 @@ class TestBert:
                             lm_labels=b["labels"])
         np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
 
+    @pytest.mark.slow
     @pytest.mark.parametrize("sp", [False, True])
     def test_tensor_parallel_matches_single_rank(self, sp):
         def run(tp, sp):
@@ -210,7 +247,7 @@ class TestBert:
 
             grad_fn = jax.value_and_grad(loss_fn)
             per_rank = lambda p, batch: grad_fn(p, batch, None)
-            out = jax.shard_map(
+            out = shard_map(
                 per_rank, mesh=mesh,
                 in_specs=(model.spec(), {"tokens": P(), "labels": P()}),
                 out_specs=(P(), model.spec()), check_vma=False,

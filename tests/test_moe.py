@@ -17,6 +17,7 @@ from jax.sharding import PartitionSpec as P
 
 from apex_tpu.transformer import parallel_state
 from apex_tpu.transformer.moe import MoEConfig, SwitchMLP
+from apex_tpu.utils.sharding import shard_map
 
 
 def _cfg(**kw):
@@ -136,7 +137,7 @@ class TestDenseDropFree:
         params = dense.init(jax.random.PRNGKey(0))
         x = _x(s=80, b=64)                # 5120 tokens = 640/rank > 512
         y_ref, _ = dense.apply(params, x, drop_free=True)
-        y, _ = jax.jit(jax.shard_map(
+        y, _ = jax.jit(shard_map(
             lambda p, x: ep.apply(p, x, drop_free=True), mesh=mesh,
             in_specs=(ep.spec(), P(None, "data")),
             out_specs=(P(None, "data"), P()), check_vma=False))(params, x)
@@ -171,7 +172,7 @@ class TestExpertParallel:
             y, aux = ep.apply(p, x)
             return y, aux.reshape(1)
 
-        y, aux = jax.jit(jax.shard_map(
+        y, aux = jax.jit(shard_map(
             per_rank, mesh=mesh,
             in_specs=(ep.spec(), P()),
             out_specs=(P(), P("data")), check_vma=False))(params, x)
@@ -188,7 +189,7 @@ class TestExpertParallel:
         params = dense.init(jax.random.PRNGKey(3))
         x = _x(s=4, b=4, seed=7)
         y_ref, _ = dense.apply(params, x)
-        y, _ = jax.jit(jax.shard_map(
+        y, _ = jax.jit(shard_map(
             lambda p, x: ep.apply(p, x),
             mesh=mesh, in_specs=(ep.spec(), P()),
             out_specs=(P(), P()), check_vma=False))(params, x)
@@ -203,7 +204,7 @@ class TestExpertParallel:
         params = SwitchMLP(_cfg(expert_axis=None, num_experts=6)).init(
             jax.random.PRNGKey(0))
         with pytest.raises(Exception):
-            jax.jit(jax.shard_map(
+            jax.jit(shard_map(
                 lambda p, x: ep.apply(p, x), mesh=mesh,
                 in_specs=(ep.spec(), P()), out_specs=(P(), P()),
                 check_vma=False))(params, _x())

@@ -519,6 +519,7 @@ class TestPackedDropout:
                                     dropout_seed=jnp.asarray([3], jnp.int32))
         np.testing.assert_array_equal(np.asarray(o0), np.asarray(o1))
 
+    @pytest.mark.slow
     def test_fallback_dropout_statistics(self):
         # CPU/interpret route: jax.random dropout on materialized probs —
         # unbiased in expectation and deterministic per seed

@@ -14,6 +14,7 @@ from apex_tpu.parallel import (
     all_reduce_gradients,
 )
 from apex_tpu.transformer import parallel_state
+from apex_tpu.utils.sharding import axis_size, shard_map
 
 
 def test_all_reduce_gradients_mean(data_mesh):
@@ -21,7 +22,7 @@ def test_all_reduce_gradients_mean(data_mesh):
     n = mesh.shape["data"]
     grads = jnp.arange(n * 4, dtype=jnp.float32).reshape(n, 4)
 
-    @jax.shard_map(mesh=mesh, in_specs=P("data"), out_specs=P("data"))
+    @shard_map(mesh=mesh, in_specs=P("data"), out_specs=P("data"))
     def reduce(g):
         return all_reduce_gradients({"g": g}, "data")["g"]
 
@@ -37,7 +38,7 @@ def test_ddp_options(data_mesh):
         allreduce_always_fp32=True, gradient_predivide_factor=2.0)
     grads = jnp.ones((n, 8), jnp.bfloat16)
 
-    @jax.shard_map(mesh=mesh, in_specs=P("data"), out_specs=P("data"))
+    @shard_map(mesh=mesh, in_specs=P("data"), out_specs=P("data"))
     def reduce(g):
         out = ddp.reduce_gradients({"g": g})["g"]
         return out
@@ -52,7 +53,7 @@ def test_reducer(data_mesh):
     n = mesh.shape["data"]
     vals = jnp.arange(n, dtype=jnp.float32).reshape(n, 1)
 
-    @jax.shard_map(mesh=mesh, in_specs=P("data"), out_specs=P("data"))
+    @shard_map(mesh=mesh, in_specs=P("data"), out_specs=P("data"))
     def rd(v):
         return Reducer().reduce({"v": v})["v"]
 
@@ -71,7 +72,7 @@ def test_syncbn_matches_global_bn(data_mesh):
     bn = SyncBatchNorm(num_features=feat, axis_name="data", momentum=1.0)
     variables = bn.init(jax.random.PRNGKey(1), x[:4])
 
-    @jax.shard_map(mesh=mesh, in_specs=(P(), P("data")), out_specs=(P("data"), P()))
+    @shard_map(mesh=mesh, in_specs=(P(), P("data")), out_specs=(P("data"), P()))
     def run(vars_, xs):
         y, updated = bn.apply(vars_, xs, mutable=["batch_stats"])
         return y, updated["batch_stats"]
@@ -134,7 +135,7 @@ def test_syncbn_process_groups_sub_axis():
         out, updates = bn.apply(v, xs, mutable=["batch_stats"])
         return out, updates["batch_stats"]
 
-    y, stats = jax.jit(jax.shard_map(
+    y, stats = jax.jit(shard_map(
         body, mesh=mesh,
         in_specs=(P(), P(("group", "member"))),
         out_specs=(P(("group", "member")), P("group")),
@@ -177,7 +178,7 @@ class TestSpecAwareGradSync:
                 lambda x: x * (1.0 + jax.lax.axis_index("data")), g)
             return sync_data_parallel_grads(g, ("data",), spec)
 
-        out = jax.jit(jax.shard_map(
+        out = jax.jit(shard_map(
             per_rank, mesh=mesh, in_specs=(jax.tree.map(lambda _: P(), grads),),
             out_specs=jax.tree.map(lambda _: P(), grads),
             check_vma=False))(grads)
@@ -203,7 +204,7 @@ class TestSpecAwareGradSync:
                 lambda x: x * (1.0 + jax.lax.axis_index("data")), g)
             return sync_data_parallel_grads(g, ("data",), spec)
 
-        out = jax.jit(jax.shard_map(
+        out = jax.jit(shard_map(
             per_rank, mesh=mesh,
             in_specs=({"expert": P("data", None), "shared": P()},),
             out_specs={"expert": P("data", None), "shared": P()},
@@ -237,7 +238,7 @@ def test_syncbn_unequal_per_rank_batches(data_mesh):
     bn = SyncBatchNorm(num_features=feat, axis_name="data", momentum=1.0)
     variables = bn.init(jax.random.PRNGKey(1), x[:4])
 
-    @jax.shard_map(mesh=mesh, in_specs=(P(), P("data"), P("data")),
+    @shard_map(mesh=mesh, in_specs=(P(), P("data"), P("data")),
                    out_specs=(P("data"), P()))
     def run(vars_, xs, m):
         y, updated = bn.apply(vars_, xs, sample_mask=m,
@@ -275,7 +276,7 @@ def test_syncbn_unequal_batches_grads(data_mesh):
     variables = bn.init(jax.random.PRNGKey(1), x[:2])
     tgt = jax.random.normal(jax.random.PRNGKey(4), x.shape)
 
-    @jax.shard_map(mesh=mesh, in_specs=(P("data"), P("data"), P("data")),
+    @shard_map(mesh=mesh, in_specs=(P("data"), P("data"), P("data")),
                    out_specs=P("data"), check_vma=False)
     def grad_x(xs, m, t):
         def loss(xs):
@@ -286,7 +287,7 @@ def test_syncbn_unequal_batches_grads(data_mesh):
             # cotangent (each rank differentiates the same replicated loss)
             w = m.astype(jnp.float32)[:, None]
             return jax.lax.psum(
-                jnp.sum(w * (y - t) ** 2), "data") / jax.lax.axis_size("data")
+                jnp.sum(w * (y - t) ** 2), "data") / axis_size("data")
         return jax.grad(loss)(xs)
 
     g = np.asarray(grad_x(x, mask_j, tgt))
@@ -461,3 +462,33 @@ def test_convert_syncbn_model_guards():
     with pytest.raises(NotImplementedError, match="eval-mode"):
         convert_syncbn_model(fnn.Sequential(
             [fnn.BatchNorm(use_running_average=True)]))
+    # a compute/output dtype override has no SyncBatchNorm equivalent
+    with pytest.raises(NotImplementedError, match="dtype"):
+        convert_syncbn_model(fnn.Sequential(
+            [fnn.BatchNorm(use_running_average=False,
+                           dtype=jnp.bfloat16)]))
+    with pytest.raises(NotImplementedError, match="use_fast_variance"):
+        convert_syncbn_model(fnn.Sequential(
+            [fnn.BatchNorm(use_running_average=False,
+                           use_fast_variance=False)]))
+
+
+def test_convert_syncbn_model_transfers_param_dtype():
+    """A BN with non-default param_dtype must convert to a SyncBatchNorm
+    initializing scale/bias in that dtype, not silently fp32."""
+    import flax.linen as fnn
+    from apex_tpu.parallel import convert_syncbn_model
+
+    model = fnn.Sequential([
+        fnn.BatchNorm(use_running_average=False,
+                      param_dtype=jnp.bfloat16),
+    ])
+    conv = convert_syncbn_model(model)
+    assert conv.layers[0].param_dtype == jnp.bfloat16
+    x = jnp.ones((4, 8), jnp.float32)
+    variables = conv.init(jax.random.PRNGKey(0), x)
+    bn_params = variables["params"]["layers_0"]
+    assert bn_params["scale"].dtype == jnp.bfloat16
+    assert bn_params["bias"].dtype == jnp.bfloat16
+    # running stats stay fp32 (flax BatchNorm keeps them fp32 too)
+    assert variables["batch_stats"]["layers_0"]["mean"].dtype == jnp.float32

@@ -6,6 +6,7 @@ import pytest
 from jax.sharding import PartitionSpec as P
 
 from apex_tpu.transformer import parallel_state as ps
+from apex_tpu.utils.sharding import shard_map
 
 
 def teardown_function():
@@ -38,7 +39,7 @@ def test_rank_inside_shard_map():
     mesh = ps.initialize_model_parallel(tensor_model_parallel_size=2)
     import jax.numpy as jnp
 
-    @jax.shard_map(mesh=mesh, in_specs=P("tensor"), out_specs=P("tensor"))
+    @shard_map(mesh=mesh, in_specs=P("tensor"), out_specs=P("tensor"))
     def get_rank(x):
         return x + ps.get_tensor_model_parallel_rank()
 

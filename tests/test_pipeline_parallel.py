@@ -41,6 +41,7 @@ from apex_tpu.transformer.pipeline_parallel.utils import (  # noqa: E402
     get_ltor_masks_and_position_ids,
     split_batch_into_microbatches,
 )
+from apex_tpu.utils.sharding import shard_map  # noqa: E402
 
 
 class TestMicrobatchCalculators:
@@ -94,7 +95,7 @@ class TestP2P:
             return fwd, bwd
 
         x = jnp.arange(8, dtype=jnp.float32).reshape(4, 2)
-        fwd, bwd = jax.jit(jax.shard_map(
+        fwd, bwd = jax.jit(shard_map(
             f, mesh=mesh,
             in_specs=P("pipeline"),
             out_specs=(P("pipeline"), P("pipeline")),
@@ -225,7 +226,7 @@ class TestSchedules:
                 return loss_fn(p, b), jax.tree.map(jnp.zeros_like, p)
             return jax.value_and_grad(loss_fn)(p, b)
 
-        run = jax.jit(jax.shard_map(
+        run = jax.jit(shard_map(
             per_rank, mesh=mesh,
             in_specs=(spec, P()),
             out_specs=(P(), spec),
@@ -304,6 +305,7 @@ def _gpt_config(**kw):
     return TransformerConfig(**defaults)
 
 
+@pytest.mark.slow  # compile-bound pipelined-model parity (10-16s each)
 class TestPipelinedGPT:
     M = 2
 
@@ -333,7 +335,7 @@ class TestPipelinedGPT:
         loss_fn = pmodel.make_loss_fn()
         spec = pmodel.spec()
 
-        run = jax.jit(jax.shard_map(
+        run = jax.jit(shard_map(
             jax.value_and_grad(loss_fn), mesh=mesh,
             in_specs=(spec, P()),
             out_specs=(P(), spec),
@@ -378,6 +380,7 @@ class TestPipelinedGPT:
                                    rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow  # compile-bound PP x MoE parity (14-23s each)
 class TestPipelinedMoE:
     """PP x MoE/EP composition (VERDICT r2 item 4): the pipeline scan
     carries each stage's pre-scaled aux loss to the total with a direct
@@ -417,7 +420,7 @@ class TestPipelinedMoE:
 
         loss_fn = pmodel.make_loss_fn()
         spec = pmodel.spec()
-        run = jax.jit(jax.shard_map(
+        run = jax.jit(shard_map(
             jax.value_and_grad(loss_fn), mesh=mesh,
             in_specs=(spec, P()),
             out_specs=(P(), spec),
@@ -479,7 +482,7 @@ class TestPipelinedDropout:
             {"tokens": tokens, "labels": tokens}, 2)
         loss_fn = model.make_loss_fn()
         spec = model.spec()
-        run = jax.jit(jax.shard_map(
+        run = jax.jit(shard_map(
             loss_fn, mesh=mesh, in_specs=(spec, P(), P()),
             out_specs=P(), check_vma=False))
         det = float(run(params, mb, None))
@@ -559,7 +562,7 @@ class Test1F1BMemory:
         def per_rank(p, b):
             return jax.value_and_grad(lambda p: loss_fn(p, b))(p)
 
-        f = jax.jit(jax.shard_map(
+        f = jax.jit(shard_map(
             per_rank, mesh=mesh,
             in_specs=(model.spec(),
                       {"tokens": P(None, "data"), "labels": P(None, "data")}),
@@ -570,6 +573,7 @@ class Test1F1BMemory:
             pytest.skip("backend does not expose memory_analysis")
         return ma.temp_size_in_bytes
 
+    @pytest.mark.slow
     def test_temp_memory_flat_in_microbatch_count(self):
         small = self._temp_bytes(4)
         big = self._temp_bytes(32)
@@ -620,7 +624,7 @@ class Test1F1BRecomputeRngAlignment:
         }
         spec = {"stages": P("pipeline"), "head": P()}
         loss_fn = make_pipelined_loss_fn(preprocess, stage, postprocess, M)
-        loss, grads = jax.jit(jax.shard_map(
+        loss, grads = jax.jit(shard_map(
             lambda p, b: jax.value_and_grad(loss_fn)(p, b),
             mesh=mesh, in_specs=(spec, P()), out_specs=(P(), spec),
             check_vma=False))(staged, batch)
@@ -680,7 +684,7 @@ class Test1F1BInputGradients:
             return jax.tree.map(
                 lambda x: jax.lax.psum(x, "pipeline"), bg)
 
-        bg = jax.jit(jax.shard_map(
+        bg = jax.jit(shard_map(
             per_rank, mesh=mesh, in_specs=(spec, P()),
             out_specs=P(), check_vma=False))(staged, batch)
         ref_bg = jax.grad(_reference_loss, argnums=1)(full, batch)
@@ -722,7 +726,7 @@ class TestInterleavedMemory:
         def per_rank(p, b):
             return jax.value_and_grad(lambda p: loss_fn(p, b))(p)
 
-        f = jax.jit(jax.shard_map(
+        f = jax.jit(shard_map(
             per_rank, mesh=mesh,
             in_specs=(model.spec(),
                       {"tokens": P(None, "data"), "labels": P(None, "data")}),
@@ -733,6 +737,7 @@ class TestInterleavedMemory:
             pytest.skip("backend does not expose memory_analysis")
         return ma.temp_size_in_bytes
 
+    @pytest.mark.slow
     def test_temp_memory_flat_in_microbatch_count(self):
         small = self._temp_bytes(4)
         big = self._temp_bytes(32)
@@ -795,7 +800,7 @@ class TestPipelinedEncoderDecoder:
 
         loss_fn = pmodel.make_loss_fn()
         spec = pmodel.spec()
-        run = jax.jit(jax.shard_map(
+        run = jax.jit(shard_map(
             jax.value_and_grad(loss_fn), mesh=mesh,
             in_specs=(spec, P()),
             out_specs=(P(), spec),
@@ -841,16 +846,20 @@ class TestPipelinedEncoderDecoder:
                 np.asarray(ref_grads[sect]["final_layernorm"]["weight"]),
                 rtol=2e-3, atol=2e-5)
 
+    @pytest.mark.slow
     def test_pp2_split1_matches_unpipelined(self):
         self._check(*self._run(S=2, split=1, n_enc=2, n_dec=2))
 
+    @pytest.mark.slow
     def test_pp4_split2_matches_unpipelined(self):
         self._check(*self._run(S=4, split=2, n_enc=2, n_dec=4))
 
+    @pytest.mark.slow
     def test_pp4_split1_uneven_sections(self):
         # 1 encoder stage vs 3 decoder stages: section depths needn't match
         self._check(*self._run(S=4, split=1, n_enc=2, n_dec=3))
 
+    @pytest.mark.slow
     def test_pp2_tp2_sp_matches_unpipelined(self):
         # TP+SP inside each stage; decoder stages re-gather the sequence-
         # sharded encoder stream for cross-attention
@@ -917,13 +926,13 @@ class TestPipelinedEncoderDecoder:
              "labels": labels}, self.M)
         loss_fn = pmodel.make_loss_fn()
         spec = pmodel.spec()
-        run = jax.jit(jax.shard_map(
+        run = jax.jit(shard_map(
             lambda p, b, r: loss_fn(p, b, r), mesh=mesh,
             in_specs=(spec, P(), P()),
             out_specs=P(), check_vma=False))
         l1 = float(run(pparams, mb, jax.random.PRNGKey(7)))
         l2 = float(run(pparams, mb, jax.random.PRNGKey(8)))
-        det = jax.jit(jax.shard_map(
+        det = jax.jit(shard_map(
             lambda p, b: loss_fn(p, b), mesh=mesh,
             in_specs=(spec, P()), out_specs=P(), check_vma=False))
         l0 = float(det(pparams, mb))
@@ -1018,7 +1027,7 @@ class TestVPPGenerality:
             {"tokens": tokens, "labels": labels}, M)
         loss_fn = pmodel.make_loss_fn()
         spec = pmodel.spec()
-        run = jax.jit(jax.shard_map(
+        run = jax.jit(shard_map(
             jax.value_and_grad(loss_fn), mesh=mesh,
             in_specs=(spec, P()),
             out_specs=(P(), spec),
@@ -1042,18 +1051,22 @@ class TestVPPGenerality:
             np.asarray(ref_grads["embedding"]["word_embeddings"]["weight"]),
             rtol=2e-3, atol=2e-5)
 
+    @pytest.mark.slow
     def test_vpp3_pp2_six_layers(self):
         self._run(vpp=3, M=4, n_layers=6)
 
+    @pytest.mark.slow
     def test_vpp2_microbatches_indivisible_by_pp(self):
         # M=5 with pp=2: indivisible by the pipeline size (the reference
         # asserts M % pp == 0; the lock-step scan doesn't need it)
         self._run(vpp=2, M=5, n_layers=4)
 
+    @pytest.mark.slow
     def test_vpp3_microbatches_indivisible(self):
         # M=5 against V = S*vpp = 6 virtual stages: M < V and coprime
         self._run(vpp=3, M=5, n_layers=6)
 
+    @pytest.mark.slow
     def test_vpp2_single_microbatch(self):
         # M=1: pure bubble — every tick is warmup/cooldown
         self._run(vpp=2, M=1, n_layers=4, bs=4)

@@ -76,6 +76,7 @@ def test_relative_position_property():
                                atol=1e-4)
 
 
+@pytest.mark.slow
 def test_cached_decode_matches_full_forward():
     model = GPTModel(_cfg())
     params = model.init(jax.random.PRNGKey(0))
@@ -186,6 +187,7 @@ def _losses_after_training(model, steps=4, lr=2e-3):
     return losses, params
 
 
+@pytest.mark.slow
 def test_tp2_matches_unsharded():
     np.testing.assert_allclose(_tp_parity_train(1, {}),
                                _tp_parity_train(2, {}),
@@ -234,6 +236,7 @@ class TestActivations:
             assert w.shape[-2] == 2 * 4 * 64   # fused [2*ffn, h], per layer
         assert losses[-1] < losses[0]
 
+    @pytest.mark.slow
     def test_swiglu_tp2_matches_unsharded(self):
         np.testing.assert_allclose(
             _tp_parity_train(1, {"activation": "swiglu"}),
@@ -272,6 +275,7 @@ class TestNormalization:
                           + 1e-5)
         np.testing.assert_allclose(np.asarray(y), ref, rtol=2e-5, atol=2e-5)
 
+    @pytest.mark.slow
     def test_rmsnorm_tp2_sp_matches_unsharded(self):
         ref = _tp_parity_train(1, {"normalization": "rmsnorm"})
         np.testing.assert_allclose(
@@ -329,6 +333,7 @@ def test_gated_projection_is_bias_free():
 
 
 class TestSlidingWindowModel:
+    @pytest.mark.slow
     def test_decode_matches_full_forward(self):
         """Cached decode must reproduce the full windowed forward (window
         folded into the cache mask at real cache offsets)."""

@@ -31,6 +31,7 @@ from apex_tpu.transformer.tensor_parallel import (
     get_rng_tracker,
     model_parallel_rng_key,
 )
+from apex_tpu.utils.sharding import shard_map
 
 TENSOR = parallel_state.TENSOR_AXIS
 
@@ -44,7 +45,7 @@ def tp8_mesh():
 
 
 def shmap(fn, mesh, in_specs, out_specs):
-    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+    return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                          check_vma=False)
 
 
@@ -482,7 +483,7 @@ class TestZLoss:
                 vocab_parallel_cross_entropy(ll, t, z_loss=1e-2)))(l)
             return loss, grad
 
-        loss, grad = jax.jit(jax.shard_map(
+        loss, grad = jax.jit(shard_map(
             body, mesh=mesh8,
             in_specs=(P(None, None, "tensor"), P()),
             out_specs=(P(), P(None, None, "tensor")),

@@ -86,6 +86,7 @@ class TestResNet:
             losses.append(float(loss))
         assert losses[-1] < losses[0]
 
+    @pytest.mark.slow
     def test_syncbn_matches_global_batch(self):
         """Sharded ResNet (BN psum over 'data') == unsharded on full batch —
         the property the reference tests in
