@@ -1,0 +1,51 @@
+"""apex_tpu.analysis — JAX/TPU hazard tooling.
+
+Two halves:
+
+- a **static lint engine** (:mod:`~apex_tpu.analysis.engine` + the APX
+  rule pack in :mod:`~apex_tpu.analysis.rules`) that machine-checks the
+  JAX-specific invariants this repo has paid postmortems for — PRNG key
+  reuse, concretization inside jit, host sync in step bodies, recompile
+  hazards, unbound collective axes, bf16 dtype drift, interpret-mode
+  pallas in scans, trace-time state mutation.  Run it as
+  ``python -m apex_tpu.analysis`` (configured via
+  ``[tool.apex_tpu.analysis]`` in pyproject.toml); the tier-1 gate test
+  keeps the tree clean.
+- a **retrace watchdog** (:mod:`~apex_tpu.analysis.retrace`) that counts
+  jit cache misses at run time and raises after a configurable budget —
+  wired into :func:`apex_tpu.resilience.run_training`.
+
+See ``docs/analysis.md`` for the rule catalog and suppression/baseline
+workflow.
+"""
+
+from apex_tpu.analysis.engine import (
+    AnalysisConfig,
+    Baseline,
+    Finding,
+    ModuleContext,
+    Rule,
+    RuleVisitor,
+    analyze_file,
+    analyze_paths,
+    analyze_source,
+    load_config,
+    main,
+)
+from apex_tpu.analysis.retrace import RetraceBudgetExceeded, RetraceWatchdog
+
+__all__ = [
+    "AnalysisConfig",
+    "Baseline",
+    "Finding",
+    "ModuleContext",
+    "RetraceBudgetExceeded",
+    "RetraceWatchdog",
+    "Rule",
+    "RuleVisitor",
+    "analyze_file",
+    "analyze_paths",
+    "analyze_source",
+    "load_config",
+    "main",
+]

@@ -1,0 +1,8 @@
+"""``python -m apex_tpu.analysis [paths ...]`` — run the hazard linter."""
+
+import sys
+
+from apex_tpu.analysis.engine import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,592 @@
+"""AST rule engine for JAX/TPU hazard linting.
+
+The classes of bug that threaten a production jax_graft stack are not
+generic Python bugs — they are JAX-specific hazards this repo has already
+paid for in postmortems: reused PRNG keys (structurally-duplicated dropout
+seeds, PR 1), host-sync inside jitted step functions, silent recompilation
+storms (the tier-1 gate truncation, PR 1), dtype drift in bf16 paths, and
+collectives naming unbound mesh axes.  TorchTitan-style production trainers
+machine-check these invariants around the hot loop; this engine does the
+same for the whole tree, statically.
+
+Architecture:
+
+- :class:`Rule` — one hazard detector, identified by an ``APX###`` code.
+  Rules subclass :class:`RuleVisitor` (an ``ast.NodeVisitor`` with a
+  ``report`` helper and a resolved-import map) and register themselves in
+  ``apex_tpu.analysis.rules``.
+- :class:`ModuleContext` — one parsed source file handed to every rule:
+  tree, source lines, path, and the canonical-import resolver
+  (:func:`build_import_map` / :func:`resolve_call`), so ``jr.normal`` and
+  ``jax.random.normal`` look identical to every rule.
+- suppression — a ``# noqa: APX###`` comment on the finding's line (or a
+  bare ``# noqa``) silences it; a committed JSON **baseline** records
+  pre-existing / deliberate findings (keyed by ``path + code + source
+  snippet`` so line drift doesn't invalidate entries), each with a
+  one-line justification.
+- config — ``[tool.apex_tpu.analysis]`` in pyproject.toml (``paths``,
+  ``baseline``, ``exclude``, ``select``, ``disable``).  Python 3.10 has no
+  ``tomllib``; :func:`_read_toml_table` parses the one flat table this
+  engine needs.
+- CLI — ``python -m apex_tpu.analysis [paths ...]``; exit 0 when every
+  finding is suppressed or baselined, 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import re
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "AnalysisConfig",
+    "Baseline",
+    "Finding",
+    "ModuleContext",
+    "Rule",
+    "RuleVisitor",
+    "analyze_file",
+    "analyze_paths",
+    "analyze_source",
+    "build_import_map",
+    "load_config",
+    "main",
+    "resolve_call",
+]
+
+
+# --------------------------------------------------------------------------
+# findings
+# --------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule hit: ``code`` at ``path:line:col``.  ``snippet`` is the
+    stripped source line — the stable baseline key (line numbers drift
+    under unrelated edits; the offending line's text rarely does)."""
+
+    code: str
+    message: str
+    path: str
+    line: int
+    col: int
+    snippet: str = ""
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.code} {self.message}"
+
+
+# --------------------------------------------------------------------------
+# import resolution shared by every rule
+# --------------------------------------------------------------------------
+
+def build_import_map(tree: ast.AST) -> Dict[str, str]:
+    """Map local names to canonical dotted prefixes.
+
+    ``import jax.numpy as jnp`` -> ``{"jnp": "jax.numpy"}``;
+    ``from jax import random as jr`` -> ``{"jr": "jax.random"}``;
+    ``from jax.experimental.pallas import pallas_call`` ->
+    ``{"pallas_call": "jax.experimental.pallas.pallas_call"}``.
+    """
+    amap: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    amap[a.asname] = a.name
+                else:
+                    head = a.name.split(".")[0]
+                    amap[head] = head
+        elif isinstance(node, ast.ImportFrom) and node.module and not node.level:
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                amap[a.asname or a.name] = f"{node.module}.{a.name}"
+    return amap
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def resolve_call(node: ast.AST, imports: Dict[str, str]) -> Optional[str]:
+    """Canonical dotted name of a Name/Attribute expression, resolving the
+    leading segment through the import map: with ``jr -> jax.random``,
+    ``jr.normal`` resolves to ``jax.random.normal``."""
+    dotted = _dotted(node)
+    if dotted is None:
+        return None
+    head, _, rest = dotted.partition(".")
+    head = imports.get(head, head)
+    return f"{head}.{rest}" if rest else head
+
+
+# --------------------------------------------------------------------------
+# rule framework
+# --------------------------------------------------------------------------
+
+class ModuleContext:
+    """One parsed file, shared by all rules."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.imports = build_import_map(self.tree)
+
+    def snippet(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+
+class Rule:
+    """Base hazard detector.  Subclasses set ``code``/``name``/
+    ``description`` and implement :meth:`check`."""
+
+    code: str = "APX000"
+    name: str = ""
+    description: str = ""
+
+    def check(self, module: ModuleContext) -> List[Finding]:
+        raise NotImplementedError
+
+
+class RuleVisitor(ast.NodeVisitor):
+    """``ast.NodeVisitor`` with the boilerplate rules share: the module
+    context, the import resolver, and a ``report`` helper that stamps the
+    finding with the node position and source snippet."""
+
+    def __init__(self, rule: Rule, module: ModuleContext):
+        self.rule = rule
+        self.module = module
+        self.findings: List[Finding] = []
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        return resolve_call(node, self.module.imports)
+
+    def report(self, node: ast.AST, message: str) -> None:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        self.findings.append(Finding(
+            code=self.rule.code, message=message, path=self.module.path,
+            line=line, col=col, snippet=self.module.snippet(line)))
+
+
+# --------------------------------------------------------------------------
+# suppression: # noqa
+# --------------------------------------------------------------------------
+
+_NOQA_RE = re.compile(
+    r"#\s*noqa(?P<sep>:\s*(?P<codes>[A-Z]+\d+(?:\s*,\s*[A-Z]+\d+)*))?",
+    re.IGNORECASE)
+
+
+def _noqa_codes(line: str) -> Optional[set]:
+    """None = no directive; empty set = bare ``# noqa`` (suppress all);
+    else the set of codes listed."""
+    m = _NOQA_RE.search(line)
+    if m is None:
+        return None
+    codes = m.group("codes")
+    if not codes:
+        return set()
+    return {c.strip().upper() for c in codes.split(",")}
+
+
+def _suppressed(finding: Finding, lines: Sequence[str]) -> bool:
+    if not (1 <= finding.line <= len(lines)):
+        return False
+    codes = _noqa_codes(lines[finding.line - 1])
+    if codes is None:
+        return False
+    return not codes or finding.code in codes
+
+
+# --------------------------------------------------------------------------
+# baseline
+# --------------------------------------------------------------------------
+
+class Baseline:
+    """Committed ledger of accepted findings.
+
+    Entries match on ``(path, code, snippet)``; duplicates are counted, so
+    two identical offending lines in one file need two entries.  ``line``
+    and ``justification`` are for humans (the gate requires a
+    justification on every committed entry).
+    """
+
+    def __init__(self, entries: Optional[List[dict]] = None, path: str = ""):
+        self.path = path
+        self.entries: List[dict] = list(entries or [])
+
+    @staticmethod
+    def _key(path: str, code: str, snippet: str) -> Tuple[str, str, str]:
+        return (path.replace(os.sep, "/"), code, snippet.strip())
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        with open(path, "r", encoding="utf-8") as fh:
+            data = json.load(fh)
+        return cls(data.get("entries", []), path=path)
+
+    def save(self, path: Optional[str] = None) -> None:
+        path = path or self.path
+        data = {"version": 1, "entries": self.entries}
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(data, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+
+    def partition(self, findings: Sequence[Finding]
+                  ) -> Tuple[List[Finding], List[Finding], List[dict]]:
+        """Split findings into (new, baselined); also return stale entries
+        (baseline lines whose finding no longer exists — fixed code whose
+        ledger entry should be dropped)."""
+        budget: Dict[Tuple[str, str, str], int] = {}
+        for e in self.entries:
+            k = self._key(e.get("path", ""), e.get("code", ""),
+                          e.get("snippet", ""))
+            budget[k] = budget.get(k, 0) + 1
+        new: List[Finding] = []
+        matched: List[Finding] = []
+        for f in findings:
+            k = self._key(f.path, f.code, f.snippet)
+            if budget.get(k, 0) > 0:
+                budget[k] -= 1
+                matched.append(f)
+            else:
+                new.append(f)
+        stale: List[dict] = []
+        for e in self.entries:
+            k = self._key(e.get("path", ""), e.get("code", ""),
+                          e.get("snippet", ""))
+            if budget.get(k, 0) > 0:
+                budget[k] -= 1
+                stale.append(e)
+        return new, matched, stale
+
+    @classmethod
+    def from_findings(cls, findings: Sequence[Finding],
+                      justification: str = "TODO: justify") -> "Baseline":
+        entries = [{
+            "path": f.path.replace(os.sep, "/"), "code": f.code,
+            "line": f.line, "snippet": f.snippet,
+            "justification": justification,
+        } for f in findings]
+        return cls(entries)
+
+
+# --------------------------------------------------------------------------
+# config: [tool.apex_tpu.analysis] in pyproject.toml
+# --------------------------------------------------------------------------
+
+@dataclass
+class AnalysisConfig:
+    paths: List[str] = field(default_factory=lambda: ["apex_tpu"])
+    baseline: Optional[str] = None       # path, relative to root
+    exclude: List[str] = field(default_factory=list)  # substring/glob-ish
+    select: List[str] = field(default_factory=list)   # empty = all rules
+    disable: List[str] = field(default_factory=list)
+    root: str = "."                      # directory holding pyproject.toml
+
+
+def _parse_toml_value(text: str):
+    text = text.strip()
+    if text.startswith("[") and text.endswith("]"):
+        inner = text[1:-1].strip()
+        if not inner:
+            return []
+        return [_parse_toml_value(p) for p in _split_toml_list(inner)]
+    if text.startswith(('"', "'")):
+        return text[1:-1]
+    if text in ("true", "false"):
+        return text == "true"
+    try:
+        return int(text)
+    except ValueError:
+        return text
+
+
+def _split_toml_list(inner: str) -> List[str]:
+    parts, depth, buf, quote = [], 0, [], None
+    for ch in inner:
+        if quote:
+            buf.append(ch)
+            if ch == quote:
+                quote = None
+            continue
+        if ch in "\"'":
+            quote = ch
+            buf.append(ch)
+        elif ch == "[":
+            depth += 1
+            buf.append(ch)
+        elif ch == "]":
+            depth -= 1
+            buf.append(ch)
+        elif ch == "," and depth == 0:
+            parts.append("".join(buf))
+            buf = []
+        else:
+            buf.append(ch)
+    if "".join(buf).strip():
+        parts.append("".join(buf))
+    return parts
+
+
+def _read_toml_table(path: str, table: str) -> Dict[str, object]:
+    """Parse one flat ``[table]`` from a TOML file — just the subset this
+    engine's config needs (strings, bools, ints, string arrays, including
+    multi-line arrays).  Python 3.10 ships no tomllib and the image policy
+    forbids new deps."""
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            lines = fh.read().splitlines()
+    except OSError:
+        return {}
+    out: Dict[str, object] = {}
+    in_table = False
+    pending_key = None
+    pending: List[str] = []
+    for raw in lines:
+        line = raw.strip()
+        if line.startswith("["):
+            in_table = line == f"[{table}]"
+            continue
+        if not in_table or not line or line.startswith("#"):
+            continue
+        if pending_key is not None:
+            pending.append(line)
+            joined = " ".join(pending)
+            if joined.count("[") == joined.count("]"):
+                out[pending_key] = _parse_toml_value(joined)
+                pending_key, pending = None, []
+            continue
+        if "=" not in line:
+            continue
+        key, _, value = line.partition("=")
+        key = key.strip().strip('"')
+        value = value.split("#")[0].strip() if not value.strip().startswith(
+            ('"', "'")) else value.strip()
+        if value.startswith("[") and value.count("[") != value.count("]"):
+            pending_key, pending = key, [value]
+            continue
+        out[key] = _parse_toml_value(value)
+    return out
+
+
+def load_config(start: str = ".",
+                pyproject: Optional[str] = None) -> AnalysisConfig:
+    """Find pyproject.toml (walking up from ``start`` unless given
+    explicitly) and build the analysis config from its
+    ``[tool.apex_tpu.analysis]`` table.  Missing file/table = defaults."""
+    if pyproject is None:
+        cur = os.path.abspath(start)
+        if os.path.isfile(cur):
+            cur = os.path.dirname(cur)
+        while True:
+            cand = os.path.join(cur, "pyproject.toml")
+            if os.path.isfile(cand):
+                pyproject = cand
+                break
+            parent = os.path.dirname(cur)
+            if parent == cur:
+                break
+            cur = parent
+    cfg = AnalysisConfig()
+    if pyproject is None:
+        return cfg
+    cfg.root = os.path.dirname(os.path.abspath(pyproject))
+    table = _read_toml_table(pyproject, "tool.apex_tpu.analysis")
+    if "paths" in table:
+        cfg.paths = [str(p) for p in table["paths"]]  # type: ignore[union-attr]
+    if "baseline" in table:
+        cfg.baseline = str(table["baseline"])
+    if "exclude" in table:
+        cfg.exclude = [str(p) for p in table["exclude"]]  # type: ignore[union-attr]
+    if "select" in table:
+        cfg.select = [str(p).upper() for p in table["select"]]  # type: ignore[union-attr]
+    if "disable" in table:
+        cfg.disable = [str(p).upper() for p in table["disable"]]  # type: ignore[union-attr]
+    return cfg
+
+
+# --------------------------------------------------------------------------
+# driving the rules
+# --------------------------------------------------------------------------
+
+def _get_rules(select: Sequence[str] = (), disable: Sequence[str] = ()
+               ) -> List[Rule]:
+    from apex_tpu.analysis.rules import all_rules
+    rules = all_rules()
+    if select:
+        rules = [r for r in rules if r.code in select]
+    if disable:
+        rules = [r for r in rules if r.code not in disable]
+    return rules
+
+
+def analyze_source(source: str, path: str = "<string>",
+                   rules: Optional[Sequence[Rule]] = None,
+                   respect_noqa: bool = True) -> List[Finding]:
+    """Run the rule pack over one source string.  Syntax errors surface as
+    a single APX000 finding rather than an exception — a lint run must
+    never die on one unparseable file."""
+    try:
+        module = ModuleContext(path, source)
+    except SyntaxError as e:
+        return [Finding("APX000", f"syntax error: {e.msg}", path,
+                        e.lineno or 1, e.offset or 0)]
+    findings: List[Finding] = []
+    for rule in (rules if rules is not None else _get_rules()):
+        findings.extend(rule.check(module))
+    if respect_noqa:
+        findings = [f for f in findings
+                    if not _suppressed(f, module.lines)]
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.code))
+    return findings
+
+
+def analyze_file(path: str, rules: Optional[Sequence[Rule]] = None,
+                 rel_to: Optional[str] = None) -> List[Finding]:
+    with open(path, "r", encoding="utf-8") as fh:
+        source = fh.read()
+    shown = os.path.relpath(path, rel_to) if rel_to else path
+    return analyze_source(source, shown.replace(os.sep, "/"), rules)
+
+
+def _iter_py_files(paths: Iterable[str], exclude: Sequence[str] = ()
+                   ) -> Iterable[str]:
+    def excluded(p: str) -> bool:
+        p = p.replace(os.sep, "/")
+        return any(pat in p for pat in exclude) or "__pycache__" in p
+
+    for path in paths:
+        if os.path.isfile(path):
+            if not excluded(path):
+                yield path
+        else:
+            for dirpath, dirnames, filenames in os.walk(path):
+                dirnames[:] = [d for d in sorted(dirnames)
+                               if d != "__pycache__"]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        full = os.path.join(dirpath, fn)
+                        if not excluded(full):
+                            yield full
+
+
+def analyze_paths(paths: Sequence[str],
+                  config: Optional[AnalysisConfig] = None,
+                  rules: Optional[Sequence[Rule]] = None) -> List[Finding]:
+    """Lint files/trees.  Paths in findings are reported relative to the
+    config root (the pyproject directory) so they match baseline entries
+    regardless of the invocation cwd."""
+    cfg = config or load_config(paths[0] if paths else ".")
+    if rules is None:
+        rules = _get_rules(cfg.select, cfg.disable)
+    findings: List[Finding] = []
+    for f in _iter_py_files(paths, cfg.exclude):
+        findings.extend(analyze_file(f, rules, rel_to=cfg.root))
+    findings.sort(key=lambda x: (x.path, x.line, x.col, x.code))
+    return findings
+
+
+# --------------------------------------------------------------------------
+# CLI
+# --------------------------------------------------------------------------
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m apex_tpu.analysis",
+        description="JAX/TPU hazard linter (APX rule pack)")
+    parser.add_argument("paths", nargs="*",
+                        help="files or directories (default: config paths)")
+    parser.add_argument("--baseline", default=None,
+                        help="baseline JSON (default: config baseline)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="report every finding, ignoring the baseline")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="write all current findings to the baseline "
+                             "file and exit 0")
+    parser.add_argument("--select", default=None,
+                        help="comma-separated rule codes to run")
+    parser.add_argument("--disable", default=None,
+                        help="comma-separated rule codes to skip")
+    parser.add_argument("--list-rules", action="store_true")
+    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument("--show-baselined", action="store_true",
+                        help="also print findings matched by the baseline")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for r in _get_rules():
+            print(f"{r.code}  {r.name}: {r.description}")
+        return 0
+
+    cfg = load_config(args.paths[0] if args.paths else ".")
+    paths = list(args.paths) or [os.path.join(cfg.root, p)
+                                 for p in cfg.paths]
+    select = ([c.strip().upper() for c in args.select.split(",")]
+              if args.select else cfg.select)
+    disable = ([c.strip().upper() for c in args.disable.split(",")]
+               if args.disable else cfg.disable)
+    rules = _get_rules(select, disable)
+
+    findings = analyze_paths(paths, cfg, rules)
+
+    baseline_path = args.baseline
+    if baseline_path is None and cfg.baseline:
+        baseline_path = os.path.join(cfg.root, cfg.baseline)
+
+    if args.write_baseline:
+        if baseline_path is None:
+            print("no baseline path (config [tool.apex_tpu.analysis] "
+                  "baseline or --baseline)", file=sys.stderr)
+            return 2
+        Baseline.from_findings(findings).save(baseline_path)
+        print(f"wrote {len(findings)} entries to {baseline_path}")
+        return 0
+
+    baselined: List[Finding] = []
+    stale: List[dict] = []
+    if baseline_path and not args.no_baseline and os.path.exists(
+            baseline_path):
+        bl = Baseline.load(baseline_path)
+        findings, baselined, stale = bl.partition(findings)
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [vars(f) for f in findings],
+            "baselined": len(baselined),
+            "stale_baseline_entries": stale,
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        if args.show_baselined:
+            for f in baselined:
+                print(f"{f.render()}  [baselined]")
+        for e in stale:
+            print(f"stale baseline entry (code fixed? drop it): "
+                  f"{e.get('path')}:{e.get('line')} {e.get('code')} "
+                  f"{e.get('snippet', '')!r}", file=sys.stderr)
+        n = len(findings)
+        print(f"{n} finding{'s' if n != 1 else ''} "
+              f"({len(baselined)} baselined, {len(stale)} stale baseline "
+              f"entr{'ies' if len(stale) != 1 else 'y'})")
+    return 1 if findings else 0

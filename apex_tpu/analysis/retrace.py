@@ -1,0 +1,156 @@
+"""Retrace watchdog: the runtime half of the hazard tooling.
+
+The static rules (APX004) catch signatures *designed* to retrace; this
+module catches the storms that only manifest at run time — a data
+pipeline that emits a ragged final batch, a checkpoint restore that
+changes a pytree's structure, a shape-dependent branch.  A recompilation
+storm is the nastiest kind of perf bug: nothing is wrong, the step just
+takes 10× longer, and on a preemptible TPU slice the job dies of slowness
+before anyone looks at a profile (the PR 1 tier-1 gate truncation was
+this, in miniature).
+
+:class:`RetraceWatchdog` wraps a step function.  Per call it measures
+whether a compilation happened — via the jit wrapper's ``_cache_size()``
+when available, falling back to tracking distinct abstract signatures
+``(shape, dtype, pytree structure)`` of the arguments — and
+
+- emits structured ``log_event`` telemetry (``event=retrace``) with the
+  call count and signature, ordered by ``seq``/``ts`` stamps;
+- raises :class:`RetraceBudgetExceeded` once retraces (compilations
+  beyond ``expected_compiles``) exceed ``budget``.
+
+``resilience.run_training`` wraps its ``step_fn`` automatically (config
+``retrace_budget``), so a storm surfaces as a watchdog event instead of a
+silent slowdown.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+from apex_tpu.utils.logging import get_logger, log_event
+
+__all__ = ["RetraceBudgetExceeded", "RetraceWatchdog"]
+
+
+class RetraceBudgetExceeded(RuntimeError):
+    """Raised when a wrapped callable recompiles more than its budget."""
+
+    def __init__(self, message: str, *, name: str, retraces: int,
+                 budget: int):
+        super().__init__(message)
+        self.name = name
+        self.retraces = retraces
+        self.budget = budget
+
+
+def _abstract_signature(args: Tuple[Any, ...], kwargs: dict) -> Tuple:
+    """Hashable jit-cache key proxy: pytree structure + per-leaf
+    (shape, dtype) for array leaves, the value itself for hashable
+    non-array leaves (weak-typed scalars collapse to their type, which
+    matches jit's weak-type bucketing closely enough for storm
+    detection)."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    sig = []
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is not None and dtype is not None:
+            sig.append(("arr", tuple(shape), str(dtype)))
+        else:
+            try:
+                hash(leaf)
+                sig.append(("val", type(leaf).__name__, leaf))
+            except TypeError:
+                sig.append(("obj", type(leaf).__name__))
+    return (str(treedef), tuple(sig))
+
+
+class RetraceWatchdog:
+    """Wrap a (typically jitted) callable and count its recompilations.
+
+    Args:
+      fn: the callable. A ``jax.jit`` wrapper is detected via its
+        ``_cache_size()`` method (jax 0.4.x+) and counted exactly; any
+        other callable falls back to abstract-signature tracking.
+      budget: retraces allowed beyond ``expected_compiles`` before
+        :class:`RetraceBudgetExceeded` is raised.  ``None`` = never raise,
+        log only.
+      expected_compiles: compilations that are legitimate (default 1 —
+        the warmup trace).  Donated-buffer aware restarts that *should*
+        recompile can raise this.
+      name: label for telemetry (defaults to the callable's ``__name__``).
+      on_retrace: optional ``(watchdog, signature) -> None`` hook, called
+        after telemetry on every counted retrace.
+    """
+
+    def __init__(self, fn: Callable, *, budget: Optional[int] = None,
+                 expected_compiles: int = 1, name: Optional[str] = None,
+                 logger=None, on_retrace: Optional[Callable] = None):
+        self._fn = fn
+        self.budget = budget
+        self.expected_compiles = expected_compiles
+        self.name = name or getattr(fn, "__name__", type(fn).__name__)
+        self._log = logger or get_logger(__name__)
+        self._on_retrace = on_retrace
+        self.calls = 0
+        self.compiles = 0
+        self._signatures: set = set()
+        self._cache_probe = getattr(fn, "_cache_size", None)
+        # a pre-warmed jit cache is not this watchdog's doing: baseline it
+        self._last_cache_size = (self._cache_probe()
+                                 if callable(self._cache_probe) else None)
+
+    @property
+    def retraces(self) -> int:
+        """Compilations beyond the expected warmup count."""
+        return max(0, self.compiles - self.expected_compiles)
+
+    def __call__(self, *args, **kwargs):
+        out = self._fn(*args, **kwargs)
+        self.calls += 1
+        self._observe(args, kwargs)
+        return out
+
+    # -- counting ---------------------------------------------------------
+
+    def _observe(self, args, kwargs) -> None:
+        new_compiles = 0
+        sig = None
+        if self._last_cache_size is not None and callable(self._cache_probe):
+            size = self._cache_probe()
+            if size > self._last_cache_size:
+                new_compiles = size - self._last_cache_size
+            self._last_cache_size = size
+        else:
+            sig = _abstract_signature(args, kwargs)
+            if sig not in self._signatures:
+                self._signatures.add(sig)
+                new_compiles = 1
+        if not new_compiles:
+            return
+        self.compiles += new_compiles
+        if self.compiles <= self.expected_compiles:
+            return
+        if sig is None:
+            sig = _abstract_signature(args, kwargs)
+        log_event(self._log, "retrace", fn=self.name, call=self.calls,
+                  compiles=self.compiles, retraces=self.retraces,
+                  budget=("none" if self.budget is None else self.budget),
+                  signature=hex(abs(hash(sig)))[:10])
+        if self._on_retrace is not None:
+            self._on_retrace(self, sig)
+        if self.budget is not None and self.retraces > self.budget:
+            line = log_event(
+                self._log, "retrace_budget_exceeded", fn=self.name,
+                retraces=self.retraces, budget=self.budget,
+                calls=self.calls, level="error")
+            raise RetraceBudgetExceeded(
+                f"'{self.name}' recompiled {self.retraces} times past the "
+                f"expected {self.expected_compiles} (budget "
+                f"{self.budget}) — recompilation storm; check for "
+                f"varying shapes/dtypes or pytree-structure churn in its "
+                f"arguments [{line}]",
+                name=self.name, retraces=self.retraces, budget=self.budget)

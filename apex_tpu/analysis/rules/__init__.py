@@ -1,0 +1,38 @@
+"""The APX rule pack.
+
+Each module contributes one :class:`~apex_tpu.analysis.engine.Rule`;
+:func:`all_rules` instantiates the full pack in code order.  Adding a rule
+= adding a module here and listing its class below — the engine, CLI,
+baseline and gate pick it up automatically.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from apex_tpu.analysis.engine import Rule
+from apex_tpu.analysis.rules.apx001_prng_reuse import APX001PrngReuse
+from apex_tpu.analysis.rules.apx002_concretization import APX002Concretization
+from apex_tpu.analysis.rules.apx003_host_sync import APX003HostSync
+from apex_tpu.analysis.rules.apx004_recompile import APX004Recompile
+from apex_tpu.analysis.rules.apx005_collectives import APX005Collectives
+from apex_tpu.analysis.rules.apx006_dtype import APX006DtypeDiscipline
+from apex_tpu.analysis.rules.apx007_pallas_scan import APX007PallasScan
+from apex_tpu.analysis.rules.apx008_mutable_state import APX008MutableState
+
+_RULE_CLASSES = [
+    APX001PrngReuse,
+    APX002Concretization,
+    APX003HostSync,
+    APX004Recompile,
+    APX005Collectives,
+    APX006DtypeDiscipline,
+    APX007PallasScan,
+    APX008MutableState,
+]
+
+__all__ = ["all_rules"] + [c.__name__ for c in _RULE_CLASSES]
+
+
+def all_rules() -> List[Rule]:
+    return [cls() for cls in _RULE_CLASSES]

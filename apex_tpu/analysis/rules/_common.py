@@ -1,0 +1,257 @@
+"""Helpers shared by the APX rule pack: jit-decorator detection and
+traced-value taint propagation.
+
+Several rules only fire *inside jitted code* (concretization, host sync,
+mutable-state mutation) — they all need the same answer to "is this
+function jit-compiled, and which of its parameters are static?".
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+#: attributes of a traced array that are static under tracing — reading
+#: them never concretizes the value.
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "sharding",
+                "weak_type", "itemsize"}
+
+#: builtins whose result on a traced argument is static (or that inspect
+#: rather than concretize).
+STATIC_CALLS = {"len", "isinstance", "type", "id", "repr", "getattr",
+                "hasattr"}
+
+JIT_NAMES = {"jax.jit", "jax.pjit", "jax.experimental.pjit.pjit"}
+
+#: transforms that trace their operand — concretization inside any of
+#: these is a hazard even without jit (grad/vmap use tracers too), and
+#: this repo jits via call form (``jax.jit(shard_map(per_rank, ...))``)
+#: far more often than via decorators.
+TRACING_WRAPPER_SUFFIXES = (
+    ".jit", ".pjit", ".pmap", ".vmap", ".grad", ".value_and_grad",
+    ".shard_map", ".checkify",
+)
+TRACING_WRAPPER_NAMES = {"jit", "pjit", "pmap", "vmap", "grad",
+                         "value_and_grad", "shard_map"}
+
+
+def _is_tracing_wrapper(fname: Optional[str]) -> bool:
+    if fname is None:
+        return False
+    return (fname in TRACING_WRAPPER_NAMES
+            or fname.endswith(TRACING_WRAPPER_SUFFIXES))
+
+
+def _is_jit_name(fname: Optional[str]) -> bool:
+    return fname is not None and (
+        fname in JIT_NAMES or fname == "jit"
+        or fname.endswith((".jit", ".pjit")))
+
+
+@dataclass
+class JitInfo:
+    """How a function is jitted: which params are compile-time static."""
+
+    static_names: Set[str] = field(default_factory=set)
+    static_nums: Set[int] = field(default_factory=set)
+    #: "jit" when compiled (hot path), "traced" for grad/vmap-style
+    #: transforms that trace but don't cache compilations
+    kind: str = "jit"
+
+    def resolve_static(self, func: ast.FunctionDef) -> Set[str]:
+        names = set(self.static_names)
+        plist = param_names(func)
+        for n in self.static_nums:
+            if 0 <= n < len(plist):
+                names.add(plist[n])
+        return names
+
+
+def _const_str_seq(node: ast.AST) -> List[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                out.append(elt.value)
+        return out
+    return []
+
+
+def _const_int_seq(node: ast.AST) -> List[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, int)]
+    return []
+
+
+def param_names(func: ast.FunctionDef) -> List[str]:
+    a = func.args
+    names = [p.arg for p in a.posonlyargs + a.args]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    names += [p.arg for p in a.kwonlyargs]
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+def jit_info(func: ast.FunctionDef, resolve) -> Optional[JitInfo]:
+    """Return :class:`JitInfo` if ``func`` carries a jit decorator
+    (``@jax.jit``, ``@jit``, ``@jax.jit(...)``, ``@partial(jax.jit, ...)``),
+    else None.  ``resolve`` maps a Name/Attribute node to its canonical
+    dotted path (``RuleVisitor.resolve``)."""
+    for deco in func.decorator_list:
+        target = deco
+        partial_wrapped = False
+        if isinstance(deco, ast.Call):
+            fname = resolve(deco.func)
+            if fname in ("functools.partial", "partial"):
+                if not deco.args:
+                    continue
+                target = deco.args[0]
+                partial_wrapped = True
+            else:
+                target = deco.func
+        name = resolve(target)
+        if name not in JIT_NAMES and name != "jit":
+            continue
+        info = JitInfo()
+        if isinstance(deco, ast.Call):
+            # positional static args of partial(jax.jit, fn?, ...) never
+            # appear in practice; only keywords carry staticness
+            _static_kwargs_into(info, deco.keywords)
+            del partial_wrapped
+        return info
+    return None
+
+
+def expr_names(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+class Taint:
+    """Forward taint pass over one function body: which local names can
+    hold traced values.  Seeds from the non-static parameters, propagates
+    through assignments in source order.  Reads through static attributes
+    (``x.shape`` etc.) and static builtins (``len``/``isinstance``) do not
+    propagate taint."""
+
+    def __init__(self, func: ast.FunctionDef, static: Set[str]):
+        self.tainted: Set[str] = {
+            n for n in param_names(func)
+            if n not in static and n not in ("self", "cls")}
+        # taint is monotone; iterate to a fixpoint so chains of
+        # assignments resolve regardless of ast.walk's visit order
+        for _ in range(8):
+            before = len(self.tainted)
+            for stmt in ast.walk(func):
+                if isinstance(stmt, (ast.Assign, ast.AnnAssign,
+                                     ast.AugAssign)):
+                    value = stmt.value
+                    if value is None or not self.is_traced(value):
+                        continue
+                    targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                               else [stmt.target])
+                    for t in targets:
+                        for n in ast.walk(t):
+                            if isinstance(n, ast.Name):
+                                self.tainted.add(n.id)
+                elif isinstance(stmt, ast.For):
+                    if self.is_traced(stmt.iter):
+                        for n in ast.walk(stmt.target):
+                            if isinstance(n, ast.Name):
+                                self.tainted.add(n.id)
+            if len(self.tainted) == before:
+                break
+
+    def is_traced(self, node: ast.AST) -> bool:
+        """Can evaluating ``node`` yield a traced value (conservatively)?"""
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Attribute):
+            if node.attr in STATIC_ATTRS:
+                return False
+            return self.is_traced(node.value)
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Name) and fn.id in STATIC_CALLS:
+                return False
+            return any(self.is_traced(a) for a in node.args) or any(
+                self.is_traced(k.value) for k in node.keywords) or (
+                self.is_traced(fn) if isinstance(fn, ast.Attribute)
+                else False)
+        if isinstance(node, ast.Compare):
+            # `x is None` / `x is not None` inspects pytree structure,
+            # not the traced value
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return False
+            return any(self.is_traced(c)
+                       for c in [node.left] + node.comparators)
+        if isinstance(node, (ast.BoolOp, ast.BinOp, ast.UnaryOp,
+                             ast.IfExp, ast.Subscript, ast.Starred,
+                             ast.Tuple, ast.List, ast.Set, ast.Dict,
+                             ast.JoinedStr, ast.FormattedValue)):
+            return any(self.is_traced(c) for c in ast.iter_child_nodes(node)
+                       if isinstance(c, ast.expr))
+        return False
+
+
+def walk_functions(tree: ast.AST):
+    """Yield every FunctionDef/AsyncFunctionDef in the module."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _static_kwargs_into(info: JitInfo, keywords) -> None:
+    for kw in keywords:
+        if kw.arg in ("static_argnames",):
+            info.static_names |= set(_const_str_seq(kw.value))
+        elif kw.arg in ("static_argnums", "static_broadcasted_argnums"):
+            info.static_nums |= set(_const_int_seq(kw.value))
+
+
+def traced_functions(tree: ast.AST, resolve) -> Dict[ast.AST, JitInfo]:
+    """Every function in the module that runs under a tracing transform,
+    with its staticness info.  Catches both the decorator form
+    (``@jax.jit``) and the call form this repo favors —
+    ``jax.jit(shard_map(per_rank, ...), ...)`` / ``jax.grad(loss_fn)`` —
+    by resolving the first positional argument back to a local def
+    (unwrapping one nested wrapper level)."""
+    defs: Dict[str, ast.AST] = {}
+    for func in walk_functions(tree):
+        defs[func.name] = func
+    out: Dict[ast.AST, JitInfo] = {}
+    for func in walk_functions(tree):
+        info = jit_info(func, resolve)
+        if info is not None:
+            out[func] = info
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        fname = resolve(node.func)
+        if not _is_tracing_wrapper(fname):
+            continue
+        target = node.args[0]
+        if isinstance(target, ast.Call) and target.args and \
+                _is_tracing_wrapper(resolve(target.func)):
+            # jax.jit(shard_map(per_rank, ...)): the inner function is
+            # what traces; jit staticness still comes from the outer call
+            target = target.args[0]
+        if not isinstance(target, ast.Name) or target.id not in defs:
+            continue
+        func = defs[target.id]
+        info = out.get(func)
+        if info is None:
+            info = JitInfo(kind="traced")
+            out[func] = info
+        if _is_jit_name(fname) or fname.endswith(".pmap") or \
+                fname == "pmap":
+            info.kind = "jit"
+            _static_kwargs_into(info, node.keywords)
+    return out

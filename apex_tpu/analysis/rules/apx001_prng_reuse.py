@@ -1,0 +1,175 @@
+"""APX001 — PRNG key reuse.
+
+Feeding the same key object to two ``jax.random.*`` sampling calls draws
+*correlated* streams — the exact bug behind PR 1's structurally-duplicated
+packed-attention dropout seeds, and the classic silent JAX correctness
+trap: nothing crashes, the statistics are just wrong.  Every consumed key
+must come from ``split`` / ``fold_in`` of a fresh parent.
+
+Detection: within one function scope, find a key name passed as the first
+argument to two sampling calls with no intervening reassignment of that
+name (``k = jax.random.split(k)`` / ``fold_in`` / any rebind kills the
+taint).  Nested function scopes are analyzed independently.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Tuple
+
+from apex_tpu.analysis.engine import Finding, ModuleContext, Rule, RuleVisitor
+
+#: jax.random functions that do NOT consume their key argument's
+#: statistical budget (constructors and derivers).
+_NON_CONSUMING = {
+    "PRNGKey", "key", "split", "fold_in", "wrap_key_data", "key_data",
+    "clone", "key_impl",
+}
+
+
+class APX001PrngReuse(Rule):
+    code = "APX001"
+    name = "prng-key-reuse"
+    description = ("same PRNG key fed to two jax.random sampling calls "
+                   "with no split/fold_in between")
+
+    def check(self, module: ModuleContext) -> List[Finding]:
+        v = _Visitor(self, module)
+        v.scan(module.tree, "<module>")
+        return v.findings
+
+
+class _Visitor(RuleVisitor):
+    def scan(self, scope: ast.AST, scope_name: str) -> None:
+        """Analyze one scope's direct statements; recurse into nested
+        function scopes separately (a closure capturing a key is its own
+        stream discipline problem, judged in its own scope)."""
+        uses: Dict[str, List[Tuple[int, str]]] = {}
+        kills: Dict[str, List[int]] = {}
+        nested: List[ast.AST] = []
+
+        for node in ast.walk(scope):
+            if node is not scope and isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.Lambda)):
+                nested.append(node)
+        nested_set = set()
+        for fn in nested:
+            for sub in ast.walk(fn):
+                if sub is not fn:
+                    nested_set.add(sub)
+        # a sampling call whose key is the comprehension's own loop
+        # variable draws a fresh key per element — not a reuse; those
+        # calls are judged by the dedicated comprehension check below
+        comp_bound_calls = set()
+        for node in ast.walk(scope):
+            if isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                 ast.GeneratorExp)):
+                bound = {n.id for g in node.generators
+                         for n in ast.walk(g.target)
+                         if isinstance(n, ast.Name)}
+                for sub in ast.walk(node):
+                    if (isinstance(sub, ast.Call) and sub.args
+                            and isinstance(sub.args[0], ast.Name)
+                            and sub.args[0].id in bound):
+                        comp_bound_calls.add(sub)
+
+        # only consider nodes belonging to THIS scope
+        for node in ast.walk(scope):
+            if node in nested_set or node is scope:
+                continue
+            if isinstance(node, ast.Call) and node not in comp_bound_calls:
+                fname = self.resolve(node.func)
+                if (fname and fname.startswith("jax.random.")
+                        and fname.rsplit(".", 1)[1] not in _NON_CONSUMING
+                        and node.args
+                        and isinstance(node.args[0], ast.Name)):
+                    key = node.args[0].id
+                    uses.setdefault(key, []).append(
+                        (node.lineno, fname.rsplit(".", 1)[1]))
+            for tgt in self._assign_targets(node):
+                kills.setdefault(tgt, []).append(node.lineno)
+
+        for key, key_uses in uses.items():
+            key_uses.sort()
+            key_kills = sorted(kills.get(key, []))
+            for (l1, f1), (l2, f2) in zip(key_uses, key_uses[1:]):
+                if not any(l1 < k <= l2 for k in key_kills):
+                    self.findings.append(Finding(
+                        self.rule.code,
+                        f"PRNG key '{key}' consumed by jax.random.{f2} "
+                        f"at line {l2} was already consumed by "
+                        f"jax.random.{f1} at line {l1} with no "
+                        f"split/fold_in between — correlated streams",
+                        self.module.path, l2, 0, self.module.snippet(l2)))
+
+        # a single consuming call lexically inside a loop (or a
+        # comprehension) reuses the key every iteration — the PR 1
+        # duplicated-dropout-seed shape — unless the loop body rebinds it
+        for node in ast.walk(scope):
+            if node in nested_set or node is scope:
+                continue
+            if isinstance(node, (ast.For, ast.While)):
+                span = (node.lineno, getattr(node, "end_lineno",
+                                             node.lineno))
+                for key, key_uses in uses.items():
+                    in_loop = [u for u in key_uses
+                               if span[0] <= u[0] <= span[1]]
+                    # multi-use reuse is already caught by the pair check
+                    if len(in_loop) != 1:
+                        continue
+                    if any(span[0] <= k <= span[1]
+                           for k in kills.get(key, [])):
+                        continue
+                    l, f = in_loop[0]
+                    self.findings.append(Finding(
+                        self.rule.code,
+                        f"PRNG key '{key}' consumed by jax.random.{f} "
+                        f"inside a loop without a per-iteration "
+                        f"split/fold_in — every iteration draws the same "
+                        f"stream",
+                        self.module.path, l, 0, self.module.snippet(l)))
+            elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                   ast.GeneratorExp)):
+                bound = {n.id for g in node.generators
+                         for n in ast.walk(g.target)
+                         if isinstance(n, ast.Name)}
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Call):
+                        fname = self.resolve(sub.func)
+                        if (fname and fname.startswith("jax.random.")
+                                and fname.rsplit(".", 1)[1]
+                                not in _NON_CONSUMING
+                                and sub.args
+                                and isinstance(sub.args[0], ast.Name)
+                                and sub.args[0].id not in bound):
+                            self.findings.append(Finding(
+                                self.rule.code,
+                                f"PRNG key '{sub.args[0].id}' consumed by "
+                                f"jax.random.{fname.rsplit('.', 1)[1]} "
+                                f"inside a comprehension — every element "
+                                f"draws the same stream",
+                                self.module.path, sub.lineno, 0,
+                                self.module.snippet(sub.lineno)))
+
+        for fn in nested:
+            self.scan(fn, getattr(fn, "name", "<lambda>"))
+
+    @staticmethod
+    def _assign_targets(node: ast.AST) -> List[str]:
+        out: List[str] = []
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        out.append(n.id)
+        elif isinstance(node, ast.For):
+            for n in ast.walk(node.target):
+                if isinstance(n, ast.Name):
+                    out.append(n.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # parameters rebind names inside nested scopes; handled there
+            pass
+        return out

@@ -1,0 +1,87 @@
+"""APX002 — concretization / host sync inside jit-decorated functions.
+
+``float(x)`` / ``int(x)`` / ``bool(x)`` / ``np.asarray(x)`` / ``x.item()``
+on a traced value either raises ``TracerBoolConversionError`` at trace
+time or — worse, under ``io_callback``-style escapes — silently forces a
+device→host transfer per step.  ``if``/``while`` on a traced value is the
+same hazard spelled as control flow (the fix is ``lax.cond`` /
+``jnp.where`` or marking the argument static).
+
+Detection: for each jit-decorated function, run a forward taint pass
+seeded from the non-static parameters (reads through ``.shape`` /
+``.ndim`` / ``.dtype`` / ``len()`` / ``is None`` stay untainted — those
+are static under tracing), then flag concretizing builtins, numpy
+materializations, ``.item()`` / ``.tolist()``, and ``if``/``while`` tests
+over tainted values.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from apex_tpu.analysis.engine import Finding, ModuleContext, Rule, RuleVisitor
+from apex_tpu.analysis.rules._common import Taint, traced_functions
+
+_CONCRETIZING_BUILTINS = {"float", "int", "bool", "complex"}
+_NUMPY_MATERIALIZERS = {"numpy.asarray", "numpy.array", "numpy.asanyarray",
+                        "numpy.ascontiguousarray"}
+_HOST_METHODS = {"item", "tolist", "__array__"}
+
+
+class APX002Concretization(Rule):
+    code = "APX002"
+    name = "concretization-in-jit"
+    description = ("float()/int()/bool()/np.asarray()/.item() or Python "
+                   "control flow on a traced value inside a jitted "
+                   "function")
+
+    def check(self, module: ModuleContext) -> List[Finding]:
+        v = RuleVisitor(self, module)
+        for func, info in traced_functions(module.tree, v.resolve).items():
+            taint = Taint(func, info.resolve_static(func))
+            nested = set()
+            for sub in ast.walk(func):
+                if sub is not func and isinstance(
+                        sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    for inner in ast.walk(sub):
+                        if inner is not sub:
+                            nested.add(inner)
+            for node in ast.walk(func):
+                if node in nested:
+                    continue  # nested defs are judged in their own right
+                if isinstance(node, ast.Call):
+                    self._check_call(v, node, taint)
+                elif isinstance(node, (ast.If, ast.While)):
+                    if taint.is_traced(node.test):
+                        kind = ("if" if isinstance(node, ast.If)
+                                else "while")
+                        v.report(node, (
+                            f"`{kind}` on a traced value inside traced "
+                            f"function '{func.name}' — use lax.cond/"
+                            f"jnp.where or mark the argument static"))
+        return v.findings
+
+    @staticmethod
+    def _check_call(v: RuleVisitor, node: ast.Call, taint: Taint) -> None:
+        fn = node.func
+        if (isinstance(fn, ast.Name)
+                and fn.id in _CONCRETIZING_BUILTINS
+                and node.args and taint.is_traced(node.args[0])):
+            v.report(node, (
+                f"`{fn.id}()` concretizes a traced value inside a jitted "
+                f"function — keep it on device or mark the argument "
+                f"static"))
+            return
+        fname = v.resolve(fn)
+        if fname in _NUMPY_MATERIALIZERS and node.args and taint.is_traced(
+                node.args[0]):
+            v.report(node, (
+                f"`{fname.replace('numpy', 'np')}()` materializes a traced "
+                f"value to host numpy inside a jitted function"))
+            return
+        if (isinstance(fn, ast.Attribute) and fn.attr in _HOST_METHODS
+                and taint.is_traced(fn.value)):
+            v.report(node, (
+                f"`.{fn.attr}()` forces a device→host sync on a traced "
+                f"value inside a jitted function"))

@@ -1,0 +1,65 @@
+"""APX003 — blocking host sync in training-step bodies.
+
+``.block_until_ready()`` / ``jax.block_until_ready()`` /
+``jax.device_get()`` inside the per-step path serializes the host against
+the device every step: the dispatch pipeline drains, and TPU utilization
+falls off a cliff (this is why ``resilience.run_training`` polls metrics
+in batches off the critical path instead of syncing per step).  Blocking
+belongs at poll boundaries, timers, and test assertions — never in the
+step function.
+
+Detection: functions that look like step bodies — name contains ``step``
+as a word segment, or the function is jit-decorated (a jitted function IS
+the hot path) — containing a blocking call.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List
+
+from apex_tpu.analysis.engine import Finding, ModuleContext, Rule, RuleVisitor
+from apex_tpu.analysis.rules._common import traced_functions, walk_functions
+
+_STEP_NAME = re.compile(r"(^|_)step(_|$)|(^|_)per_rank(_|$)")
+_BLOCKING_FUNCS = {"jax.block_until_ready", "jax.device_get"}
+
+
+class APX003HostSync(Rule):
+    code = "APX003"
+    name = "host-sync-in-step"
+    description = ("block_until_ready()/jax.device_get() inside a "
+                   "training-step body serializes host and device every "
+                   "step")
+
+    def check(self, module: ModuleContext) -> List[Finding]:
+        v = RuleVisitor(self, module)
+        compiled = {f for f, info in traced_functions(
+            module.tree, v.resolve).items() if info.kind == "jit"}
+        for func in walk_functions(module.tree):
+            # test bodies assert on host values by design
+            if func.name.startswith("test_"):
+                continue
+            is_step = bool(_STEP_NAME.search(func.name))
+            if not is_step and func not in compiled:
+                continue
+            where = (f"step body '{func.name}'" if is_step
+                     else f"jitted function '{func.name}'")
+            for node in ast.walk(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                if (isinstance(fn, ast.Attribute)
+                        and fn.attr == "block_until_ready"):
+                    v.report(node, (
+                        f"`.block_until_ready()` in {where} — move the "
+                        f"sync to a poll boundary outside the hot loop"))
+                    continue
+                fname = v.resolve(fn)
+                if fname in _BLOCKING_FUNCS:
+                    short = fname.split(".", 1)[1]
+                    v.report(node, (
+                        f"`jax.{short}()` in {where} — batch device reads "
+                        f"off the critical path instead"))
+        return v.findings

@@ -1,0 +1,81 @@
+"""APX004 — recompile hazards on jitted signatures.
+
+``jax.jit`` caches compilations by the abstract signature of every
+non-static argument.  Two signature shapes silently defeat the cache:
+
+1. **mutable defaults** (``def f(x, opts={})``) — a dict/list default is
+   a pytree of leaves, and any call that mutates or replaces it changes
+   the tree structure → retrace.  Worse, an *unhashable* value passed for
+   a ``static_argnames`` parameter raises at call time.
+2. **shape-like Python scalars not marked static** — a ``shape``/
+   ``*_shape`` parameter consumed by ``reshape``/``zeros``-style calls
+   must be concrete at trace time; passing it as a traced arg either
+   fails or, when it arrives as a plain int that changes per call,
+   triggers a retrace per distinct value (the recompilation-storm shape
+   that truncated this repo's tier-1 gate in PR 1).
+
+Detection is signature-only (no cross-call dataflow): jitted defs with
+list/dict/set displays (or ``list()``/``dict()``/``set()`` calls) as
+defaults, and params named ``shape``/``*_shape``/``*_shapes`` absent
+from ``static_argnames``/``static_argnums``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from apex_tpu.analysis.engine import Finding, ModuleContext, Rule, RuleVisitor
+from apex_tpu.analysis.rules._common import param_names, traced_functions
+
+_MUTABLE_CTORS = {"list", "dict", "set", "bytearray"}
+
+
+def _is_mutable_default(node: ast.AST) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set)):
+        return True
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+        return node.func.id in _MUTABLE_CTORS
+    return False
+
+
+def _shape_like(name: str) -> bool:
+    return name == "shape" or name.endswith("_shape") or name.endswith(
+        "_shapes")
+
+
+class APX004Recompile(Rule):
+    code = "APX004"
+    name = "recompile-hazard"
+    description = ("mutable/unhashable defaults or unmarked shape args on "
+                   "a jitted signature defeat the jit cache")
+
+    def check(self, module: ModuleContext) -> List[Finding]:
+        v = RuleVisitor(self, module)
+        for func, info in traced_functions(module.tree, v.resolve).items():
+            if info.kind != "jit":
+                continue  # grad/vmap tracing recompiles nothing
+            static = info.resolve_static(func)
+            args = func.args
+            defaults = list(zip(
+                [a.arg for a in (args.posonlyargs + args.args)][
+                    -len(args.defaults):] if args.defaults else [],
+                args.defaults))
+            defaults += [(a.arg, d) for a, d in zip(args.kwonlyargs,
+                                                    args.kw_defaults)
+                         if d is not None]
+            for pname, default in defaults:
+                if _is_mutable_default(default):
+                    v.report(default, (
+                        f"mutable default for parameter '{pname}' of "
+                        f"jitted '{func.name}' — every structural change "
+                        f"retraces; pass an immutable (tuple/frozen) "
+                        f"value or mark it static"))
+            for pname in param_names(func):
+                if _shape_like(pname) and pname not in static:
+                    v.report(func, (
+                        f"shape-like parameter '{pname}' of jitted "
+                        f"'{func.name}' is not in static_argnames — "
+                        f"per-value retraces (or a trace-time failure) "
+                        f"instead of one compile per shape"))
+        return v.findings

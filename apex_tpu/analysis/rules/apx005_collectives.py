@@ -1,0 +1,119 @@
+"""APX005 — collective axis-name discipline.
+
+``lax.psum(x, 'data')`` outside a ``shard_map``/``pmap`` binding ``'data'``
+raises ``NameError: unbound axis name`` — but only on the code path that
+actually executes the collective, which on a single-host dev box is often
+never.  The cross-replica weight-update sharding literature (PAPERS.md)
+identifies axis-name/collective discipline as where distributed JAX code
+silently goes wrong: the string is a free variable checked only at trace
+time under a live mesh.
+
+Detection (single file): collect every axis name the file *binds* — mesh
+constructions (``Mesh(devices, ('data', 'model'))``), ``axis_name=`` /
+``axis_names=`` keywords (pmap/shard_map/psum-wrapper style), and
+``PartitionSpec`` string literals — then flag collectives whose
+string-literal axis argument names none of them.  Axis names passed as
+variables/constants (``DATA_AXIS``) resolve across files and are skipped.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from apex_tpu.analysis.engine import Finding, ModuleContext, Rule, RuleVisitor
+
+#: canonical collective -> positional index of the axis-name argument
+_COLLECTIVES = {
+    "jax.lax.psum": 1,
+    "jax.lax.pmean": 1,
+    "jax.lax.pmax": 1,
+    "jax.lax.pmin": 1,
+    "jax.lax.psum_scatter": 1,
+    "jax.lax.all_gather": 1,
+    "jax.lax.all_to_all": 1,
+    "jax.lax.ppermute": 1,
+    "jax.lax.pshuffle": 1,
+    "jax.lax.axis_index": 0,
+    "jax.lax.axis_size": 0,
+}
+
+_SPEC_CTORS = {"jax.sharding.PartitionSpec", "PartitionSpec",
+               "jax.experimental.pjit.PartitionSpec"}
+
+
+def _literal_axes(node: ast.AST) -> List[str]:
+    """String-literal axis names in an expression (str or tuple/list of)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.append(e.value)
+        return out
+    return []
+
+
+class APX005Collectives(Rule):
+    code = "APX005"
+    name = "unbound-collective-axis"
+    description = ("lax collective names a string-literal axis bound "
+                   "nowhere in the file (no mesh/shard_map/pmap/"
+                   "PartitionSpec mentions it)")
+
+    def check(self, module: ModuleContext) -> List[Finding]:
+        v = RuleVisitor(self, module)
+        bound = self._bound_axes(module, v)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = v.resolve(node.func)
+            if fname is None:
+                continue
+            idx = _COLLECTIVES.get(fname)
+            if idx is None:
+                continue
+            axis_expr: Optional[ast.AST] = None
+            if len(node.args) > idx:
+                axis_expr = node.args[idx]
+            for kw in node.keywords:
+                if kw.arg in ("axis_name", "axis_names"):
+                    axis_expr = kw.value
+            if axis_expr is None:
+                continue
+            for axis in _literal_axes(axis_expr):
+                if axis not in bound:
+                    v.report(node, (
+                        f"collective `{fname.rsplit('.', 1)[1]}` names "
+                        f"axis '{axis}' but no mesh/shard_map/pmap/"
+                        f"PartitionSpec in this file binds it — unbound "
+                        f"axis names fail only when the collective "
+                        f"actually traces under a mesh"))
+        return v.findings
+
+    @staticmethod
+    def _bound_axes(module: ModuleContext, v: RuleVisitor) -> Set[str]:
+        bound: Set[str] = set()
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = v.resolve(node.func) or ""
+            # Mesh(devices, ('data', 'model')) / Mesh(..., axis_names=...)
+            if fname.endswith("Mesh") or "mesh" in fname.rsplit(
+                    ".", 1)[-1].lower():
+                for arg in list(node.args[1:]) + [
+                        kw.value for kw in node.keywords
+                        if kw.arg == "axis_names"]:
+                    bound.update(_literal_axes(arg))
+            # any axis_name(s)= keyword anywhere binds/forwards an axis:
+            # pmap, shard_map, and this repo's psum-wrapper helpers
+            for kw in node.keywords:
+                if kw.arg in ("axis_name", "axis_names", "data_axes",
+                              "axis"):
+                    bound.update(_literal_axes(kw.value))
+            # PartitionSpec('data', ...) names mesh axes by construction
+            if fname in _SPEC_CTORS or fname.endswith("PartitionSpec"):
+                for arg in node.args:
+                    bound.update(_literal_axes(arg))
+        return bound

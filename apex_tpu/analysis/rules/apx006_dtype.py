@@ -1,0 +1,110 @@
+"""APX006 — dtype discipline in bf16 paths.
+
+Two shapes of silent precision drift:
+
+1. **chained round-trip casts** — ``x.astype(jnp.float32).astype(
+   jnp.bfloat16)`` destroys information while looking like a no-op; the
+   inner cast is either redundant or hiding a computation that should
+   have declared its precision explicitly.
+2. **fp32 constructions inside bf16 functions** — a function that casts
+   activations to ``bfloat16`` but also materializes ``float32`` buffers
+   mid-path usually has an accidental upcast (the PR 1 sparsity
+   permutation search noise-floor bug was exactly an unintended fp32/bf16
+   mismatch).  Deliberate fp32 accumulators are fine — baseline them with
+   a justification, which documents the policy decision in-tree.
+
+Detection: (a) any ``.astype(A).astype(B)`` chain with distinct float
+dtypes; (b) within a function that casts to bfloat16, ``astype(jnp.
+float32)`` casts and ``dtype=jnp.float32`` construction keywords.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from apex_tpu.analysis.engine import Finding, ModuleContext, Rule, RuleVisitor
+from apex_tpu.analysis.rules._common import walk_functions
+
+_FLOAT_DTYPES = {"float32", "float16", "bfloat16", "float64", "float8_e4m3fn",
+                 "float8_e5m2"}
+
+
+def _dtype_name(node: ast.AST) -> Optional[str]:
+    """'float32' for jnp.float32 / np.float32 / 'float32' literals."""
+    if isinstance(node, ast.Attribute) and node.attr in _FLOAT_DTYPES:
+        return node.attr
+    if isinstance(node, ast.Constant) and isinstance(node.value, str) and \
+            node.value in _FLOAT_DTYPES:
+        return node.value
+    return None
+
+
+class APX006DtypeDiscipline(Rule):
+    code = "APX006"
+    name = "bf16-dtype-drift"
+    description = ("float32 casts/constructions inside bf16-policy "
+                   "functions, or information-destroying chained astype "
+                   "round-trips")
+
+    def check(self, module: ModuleContext) -> List[Finding]:
+        v = RuleVisitor(self, module)
+        # (a) chained .astype(A).astype(B), A != B, both floats
+        chain_inner = set()  # inner Call nodes of chains, skipped in (b)
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "astype" and node.args):
+                continue
+            outer = _dtype_name(node.args[0])
+            inner_call = node.func.value
+            if (outer and isinstance(inner_call, ast.Call)
+                    and isinstance(inner_call.func, ast.Attribute)
+                    and inner_call.func.attr == "astype"
+                    and inner_call.args):
+                inner = _dtype_name(inner_call.args[0])
+                if inner and inner != outer:
+                    chain_inner.add(inner_call)
+                    v.report(node, (
+                        f"chained `.astype({inner}).astype({outer})` — "
+                        f"the round-trip destroys precision silently; "
+                        f"cast once to the intended dtype"))
+        # (b) fp32 constructions in functions that also cast to bf16
+        for func in walk_functions(module.tree):
+            if not self._casts_to_bf16(func):
+                continue
+            for node in ast.walk(func):
+                if isinstance(node, ast.Call):
+                    if (isinstance(node.func, ast.Attribute)
+                            and node.func.attr == "astype" and node.args
+                            and _dtype_name(node.args[0]) == "float32"
+                            and node not in chain_inner):
+                        v.report(node, (
+                            f"`.astype(float32)` inside bf16-policy "
+                            f"function '{func.name}' — deliberate fp32 "
+                            f"accumulation should be baselined with a "
+                            f"justification"))
+                        continue
+                    for kw in node.keywords:
+                        if kw.arg == "dtype" and _dtype_name(
+                                kw.value) == "float32":
+                            v.report(node, (
+                                f"`dtype=float32` construction inside "
+                                f"bf16-policy function '{func.name}' — "
+                                f"unintended upcast, or an fp32 "
+                                f"accumulator worth a baseline entry"))
+        return v.findings
+
+    @staticmethod
+    def _casts_to_bf16(func: ast.AST) -> bool:
+        for node in ast.walk(func):
+            if isinstance(node, ast.Call):
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "astype" and node.args
+                        and _dtype_name(node.args[0]) == "bfloat16"):
+                    return True
+                for kw in node.keywords:
+                    if kw.arg == "dtype" and _dtype_name(
+                            kw.value) == "bfloat16":
+                        return True
+        return False

@@ -1,0 +1,100 @@
+"""APX007 — interpret-mode ``pallas_call`` inside ``lax.scan`` bodies.
+
+The exact SPMD-partitioner trap PR 1 hit in ``ring_attention``: on
+jax 0.4.x, a ``pallas_call`` with ``interpret=True`` (or a
+runtime-configurable ``interpret=`` flag) inside a ``lax.scan`` body
+makes XLA's SPMD partitioner choke when the scan traces under a sharded
+mesh — the interpreter's callback lowering can't be partitioned.  The
+fix that shipped was unrolling the hops under interpret mode and keeping
+the scan only on real hardware; this rule keeps the trap from being
+reintroduced.
+
+Detection: functions (or lambdas) used as a scan body in the same file —
+``lax.scan(f, ...)`` — whose body (directly, or through one local call
+hop) contains a ``pallas_call`` with an ``interpret`` keyword that is not
+the literal ``False``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from apex_tpu.analysis.engine import Finding, ModuleContext, Rule, RuleVisitor
+
+_SCAN_FUNCS = {"jax.lax.scan"}
+
+
+def _is_pallas_call(fname) -> bool:
+    return fname is not None and (
+        fname.endswith(".pallas_call") or fname == "pallas_call"
+        or fname.endswith(".pl.pallas_call"))
+
+
+def _interpret_not_off(call: ast.Call) -> bool:
+    """True when the call carries interpret= that is not literally False —
+    literal True and runtime-selected flags are both the hazard (the
+    latter becomes interpret=True exactly on the CPU paths that trace
+    under a forced mesh)."""
+    for kw in call.keywords:
+        if kw.arg == "interpret":
+            return not (isinstance(kw.value, ast.Constant)
+                        and kw.value.value is False)
+    return False
+
+
+class APX007PallasScan(Rule):
+    code = "APX007"
+    name = "interpret-pallas-in-scan"
+    description = ("pallas_call with interpret mode inside a lax.scan "
+                   "body trips XLA's SPMD partitioner (ring_attention "
+                   "postmortem, PR 1)")
+
+    def check(self, module: ModuleContext) -> List[Finding]:
+        v = RuleVisitor(self, module)
+        # local function name -> the offending pallas_call nodes inside it
+        offenders: Dict[str, List[ast.Call]] = {}
+        callers: Dict[str, Set[str]] = {}  # fn name -> local fns it calls
+        local_funcs: Dict[str, ast.AST] = {}
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                local_funcs[node.name] = node
+        for name, func in local_funcs.items():
+            for sub in ast.walk(func):
+                if isinstance(sub, ast.Call):
+                    fname = v.resolve(sub.func)
+                    if _is_pallas_call(fname) and _interpret_not_off(sub):
+                        offenders.setdefault(name, []).append(sub)
+                    elif isinstance(sub.func, ast.Name) and \
+                            sub.func.id in local_funcs:
+                        callers.setdefault(name, set()).add(sub.func.id)
+        # one transitive hop: f calls g, g holds the pallas_call
+        reaches: Dict[str, List[ast.Call]] = dict(offenders)
+        for name, callees in callers.items():
+            for c in callees:
+                if c in offenders:
+                    reaches.setdefault(name, []).extend(offenders[c])
+
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = v.resolve(node.func)
+            if fname not in _SCAN_FUNCS or not node.args:
+                continue
+            body = node.args[0]
+            if isinstance(body, ast.Lambda):
+                for sub in ast.walk(body):
+                    if isinstance(sub, ast.Call) and _is_pallas_call(
+                            v.resolve(sub.func)) and _interpret_not_off(sub):
+                        v.report(node, self._msg("<lambda>"))
+            elif isinstance(body, ast.Name) and body.id in reaches:
+                v.report(node, self._msg(body.id))
+        return v.findings
+
+    @staticmethod
+    def _msg(body_name: str) -> str:
+        return (f"lax.scan body '{body_name}' reaches a pallas_call with "
+                f"interpret mode enabled — the SPMD partitioner cannot "
+                f"split the interpreter callback; unroll the loop under "
+                f"interpret mode (ring_attention pattern) or force "
+                f"interpret=False inside scans")

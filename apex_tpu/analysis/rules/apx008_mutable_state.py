@@ -1,0 +1,116 @@
+"""APX008 — module-level mutable state mutated from jitted code.
+
+A jitted function runs its Python body ONCE per abstract signature; a
+mutation of module-level state inside it (``_CACHE[key] = ...``,
+``STATS.append(...)``, ``global counter``) executes at trace time, not at
+run time.  The state then silently stops updating after the first call —
+or worse, updates exactly once per retrace, turning a recompile storm
+into corrupted bookkeeping.  Side state belongs outside jit (host
+callbacks, returned metrics, or the functional carry).
+
+Detection: module-level names bound to mutable containers (dict/list/set
+displays or constructor calls), then — inside jit-decorated functions —
+``global`` declarations, subscript stores, ``del`` statements, and
+mutating method calls (``append``/``update``/``setdefault``/...) on
+those names.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from apex_tpu.analysis.engine import Finding, ModuleContext, Rule, RuleVisitor
+from apex_tpu.analysis.rules._common import traced_functions
+
+_MUTATORS = {"append", "extend", "insert", "add", "update", "setdefault",
+             "pop", "popitem", "remove", "discard", "clear", "sort",
+             "reverse", "appendleft", "extendleft"}
+_MUTABLE_CTORS = {"list", "dict", "set", "bytearray", "deque",
+                  "defaultdict", "Counter", "OrderedDict"}
+
+
+def _module_mutables(tree: ast.Module) -> Set[str]:
+    names: Set[str] = set()
+    for stmt in tree.body:
+        targets = []
+        if isinstance(stmt, ast.Assign):
+            targets, value = stmt.targets, stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets, value = [stmt.target], stmt.value
+        else:
+            continue
+        mutable = isinstance(value, (ast.List, ast.Dict, ast.Set,
+                                     ast.ListComp, ast.DictComp,
+                                     ast.SetComp))
+        if isinstance(value, ast.Call):
+            fn = value.func
+            ctor = (fn.id if isinstance(fn, ast.Name)
+                    else fn.attr if isinstance(fn, ast.Attribute) else "")
+            mutable = ctor in _MUTABLE_CTORS
+        if not mutable:
+            continue
+        for t in targets:
+            for n in ast.walk(t):
+                if isinstance(n, ast.Name):
+                    names.add(n.id)
+    return names
+
+
+class APX008MutableState(Rule):
+    code = "APX008"
+    name = "mutable-state-in-jit"
+    description = ("module-level mutable state mutated inside a jitted "
+                   "function executes at trace time, not run time")
+
+    def check(self, module: ModuleContext) -> List[Finding]:
+        v = RuleVisitor(self, module)
+        mutables = _module_mutables(module.tree)
+        if not mutables:
+            return []
+        for func in traced_functions(module.tree, v.resolve):
+            # a local rebinding shadows the module global — drop those
+            shadowed = set()
+            for sub in ast.walk(func):
+                if isinstance(sub, ast.Assign):
+                    for t in sub.targets:
+                        if isinstance(t, ast.Name):
+                            shadowed.add(t.id)
+            live = mutables - shadowed
+            for sub in ast.walk(func):
+                if isinstance(sub, ast.Global):
+                    for name in sub.names:
+                        if name in mutables:
+                            v.report(sub, self._msg(name, func.name,
+                                                    "rebinds"))
+                elif isinstance(sub, (ast.Assign, ast.AugAssign)):
+                    targets = (sub.targets if isinstance(sub, ast.Assign)
+                               else [sub.target])
+                    for t in targets:
+                        if (isinstance(t, ast.Subscript)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id in live):
+                            v.report(sub, self._msg(t.value.id, func.name,
+                                                    "stores into"))
+                elif isinstance(sub, ast.Delete):
+                    for t in sub.targets:
+                        if (isinstance(t, ast.Subscript)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id in live):
+                            v.report(sub, self._msg(t.value.id, func.name,
+                                                    "deletes from"))
+                elif isinstance(sub, ast.Call):
+                    fn = sub.func
+                    if (isinstance(fn, ast.Attribute)
+                            and fn.attr in _MUTATORS
+                            and isinstance(fn.value, ast.Name)
+                            and fn.value.id in live):
+                        v.report(sub, self._msg(fn.value.id, func.name,
+                                                f"calls .{fn.attr}() on"))
+        return v.findings
+
+    @staticmethod
+    def _msg(name: str, func: str, verb: str) -> str:
+        return (f"jitted '{func}' {verb} module-level mutable '{name}' — "
+                f"this executes at trace time only; return the value or "
+                f"use a host callback instead")

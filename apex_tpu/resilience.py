@@ -30,6 +30,10 @@ for apex_tpu, composing the pieces that already exist —
 - **preemption hook** — SIGTERM flips a flag; the loop flushes an
   emergency (forced) save and returns cleanly with
   ``status="preempted"``, resumable by the next invocation.
+- **retrace watchdog** — :class:`apex_tpu.analysis.retrace.
+  RetraceWatchdog` wraps ``step_fn`` and counts jit recompilations; a
+  recompilation storm (ragged batches, pytree churn after a restore)
+  raises after ``retrace_budget`` instead of silently running 10× slow.
 
 Every recovery path is exercised deterministically in tier-1 CPU tests via
 :class:`apex_tpu.testing_faults.FaultInjector`.
@@ -52,6 +56,7 @@ from jax import lax
 from jax.sharding import PartitionSpec
 
 from apex_tpu.amp.scaler import LossScaler, LossScalerState, all_finite
+from apex_tpu.analysis.retrace import RetraceWatchdog
 from apex_tpu.checkpoint import CheckpointManager, RetryingCheckpointManager
 from apex_tpu.training import sync_data_parallel_grads
 from apex_tpu.transformer.parallel_state import DATA_AXIS
@@ -119,6 +124,12 @@ class ResilienceConfig:
     save_backoff_base: float = 0.5
     save_backoff_max: float = 8.0
     delete_corrupt: bool = True
+    # -- retrace watchdog -------------------------------------------------
+    #: recompilations of ``step_fn`` allowed beyond the warmup trace
+    #: before :class:`~apex_tpu.analysis.retrace.RetraceBudgetExceeded`
+    #: aborts the run (a recompilation storm means a 10× slowdown that
+    #: would otherwise pass silently).  ``None`` disables the watchdog.
+    retrace_budget: Optional[int] = 8
     # -- preemption -------------------------------------------------------
     handle_sigterm: bool = True
     record_history: bool = True
@@ -457,10 +468,19 @@ def run_training(
                                 None))
         own_mgr = True
 
+    # a recompilation storm (ragged batch shapes, pytree-structure churn
+    # after a restore) must surface as a watchdog event, not as a silent
+    # 10× slowdown — wrap the step in the retrace watchdog
+    if cfg.retrace_budget is not None and not isinstance(step_fn,
+                                                         RetraceWatchdog):
+        step_fn = RetraceWatchdog(step_fn, budget=cfg.retrace_budget,
+                                  name="train_step", logger=log)
+
     watchdog = Watchdog(cfg)
     get_batch = _batch_caller(batch_fn)
     telemetry = {"steps": 0, "skips": 0, "rollbacks": 0, "preemptions": 0,
-                 "emergency_saves": 0, "resumes": 0, "verdicts": 0}
+                 "emergency_saves": 0, "resumes": 0, "verdicts": 0,
+                 "retraces": 0}
     history: List[dict] = []
     pending: List[Tuple[int, Any]] = []
 
@@ -609,6 +629,8 @@ def run_training(
                     mgr.save(host_step, state, force=True)
                 break
     finally:
+        if isinstance(step_fn, RetraceWatchdog):
+            telemetry["retraces"] = step_fn.retraces
         if mgr is not None:
             try:
                 mgr.wait_until_finished()

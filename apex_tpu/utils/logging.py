@@ -9,8 +9,10 @@ process index and the active mesh registry instead of torch.distributed.
 
 from __future__ import annotations
 
+import itertools
 import logging
 import os
+import time
 
 
 def _rank_info() -> str:
@@ -36,18 +38,28 @@ class RankInfoFormatter(logging.Formatter):
 
 _LOGGER_NAME = "apex_tpu"
 
+#: process-wide event ordering.  ``next()`` on a count is atomic under
+#: the GIL, so concurrent emitters (watchdog thread + step loop) get
+#: strictly increasing, gap-free sequence numbers.
+_EVENT_SEQ = itertools.count()
+
 
 def log_event(logger: logging.Logger, event: str, *, level: str = "warning",
               **fields) -> str:
     """Structured failure/recovery telemetry: one ``logfmt``-style line
-    (``event=<name> key=value ...``) per incident, machine-greppable by
-    event name. The resilience layer routes every skip/rollback/retry/
-    preemption incident through here (the counters in
-    ``TrainingResult.telemetry`` aggregate the same incidents), the way the
-    reference's RankInfoFormatter gives every record a parseable rank
-    prefix. Returns the formatted line (callers embed it in exceptions).
+    (``event=<name> seq=<n> ts=<monotonic> key=value ...``) per incident,
+    machine-greppable by event name. The resilience layer routes every
+    skip/rollback/retry/preemption/retrace incident through here (the
+    counters in ``TrainingResult.telemetry`` aggregate the same
+    incidents), the way the reference's RankInfoFormatter gives every
+    record a parseable rank prefix. ``seq`` is a process-wide strictly
+    increasing counter and ``ts`` a monotonic-clock stamp, so events can
+    be totally ordered and rate-measured (retraces/min, skips/min) even
+    when the logging backend reorders or batches lines. Returns the
+    formatted line (callers embed it in exceptions).
     """
-    parts = [f"event={event}"]
+    parts = [f"event={event}", f"seq={next(_EVENT_SEQ)}",
+             f"ts={time.monotonic():.6f}"]
     for k in sorted(fields):
         v = fields[k]
         v = f"{v:.6g}" if isinstance(v, float) else str(v)

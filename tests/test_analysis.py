@@ -1,0 +1,573 @@
+"""apex_tpu.analysis suite: one positive + one negative fixture per APX
+rule, suppression/baseline/config behavior, CLI exit codes, and the
+retrace watchdog (fires on a forced recompile storm, stays silent on
+stable shapes — standalone and wired through ``resilience.run_training``).
+"""
+
+import json
+import logging
+import os
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from apex_tpu.analysis import (
+    Baseline,
+    RetraceBudgetExceeded,
+    RetraceWatchdog,
+    analyze_source,
+    load_config,
+)
+from apex_tpu.analysis.engine import main as cli_main
+from apex_tpu.analysis.rules import all_rules
+
+
+def codes(src, only=None):
+    """Run the pack (or one rule) over a snippet, return finding codes."""
+    rules = all_rules()
+    if only is not None:
+        rules = [r for r in rules if r.code == only]
+    return [f.code for f in analyze_source(textwrap.dedent(src),
+                                           "snippet.py", rules)]
+
+
+# ---------------------------------------------------------------------------
+# rule fixtures: positive (must fire) + negative (must stay silent)
+# ---------------------------------------------------------------------------
+
+class TestAPX001PrngReuse:
+    def test_positive_sequential_reuse(self):
+        src = """
+            import jax
+            def sample(key):
+                a = jax.random.normal(key, (3,))
+                b = jax.random.uniform(key, (3,))
+                return a + b
+        """
+        assert codes(src, "APX001") == ["APX001"]
+
+    def test_positive_loop_reuse(self):
+        src = """
+            import jax
+            def sample(key, n):
+                out = []
+                for _ in range(n):
+                    out.append(jax.random.normal(key, (3,)))
+                return out
+        """
+        assert codes(src, "APX001") == ["APX001"]
+
+    def test_positive_comprehension_reuse(self):
+        src = """
+            import jax
+            def sample(key):
+                return [jax.random.normal(key, (3,)) for _ in range(4)]
+        """
+        assert codes(src, "APX001") == ["APX001"]
+
+    def test_negative_split_between(self):
+        src = """
+            import jax
+            def sample(key):
+                a = jax.random.normal(key, (3,))
+                key, sub = jax.random.split(key)
+                b = jax.random.uniform(key, (3,))
+                c = {k: jax.random.normal(k, (2,))
+                     for k in jax.random.split(sub, 3)}
+                return a + b, c
+        """
+        assert codes(src, "APX001") == []
+
+    def test_negative_fold_in_loop(self):
+        src = """
+            import jax
+            def sample(key, n):
+                out = []
+                for i in range(n):
+                    k = jax.random.fold_in(key, i)
+                    out.append(jax.random.normal(k, (3,)))
+                return out
+        """
+        assert codes(src, "APX001") == []
+
+    def test_import_alias_resolved(self):
+        src = """
+            from jax import random as jr
+            def sample(key):
+                return jr.normal(key, (3,)) + jr.uniform(key, (3,))
+        """
+        assert codes(src, "APX001") == ["APX001"]
+
+
+class TestAPX002Concretization:
+    def test_positive_float_and_if(self):
+        src = """
+            import jax
+            @jax.jit
+            def f(x):
+                if x > 0:
+                    return float(x)
+                return x
+        """
+        got = codes(src, "APX002")
+        assert got == ["APX002", "APX002"]
+
+    def test_positive_call_form_jit(self):
+        src = """
+            import jax
+            def f(x):
+                return x.item()
+            g = jax.jit(f)
+        """
+        assert codes(src, "APX002") == ["APX002"]
+
+    def test_negative_static_and_shape_reads(self):
+        src = """
+            import jax
+            from functools import partial
+            @partial(jax.jit, static_argnames=("n",))
+            def f(x, n):
+                if n > 2:               # static: fine
+                    pass
+                if x is not None:       # structure check: fine
+                    pass
+                if x.ndim == 2:         # shape read: fine
+                    pass
+                m = int(x.shape[0])     # static shape: fine
+                return x * m
+        """
+        assert codes(src, "APX002") == []
+
+
+class TestAPX003HostSync:
+    def test_positive_step_body(self):
+        src = """
+            import jax
+            def train_step(state, batch):
+                loss = state + batch
+                jax.device_get(loss)
+                return loss
+        """
+        assert codes(src, "APX003") == ["APX003"]
+
+    def test_positive_block_until_ready(self):
+        src = """
+            import jax
+            def _step(x):
+                x.block_until_ready()
+                return x
+        """
+        assert codes(src, "APX003") == ["APX003"]
+
+    def test_negative_poll_helper_and_tests(self):
+        src = """
+            import jax
+            def poll_metrics(pending):
+                return jax.device_get(pending)   # off the hot loop: fine
+            def test_step_values(x):
+                return jax.device_get(x)         # test body: fine
+        """
+        assert codes(src, "APX003") == []
+
+
+class TestAPX004Recompile:
+    def test_positive_mutable_default_and_shape(self):
+        src = """
+            import jax
+            @jax.jit
+            def f(x, opts={}, shape=None):
+                return x
+        """
+        got = codes(src, "APX004")
+        assert got == ["APX004", "APX004"]
+
+    def test_negative_static_shape(self):
+        src = """
+            import jax
+            from functools import partial
+            @partial(jax.jit, static_argnames=("shape",))
+            def f(x, shape=None, opts=()):
+                return x
+        """
+        assert codes(src, "APX004") == []
+
+
+class TestAPX005Collectives:
+    def test_positive_unbound_axis(self):
+        src = """
+            from jax import lax
+            def f(x):
+                return lax.psum(x, "tp")
+        """
+        assert codes(src, "APX005") == ["APX005"]
+
+    def test_negative_bound_by_spec_or_mesh(self):
+        src = """
+            from jax import lax
+            from jax.sharding import Mesh, PartitionSpec
+            def make(devs):
+                return Mesh(devs, ("data",))
+            SPEC = PartitionSpec("tp")
+            def f(x, axis):
+                return lax.psum(x, "tp") + lax.pmean(x, "data") \\
+                    + lax.psum(x, axis)   # variable axis: resolved elsewhere
+        """
+        assert codes(src, "APX005") == []
+
+
+class TestAPX006Dtype:
+    def test_positive_chained_roundtrip(self):
+        src = """
+            import jax.numpy as jnp
+            def f(x):
+                return x.astype(jnp.float32).astype(jnp.bfloat16)
+        """
+        assert codes(src, "APX006") == ["APX006"]
+
+    def test_positive_fp32_in_bf16_function(self):
+        src = """
+            import jax.numpy as jnp
+            def f(x):
+                h = x.astype(jnp.bfloat16)
+                acc = jnp.zeros((4,), dtype=jnp.float32)
+                return h, acc
+        """
+        assert codes(src, "APX006") == ["APX006"]
+
+    def test_negative_single_policy(self):
+        src = """
+            import jax.numpy as jnp
+            def f(x):
+                return x.astype(jnp.bfloat16)
+            def g(x):
+                return jnp.zeros((4,), dtype=jnp.float32)
+        """
+        assert codes(src, "APX006") == []
+
+
+class TestAPX007PallasScan:
+    def test_positive_interpret_in_scan_body(self):
+        src = """
+            from jax import lax
+            from jax.experimental import pallas as pl
+            def body(c, x):
+                y = pl.pallas_call(lambda r: None, interpret=True)(x)
+                return c, y
+            def run(xs):
+                return lax.scan(body, 0, xs)
+        """
+        assert codes(src, "APX007") == ["APX007"]
+
+    def test_positive_one_call_hop(self):
+        src = """
+            from jax import lax
+            from jax.experimental import pallas as pl
+            def kernel(x, interpret):
+                return pl.pallas_call(lambda r: None,
+                                      interpret=interpret)(x)
+            def body(c, x):
+                return c, kernel(x, True)
+            def run(xs):
+                return lax.scan(body, 0, xs)
+        """
+        assert codes(src, "APX007") == ["APX007"]
+
+    def test_negative_interpret_false_or_no_scan(self):
+        src = """
+            from jax import lax
+            from jax.experimental import pallas as pl
+            def body(c, x):
+                y = pl.pallas_call(lambda r: None, interpret=False)(x)
+                return c, y
+            def run(xs):
+                return lax.scan(body, 0, xs)
+            def standalone(x):
+                return pl.pallas_call(lambda r: None, interpret=True)(x)
+        """
+        assert codes(src, "APX007") == []
+
+
+class TestAPX008MutableState:
+    def test_positive_store_and_method(self):
+        src = """
+            import jax
+            _CACHE = {}
+            _LOG = []
+            @jax.jit
+            def f(x):
+                _CACHE["last"] = x
+                _LOG.append(1)
+                return x
+        """
+        got = codes(src, "APX008")
+        assert got == ["APX008", "APX008"]
+
+    def test_negative_outside_jit_or_immutable(self):
+        src = """
+            import jax
+            _CACHE = {}
+            _LIMIT = 3
+            def warm(x):
+                _CACHE["x"] = x     # host-side registry: fine
+                return x
+            @jax.jit
+            def f(x):
+                return x * _LIMIT   # read-only: fine
+        """
+        assert codes(src, "APX008") == []
+
+
+# ---------------------------------------------------------------------------
+# suppression, baseline, config, CLI
+# ---------------------------------------------------------------------------
+
+REUSE_SRC = """
+import jax
+def sample(key):
+    a = jax.random.normal(key, (3,))
+    b = jax.random.uniform(key, (3,))%s
+    return a + b
+"""
+
+
+class TestSuppression:
+    def test_noqa_specific_code(self):
+        assert codes(REUSE_SRC % "  # noqa: APX001") == []
+
+    def test_noqa_bare(self):
+        assert codes(REUSE_SRC % "  # noqa") == []
+
+    def test_noqa_other_code_does_not_suppress(self):
+        assert codes(REUSE_SRC % "  # noqa: APX005") == ["APX001"]
+
+    def test_noqa_multiple_codes(self):
+        assert codes(REUSE_SRC % "  # noqa: APX005, APX001") == []
+
+
+class TestBaseline:
+    def _findings(self):
+        from apex_tpu.analysis.engine import analyze_source
+        return analyze_source(REUSE_SRC % "", "pkg/mod.py")
+
+    def test_partition_matches_and_news(self):
+        found = self._findings()
+        bl = Baseline([{"path": "pkg/mod.py", "code": "APX001",
+                        "snippet": found[0].snippet,
+                        "justification": "known"}])
+        new, matched, stale = bl.partition(found)
+        assert new == [] and len(matched) == 1 and stale == []
+
+    def test_unmatched_finding_is_new(self):
+        found = self._findings()
+        bl = Baseline([{"path": "other.py", "code": "APX001",
+                        "snippet": found[0].snippet}])
+        new, matched, stale = bl.partition(found)
+        assert len(new) == 1 and matched == [] and len(stale) == 1
+
+    def test_snippet_keying_survives_line_drift(self):
+        found = self._findings()
+        bl = Baseline([{"path": "pkg/mod.py", "code": "APX001",
+                        "line": 9999,  # wrong line: snippet still matches
+                        "snippet": found[0].snippet}])
+        new, _, _ = bl.partition(found)
+        assert new == []
+
+    def test_roundtrip_save_load(self, tmp_path):
+        found = self._findings()
+        bl = Baseline.from_findings(found)
+        p = tmp_path / "bl.json"
+        bl.save(str(p))
+        loaded = Baseline.load(str(p))
+        new, matched, stale = loaded.partition(found)
+        assert new == [] and len(matched) == 1 and stale == []
+        assert all("justification" in e for e in loaded.entries)
+
+
+class TestConfigAndCLI:
+    def _project(self, tmp_path, extra=""):
+        (tmp_path / "pyproject.toml").write_text(textwrap.dedent(f"""
+            [project]
+            name = "demo"
+
+            [tool.apex_tpu.analysis]
+            paths = ["pkg"]
+            baseline = "bl.json"
+            exclude = ["skipme"]
+            {extra}
+        """))
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "mod.py").write_text(REUSE_SRC % "")
+        (pkg / "skipme.py").write_text(REUSE_SRC % "")
+        return tmp_path
+
+    def test_load_config_walks_up(self, tmp_path):
+        root = self._project(tmp_path)
+        cfg = load_config(str(root / "pkg" / "mod.py"))
+        assert cfg.paths == ["pkg"]
+        assert cfg.baseline == "bl.json"
+        assert cfg.exclude == ["skipme"]
+        assert cfg.root == str(root)
+
+    def test_cli_reports_and_exits_nonzero(self, tmp_path, capsys):
+        root = self._project(tmp_path)
+        rc = cli_main([str(root / "pkg")])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "APX001" in out and "skipme" not in out
+
+    def test_cli_write_baseline_then_clean(self, tmp_path, capsys):
+        root = self._project(tmp_path)
+        rc = cli_main([str(root / "pkg"), "--write-baseline"])
+        assert rc == 0
+        assert json.loads((root / "bl.json").read_text())["entries"]
+        rc = cli_main([str(root / "pkg")])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "1 baselined" in out
+
+    def test_cli_stale_entry_reported(self, tmp_path, capsys):
+        root = self._project(tmp_path)
+        cli_main([str(root / "pkg"), "--write-baseline"])
+        (root / "pkg" / "mod.py").write_text("x = 1\n")
+        rc = cli_main([str(root / "pkg")])
+        err = capsys.readouterr().err
+        assert rc == 0
+        assert "stale" in err
+
+    def test_cli_select_disable(self, tmp_path, capsys):
+        root = self._project(tmp_path)
+        assert cli_main([str(root / "pkg"), "--disable", "APX001"]) == 0
+        assert cli_main([str(root / "pkg"), "--select", "APX005"]) == 0
+        assert cli_main([str(root / "pkg"), "--select", "APX001"]) == 1
+        capsys.readouterr()
+
+    def test_syntax_error_is_finding_not_crash(self, tmp_path, capsys):
+        root = self._project(tmp_path)
+        (root / "pkg" / "broken.py").write_text("def f(:\n")
+        rc = cli_main([str(root / "pkg")])
+        out = capsys.readouterr().out
+        assert rc == 1 and "APX000" in out
+
+
+# ---------------------------------------------------------------------------
+# log_event ordering stamps (satellite: seq + monotonic ts)
+# ---------------------------------------------------------------------------
+
+class TestLogEventStamps:
+    def test_seq_and_ts_present_and_monotonic(self):
+        from apex_tpu.utils.logging import get_logger, log_event
+        log = get_logger("apex_tpu.test_stamps")
+        log.setLevel(logging.CRITICAL)  # keep output quiet
+        lines = [log_event(log, "retrace", fn="step", call=i)
+                 for i in range(3)]
+        seqs, tss = [], []
+        for line in lines:
+            fields = dict(kv.split("=", 1) for kv in line.split()
+                          if "=" in kv)
+            assert fields["event"] == "retrace"
+            seqs.append(int(fields["seq"]))
+            tss.append(float(fields["ts"]))
+        assert seqs == sorted(seqs) and len(set(seqs)) == 3
+        assert tss == sorted(tss)
+
+
+# ---------------------------------------------------------------------------
+# retrace watchdog
+# ---------------------------------------------------------------------------
+
+class TestRetraceWatchdog:
+    def test_stable_shapes_stay_silent(self):
+        f = jax.jit(lambda x: x * 2)
+        wd = RetraceWatchdog(f, budget=0)
+        for _ in range(5):
+            wd(jnp.ones((4,)))
+        assert wd.retraces == 0 and wd.compiles == 1 and wd.calls == 5
+
+    def test_budget_fires_on_forced_recompiles(self):
+        f = jax.jit(lambda x: x * 2)
+        wd = RetraceWatchdog(f, budget=2)
+        with pytest.raises(RetraceBudgetExceeded) as exc:
+            for n in range(2, 10):
+                wd(jnp.ones((n,)))  # every call a new shape = a retrace
+        assert exc.value.retraces == 3 and exc.value.budget == 2
+
+    def test_log_only_when_budget_none(self):
+        f = jax.jit(lambda x: x + 1)
+        wd = RetraceWatchdog(f, budget=None)
+        for n in range(2, 8):
+            wd(jnp.ones((n,)))
+        assert wd.retraces == 5  # counted, never raised
+
+    def test_prewarmed_cache_is_baselined(self):
+        f = jax.jit(lambda x: x - 1)
+        f(jnp.ones((3,)))  # compile before the watchdog watches
+        wd = RetraceWatchdog(f, budget=0)
+        wd(jnp.ones((3,)))
+        assert wd.compiles == 0 and wd.retraces == 0
+
+    def test_signature_fallback_for_plain_callables(self):
+        calls = []
+
+        def plain(x):
+            calls.append(x.shape)
+            return x
+
+        wd = RetraceWatchdog(plain, budget=2)
+        wd(jnp.ones((2,)))
+        wd(jnp.ones((2,)))
+        assert wd.compiles == 1  # same signature, one "trace"
+        with pytest.raises(RetraceBudgetExceeded):
+            for n in range(3, 10):
+                wd(jnp.ones((n,)))
+
+    def test_dtype_change_counts_as_retrace(self):
+        f = jax.jit(lambda x: x * 1)
+        wd = RetraceWatchdog(f, budget=None)
+        wd(jnp.ones((4,), jnp.float32))
+        wd(jnp.ones((4,), jnp.bfloat16))
+        assert wd.retraces == 1
+
+
+class TestRunTrainingRetraceIntegration:
+    def _step(self):
+        @jax.jit
+        def step(state, batch, rng):
+            new = {"params": state["params"] - 0.1 * batch.mean(),
+                   "step": state["step"] + 1}
+            return new, {"loss": batch.mean(), "skipped": jnp.asarray(False)}
+        return step
+
+    def test_ragged_batches_trip_budget(self):
+        from apex_tpu.resilience import ResilienceConfig, run_training
+        state = {"params": jnp.zeros(()), "step": jnp.asarray(0, jnp.int32)}
+        cfg = ResilienceConfig(retrace_budget=2, handle_sigterm=False,
+                               poll_interval_steps=100)
+        with pytest.raises(RetraceBudgetExceeded):
+            # a ragged data pipeline: every step a new batch shape
+            run_training(self._step(), state,
+                         lambda step: jnp.ones((step + 2,)),
+                         num_steps=10, config=cfg)
+
+    def test_stable_run_reports_zero_retraces(self):
+        from apex_tpu.resilience import ResilienceConfig, run_training
+        state = {"params": jnp.zeros(()), "step": jnp.asarray(0, jnp.int32)}
+        cfg = ResilienceConfig(retrace_budget=2, handle_sigterm=False,
+                               poll_interval_steps=4)
+        res = run_training(self._step(), state,
+                           lambda step: jnp.ones((8,)),
+                           num_steps=6, config=cfg)
+        assert res.status == "completed"
+        assert res.telemetry["retraces"] == 0
+
+    def test_watchdog_disabled_with_none(self):
+        from apex_tpu.resilience import ResilienceConfig, run_training
+        state = {"params": jnp.zeros(()), "step": jnp.asarray(0, jnp.int32)}
+        cfg = ResilienceConfig(retrace_budget=None, handle_sigterm=False,
+                               poll_interval_steps=4)
+        res = run_training(self._step(), state,
+                           lambda step: jnp.ones((step + 2,)),
+                           num_steps=5, config=cfg)
+        assert res.status == "completed"  # slow, but allowed when opted out

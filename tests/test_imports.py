@@ -16,6 +16,8 @@ import apex_tpu
 MODULES = [
     "apex_tpu",
     "apex_tpu.amp",
+    "apex_tpu.analysis",
+    "apex_tpu.analysis.rules",
     "apex_tpu.checkpoint",
     "apex_tpu.data",
     "apex_tpu.fp16_utils",
