@@ -13,6 +13,7 @@ A from-scratch reimplementation of the *capabilities* of NVIDIA Apex
 - ``apex_tpu.native``         — C++ host runtime (flatten/bucketing/staging pool/queues)
 - ``apex_tpu.data``           — prefetching host→device pipeline on the native queue
 - ``apex_tpu.resilience``     — fault-tolerant training driver (watchdog, rollback, retrying checkpoints)
+- ``apex_tpu.observability``  — metrics/tracing (step metrics, MFU, sinks) + ``python -m apex_tpu.monitor`` run reports
 
 Where the reference dispatches CUDA kernels through pybind11 extensions
 (``setup.py:110-860``), this package dispatches Pallas TPU kernels with pure-XLA
@@ -29,6 +30,7 @@ from apex_tpu import mlp
 from apex_tpu import multi_tensor_apply
 from apex_tpu import native
 from apex_tpu import normalization
+from apex_tpu import observability
 from apex_tpu import ops
 from apex_tpu import optimizers
 from apex_tpu import parallel
@@ -50,6 +52,7 @@ __all__ = [
     "mlp",
     "multi_tensor_apply",
     "normalization",
+    "observability",
     "ops",
     "optimizers",
     "parallel",

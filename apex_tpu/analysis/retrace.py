@@ -16,6 +16,10 @@ when available, falling back to tracking distinct abstract signatures
 
 - emits structured ``log_event`` telemetry (``event=retrace``) with the
   call count and signature, ordered by ``seq``/``ts`` stamps;
+- mirrors each counted retrace into an attached
+  :class:`apex_tpu.observability.MetricsRegistry` (``metrics=`` — a
+  ``retraces`` counter plus ``retrace`` events), so the monitor CLI
+  reports recompilation storms without scraping log lines;
 - raises :class:`RetraceBudgetExceeded` once retraces (compilations
   beyond ``expected_compiles``) exceed ``budget``.
 
@@ -84,17 +88,23 @@ class RetraceWatchdog:
       name: label for telemetry (defaults to the callable's ``__name__``).
       on_retrace: optional ``(watchdog, signature) -> None`` hook, called
         after telemetry on every counted retrace.
+      metrics: optional :class:`apex_tpu.observability.MetricsRegistry` —
+        each counted retrace then also increments its ``retraces``
+        counter and emits an ``event="retrace"`` record, so the monitor
+        CLI reports retraces without scraping log lines.
     """
 
     def __init__(self, fn: Callable, *, budget: Optional[int] = None,
                  expected_compiles: int = 1, name: Optional[str] = None,
-                 logger=None, on_retrace: Optional[Callable] = None):
+                 logger=None, on_retrace: Optional[Callable] = None,
+                 metrics=None):
         self._fn = fn
         self.budget = budget
         self.expected_compiles = expected_compiles
         self.name = name or getattr(fn, "__name__", type(fn).__name__)
         self._log = logger or get_logger(__name__)
         self._on_retrace = on_retrace
+        self.metrics = metrics
         self.calls = 0
         self.compiles = 0
         self._signatures: set = set()
@@ -131,6 +141,7 @@ class RetraceWatchdog:
                 new_compiles = 1
         if not new_compiles:
             return
+        retraces_before = self.retraces
         self.compiles += new_compiles
         if self.compiles <= self.expected_compiles:
             return
@@ -140,6 +151,13 @@ class RetraceWatchdog:
                   compiles=self.compiles, retraces=self.retraces,
                   budget=("none" if self.budget is None else self.budget),
                   signature=hex(abs(hash(sig)))[:10])
+        if self.metrics is not None:
+            # counter delta, not a bare +1: one batched _cache_size jump
+            # can cover several compiles
+            self.metrics.inc("retraces", self.retraces - retraces_before)
+            self.metrics.event("retrace", fn=self.name, call=self.calls,
+                               compiles=self.compiles,
+                               retraces=self.retraces)
         if self._on_retrace is not None:
             self._on_retrace(self, sig)
         if self.budget is not None and self.retraces > self.budget:

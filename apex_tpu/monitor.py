@@ -1,0 +1,26 @@
+"""``python -m apex_tpu.monitor <run.jsonl>`` — the run-report CLI.
+
+Reads the JSONL metric log a
+:class:`apex_tpu.observability.JsonlSink`-equipped run wrote and prints
+the report: telemetry counter totals (reconciling exactly with the
+run's ``TrainingResult.telemetry``), step-time p50/p95, throughput/MFU
+trajectory, and the incident timeline (skips, rollbacks, retraces,
+preemptions). ``--json`` emits the raw report dict instead.
+
+Thin shim over :mod:`apex_tpu.observability.report` so the command
+reads ``apex_tpu.monitor`` while the logic lives with the subsystem.
+"""
+
+from apex_tpu.observability.report import (  # noqa: F401
+    build_report,
+    read_records,
+    render_report,
+    main,
+)
+
+__all__ = ["build_report", "read_records", "render_report", "main"]
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,56 @@
+"""apex_tpu.observability — metrics, tracing, and run reports.
+
+The third leg of the production triangle next to ``resilience``
+(survive) and ``analysis`` (lint): *observe*. TorchTitan (PAPERS.md,
+arXiv:2410.06511) treats metrics/logging/profiling as a first-class
+subsystem of a pre-training stack; this package is that subsystem here.
+
+- :class:`MetricsRegistry` — thread-safe counters, gauges, and
+  bounded-memory histograms with pluggable sinks
+  (:class:`JsonlSink`, :class:`PrometheusTextfileSink`,
+  :class:`InMemorySink`).
+- :class:`StepMetrics` / :class:`StepTimer` — per-step wall time,
+  tokens/s, and MFU (FLOP math shared with the benchmark harness via
+  :mod:`apex_tpu.utils.flops`), plus device ``memory_stats`` gauges.
+  ``ResilienceConfig(metrics=registry)`` wires the whole layer into
+  :func:`apex_tpu.resilience.run_training`.
+- :func:`span` / :class:`ProfilerCapture` — named scopes that also
+  record host durations, and windowed ``jax.profiler`` captures
+  (every-N-steps or on watchdog incident).
+- :func:`build_report` / :func:`render_report` — fold a run's JSONL log
+  into the report ``python -m apex_tpu.monitor`` prints.
+"""
+
+from apex_tpu.observability.registry import (
+    HistogramSnapshot,
+    MetricsRegistry,
+    percentile,
+)
+from apex_tpu.observability.sinks import (
+    InMemorySink,
+    JsonlSink,
+    PrometheusTextfileSink,
+)
+from apex_tpu.observability.step_metrics import StepMetrics, StepTimer
+from apex_tpu.observability.tracing import ProfilerCapture, span
+from apex_tpu.observability.report import (
+    build_report,
+    read_records,
+    render_report,
+)
+
+__all__ = [
+    "MetricsRegistry",
+    "HistogramSnapshot",
+    "percentile",
+    "InMemorySink",
+    "JsonlSink",
+    "PrometheusTextfileSink",
+    "StepMetrics",
+    "StepTimer",
+    "ProfilerCapture",
+    "span",
+    "build_report",
+    "read_records",
+    "render_report",
+]

@@ -1,0 +1,199 @@
+"""Run reports from JSONL metric logs — the ``apex_tpu.monitor`` backend.
+
+Reads the record stream a :class:`~apex_tpu.observability.sinks.JsonlSink`
+wrote during a run and folds it into one report dict / text page:
+
+- **counter totals** — the last ``kind="counters"`` snapshot. For a run
+  driven by :func:`apex_tpu.resilience.run_training` these reconcile
+  *exactly* with ``TrainingResult.telemetry`` (the driver increments both
+  from the same sites and flushes a final snapshot on exit).
+- **step statistics** — p50/p95/mean step time, tokens/s, MFU over the
+  per-step records, plus a trajectory (windowed means) so throughput
+  regressions over the run are visible at a glance.
+- **incident timeline** — every ``kind="event"`` record (skips,
+  rollbacks, retraces, preemptions, resumes, captures) in ``seq`` order.
+
+Pure stdlib on purpose: no jax import, so the CLI works on a laptop far
+away from the TPU that wrote the log.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional
+
+from apex_tpu.observability.registry import percentile
+
+__all__ = ["read_records", "build_report", "render_report", "main"]
+
+#: number of windows in the throughput/MFU trajectory
+_TRAJECTORY_WINDOWS = 5
+
+
+def read_records(path: str) -> List[dict]:
+    """Parse a JSONL metric log; malformed lines are skipped (a run
+    killed mid-write leaves a torn last line — the report must still
+    build)."""
+    records = []
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict):
+                records.append(rec)
+    return records
+
+
+def _stats(values: List[float]) -> Optional[dict]:
+    values = [v for v in values if v == v]  # drop NaN
+    if not values:
+        return None
+    return {"count": len(values), "mean": sum(values) / len(values),
+            "min": min(values), "max": max(values),
+            "p50": percentile(values, 50), "p95": percentile(values, 95)}
+
+
+def _trajectory(steps: List[dict], key: str) -> List[dict]:
+    """Windowed means of ``key`` over the step records, in step order —
+    a coarse trend line (is throughput decaying? did MFU recover after
+    the rollback?)."""
+    pts = [(r["step"], r[key]) for r in steps
+           if key in r and r[key] == r[key]]
+    if not pts:
+        return []
+    pts.sort()
+    n = max(1, (len(pts) + _TRAJECTORY_WINDOWS - 1) // _TRAJECTORY_WINDOWS)
+    out = []
+    for i in range(0, len(pts), n):
+        window = pts[i:i + n]
+        out.append({"from_step": window[0][0], "to_step": window[-1][0],
+                    "mean": sum(v for _, v in window) / len(window)})
+    return out
+
+
+def build_report(path: str) -> dict:
+    """Fold one JSONL metric log into a report dict."""
+    records = read_records(path)
+    steps = [r for r in records if r.get("kind") == "step"]
+    events = [r for r in records if r.get("kind") == "event"]
+    counters: Dict[str, int] = {}
+    gauges: Dict[str, float] = {}
+    histograms: Dict[str, dict] = {}
+    for r in records:  # later snapshots win: the last one is end-of-run
+        if r.get("kind") == "counters":
+            counters = dict(r.get("values", {}))
+        elif r.get("kind") == "gauges":
+            gauges = dict(r.get("values", {}))
+        elif r.get("kind") == "histograms":
+            histograms = dict(r.get("values", {}))
+
+    losses = [r["loss"] for r in steps
+              if "loss" in r and not r.get("skipped")
+              and r["loss"] == r["loss"]]
+    report = {
+        "path": path,
+        "records": len(records),
+        "counters": counters,
+        "gauges": gauges,
+        "histograms": histograms,
+        "steps_recorded": len(steps),
+        "skipped_steps": sum(1 for r in steps if r.get("skipped")),
+        "step_time_s": _stats([r["step_time_s"] for r in steps
+                               if "step_time_s" in r]),
+        "tokens_per_s": _stats([r["tokens_per_s"] for r in steps
+                                if "tokens_per_s" in r]),
+        "mfu": _stats([r["mfu"] for r in steps if "mfu" in r]),
+        "loss": ({"first": losses[0], "last": losses[-1],
+                  "min": min(losses)} if losses else None),
+        "throughput_trajectory": _trajectory(steps, "tokens_per_s"),
+        "mfu_trajectory": _trajectory(steps, "mfu"),
+        "timeline": sorted(events, key=lambda e: e.get("seq", 0)),
+    }
+    return report
+
+
+def _fmt(value: float, unit: str = "") -> str:
+    if value is None:
+        return "-"
+    if abs(value) >= 1000:
+        return f"{value:,.0f}{unit}"
+    return f"{value:.4g}{unit}"
+
+
+def _render_stat_line(label: str, stats: Optional[dict],
+                      unit: str = "") -> str:
+    if not stats:
+        return f"  {label:<14} (no data)"
+    return (f"  {label:<14} p50={_fmt(stats['p50'], unit)} "
+            f"p95={_fmt(stats['p95'], unit)} mean={_fmt(stats['mean'], unit)} "
+            f"max={_fmt(stats['max'], unit)} n={stats['count']}")
+
+
+def render_report(report: dict) -> str:
+    lines = [f"== apex_tpu run report: {report['path']} ==",
+             f"records: {report['records']}  "
+             f"step records: {report['steps_recorded']}  "
+             f"skipped: {report['skipped_steps']}",
+             "",
+             "counters:"]
+    if report["counters"]:
+        lines += [f"  {k} = {v}" for k, v in sorted(
+            report["counters"].items())]
+    else:
+        lines.append("  (none — was the registry flushed?)")
+    lines += ["", "step statistics:",
+              _render_stat_line("step time", report["step_time_s"], "s"),
+              _render_stat_line("tokens/s", report["tokens_per_s"]),
+              _render_stat_line("mfu", report["mfu"])]
+    if report["loss"]:
+        lo = report["loss"]
+        lines.append(f"  {'loss':<14} first={_fmt(lo['first'])} "
+                     f"last={_fmt(lo['last'])} min={_fmt(lo['min'])}")
+    for key, label in (("throughput_trajectory", "tokens/s trajectory"),
+                       ("mfu_trajectory", "mfu trajectory")):
+        traj = report[key]
+        if traj:
+            arrow = " -> ".join(_fmt(w["mean"]) for w in traj)
+            lines += ["", f"{label} (steps "
+                          f"{traj[0]['from_step']}..{traj[-1]['to_step']}):",
+                      f"  {arrow}"]
+    lines += ["", f"incident timeline ({len(report['timeline'])} events):"]
+    if not report["timeline"]:
+        lines.append("  (clean run — no incidents)")
+    for ev in report["timeline"]:
+        extra = " ".join(
+            f"{k}={v}" for k, v in sorted(ev.items())
+            if k not in ("kind", "event", "seq", "ts", "wall"))
+        lines.append(f"  [seq={ev.get('seq', '?')} "
+                     f"wall={ev.get('wall', 0):.3f}] "
+                     f"{ev.get('event', '?')} {extra}".rstrip())
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m apex_tpu.monitor",
+        description="Print a run report from a JSONL metric log written "
+                    "by apex_tpu.observability's JsonlSink.")
+    parser.add_argument("path", help="path to the run's .jsonl metric log")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the report as JSON instead of text")
+    args = parser.parse_args(argv)
+    try:
+        report = build_report(args.path)
+    except OSError as exc:
+        print(f"apex_tpu.monitor: cannot read {args.path}: {exc}",
+              file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(report, indent=2, default=str))
+    else:
+        print(render_report(report))
+    return 0

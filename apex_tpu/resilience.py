@@ -34,6 +34,12 @@ for apex_tpu, composing the pieces that already exist —
   RetraceWatchdog` wraps ``step_fn`` and counts jit recompilations; a
   recompilation storm (ragged batches, pytree churn after a restore)
   raises after ``retrace_budget`` instead of silently running 10× slow.
+- **observability** — attach an :class:`apex_tpu.observability.
+  MetricsRegistry` (``ResilienceConfig.metrics``) and the driver mirrors
+  every telemetry counter into it, emits incident events next to
+  ``log_event``, and records step-time/tokens-per-s/MFU/memory metrics;
+  ``python -m apex_tpu.monitor`` folds a JSONL sink's log into a run
+  report that reconciles with :attr:`TrainingResult.telemetry`.
 
 Every recovery path is exercised deterministically in tier-1 CPU tests via
 :class:`apex_tpu.testing_faults.FaultInjector`.
@@ -58,6 +64,7 @@ from jax.sharding import PartitionSpec
 from apex_tpu.amp.scaler import LossScaler, LossScalerState, all_finite
 from apex_tpu.analysis.retrace import RetraceWatchdog
 from apex_tpu.checkpoint import CheckpointManager, RetryingCheckpointManager
+from apex_tpu.observability.step_metrics import StepMetrics
 from apex_tpu.training import sync_data_parallel_grads
 from apex_tpu.transformer.parallel_state import DATA_AXIS
 from apex_tpu.utils.logging import get_logger, log_event
@@ -130,6 +137,28 @@ class ResilienceConfig:
     #: aborts the run (a recompilation storm means a 10× slowdown that
     #: would otherwise pass silently).  ``None`` disables the watchdog.
     retrace_budget: Optional[int] = 8
+    # -- observability ----------------------------------------------------
+    #: a :class:`apex_tpu.observability.MetricsRegistry`; when attached,
+    #: the driver mirrors every ``TrainingResult.telemetry`` counter into
+    #: it, emits incident events alongside ``log_event``, and feeds a
+    #: :class:`~apex_tpu.observability.StepMetrics` layer (step time,
+    #: tokens/s, MFU, memory gauges). ``python -m apex_tpu.monitor`` then
+    #: reports the run from a JSONL sink's log.
+    metrics: Optional[Any] = None
+    #: global tokens per step — enables the ``tokens_per_s`` metric.
+    tokens_per_step: Optional[int] = None
+    #: model FLOPs per step (see :mod:`apex_tpu.utils.flops`) — enables
+    #: ``model_tflops`` and, with a known/overridden peak, ``mfu``.
+    model_flops_per_step: Optional[float] = None
+    #: per-chip peak FLOP/s override; default auto-detects from the chip
+    #: table (None on CPU/unknown — MFU then stays unset).
+    peak_flops: Optional[float] = None
+    #: device ``memory_stats()`` gauge cadence in steps (0 disables).
+    memory_stats_interval_steps: int = 50
+    #: a :class:`apex_tpu.observability.ProfilerCapture`; the driver
+    #: advances its schedule each step and triggers a capture on watchdog
+    #: verdicts.
+    profiler: Optional[Any] = None
     # -- preemption -------------------------------------------------------
     handle_sigterm: bool = True
     record_history: bool = True
@@ -474,13 +503,40 @@ def run_training(
     if cfg.retrace_budget is not None and not isinstance(step_fn,
                                                          RetraceWatchdog):
         step_fn = RetraceWatchdog(step_fn, budget=cfg.retrace_budget,
-                                  name="train_step", logger=log)
+                                  name="train_step", logger=log,
+                                  metrics=cfg.metrics)
+    elif (isinstance(step_fn, RetraceWatchdog) and cfg.metrics is not None
+            and step_fn.metrics is None):
+        # a pre-wrapped watchdog still reports into the attached registry,
+        # else the monitor's retrace counter cannot reconcile
+        step_fn.metrics = cfg.metrics
 
     watchdog = Watchdog(cfg)
     get_batch = _batch_caller(batch_fn)
     telemetry = {"steps": 0, "skips": 0, "rollbacks": 0, "preemptions": 0,
                  "emergency_saves": 0, "resumes": 0, "verdicts": 0,
                  "retraces": 0}
+    reg = cfg.metrics
+    prof = cfg.profiler
+    step_metrics = None
+    if reg is not None:
+        # every telemetry key exists in the registry from step 0, so the
+        # final counters snapshot reconciles key-for-key even for
+        # incident types that never fired
+        reg.declare_counters(*telemetry)
+        step_metrics = StepMetrics(
+            reg, tokens_per_step=cfg.tokens_per_step,
+            model_flops_per_step=cfg.model_flops_per_step,
+            peak_flops=cfg.peak_flops,
+            memory_interval_steps=cfg.memory_stats_interval_steps)
+
+    def _tick(key: str, n: int = 1) -> None:
+        """One incident, two ledgers: the TrainingResult telemetry dict
+        and (when attached) the registry counter of the same name."""
+        telemetry[key] += n
+        if reg is not None:
+            reg.inc(key, n)
+
     history: List[dict] = []
     pending: List[Tuple[int, Any]] = []
 
@@ -493,9 +549,12 @@ def run_training(
         if restored is not None:
             ckpt_step, state = restored
             host_step = int(jax.device_get(state["step"]))
-            telemetry["resumes"] += 1
+            _tick("resumes")
             log_event(log, "training_resumed", step=host_step,
                       checkpoint=ckpt_step, level="info")
+            if reg is not None:
+                reg.event("training_resumed", step=host_step,
+                          checkpoint=ckpt_step)
 
     def _flush() -> Optional[WatchdogVerdict]:
         """Sync pending device metrics to host and feed the watchdog —
@@ -511,10 +570,17 @@ def run_training(
             gnorm = vals.get("grad_norm")
             gnorm = None if gnorm is None else float(gnorm)
             skipped = bool(vals.get("skipped", False))
-            telemetry["skips"] += int(skipped)
+            _tick("skips", int(skipped))
             if cfg.record_history:
                 history.append({"step": step_i, "loss": loss,
                                 "grad_norm": gnorm, "skipped": skipped})
+            if step_metrics is not None:
+                scale = vals.get("loss_scale")
+                step_metrics.record_polled(
+                    step_i, loss=loss, grad_norm=gnorm, skipped=skipped,
+                    loss_scale=None if scale is None else float(scale))
+                if skipped:
+                    reg.event("skip", step=step_i)
             if verdict is None:
                 verdict = watchdog.observe(step_i, loss, gnorm, skipped)
         pending = []
@@ -522,17 +588,24 @@ def run_training(
 
     def _rollback(verdict: WatchdogVerdict) -> None:
         nonlocal state, host_step, data_epoch, rollbacks
-        telemetry["verdicts"] += 1
+        _tick("verdicts")
         log_event(log, "watchdog_verdict", reason=verdict.reason,
                   step=verdict.step, first_bad_step=verdict.first_bad_step,
                   detail=verdict.detail, level="error")
+        if reg is not None:
+            reg.event("watchdog_verdict", reason=verdict.reason,
+                      step=verdict.step,
+                      first_bad_step=verdict.first_bad_step,
+                      detail=verdict.detail)
+        if prof is not None:
+            prof.on_incident(verdict.reason, verdict.step)
         if mgr is None:
             raise TrainingDiverged(
                 f"watchdog verdict '{verdict.reason}' at step "
                 f"{verdict.step} and no checkpoint manager to roll back "
                 f"with: {verdict.detail}", telemetry)
         rollbacks += 1
-        telemetry["rollbacks"] += 1
+        _tick("rollbacks")
         if rollbacks > cfg.max_rollbacks:
             raise TrainingDiverged(
                 f"rollback budget exhausted ({cfg.max_rollbacks}) after "
@@ -570,6 +643,9 @@ def run_training(
         log_event(log, "rollback", to_step=ckpt_step, attempt=rollbacks,
                   budget=cfg.max_rollbacks, data_epoch=data_epoch,
                   level="warning")
+        if reg is not None:
+            reg.event("rollback", to_step=ckpt_step, attempt=rollbacks,
+                      budget=cfg.max_rollbacks, data_epoch=data_epoch)
 
     status = "completed"
     try:
@@ -583,14 +659,18 @@ def run_training(
                         source = ("sigterm" if guard.triggered
                                   else "injected")
                         _flush()
-                        telemetry["preemptions"] += 1
+                        _tick("preemptions")
                         status = "preempted"
                         if mgr is not None:
                             saved = mgr.save(host_step, state, force=True)
-                            telemetry["emergency_saves"] += int(saved)
+                            _tick("emergency_saves", int(saved))
                             log_event(log, "preemption_save",
                                       step=host_step, saved=saved,
                                       source=source, level="warning")
+                            if reg is not None:
+                                reg.event("preemption_save",
+                                          step=host_step, saved=saved,
+                                          source=source)
                         break
                     batch = get_batch(host_step, data_epoch)
                     if faults is not None and faults.nan_grads:
@@ -598,9 +678,15 @@ def run_training(
                         batch = poison_batch(batch)
                     step_rng = (None if rng is None
                                 else jax.random.fold_in(rng, host_step))
+                    if step_metrics is not None:
+                        step_metrics.begin_step()
                     state, metrics = step_fn(state, batch, step_rng)
                     host_step += 1
-                    telemetry["steps"] += 1
+                    _tick("steps")
+                    if step_metrics is not None:
+                        step_metrics.end_step(host_step)
+                    if prof is not None:
+                        prof.on_step(host_step)
                     pending.append((host_step, metrics))
 
                     at_save = (mgr is not None
@@ -631,6 +717,12 @@ def run_training(
     finally:
         if isinstance(step_fn, RetraceWatchdog):
             telemetry["retraces"] = step_fn.retraces
+        if prof is not None and prof.active:
+            prof.stop(host_step)
+        if reg is not None:
+            # the final snapshot is the monitor CLI's reconciliation
+            # anchor — flush even on the TrainingDiverged exit paths
+            reg.flush()
         if mgr is not None:
             try:
                 mgr.wait_until_finished()

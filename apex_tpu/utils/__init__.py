@@ -1,5 +1,10 @@
 from apex_tpu.utils.logging import get_logger, RankInfoFormatter
 from apex_tpu.utils.deprecation import deprecated_warning
+from apex_tpu.utils.flops import (
+    peak_flops_per_chip,
+    resnet50_train_flops,
+    transformer_train_flops,
+)
 from apex_tpu.utils.profiling import (
     annotate_fn,
     device_memory_stats,
@@ -29,4 +34,7 @@ __all__ = [
     "profiler_stop",
     "trace",
     "device_memory_stats",
+    "peak_flops_per_chip",
+    "resnet50_train_flops",
+    "transformer_train_flops",
 ]

@@ -47,19 +47,22 @@ _EVENT_SEQ = itertools.count()
 def log_event(logger: logging.Logger, event: str, *, level: str = "warning",
               **fields) -> str:
     """Structured failure/recovery telemetry: one ``logfmt``-style line
-    (``event=<name> seq=<n> ts=<monotonic> key=value ...``) per incident,
-    machine-greppable by event name. The resilience layer routes every
-    skip/rollback/retry/preemption/retrace incident through here (the
-    counters in ``TrainingResult.telemetry`` aggregate the same
+    (``event=<name> seq=<n> ts=<monotonic> wall=<epoch> key=value ...``)
+    per incident, machine-greppable by event name. The resilience layer
+    routes every skip/rollback/retry/preemption/retrace incident through
+    here (the counters in ``TrainingResult.telemetry`` aggregate the same
     incidents), the way the reference's RankInfoFormatter gives every
     record a parseable rank prefix. ``seq`` is a process-wide strictly
     increasing counter and ``ts`` a monotonic-clock stamp, so events can
     be totally ordered and rate-measured (retraces/min, skips/min) even
-    when the logging backend reorders or batches lines. Returns the
-    formatted line (callers embed it in exceptions).
+    when the logging backend reorders or batches lines; ``wall`` is epoch
+    seconds (``time.time()``), the only stamp comparable *across*
+    processes/hosts — use it to correlate events from different workers,
+    and ``ts`` (immune to clock steps) for intervals and rates. Returns
+    the formatted line (callers embed it in exceptions).
     """
     parts = [f"event={event}", f"seq={next(_EVENT_SEQ)}",
-             f"ts={time.monotonic():.6f}"]
+             f"ts={time.monotonic():.6f}", f"wall={time.time():.6f}"]
     for k in sorted(fields):
         v = fields[k]
         v = f"{v:.6g}" if isinstance(v, float) else str(v)

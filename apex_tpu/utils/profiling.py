@@ -7,7 +7,11 @@ flag wraps hooks/comm in ``torch.cuda.nvtx`` ranges,
 
 - :func:`nvtx_range` — ``jax.named_scope`` context manager (the name lands
   in XLA HLO metadata and shows up in the profiler timeline exactly like an
-  NVTX range does in Nsight);
+  NVTX range does in Nsight); pass a
+  :class:`~apex_tpu.observability.registry.MetricsRegistry` and the scope
+  also records its host-side wall duration into the ``span/<name>_s``
+  histogram — one annotation, visible both in the trace and in the run's
+  own metrics;
 - :func:`profiler_start` / :func:`profiler_stop` — ``jax.profiler`` trace
   capture to a TensorBoard-readable directory;
 - :func:`annotate_fn` — decorator form of :func:`nvtx_range`;
@@ -20,6 +24,7 @@ from __future__ import annotations
 
 import contextlib
 import functools
+import time
 from typing import Any, Callable, Dict, Optional
 
 import jax
@@ -28,21 +33,38 @@ __all__ = ["nvtx_range", "annotate_fn", "profiler_start", "profiler_stop",
            "trace", "device_memory_stats"]
 
 
-def nvtx_range(name: str):
+@contextlib.contextmanager
+def _timed_scope(name: str, registry):
+    t0 = time.perf_counter()
+    try:
+        with jax.named_scope(name):
+            yield
+    finally:
+        # host-side wall duration: dispatch time, not device time — in a
+        # saturated pipeline they converge; either way it is free (no sync)
+        registry.observe(f"span/{name}_s", time.perf_counter() - t0)
+
+
+def nvtx_range(name: str, registry=None):
     """``with nvtx_range("fwd"):`` — names the enclosed computation in the
-    profiler timeline (``jax.named_scope``)."""
-    return jax.named_scope(name)
+    profiler timeline (``jax.named_scope``). With ``registry`` (a
+    ``MetricsRegistry``), the scope's host-side wall duration is also
+    observed into the ``span/<name>_s`` histogram."""
+    if registry is None:
+        return jax.named_scope(name)
+    return _timed_scope(name, registry)
 
 
-def annotate_fn(name: Optional[str] = None) -> Callable:
-    """Decorator: run the function under a named scope."""
+def annotate_fn(name: Optional[str] = None, registry=None) -> Callable:
+    """Decorator: run the function under a named scope (optionally timed
+    into ``registry``, as :func:`nvtx_range`)."""
 
     def deco(fn: Callable) -> Callable:
         scope = name or fn.__name__
 
         @functools.wraps(fn)
         def wrapped(*a, **kw):
-            with jax.named_scope(scope):
+            with nvtx_range(scope, registry=registry):
                 return fn(*a, **kw)
 
         return wrapped

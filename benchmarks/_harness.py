@@ -9,42 +9,15 @@ import json
 import time
 from typing import Callable, Optional
 
-# bf16 peak TFLOP/s per chip by device kind (public Cloud TPU specs); MFU is
-# model-FLOPs utilization against this number
-_PEAK_FLOPS = {
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,
-    "TPU v5e": 197e12,
-    "TPU v5": 459e12,
-    "TPU v5p": 459e12,
-    "TPU v6 lite": 918e12,
-    "TPU v6e": 918e12,
-}
-
-
-def peak_flops_per_chip() -> Optional[float]:
-    import jax
-
-    kind = jax.devices()[0].device_kind
-    for name, peak in _PEAK_FLOPS.items():
-        if kind.startswith(name):
-            return peak
-    return None
-
-
-def transformer_train_flops(n_params: int, tokens: int, num_layers: int,
-                            hidden: int, seq: int, causal: bool) -> float:
-    """Model FLOPs for one training step over ``tokens`` tokens: the
-    standard ``6N`` matmul term plus the attention score/value term
-    ``12 * L * s * d`` per token (halved for causal masking)."""
-    attn = 12 * num_layers * seq * hidden * (0.5 if causal else 1.0)
-    return float(tokens) * (6.0 * n_params + attn)
-
-
-def resnet50_train_flops(images: int, image_size: int) -> float:
-    """Model FLOPs for one RN50 training step: 4.09 GFLOP forward per
-    224px image (torchvision profile), scaled by area, x3 for fwd+bwd."""
-    return images * 3.0 * 4.09e9 * (image_size / 224.0) ** 2
+# FLOP accounting lives in the library (apex_tpu.utils.flops) — the same
+# peak table and estimators drive the observability layer's MFU metric,
+# so benchmark MFU and in-run MFU can never drift apart. Re-exported here
+# because every benchmark script imports them from the harness.
+from apex_tpu.utils.flops import (  # noqa: F401
+    peak_flops_per_chip,
+    resnet50_train_flops,
+    transformer_train_flops,
+)
 
 
 def run(metric: str, unit: str, step_fn: Callable, *state,

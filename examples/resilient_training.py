@@ -2,7 +2,13 @@
 + FusedAdam under `run_training`, with a scripted NaN-gradient burst that
 trips the watchdog, rolls training back to the last good checkpoint at a
 decayed loss scale, and still converges. Ctrl-free: faults come from the
-deterministic injector, so the run behaves identically everywhere."""
+deterministic injector, so the run behaves identically everywhere.
+
+The run also measures itself: a MetricsRegistry with a JSONL sink rides
+along (ResilienceConfig.metrics), and at the end the same report that
+`python -m apex_tpu.monitor <run.jsonl>` prints — counters reconciling
+with TrainingResult.telemetry, step-time p50/p95, throughput/MFU,
+incident timeline — is rendered from the log."""
 import os
 import tempfile
 
@@ -11,10 +17,13 @@ import jax.numpy as jnp
 
 import apex_tpu
 from apex_tpu.amp.scaler import LossScaler
+from apex_tpu.observability import (JsonlSink, MetricsRegistry,
+                                    build_report, render_report)
 from apex_tpu.optimizers import FusedAdam
 from apex_tpu.resilience import (ResilienceConfig, make_train_state,
                                  make_resilient_train_step, run_training)
 from apex_tpu.testing_faults import FaultInjector
+from apex_tpu.utils.flops import peak_flops_per_chip
 
 print("devices:", jax.devices(), "| apex_tpu", apex_tpu.__version__)
 
@@ -46,24 +55,35 @@ def batch_fn(step):  # pure function of step -> replayable after rollback
 step_fn = make_resilient_train_step(loss_fn, opt, scaler)
 state = make_train_state(params, opt.init(params), scaler.init())
 
-cfg = ResilienceConfig(
-    save_interval_steps=20,       # checkpoint cadence (orbax, atomic)
-    poll_interval_steps=5,        # watchdog device->host sync cadence
-    max_consecutive_skips=4,      # divergence = 4 skipped steps in a row
-    max_rollbacks=2,              # retry budget before TrainingDiverged
-    rollback_scale_decay=4.0,     # retry at loss_scale/4
-    save_backoff_base=0.2,        # checkpoint-save retry backoff
-)
-
 # a transient fault: train-step calls 30..35 produce NaN gradients
 injector = FaultInjector(nan_grad_calls=range(30, 36))
 
 with tempfile.TemporaryDirectory() as tmp:
+    run_log = os.path.join(tmp, "run.jsonl")
+    registry = MetricsRegistry([JsonlSink(run_log)])
+    cfg = ResilienceConfig(
+        save_interval_steps=20,       # checkpoint cadence (orbax, atomic)
+        poll_interval_steps=5,        # watchdog device->host sync cadence
+        max_consecutive_skips=4,      # divergence = 4 skipped steps in a row
+        max_rollbacks=2,              # retry budget before TrainingDiverged
+        rollback_scale_decay=4.0,     # retry at loss_scale/4
+        save_backoff_base=0.2,        # checkpoint-save retry backoff
+        metrics=registry,             # step metrics + incident events
+        tokens_per_step=256,          # enables tokens/s
+        model_flops_per_step=6.0 * (64 * 128 + 128),  # 6N for the 2-layer MLP
+        peak_flops=peak_flops_per_chip() or 1e12,     # CPU: nominal peak
+    )
     result = run_training(
         step_fn, state, batch_fn, num_steps=300,
         rng=jax.random.PRNGKey(42),
         checkpoint_dir=os.path.join(tmp, "ckpts"),
         config=cfg, fault_injector=injector)
+    registry.close()
+    # same output as `python -m apex_tpu.monitor <run.jsonl>`
+    report = build_report(run_log)
+    print(render_report(report))
+    assert report["counters"] == result.telemetry  # two ledgers, one truth
+    assert report["step_time_s"]["p50"] > 0 and report["mfu"]["p50"] > 0
 
 print(f"status={result.status} steps={result.steps_completed} "
       f"rollbacks={result.rollbacks}")

@@ -473,6 +473,22 @@ class TestLogEventStamps:
         assert seqs == sorted(seqs) and len(set(seqs)) == 3
         assert tss == sorted(tss)
 
+    def test_wall_stamp_is_epoch_time(self):
+        # wall= (time.time()) rides next to the monotonic ts= so events
+        # from different processes/hosts can be correlated; ts stays the
+        # rate-measurement stamp (immune to clock steps)
+        import time as _time
+
+        from apex_tpu.utils.logging import get_logger, log_event
+        log = get_logger("apex_tpu.test_stamps")
+        log.setLevel(logging.CRITICAL)
+        before = _time.time()
+        line = log_event(log, "retrace", fn="step", call=0)
+        after = _time.time()
+        fields = dict(kv.split("=", 1) for kv in line.split() if "=" in kv)
+        assert before <= float(fields["wall"]) <= after
+        assert "ts" in fields  # monotonic stamp kept alongside
+
 
 # ---------------------------------------------------------------------------
 # retrace watchdog

@@ -1,0 +1,457 @@
+"""Observability-subsystem suite (ISSUE 3): registry semantics and
+thread-safety, bounded histograms, sink round-trips, MFU math, span
+tracing, profiler-capture scheduling, retrace-watchdog metric emission —
+and the acceptance path: a fault-injected CPU ``run_training`` with a
+JSONL sink whose ``python -m apex_tpu.monitor`` report reconciles
+exactly with ``TrainingResult.telemetry``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from apex_tpu.amp.scaler import LossScaler
+from apex_tpu.analysis.retrace import RetraceWatchdog
+from apex_tpu.observability import (
+    InMemorySink,
+    JsonlSink,
+    MetricsRegistry,
+    PrometheusTextfileSink,
+    ProfilerCapture,
+    StepMetrics,
+    StepTimer,
+    build_report,
+    percentile,
+    render_report,
+    span,
+)
+from apex_tpu.optimizers import FusedSGD
+from apex_tpu.resilience import (
+    ResilienceConfig,
+    make_resilient_train_step,
+    make_train_state,
+    run_training,
+)
+from apex_tpu.testing_faults import FaultInjector
+from apex_tpu.utils.flops import (
+    peak_flops_per_chip,
+    resnet50_train_flops,
+    transformer_train_flops,
+)
+
+
+class TestRegistry:
+    def test_counters_gauges_histograms(self):
+        reg = MetricsRegistry()
+        assert reg.inc("steps") == 1
+        assert reg.inc("steps", 4) == 5
+        reg.declare_counters("skips", "steps")
+        assert reg.counters() == {"steps": 5, "skips": 0}
+        reg.set_gauge("loss", 0.25)
+        assert reg.gauges()["loss"] == 0.25
+        for v in (1.0, 2.0, 3.0, 4.0):
+            reg.observe("h", v)
+        snap = reg.histogram("h")
+        assert snap.count == 4 and snap.sum == 10.0
+        assert snap.min == 1.0 and snap.max == 4.0 and snap.mean == 2.5
+        assert reg.histogram("missing") is None
+
+    def test_percentile_nearest_rank(self):
+        values = list(range(1, 101))  # 1..100
+        assert percentile(values, 50) == 50
+        assert percentile(values, 95) == 95
+        assert percentile(values, 0) == 1
+        assert percentile(values, 100) == 100
+        assert percentile([7.0], 50) == 7.0
+        with pytest.raises(ValueError):
+            percentile([], 50)
+
+    def test_events_are_seq_ordered_and_stamped(self):
+        mem = InMemorySink()
+        reg = MetricsRegistry([mem])
+        reg.event("skip", step=3)
+        reg.event("rollback", to_step=1)
+        events = mem.of_kind("event")
+        assert [e["event"] for e in events] == ["skip", "rollback"]
+        assert events[0]["seq"] < events[1]["seq"]
+        assert events[0]["ts"] <= events[1]["ts"]
+        assert all("wall" in e for e in events)
+        assert events[0]["step"] == 3
+
+    def test_thread_safety_under_concurrent_emitters(self):
+        # the real topology: watchdog thread + step loop both emit
+        mem = InMemorySink()
+        reg = MetricsRegistry([mem])
+        workers, per = 8, 500
+
+        def emit(worker):
+            for i in range(per):
+                reg.inc("c")
+                reg.observe("h", float(i))
+                reg.event("tick", worker=worker)
+
+        threads = [threading.Thread(target=emit, args=(w,))
+                   for w in range(workers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert reg.counters()["c"] == workers * per
+        assert reg.histogram("h").count == workers * per
+        events = mem.of_kind("event")
+        assert len(events) == workers * per
+        # seq never duplicated or skipped despite contention
+        seqs = sorted(e["seq"] for e in events)
+        assert seqs == list(range(1, workers * per + 1))
+
+    def test_histogram_memory_bounded_over_1000_steps(self):
+        # acceptance: ring memory does not grow with step count
+        reg = MetricsRegistry(histogram_bound=64)
+        for i in range(1200):
+            reg.observe("step_time_s", float(i))
+        snap = reg.histogram("step_time_s")
+        assert snap.count == 1200          # exact aggregates kept
+        assert snap.max == 1199.0 and snap.min == 0.0
+        assert len(snap._recent) == 64     # percentile window stays bounded
+        # percentiles reflect the recent window (values 1136..1199)
+        assert snap.percentile(50) >= 1136
+
+
+class TestSinks:
+    def test_jsonl_round_trip(self, tmp_path):
+        path = str(tmp_path / "run.jsonl")
+        sink = JsonlSink(path)
+        reg = MetricsRegistry([sink])
+        reg.inc("steps", 3)
+        reg.event("skip", step=1)
+        reg.emit_step({"kind": "step", "step": 1, "step_time_s": 0.5})
+        reg.flush()
+        sink.close()
+        kinds = [json.loads(line)["kind"]
+                 for line in open(path, encoding="utf-8")]
+        assert kinds == ["event", "step", "counters", "gauges",
+                         "histograms"]
+        counters = [json.loads(line) for line in open(path, encoding="utf-8")
+                    if json.loads(line)["kind"] == "counters"]
+        assert counters[-1]["values"] == {"steps": 3}
+
+    def test_jsonl_degrades_unserializable_fields(self, tmp_path):
+        path = str(tmp_path / "run.jsonl")
+        sink = JsonlSink(path)
+        sink.write({"kind": "event", "event": "odd", "obj": object()})
+        sink.close()
+        rec = json.loads(open(path, encoding="utf-8").read())
+        assert rec["event"] == "odd" and "object" in rec["obj"]
+
+    def test_prometheus_textfile_round_trip(self, tmp_path):
+        path = str(tmp_path / "metrics.prom")
+        reg = MetricsRegistry([PrometheusTextfileSink(path)])
+        reg.inc("steps", 7)
+        reg.set_gauge("mfu", 0.4)
+        for v in (1.0, 2.0, 3.0):
+            reg.observe("step_time_s", v)
+        reg.flush()
+        text = open(path, encoding="utf-8").read()
+        assert "apex_tpu_steps_total 7" in text
+        assert "apex_tpu_mfu 0.4" in text
+        assert "apex_tpu_step_time_s_count 3" in text
+        assert "apex_tpu_step_time_s_sum 6.0" in text
+        assert 'quantile="0.50"' in text
+        # no torn files: the render is atomic (temp + rename)
+        assert not os.path.exists(path + ".tmp")
+
+
+class TestFlops:
+    def test_transformer_train_flops_hand_computed(self):
+        # 6N term: 6 * 1e6 params; attention: 12 * L2 * s8 * d16 = 1536/tok
+        got = transformer_train_flops(n_params=1_000_000, tokens=100,
+                                      num_layers=2, hidden=16, seq=8,
+                                      causal=False)
+        assert got == 100 * (6.0 * 1_000_000 + 12 * 2 * 8 * 16)
+        causal = transformer_train_flops(n_params=1_000_000, tokens=100,
+                                         num_layers=2, hidden=16, seq=8,
+                                         causal=True)
+        assert causal == 100 * (6.0 * 1_000_000 + 6 * 2 * 8 * 16)
+
+    def test_resnet50_train_flops_hand_computed(self):
+        assert resnet50_train_flops(10, 224) == 10 * 3.0 * 4.09e9
+        # area scaling: 112px is a quarter of the pixels
+        assert resnet50_train_flops(1, 112) == pytest.approx(
+            3.0 * 4.09e9 * 0.25)
+
+    def test_peak_flops_unknown_on_cpu(self):
+        assert peak_flops_per_chip() is None  # tier-1 runs on CPU
+
+    def test_harness_shares_the_library_estimators(self):
+        # satellite: benchmarks/_harness re-exports, not redefines
+        from benchmarks import _harness
+
+        assert _harness.transformer_train_flops is transformer_train_flops
+        assert _harness.resnet50_train_flops is resnet50_train_flops
+        assert _harness.peak_flops_per_chip is peak_flops_per_chip
+
+
+class TestStepMetrics:
+    def _clock(self, dt):
+        """Deterministic clock advancing dt per reading."""
+        state = {"t": 0.0}
+
+        def clock():
+            state["t"] += dt / 2  # begin+end = one dt per step
+            return state["t"]
+
+        return clock
+
+    def test_mfu_and_throughput_hand_computed(self):
+        mem = InMemorySink()
+        reg = MetricsRegistry([mem])
+        sm = StepMetrics(reg, tokens_per_step=1000,
+                         model_flops_per_step=2e12, peak_flops=8e12,
+                         memory_interval_steps=0, clock=self._clock(0.5))
+        sm.begin_step()
+        sm.end_step(1)
+        rec = sm.record_polled(1, loss=0.5, grad_norm=2.0, skipped=False)
+        assert rec["step_time_s"] == pytest.approx(0.25)
+        assert rec["tokens_per_s"] == pytest.approx(1000 / 0.25)
+        # mfu = model_flops / dt / peak = 2e12 / 0.25 / 8e12 = 1.0
+        assert rec["mfu"] == pytest.approx(1.0)
+        assert rec["model_tflops"] == pytest.approx(8.0)
+        assert reg.gauges()["mfu"] == pytest.approx(1.0)
+        assert reg.histogram("loss").count == 1
+        steps = mem.of_kind("step")
+        assert len(steps) == 1 and steps[0]["loss"] == 0.5
+
+    def test_peak_defaults_to_chip_table(self):
+        reg = MetricsRegistry()
+        sm = StepMetrics(reg, model_flops_per_step=1e12)
+        assert sm.peak_flops is None  # CPU: unknown chip, MFU stays unset
+        sm.begin_step()
+        sm.end_step(1)
+        rec = sm.record_polled(1, loss=1.0)
+        assert "mfu" not in rec and "model_tflops" in rec
+
+    def test_skipped_steps_stay_out_of_loss_histogram(self):
+        reg = MetricsRegistry()
+        sm = StepMetrics(reg, memory_interval_steps=0)
+        sm.begin_step()
+        sm.end_step(1)
+        rec = sm.record_polled(1, loss=float("nan"), skipped=True)
+        assert rec["skipped"] is True
+        assert reg.histogram("loss") is None  # never polluted by NaN
+
+    def test_pending_map_stays_bounded(self):
+        # 1200 steps, polled each step: buffered timings never accumulate
+        reg = MetricsRegistry(histogram_bound=32)
+        sm = StepMetrics(reg, tokens_per_step=10, memory_interval_steps=0,
+                         clock=self._clock(0.1))
+        for step in range(1, 1201):
+            sm.begin_step()
+            sm.end_step(step)
+            sm.record_polled(step, loss=1.0)
+        assert sm._pending == {}
+        snap = reg.histogram("step_time_s")
+        assert snap.count == 1200 and len(snap._recent) == 32
+
+    def test_step_timer_context(self):
+        reg = MetricsRegistry()
+        with StepTimer(reg, "data_wait_s") as t:
+            pass
+        assert t.elapsed >= 0
+        assert reg.histogram("data_wait_s").count == 1
+
+
+class TestTracing:
+    def test_span_records_host_duration(self):
+        reg = MetricsRegistry()
+        with span("fwd", reg):
+            jnp.ones((2, 2)) + 1
+        snap = reg.histogram("span/fwd_s")
+        assert snap is not None and snap.count == 1 and snap.min >= 0
+
+    def test_nvtx_range_without_registry_is_bare_scope(self):
+        from apex_tpu.utils.profiling import nvtx_range
+
+        with nvtx_range("legacy"):  # original call shape still works
+            pass
+
+    def test_annotate_fn_with_registry(self):
+        from apex_tpu.utils.profiling import annotate_fn
+
+        reg = MetricsRegistry()
+
+        @annotate_fn("bwd", registry=reg)
+        def f(x):
+            return x + 1
+
+        assert f(1) == 2 and f(2) == 3
+        assert reg.histogram("span/bwd_s").count == 2
+
+    def test_profiler_capture_schedule(self, tmp_path):
+        calls = []
+        prof = ProfilerCapture(
+            str(tmp_path), every_n_steps=5, capture_steps=2,
+            max_captures=2, registry=None,
+            start_fn=lambda d: calls.append(("start", d)),
+            stop_fn=lambda: calls.append(("stop",)))
+        for step in range(1, 21):
+            prof.on_step(step)
+        # windows [5,7) and [10,12); then the capture budget is spent
+        assert [c[0] for c in calls] == ["start", "stop", "start", "stop"]
+        assert calls[0][1].endswith("step5_interval")
+        assert calls[2][1].endswith("step10_interval")
+        assert prof.captures == 2 and not prof.active
+
+    def test_profiler_capture_on_incident(self, tmp_path):
+        calls = []
+        reg = MetricsRegistry()
+        prof = ProfilerCapture(
+            str(tmp_path), capture_steps=1, registry=reg,
+            start_fn=lambda d: calls.append(d),
+            stop_fn=lambda: None)
+        prof.on_incident("loss_spike", step=42)
+        assert prof.active and calls[0].endswith("step42_loss_spike")
+        prof.on_incident("grad_spike", step=43)  # already active: no-op
+        assert len(calls) == 1
+        prof.on_step(43)  # past the window: auto-stop
+        assert not prof.active
+        assert reg.counters()["profiler_captures"] == 1
+
+
+class TestRetraceWatchdogMetrics:
+    def test_retraces_emit_counter_and_events(self):
+        mem = InMemorySink()
+        reg = MetricsRegistry([mem])
+        f = jax.jit(lambda x: x * 2)
+        wd = RetraceWatchdog(f, budget=None, metrics=reg)
+        for n in range(2, 8):  # every call a new shape
+            wd(jnp.ones((n,)))
+        assert wd.retraces == 5
+        assert reg.counters()["retraces"] == 5
+        events = [e for e in mem.of_kind("event")
+                  if e["event"] == "retrace"]
+        assert len(events) == 5
+        assert events[-1]["retraces"] == 5
+
+    def test_no_registry_no_emission(self):
+        f = jax.jit(lambda x: x + 1)
+        wd = RetraceWatchdog(f, budget=None)
+        for n in range(2, 5):
+            wd(jnp.ones((n,)))
+        assert wd.metrics is None and wd.retraces == 2
+
+
+# ---------------------------------------------------------------------------
+# acceptance: fault-injected run -> JSONL -> monitor report reconciliation
+# ---------------------------------------------------------------------------
+
+TARGET = jnp.full((4, 4), 0.3)
+
+
+def _loss_fn(p, batch, rng):
+    pred = batch["x"] @ p["w"] + p["b"]
+    return jnp.mean((pred - batch["y"]) ** 2)
+
+
+def _batch_fn(step):
+    x = jax.random.normal(jax.random.PRNGKey(step), (8, 4))
+    return {"x": x, "y": x @ TARGET}
+
+
+@pytest.fixture(scope="module")
+def fault_run(tmp_path_factory):
+    """One fault-injected CPU run with the full sink stack attached;
+    shared by the reconciliation/report/CLI assertions below."""
+    tmp = tmp_path_factory.mktemp("obsrun")
+    jsonl = str(tmp / "run.jsonl")
+    prom = str(tmp / "metrics.prom")
+    reg = MetricsRegistry([JsonlSink(jsonl), PrometheusTextfileSink(prom)])
+    scaler = LossScaler("dynamic", init_scale=2.0 ** 8, scale_window=100)
+    opt = FusedSGD(lr=0.05)
+    params = {"w": jnp.ones((4, 4)), "b": jnp.zeros((4,))}
+    step_fn = make_resilient_train_step(_loss_fn, opt, scaler)
+    state = make_train_state(params, opt.init(params), scaler.init())
+    cfg = ResilienceConfig(
+        poll_interval_steps=2, save_interval_steps=4,
+        max_consecutive_skips=3, min_history=4, save_backoff_base=0.0,
+        handle_sigterm=False, metrics=reg,
+        tokens_per_step=32, model_flops_per_step=1e9,
+        peak_flops=1e12,  # CPU has no table entry: override for MFU
+        memory_stats_interval_steps=5)
+    inj = FaultInjector(nan_grad_calls=range(6, 10))
+    result = run_training(step_fn, state, _batch_fn, 20,
+                          checkpoint_dir=str(tmp / "ckpts"),
+                          config=cfg, fault_injector=inj)
+    reg.close()
+    return {"result": result, "jsonl": jsonl, "prom": prom}
+
+
+class TestMonitorReconciliation:
+    def test_counters_reconcile_exactly_with_telemetry(self, fault_run):
+        report = build_report(fault_run["jsonl"])
+        assert report["counters"] == fault_run["result"].telemetry
+        # the run actually exercised the incident paths
+        assert report["counters"]["rollbacks"] == 1
+        assert report["counters"]["skips"] >= 3
+
+    def test_step_stats_nonzero(self, fault_run):
+        report = build_report(fault_run["jsonl"])
+        for key in ("step_time_s", "tokens_per_s", "mfu"):
+            stats = report[key]
+            assert stats is not None, key
+            assert stats["p50"] > 0 and stats["p95"] > 0, key
+            assert stats["count"] == fault_run["result"].telemetry["steps"]
+        assert report["loss"]["last"] < report["loss"]["first"]
+
+    def test_incident_timeline_orders_skips_and_rollback(self, fault_run):
+        report = build_report(fault_run["jsonl"])
+        names = [e["event"] for e in report["timeline"]]
+        assert "skip" in names and "rollback" in names
+        assert "watchdog_verdict" in names
+        # verdict precedes its rollback in seq order
+        assert names.index("watchdog_verdict") < names.index("rollback")
+
+    def test_rendered_report_mentions_everything(self, fault_run):
+        report = build_report(fault_run["jsonl"])
+        text = render_report(report)
+        for token in ("counters:", "step time", "tokens/s", "mfu",
+                      "incident timeline", "rollback"):
+            assert token in text, token
+
+    def test_monitor_cli_reconciles(self, fault_run):
+        """The acceptance criterion through the real CLI:
+        ``python -m apex_tpu.monitor run.jsonl --json``."""
+        proc = subprocess.run(
+            [sys.executable, "-m", "apex_tpu.monitor",
+             fault_run["jsonl"], "--json"],
+            capture_output=True, text=True, timeout=300,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert proc.returncode == 0, proc.stderr
+        report = json.loads(proc.stdout)
+        telemetry = fault_run["result"].telemetry
+        assert report["counters"] == {k: int(v) for k, v in
+                                      telemetry.items()}
+        assert report["mfu"]["p50"] > 0
+
+    def test_monitor_cli_text_mode_and_missing_file(self, fault_run):
+        from apex_tpu.monitor import main
+
+        assert main([fault_run["jsonl"]]) == 0
+        assert main([fault_run["jsonl"] + ".nope"]) == 2
+
+    def test_prometheus_file_written(self, fault_run):
+        text = open(fault_run["prom"], encoding="utf-8").read()
+        assert "apex_tpu_steps_total" in text
+        assert "apex_tpu_rollbacks_total 1" in text
+
+    def test_report_survives_torn_last_line(self, fault_run, tmp_path):
+        torn = tmp_path / "torn.jsonl"
+        data = open(fault_run["jsonl"], encoding="utf-8").read()
+        torn.write_text(data + '{"kind": "step", "ste')  # killed mid-write
+        report = build_report(str(torn))
+        assert report["counters"] == fault_run["result"].telemetry
