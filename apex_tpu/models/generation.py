@@ -132,20 +132,33 @@ def _gather_vocab(logits: jax.Array, axis_name: str) -> jax.Array:
 
 
 def _cached_forward(model, params, caches, tokens: jax.Array, index,
-                    last_only: bool = False):
+                    last_only: bool = False, last_index=None):
     """Run ``tokens`` [batch, s] occupying cache slots [index, index+s) ->
     (fp32 full-vocab logits [s, batch, V], new caches). ``last_only``:
     compute the LM head for the FINAL position only (returns [1, b, V]) —
     a 1024-token prefill otherwise materializes [s, b, V] fp32 logits
-    (1.65 GB at GPT-2 vocab) of which sampling reads one row."""
+    (1.65 GB at GPT-2 vocab) of which sampling reads one row.
+    ``last_index`` (scalar, may be traced): compute the LM head for that
+    SINGLE sequence position instead — the bucketed-prefill form, where
+    the prompt is right-padded to a bucket length and the last real token
+    sits mid-sequence. ``index`` may be a ``[batch]`` vector of per-row
+    cache offsets (continuous-batching decode over FLAT caches): each
+    row then reads its own learned-position rows / rope angles and
+    writes K/V at its own offset."""
     c = model.config
     emb_p = params["embedding"]
     s = tokens.shape[1]
     emb = model.embedding.apply(emb_p["word_embeddings"], tokens)  # [b,s,h]
     if c.position_embedding_type == "learned":
-        pos = lax.dynamic_slice_in_dim(emb_p["position_embeddings"], index,
-                                       s, axis=0)                   # [s, h]
-        emb = emb + pos[None]
+        if getattr(index, "ndim", 0) == 1:
+            positions = index[:, None] + jnp.arange(s)[None, :]    # [b, s]
+            pos = jnp.take(emb_p["position_embeddings"], positions,
+                           axis=0)                                 # [b,s,h]
+            emb = emb + pos
+        else:
+            pos = lax.dynamic_slice_in_dim(emb_p["position_embeddings"],
+                                           index, s, axis=0)       # [s, h]
+            emb = emb + pos[None]
     # (rope rotates q/k inside attention at offset ``index``; nothing to add)
     hidden = emb.transpose(1, 0, 2)                                 # [s,b,h]
     hidden = hidden.astype(c.compute_dtype)
@@ -154,6 +167,8 @@ def _cached_forward(model, params, caches, tokens: jax.Array, index,
     from apex_tpu.models.gpt import lm_head_loss
     if last_only:
         hidden = hidden[-1:]
+    elif last_index is not None:
+        hidden = lax.dynamic_slice_in_dim(hidden, last_index, 1, axis=0)
     logits = lm_head_loss(
         emb_p["word_embeddings"]["weight"], hidden, None, None, c)
     logits = _gather_vocab(logits, c.axis_name)
@@ -165,8 +180,11 @@ def decode_step(model, params, caches, tokens: jax.Array, index):
     (fp32 full-vocab logits [batch, V], updated caches). ``caches`` is
     either form :func:`init_kv_caches` produces — the stacked ``(k, v)``
     pair or the per-layer list (the form ``generate()`` decodes with) —
-    and the return matches the input form. MoE models route drop-free on
-    the cache path (prefill and decode; see :func:`generate`)."""
+    and the return matches the input form. ``index`` may be a ``[batch]``
+    vector of per-row positions on the FLAT list form (continuous
+    batching — the serving engine's batched decode over independent
+    slots). MoE models route drop-free on the cache path (prefill and
+    decode; see :func:`generate`)."""
     logits, new_caches = _cached_forward(model, params, caches,
                                          tokens[:, None], index)
     return logits[0], new_caches
@@ -195,6 +213,16 @@ def generate(model, params, prompt: jax.Array, max_new_tokens: int, *,
     still flip that token's expert; trained routers are confident,
     random-init ones are not.)
     """
+    if max_new_tokens < 1:
+        # max_new_tokens=0 would make total == prompt_len, so the
+        # out.at[:, prompt_len] first-token write silently clamps onto the
+        # last prompt slot — reject instead of corrupting the prompt
+        raise ValueError(
+            f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if top_k is not None and top_k < 1:
+        # lax.top_k(logits, 0) would yield an empty kth slice (and a
+        # shape error only deep inside the sampling trace)
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
     if temperature > 0.0 and rng is None:
         raise ValueError("sampling (temperature > 0) needs rng")
     # pre-cast fp32 params to the compute dtype ONCE (decode is inference;

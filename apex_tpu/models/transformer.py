@@ -227,9 +227,18 @@ def rope_freqs(start, length: int, rot_dim: int, theta: float) -> jax.Array:
     Megatron ``concat(f, f)`` convention (reference
     ``apex/transformer/functional/fused_rope.py`` pairs with
     ``RotaryEmbedding`` in NeMo producing exactly this). ``start`` may be a
-    traced value (decode offset, context-parallel shard offset)."""
+    traced value (decode offset, context-parallel shard offset), or a
+    ``[batch]`` VECTOR of per-row offsets (the serving engine's
+    continuous-batching decode, where every cache slot sits at its own
+    position) — then the return is ``[s, batch, 1, rot_dim]``, which
+    broadcasts against ``[s, b, h, d]`` q/k exactly like the scalar form."""
     inv = 1.0 / theta ** (jnp.arange(0, rot_dim, 2, dtype=jnp.float32)
                           / rot_dim)
+    if getattr(start, "ndim", 0) == 1:
+        pos = (jnp.asarray(start, jnp.float32)[None, :]
+               + jnp.arange(length, dtype=jnp.float32)[:, None])  # [s, b]
+        f = pos[:, :, None] * inv[None, None, :]      # [s, b, rot_dim/2]
+        return jnp.concatenate([f, f], axis=-1)[:, :, None, :]
     pos = start + jnp.arange(length, dtype=jnp.float32)
     f = pos[:, None] * inv[None, :]                   # [s, rot_dim/2]
     return jnp.concatenate([f, f], axis=-1)[:, None, None, :]
@@ -554,6 +563,12 @@ class ParallelAttention:
         the in-branch comments; the per-head view is a bitcast —
         ``reshape`` splitting the minor dim).
         ``q``/``k``/``v`` arrive as ``[b, local_heads, s, dh]``.
+
+        ``cache_index`` may be a ``[b]`` VECTOR of per-row offsets
+        (continuous batching: each cache row is an independent request at
+        its own position) — the write becomes a per-row scatter and the
+        causal mask is taken per row, so one batched decode step serves
+        rows at arbitrary, unequal positions.
         """
         c = self.config
         dh = c.head_dim
@@ -561,16 +576,27 @@ class ParallelAttention:
         kvh = k.shape[1]
         kf = k.transpose(0, 2, 1, 3).reshape(b, s, kvh * dh)
         vf = v.transpose(0, 2, 1, 3).reshape(b, s, kvh * dh)
-        ck = lax.dynamic_update_slice(ck, kf.astype(ck.dtype),
-                                      (0, cache_index, 0))
-        cv = lax.dynamic_update_slice(cv, vf.astype(cv.dtype),
-                                      (0, cache_index, 0))
+        if getattr(cache_index, "ndim", 0) == 1:
+            # per-row offsets: each row r writes its s tokens at
+            # [cache_index[r], cache_index[r]+s) in its own cache row
+            row_update = jax.vmap(
+                lambda cache, update, idx: lax.dynamic_update_slice(
+                    cache, update, (idx, 0)))
+            ck = row_update(ck, kf.astype(ck.dtype), cache_index)
+            cv = row_update(cv, vf.astype(cv.dtype), cache_index)
+            ci = cache_index[:, None, None, None]         # [b, 1, 1, 1]
+        else:
+            ck = lax.dynamic_update_slice(ck, kf.astype(ck.dtype),
+                                          (0, cache_index, 0))
+            cv = lax.dynamic_update_slice(cv, vf.astype(cv.dtype),
+                                          (0, cache_index, 0))
+            ci = cache_index
         S = ck.shape[1]
         # identical mask to the 4D cached branch: query i of the slice may
         # see slots j <= cache_index + i, within the window and (varlen)
         # below the row's valid length
         slots = jnp.arange(S)[None, None, None, :]
-        allowed_up_to = cache_index + jnp.arange(s)[None, None, :, None]
+        allowed_up_to = ci + jnp.arange(s)[None, None, :, None]
         invalid = slots > allowed_up_to
         if c.sliding_window is not None:
             invalid = jnp.logical_or(
@@ -661,7 +687,10 @@ class ParallelAttention:
         S_max, dh]`` each — K/V heads, i.e. ``num_query_groups`` under
         GQA/MQA) and ``cache_index`` (tokens already cached); the
         current K/V are written at that offset, attention runs over the
-        cache, and the return becomes ``(out, new_cache)``.
+        cache, and the return becomes ``(out, new_cache)``. On the FLAT
+        cache form ``cache_index`` may be a ``[b]`` vector of per-row
+        offsets (continuous batching; rope rotates each row at its own
+        position).
         """
         c = self.config
         dh = c.head_dim
@@ -775,6 +804,11 @@ class ParallelAttention:
                     params, q, k, v, ck, cv, cache_index, attention_mask,
                     kv_lengths, rng, deterministic)
                 return out, new_cache
+            if getattr(cache_index, "ndim", 0) == 1:
+                raise NotImplementedError(
+                    "per-row cache_index (continuous-batching decode) "
+                    "needs the FLAT cache form — "
+                    "init_kv_caches(stacked=False, flat=True)")
             ck = lax.dynamic_update_slice(
                 ck, k.astype(ck.dtype), (0, 0, cache_index, 0))
             cv = lax.dynamic_update_slice(

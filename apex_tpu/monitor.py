@@ -4,8 +4,11 @@ Reads the JSONL metric log a
 :class:`apex_tpu.observability.JsonlSink`-equipped run wrote and prints
 the report: telemetry counter totals (reconciling exactly with the
 run's ``TrainingResult.telemetry``), step-time p50/p95, throughput/MFU
-trajectory, and the incident timeline (skips, rollbacks, retraces,
-preemptions). ``--json`` emits the raw report dict instead.
+trajectory, the serving-request section (per-request latency quantiles
+and finish-reason counts from an ``InferenceEngine``'s
+``kind="request"`` rows, reconciling with its ``requests_*`` counters),
+and the incident timeline (skips, rollbacks, retraces, preemptions).
+``--json`` emits the raw report dict instead.
 
 Thin shim over :mod:`apex_tpu.observability.report` so the command
 reads ``apex_tpu.monitor`` while the logic lives with the subsystem.

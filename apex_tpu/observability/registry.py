@@ -172,10 +172,16 @@ class MetricsRegistry:
             self._write(record)
             return record
 
-    def emit_step(self, record: dict) -> None:
-        """Forward one per-step record (``kind="step"``) to the sinks."""
+    def emit_record(self, record: dict) -> None:
+        """Forward one pre-built record to the sinks — the generic form
+        behind :meth:`emit_step`; serving uses it for its per-request
+        ``kind="request"`` rows."""
         with self._lock:
             self._write(record)
+
+    def emit_step(self, record: dict) -> None:
+        """Forward one per-step record (``kind="step"``) to the sinks."""
+        self.emit_record(record)
 
     # -- consumers ---------------------------------------------------------
 

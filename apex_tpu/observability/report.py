@@ -12,6 +12,11 @@ wrote during a run and folds it into one report dict / text page:
   regressions over the run are visible at a glance.
 - **incident timeline** — every ``kind="event"`` record (skips,
   rollbacks, retraces, preemptions, resumes, captures) in ``seq`` order.
+- **serving requests** — the ``kind="request"`` rows a
+  :class:`~apex_tpu.serving.InferenceEngine` emits per terminal request:
+  count and finish-reason split (these reconcile exactly with the
+  engine's ``requests_*`` counters), plus queue/prefill/decode/total
+  latency quantiles and per-request tokens/s.
 
 Pure stdlib on purpose: no jax import, so the CLI works on a laptop far
 away from the TPU that wrote the log.
@@ -78,11 +83,39 @@ def _trajectory(steps: List[dict], key: str) -> List[dict]:
     return out
 
 
+def _request_summary(requests: List[dict]) -> Optional[dict]:
+    """Fold ``kind="request"`` serving rows into the report's requests
+    section. ``by_finish_reason`` counts reconcile with the engine's
+    ``requests_<reason>`` counters — same increment sites."""
+    if not requests:
+        return None
+    by_reason: Dict[str, int] = {}
+    for r in requests:
+        reason = str(r.get("finish_reason", "?"))
+        by_reason[reason] = by_reason.get(reason, 0) + 1
+    return {
+        "count": len(requests),
+        "by_finish_reason": by_reason,
+        "new_tokens": sum(int(r.get("new_tokens", 0)) for r in requests),
+        "queue_s": _stats([r["queue_s"] for r in requests
+                           if "queue_s" in r]),
+        "prefill_s": _stats([r["prefill_s"] for r in requests
+                             if "prefill_s" in r]),
+        "decode_s": _stats([r["decode_s"] for r in requests
+                            if "decode_s" in r]),
+        "total_s": _stats([r["total_s"] for r in requests
+                           if "total_s" in r]),
+        "tokens_per_s": _stats([r["tokens_per_s"] for r in requests
+                                if "tokens_per_s" in r]),
+    }
+
+
 def build_report(path: str) -> dict:
     """Fold one JSONL metric log into a report dict."""
     records = read_records(path)
     steps = [r for r in records if r.get("kind") == "step"]
     events = [r for r in records if r.get("kind") == "event"]
+    requests = [r for r in records if r.get("kind") == "request"]
     counters: Dict[str, int] = {}
     gauges: Dict[str, float] = {}
     histograms: Dict[str, dict] = {}
@@ -114,6 +147,7 @@ def build_report(path: str) -> dict:
                   "min": min(losses)} if losses else None),
         "throughput_trajectory": _trajectory(steps, "tokens_per_s"),
         "mfu_trajectory": _trajectory(steps, "mfu"),
+        "requests": _request_summary(requests),
         "timeline": sorted(events, key=lambda e: e.get("seq", 0)),
     }
     return report
@@ -156,6 +190,18 @@ def render_report(report: dict) -> str:
         lo = report["loss"]
         lines.append(f"  {'loss':<14} first={_fmt(lo['first'])} "
                      f"last={_fmt(lo['last'])} min={_fmt(lo['min'])}")
+    req = report.get("requests")
+    if req:
+        reasons = " ".join(f"{k}={v}" for k, v in sorted(
+            req["by_finish_reason"].items()))
+        lines += ["", f"serving requests ({req['count']}, "
+                      f"{req['new_tokens']} tokens generated):",
+                  f"  finish: {reasons}",
+                  _render_stat_line("queue", req["queue_s"], "s"),
+                  _render_stat_line("prefill", req["prefill_s"], "s"),
+                  _render_stat_line("decode", req["decode_s"], "s"),
+                  _render_stat_line("total", req["total_s"], "s"),
+                  _render_stat_line("tokens/s", req["tokens_per_s"])]
     for key, label in (("throughput_trajectory", "tokens/s trajectory"),
                        ("mfu_trajectory", "mfu trajectory")):
         traj = report[key]

@@ -1,0 +1,54 @@
+"""apex_tpu.serving — continuous-batching inference over the KV-cache
+decode path.
+
+The request-level layer above :mod:`apex_tpu.models.generation`: where
+``generate()`` is one lockstep prefill+decode batch, the
+:class:`InferenceEngine` admits and retires requests **per decode step**
+(Orca-style continuous batching) over a fixed-capacity slot pool and a
+single jitted batched decode program that never retraces. FCFS
+scheduling with bucketed prefill and backpressure lives in
+:mod:`~apex_tpu.serving.scheduler`; request/result types in
+:mod:`~apex_tpu.serving.request`. See docs/serving.md.
+"""
+
+from apex_tpu.serving.engine import EngineConfig, InferenceEngine
+from apex_tpu.serving.request import (
+    FINISH_CANCELLED,
+    FINISH_EOS,
+    FINISH_LENGTH,
+    FINISH_REASONS,
+    FINISH_REJECTED,
+    FINISH_TIMEOUT,
+    Request,
+    RequestResult,
+    SamplingParams,
+)
+from apex_tpu.serving.scheduler import (
+    FCFSScheduler,
+    QueueFullError,
+    SchedulerConfig,
+    bucket_for,
+    prefill_buckets,
+)
+from apex_tpu.serving.slots import SlotError, SlotPool
+
+__all__ = [
+    "InferenceEngine",
+    "EngineConfig",
+    "Request",
+    "RequestResult",
+    "SamplingParams",
+    "FCFSScheduler",
+    "SchedulerConfig",
+    "QueueFullError",
+    "bucket_for",
+    "prefill_buckets",
+    "SlotPool",
+    "SlotError",
+    "FINISH_EOS",
+    "FINISH_LENGTH",
+    "FINISH_CANCELLED",
+    "FINISH_TIMEOUT",
+    "FINISH_REJECTED",
+    "FINISH_REASONS",
+]

@@ -1,0 +1,512 @@
+"""Continuous-batching inference engine over the cached decode path.
+
+``generate()`` (apex_tpu/models/generation.py) is a single-shot batch
+primitive: every caller pays one lockstep prefill+decode, and short
+requests wait for the longest. :class:`InferenceEngine` turns those
+primitives into a request-level serving loop — Orca-style continuous
+(in-flight) batching: requests are admitted and retired **per decode
+step**, not per batch, over one fixed-shape jitted decode program.
+
+Architecture (docs/serving.md has the full walkthrough):
+
+- **Slot pool**: a ``[max_slots, max_len]`` batched FLAT KV cache
+  (``init_kv_caches(stacked=False, flat=True)``) whose rows are
+  independent requests; :class:`~apex_tpu.serving.slots.SlotPool` does
+  free-list allocation, eviction on EOS/length budget/cancel/timeout.
+- **One decode program**: a single ``jax.jit`` step over ALL slots with
+  per-slot position vectors (the vector ``cache_index`` capability of
+  the flat cache path — attention masks each row to its own length, rope
+  rotates each row at its own offset, and per-request sampling runs
+  in-jit from per-slot temperature/top-k/seed arrays). Arrivals and
+  retirements mutate host-side arrays only, so the decode step NEVER
+  retraces — asserted by a
+  :class:`~apex_tpu.analysis.retrace.RetraceWatchdog`, since the decode
+  roofline (PAPERS: arXiv 2502.17728) is only reachable when every step
+  is the same compiled program.
+- **Bucketed prefill**: prompts prefill one-at-a-time, right-padded to
+  power-of-two buckets, on the SAME 4D-list/flash path ``generate()``
+  uses (then flattened and scattered into the slot row) — compile count
+  is bounded by the bucket set and greedy outputs are token-exact
+  against per-request ``generate()`` calls.
+- **Scheduling**: FCFS bounded queue with a decode-starvation cap
+  (:mod:`apex_tpu.serving.scheduler`); queue-full rejection, deadlines,
+  and cancellation follow ``resilience``'s structured ``log_event``
+  conventions, and every terminal request emits one ``kind="request"``
+  JSONL record plus latency/occupancy histograms into an attached
+  :class:`~apex_tpu.observability.MetricsRegistry` (rendered by
+  ``python -m apex_tpu.monitor``).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.analysis.retrace import RetraceWatchdog
+from apex_tpu.models.generation import (
+    _cached_forward,
+    cast_decode_params,
+    decode_step,
+    flatten_decode_caches,
+    init_kv_caches,
+    preslice_layer_params,
+)
+from apex_tpu.observability import MetricsRegistry
+from apex_tpu.serving.request import (
+    FINISH_CANCELLED,
+    FINISH_EOS,
+    FINISH_LENGTH,
+    FINISH_REJECTED,
+    FINISH_TIMEOUT,
+    Request,
+    RequestResult,
+)
+from apex_tpu.serving.scheduler import (
+    FCFSScheduler,
+    QueueFullError,
+    SchedulerConfig,
+    bucket_for,
+    prefill_buckets,
+)
+from apex_tpu.serving.slots import SlotPool
+from apex_tpu.utils.logging import get_logger, log_event
+
+__all__ = ["EngineConfig", "InferenceEngine"]
+
+_LOG = get_logger(__name__)
+
+#: declared up front so final counter snapshots carry every key even for
+#: outcomes that never fired — the monitor report reconciles these
+#: against the per-request records key-for-key
+_COUNTERS = ("requests_submitted", "requests_eos", "requests_length",
+             "requests_cancelled", "requests_timeout", "requests_rejected",
+             "prefills", "decode_steps", "tokens_generated")
+
+
+@dataclass
+class EngineConfig:
+    """Engine sizing and robustness knobs.
+
+    ``retrace_budget`` guards the one-compile decode invariant: after the
+    warmup compile, that many decode retraces are tolerated before
+    :class:`~apex_tpu.analysis.retrace.RetraceBudgetExceeded` aborts the
+    engine (0 = any retrace is a bug; None = log only). ``donate_caches``
+    donates the KV-cache buffers into the jitted steps so decode updates
+    in place on TPU; ``None`` auto-disables it on the CPU backend (which
+    cannot donate and would warn every compile).
+    """
+
+    max_slots: int = 8
+    max_len: int = 512
+    scheduler: SchedulerConfig = field(default_factory=SchedulerConfig)
+    retrace_budget: Optional[int] = 0
+    donate_caches: Optional[bool] = None
+
+    def __post_init__(self):
+        if self.max_slots < 1:
+            raise ValueError(f"max_slots must be >= 1, got {self.max_slots}")
+        if self.max_len < 2:
+            raise ValueError(
+                f"max_len must be >= 2 (one prompt + one generated token), "
+                f"got {self.max_len}")
+
+
+class _Active:
+    """Host-side state of a request holding a slot."""
+
+    __slots__ = ("request", "slot", "tokens", "last_token", "position",
+                 "submit_ts", "prefill_start", "prefill_end", "cancelled")
+
+    def __init__(self, request: Request, slot: int, submit_ts: float):
+        self.request = request
+        self.slot = slot
+        self.tokens: List[int] = []
+        self.last_token = 0
+        self.position = 0       # cache rows written for this slot
+        self.submit_ts = submit_ts
+        self.prefill_start = 0.0
+        self.prefill_end = 0.0
+        self.cancelled = False
+
+
+def _sample_tokens(logits, temps, topks, seeds, steps):
+    """Per-row sampling over ``logits`` [n, V]: greedy where
+    ``temps == 0``, else softmax at the row's temperature truncated to
+    its top-k (``topks == V`` disables truncation), keyed by
+    ``fold_in(PRNGKey(seed), step)`` so a request's stream depends only
+    on its own (seed, positions) — never on batch co-tenants."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    v = logits.shape[-1]
+    safe_t = jnp.where(temps > 0.0, temps, 1.0).astype(logits.dtype)
+    scaled = logits / safe_t[:, None]
+    # kth-largest per row via one sort (top_k varies per row, so the
+    # static-k lax.top_k form generate() uses cannot batch here);
+    # mask logits < kth — identical support to generate()'s truncation
+    order = jnp.sort(scaled, axis=-1)                      # ascending
+    kth = jnp.take_along_axis(order, (v - topks)[:, None], axis=-1)
+    masked = jnp.where(scaled < kth, -jnp.inf, scaled)
+
+    def draw(seed, step, row):
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), step)
+        return jax.random.categorical(key, row)
+
+    sampled = jax.vmap(draw)(seeds, steps, masked).astype(jnp.int32)
+    return jnp.where(temps > 0.0, sampled, greedy)
+
+
+class InferenceEngine:
+    """Continuous-batching serving engine; see the module docstring.
+
+    Drive it either with :meth:`serve` (submit a request list, tick to
+    completion, collect results) or manually: :meth:`submit` +
+    :meth:`tick` in a loop, harvesting :attr:`completed`.
+    """
+
+    def __init__(self, model, params, config: Optional[EngineConfig] = None,
+                 *, metrics: Optional[MetricsRegistry] = None):
+        self.model = model
+        self.config = config or EngineConfig()
+        c = model.config
+        if (c.position_embedding_type == "learned"
+                and self.config.max_len > c.max_position_embeddings):
+            raise ValueError(
+                f"max_len ({self.config.max_len}) exceeds the model's "
+                f"max_position_embeddings ({c.max_position_embeddings})")
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        self.metrics.declare_counters(*_COUNTERS)
+        self.scheduler = FCFSScheduler(self.config.scheduler)
+        self.slots = SlotPool(self.config.max_slots)
+        self.buckets = prefill_buckets(self.config.max_len)
+        self.completed: Dict[int, RequestResult] = {}
+        #: request ids in admission (prefill) order — the FCFS audit trail
+        self.admission_log: List[int] = []
+        self._active: Dict[int, _Active] = {}      # slot -> state
+        self._vocab = c.vocab_size
+
+        # serving precision: generate()'s own one-time pre-cast +
+        # per-layer param pre-slice, materialized ONCE at engine build
+        if c.compute_dtype != jnp.float32:
+            params = cast_decode_params(params, c.compute_dtype)
+        self._params = preslice_layer_params(params, c.num_layers)
+        self._caches = init_kv_caches(
+            model, self.config.max_slots, self.config.max_len,
+            stacked=False, flat=True)
+
+        n = self.config.max_slots
+        self._tokens_h = np.zeros(n, np.int32)
+        self._positions_h = np.zeros(n, np.int32)
+        self._temps_h = np.zeros(n, np.float32)
+        self._topks_h = np.full(n, self._vocab, np.int32)
+        self._seeds_h = np.zeros(n, np.int32)
+
+        donate = self.config.donate_caches
+        if donate is None:
+            donate = jax.default_backend() != "cpu"
+
+        def _decode(params, caches, tokens, positions, temps, topks, seeds):
+            logits, caches = decode_step(model, params, caches, tokens,
+                                         positions)
+            nxt = _sample_tokens(logits, temps, topks, seeds, positions + 1)
+            return nxt, caches
+
+        def _prefill(params, caches, prompt, slot, prompt_len,
+                     temp, topk, seed):
+            # the EXACT prefill generate() runs (4D per-layer list -> the
+            # cache_index==0 causal-flash fast path), at the bucket-padded
+            # length; pad rows are causally invisible to real rows and
+            # their K/V land beyond the row's live length, so they are
+            # never read back
+            small = init_kv_caches(model, 1, prompt.shape[1], stacked=False)
+            logits, small = _cached_forward(model, params, small, prompt, 0,
+                                            last_index=prompt_len - 1)
+            flat = flatten_decode_caches(small, c.num_layers)
+            new = [
+                (jax.lax.dynamic_update_slice(bk, fk, (slot, 0, 0)),
+                 jax.lax.dynamic_update_slice(bv, fv, (slot, 0, 0)))
+                for (bk, bv), (fk, fv) in zip(caches, flat)]
+            first = _sample_tokens(logits[0], temp[None], topk[None],
+                                   seed[None], prompt_len[None])
+            return first[0], new
+
+        donate_args = (1,) if donate else ()
+        self._decode_fn = RetraceWatchdog(
+            jax.jit(_decode, donate_argnums=donate_args),
+            budget=self.config.retrace_budget, expected_compiles=1,
+            name="serving_decode", metrics=self.metrics)
+        # one jit whose compile count is bounded by the bucket set (each
+        # distinct padded prompt shape is one entry); budget=None — bucket
+        # compiles are expected, the TEST asserts compiles <= buckets
+        self._prefill_fn = RetraceWatchdog(
+            jax.jit(_prefill, donate_argnums=donate_args),
+            budget=None, expected_compiles=len(self.buckets),
+            name="serving_prefill", metrics=self.metrics)
+
+    # -- introspection ----------------------------------------------------
+
+    @property
+    def decode_retraces(self) -> int:
+        """Decode-step recompiles beyond the warmup — must stay 0."""
+        return self._decode_fn.retraces
+
+    @property
+    def prefill_compiles(self) -> int:
+        """Distinct prefill shapes compiled — bounded by ``len(buckets)``."""
+        return self._prefill_fn.compiles
+
+    @property
+    def active_count(self) -> int:
+        return self.slots.active_count
+
+    @property
+    def queued_count(self) -> int:
+        return self.scheduler.depth
+
+    # -- request lifecycle ------------------------------------------------
+
+    def submit(self, request: Request) -> int:
+        """Enqueue; returns the request id. Raises
+        :class:`~apex_tpu.serving.scheduler.QueueFullError` when the
+        bounded queue is full (the rejection is also recorded: counter,
+        ``request_rejected`` event, and a terminal ``kind="request"``
+        record with ``finish_reason="rejected"``)."""
+        if request.request_id in self.completed:
+            raise ValueError(
+                f"request id {request.request_id} already completed")
+        if request.total_len > self.config.max_len:
+            raise ValueError(
+                f"prompt ({request.prompt_len}) + max_new_tokens "
+                f"({request.max_new_tokens}) exceeds the engine's max_len "
+                f"({self.config.max_len})")
+        now = time.monotonic()
+        self.metrics.inc("requests_submitted")
+        try:
+            self.scheduler.submit(request, now)
+        except QueueFullError:
+            self._finish(request, [], FINISH_REJECTED, submit_ts=now,
+                         now=now)
+            raise
+        return request.request_id
+
+    def cancel(self, request_id: int) -> bool:
+        """Cancel a queued or in-flight request; returns True when found.
+        A queued request terminates immediately; an in-flight one is
+        evicted at the start of the next tick, keeping its partial
+        tokens in the result."""
+        queued = self.scheduler.cancel(request_id)
+        if queued is not None:
+            request, submit_ts = queued
+            self._finish(request, [], FINISH_CANCELLED, submit_ts=submit_ts,
+                         now=time.monotonic())
+            return True
+        for rec in self._active.values():
+            if rec.request.request_id == request_id:
+                rec.cancelled = True
+                return True
+        return False
+
+    def tick(self) -> List[RequestResult]:
+        """One scheduler iteration: expire deadlines, evict cancellations,
+        admit+prefill FCFS (decode-starvation capped), then one batched
+        decode step over all active slots. Returns the requests that
+        reached a terminal state during this tick."""
+        finished: List[RequestResult] = []
+        now = time.monotonic()
+        self._expire(now, finished)
+        self._evict_cancelled(finished)
+        self._admit(finished)
+        self._decode_tick(finished)
+        self.metrics.observe("slot_occupancy", self.slots.occupancy)
+        return finished
+
+    def serve(self, requests: Sequence[Request], *,
+              on_tick: Optional[Callable[["InferenceEngine", int], None]]
+              = None, max_ticks: Optional[int] = None
+              ) -> List[RequestResult]:
+        """Serve ``requests`` to completion: submits lazily as the bounded
+        queue drains (backpressure without rejections), ticks until idle,
+        and returns results in input order. ``on_tick(engine, i)`` runs
+        after each tick — the hook fault-injection and tests use to
+        cancel/submit mid-flight."""
+        pending = list(requests)
+        ids = [r.request_id for r in pending]
+        ticks = 0
+        while pending or self.scheduler.depth or self._active:
+            while pending and \
+                    self.scheduler.depth < self.config.scheduler.max_queue:
+                self.submit(pending.pop(0))
+            before = (len(pending), self.scheduler.depth, len(self._active))
+            self.tick()
+            ticks += 1
+            if on_tick is not None:
+                on_tick(self, ticks)
+            if max_ticks is not None and ticks >= max_ticks:
+                break
+            if (before == (len(pending), self.scheduler.depth,
+                           len(self._active))
+                    and not self._active and self.scheduler.depth):
+                raise RuntimeError(
+                    "serve() made no progress: queued requests exist but "
+                    "none are admissible (admission_hook deferring "
+                    "forever?)")
+        return [self.completed[i] for i in ids if i in self.completed]
+
+    def close(self) -> None:
+        """Flush the metrics registry (final counter snapshot — what the
+        monitor report reconciles against the request records)."""
+        self.metrics.flush()
+
+    # -- tick phases ------------------------------------------------------
+
+    def _expire(self, now: float, finished: List[RequestResult]) -> None:
+        for request, submit_ts in self.scheduler.expire(now):
+            finished.append(self._finish(
+                request, [], FINISH_TIMEOUT, submit_ts=submit_ts, now=now))
+        for slot in sorted(self._active):
+            rec = self._active[slot]
+            d = rec.request.deadline_s
+            if d is not None and now - rec.submit_ts > d:
+                finished.append(self._retire(rec, FINISH_TIMEOUT, now))
+
+    def _evict_cancelled(self, finished: List[RequestResult]) -> None:
+        for slot in sorted(self._active):
+            rec = self._active[slot]
+            if rec.cancelled:
+                finished.append(self._retire(
+                    rec, FINISH_CANCELLED, time.monotonic()))
+
+    def _admit(self, finished: List[RequestResult]) -> None:
+        batch = self.scheduler.pop_admissible(
+            self.slots.free_count, decoding=bool(self._active))
+        for request, submit_ts in batch:
+            slot = self.slots.allocate()
+            assert slot is not None  # pop_admissible respects free_count
+            self._prefill_into(request, slot, submit_ts, finished)
+
+    def _prefill_into(self, request: Request, slot: int, submit_ts: float,
+                      finished: List[RequestResult]) -> None:
+        rec = _Active(request, slot, submit_ts)
+        rec.prefill_start = time.monotonic()
+        bucket = bucket_for(request.prompt_len, self.config.max_len)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :request.prompt_len] = request.prompt
+        sp = request.sampling
+        first, self._caches = self._prefill_fn(
+            self._params, self._caches, jnp.asarray(padded),
+            jnp.int32(slot), jnp.int32(request.prompt_len),
+            jnp.float32(sp.temperature),
+            jnp.int32(sp.top_k if sp.top_k is not None else self._vocab),
+            jnp.int32(sp.seed))
+        first = int(np.asarray(first))
+        rec.prefill_end = time.monotonic()
+        rec.tokens.append(first)
+        rec.last_token = first
+        rec.position = request.prompt_len
+        self._active[slot] = rec
+        self.admission_log.append(request.request_id)
+        self.metrics.inc("prefills")
+        self.metrics.inc("tokens_generated")
+        self._sync_slot(rec)
+        done = self._finish_reason(rec, first)
+        if done is not None:
+            finished.append(self._retire(rec, done, time.monotonic()))
+
+    def _decode_tick(self, finished: List[RequestResult]) -> None:
+        if not self._active:
+            return
+        nxt, self._caches = self._decode_fn(
+            self._params, self._caches,
+            jnp.asarray(self._tokens_h), jnp.asarray(self._positions_h),
+            jnp.asarray(self._temps_h), jnp.asarray(self._topks_h),
+            jnp.asarray(self._seeds_h))
+        nxt = np.asarray(nxt)
+        self.metrics.inc("decode_steps")
+        self.metrics.inc("tokens_generated", len(self._active))
+        self.metrics.observe("decode_batch_size", len(self._active))
+        now = time.monotonic()
+        for slot in sorted(self._active):
+            rec = self._active[slot]
+            rec.position += 1            # last_token's K/V are now cached
+            token = int(nxt[slot])
+            rec.tokens.append(token)
+            rec.last_token = token
+            self._sync_slot(rec)
+            done = self._finish_reason(rec, token)
+            if done is not None:
+                finished.append(self._retire(rec, done, now))
+
+    # -- retirement & bookkeeping ----------------------------------------
+
+    def _finish_reason(self, rec: _Active, token: int) -> Optional[str]:
+        if rec.request.eos_token is not None and \
+                token == rec.request.eos_token:
+            return FINISH_EOS
+        if len(rec.tokens) >= rec.request.max_new_tokens:
+            return FINISH_LENGTH
+        return None
+
+    def _sync_slot(self, rec: _Active) -> None:
+        sp = rec.request.sampling
+        i = rec.slot
+        self._tokens_h[i] = rec.last_token
+        self._positions_h[i] = rec.position
+        self._temps_h[i] = sp.temperature
+        self._topks_h[i] = sp.top_k if sp.top_k is not None else self._vocab
+        self._seeds_h[i] = sp.seed
+
+    def _clear_slot(self, slot: int) -> None:
+        self._tokens_h[slot] = 0
+        self._positions_h[slot] = 0
+        self._temps_h[slot] = 0.0
+        self._topks_h[slot] = self._vocab
+        self._seeds_h[slot] = 0
+
+    def _retire(self, rec: _Active, reason: str,
+                now: float) -> RequestResult:
+        del self._active[rec.slot]
+        self.slots.release(rec.slot)
+        self._clear_slot(rec.slot)
+        return self._finish(
+            rec.request, rec.tokens, reason, submit_ts=rec.submit_ts,
+            now=now, prefill_start=rec.prefill_start,
+            prefill_end=rec.prefill_end)
+
+    def _finish(self, request: Request, tokens: List[int], reason: str, *,
+                submit_ts: float, now: float, prefill_start: float = 0.0,
+                prefill_end: float = 0.0) -> RequestResult:
+        if prefill_start:
+            queue_s = prefill_start - submit_ts
+            prefill_s = prefill_end - prefill_start
+            decode_s = now - prefill_end
+        else:                       # never left the queue
+            queue_s, prefill_s, decode_s = now - submit_ts, 0.0, 0.0
+        result = RequestResult(
+            request_id=request.request_id, prompt_len=request.prompt_len,
+            tokens=list(tokens), finish_reason=reason, queue_s=queue_s,
+            prefill_s=prefill_s, decode_s=decode_s,
+            total_s=now - submit_ts)
+        self.completed[request.request_id] = result
+        self.metrics.inc(f"requests_{reason}")
+        for name, value in (("request_queue_s", result.queue_s),
+                            ("request_prefill_s", result.prefill_s),
+                            ("request_decode_s", result.decode_s),
+                            ("request_total_s", result.total_s)):
+            self.metrics.observe(name, value)
+        tps = result.tokens_per_s
+        if tps is not None:
+            self.metrics.observe("request_tokens_per_s", tps)
+        self.metrics.emit_record(result.record(wall=time.time()))
+        if reason in (FINISH_REJECTED, FINISH_TIMEOUT, FINISH_CANCELLED):
+            log_event(_LOG, f"request_{reason}",
+                      request_id=request.request_id,
+                      prompt_len=request.prompt_len,
+                      new_tokens=result.new_tokens,
+                      total_s=result.total_s)
+            self.metrics.event(f"request_{reason}",
+                               request_id=request.request_id,
+                               new_tokens=result.new_tokens)
+        return result

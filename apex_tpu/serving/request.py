@@ -1,0 +1,146 @@
+"""Request/result types for the serving engine.
+
+A :class:`Request` is one user generation call: a prompt, a budget of new
+tokens, per-request sampling parameters, and optional deadline/EOS. The
+engine turns each terminal request into a :class:`RequestResult` carrying
+the generated tokens, the finish reason, and the latency breakdown
+(queue/prefill/decode/total) that feeds the ``kind="request"`` JSONL
+records and the monitor report's serving section.
+
+Validation lives here, at construction time — a malformed request must
+fail loudly at ``submit()`` instead of deep inside a jitted trace (the
+same contract :func:`apex_tpu.models.generation.generate` enforces for
+``max_new_tokens``/``top_k``).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+__all__ = ["SamplingParams", "Request", "RequestResult",
+           "FINISH_EOS", "FINISH_LENGTH", "FINISH_CANCELLED",
+           "FINISH_TIMEOUT", "FINISH_REJECTED", "FINISH_REASONS"]
+
+#: terminal outcomes a request can reach (RequestResult.finish_reason)
+FINISH_EOS = "eos"              # emitted its eos_token
+FINISH_LENGTH = "length"        # hit max_new_tokens
+FINISH_CANCELLED = "cancelled"  # cancel() — queued or mid-decode
+FINISH_TIMEOUT = "timeout"      # deadline_s elapsed — queued or mid-decode
+FINISH_REJECTED = "rejected"    # bounded queue was full at submit()
+FINISH_REASONS = (FINISH_EOS, FINISH_LENGTH, FINISH_CANCELLED,
+                  FINISH_TIMEOUT, FINISH_REJECTED)
+
+_REQUEST_IDS = itertools.count()
+
+
+@dataclass(frozen=True)
+class SamplingParams:
+    """Per-request sampling: ``temperature == 0`` is greedy (the parity
+    anchor against :func:`~apex_tpu.models.generation.generate`); with
+    ``temperature > 0`` the engine samples from the (optionally
+    ``top_k``-truncated) softmax, keyed by ``seed`` folded with the
+    absolute position of each generated token — one request's stream is
+    deterministic in (seed, prompt) and independent of what else shares
+    the batch."""
+
+    temperature: float = 0.0
+    top_k: Optional[int] = None
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.temperature < 0.0:
+            raise ValueError(
+                f"temperature must be >= 0, got {self.temperature}")
+        if self.top_k is not None and self.top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {self.top_k}")
+
+
+@dataclass
+class Request:
+    """One generation request.
+
+    ``deadline_s`` is a wall-clock budget relative to submission: a
+    request still queued (or still decoding) when it elapses finishes as
+    ``timeout`` — queued requests never silently rot behind a long
+    backlog. ``request_id`` is assigned process-wide; pass an explicit id
+    to correlate with an external system.
+    """
+
+    prompt: Sequence[int]
+    max_new_tokens: int
+    sampling: SamplingParams = field(default_factory=SamplingParams)
+    eos_token: Optional[int] = None
+    deadline_s: Optional[float] = None
+    request_id: int = field(default_factory=lambda: next(_REQUEST_IDS))
+
+    def __post_init__(self):
+        self.prompt = [int(t) for t in self.prompt]
+        if not self.prompt:
+            raise ValueError("prompt must be non-empty")
+        if self.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {self.max_new_tokens}")
+        if self.deadline_s is not None and self.deadline_s <= 0:
+            raise ValueError(
+                f"deadline_s must be positive, got {self.deadline_s}")
+
+    @property
+    def prompt_len(self) -> int:
+        return len(self.prompt)
+
+    @property
+    def total_len(self) -> int:
+        return len(self.prompt) + self.max_new_tokens
+
+
+@dataclass
+class RequestResult:
+    """Terminal outcome of one request.
+
+    ``tokens`` are the GENERATED ids only (no prompt echo), including the
+    ``eos_token`` when that is what ended the request — exactly the
+    ``out[:, prompt_len:]`` slice of a per-request ``generate()`` call
+    truncated at its first EOS. Latencies are host wall-clock seconds:
+    ``queue_s`` (submit -> prefill start), ``prefill_s``, ``decode_s``
+    (first decode participation -> finish) and ``total_s`` (submit ->
+    finish); a request that never left the queue has zero prefill/decode.
+    """
+
+    request_id: int
+    prompt_len: int
+    tokens: List[int]
+    finish_reason: str
+    queue_s: float = 0.0
+    prefill_s: float = 0.0
+    decode_s: float = 0.0
+    total_s: float = 0.0
+
+    @property
+    def new_tokens(self) -> int:
+        return len(self.tokens)
+
+    @property
+    def tokens_per_s(self) -> Optional[float]:
+        """Generation rate over the in-engine (non-queue) lifetime."""
+        busy = self.prefill_s + self.decode_s
+        if not self.tokens or busy <= 0.0:
+            return None
+        return len(self.tokens) / busy
+
+    def record(self, wall: float) -> dict:
+        """The ``kind="request"`` JSONL record the engine emits into its
+        :class:`~apex_tpu.observability.MetricsRegistry` sinks — the
+        per-request counterpart of the trainer's ``kind="step"`` rows."""
+        rec = {"kind": "request", "request_id": self.request_id,
+               "finish_reason": self.finish_reason,
+               "prompt_len": self.prompt_len,
+               "new_tokens": self.new_tokens,
+               "queue_s": self.queue_s, "prefill_s": self.prefill_s,
+               "decode_s": self.decode_s, "total_s": self.total_s,
+               "wall": wall}
+        tps = self.tokens_per_s
+        if tps is not None:
+            rec["tokens_per_s"] = tps
+        return rec

@@ -1,0 +1,159 @@
+"""FCFS admission scheduling for the serving engine.
+
+The queue half of continuous batching (Orca-style — PAPERS.md survey of
+request-level schedulers; TorchTitan's serving siblings ship the same
+split): the engine owns device state (slots, caches, jitted steps), the
+scheduler owns the host-side request queue and the admission policy.
+
+Design points:
+
+- **FCFS, head-of-line honest**: requests are admitted strictly in
+  arrival order. If the head cannot be admitted (no free slot, policy
+  hook defers), nothing behind it jumps the line — fairness is the
+  contract; smarter policies plug in via ``admission_hook``.
+- **Bounded queue = backpressure**: ``submit`` past ``max_queue`` raises
+  :class:`QueueFullError` so callers shed load at the edge instead of
+  growing an unbounded host-side backlog.
+- **Bucketed prefill**: prompts prefill at power-of-two padded lengths
+  (:func:`bucket_for`), so the number of distinct prefill shapes — and
+  therefore XLA compiles — is ``log2(max_len)``-bounded no matter how
+  ragged the traffic is.
+- **Decode-starvation cap**: while any slot is decoding, at most
+  ``max_prefills_per_tick`` prefills are admitted per engine tick, so a
+  deep queue of arrivals cannot stall in-flight requests' token cadence;
+  with nothing decoding, admission bursts to fill all free slots.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Deque, List, Optional, Tuple
+
+from apex_tpu.serving.request import Request
+
+__all__ = ["QueueFullError", "SchedulerConfig", "FCFSScheduler",
+           "prefill_buckets", "bucket_for"]
+
+
+class QueueFullError(RuntimeError):
+    """The bounded admission queue is full — shed load upstream."""
+
+
+def prefill_buckets(max_len: int) -> Tuple[int, ...]:
+    """Padded prefill lengths: powers of two up to ``max_len``, plus
+    ``max_len`` itself — the complete, static set of prefill shapes the
+    engine can ever compile."""
+    if max_len < 1:
+        raise ValueError(f"max_len must be >= 1, got {max_len}")
+    buckets = []
+    b = 1
+    while b < max_len:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_len)
+    return tuple(buckets)
+
+
+def bucket_for(length: int, max_len: int) -> int:
+    """Smallest bucket that fits ``length`` tokens."""
+    if length < 1:
+        raise ValueError(f"length must be >= 1, got {length}")
+    if length > max_len:
+        raise ValueError(f"length {length} exceeds max_len {max_len}")
+    for b in prefill_buckets(max_len):
+        if b >= length:
+            return b
+    raise AssertionError("unreachable: max_len bucket fits by construction")
+
+
+@dataclass
+class SchedulerConfig:
+    """Knobs for :class:`FCFSScheduler`.
+
+    ``admission_hook`` is the policy extension point: called with the
+    head-of-queue request right before admission; returning False defers
+    it (and, FCFS, everything behind it) to a later tick — enough to
+    express cost caps, per-tenant throttles, or load-aware admission
+    without subclassing.
+    """
+
+    max_queue: int = 64
+    #: decode-starvation cap — prefills admitted per tick while any slot
+    #: is mid-decode (a tick always runs one decode step for all active
+    #: slots, so in-flight requests advance at least once per tick)
+    max_prefills_per_tick: int = 1
+    admission_hook: Optional[Callable[[Request], bool]] = None
+
+    def __post_init__(self):
+        if self.max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {self.max_queue}")
+        if self.max_prefills_per_tick < 1:
+            raise ValueError(
+                f"max_prefills_per_tick must be >= 1, got "
+                f"{self.max_prefills_per_tick}")
+
+
+@dataclass
+class _Queued:
+    request: Request
+    submit_ts: float
+
+
+class FCFSScheduler:
+    """Bounded FIFO admission queue with deadline expiry."""
+
+    def __init__(self, config: Optional[SchedulerConfig] = None):
+        self.config = config or SchedulerConfig()
+        self._queue: Deque[_Queued] = deque()
+
+    @property
+    def depth(self) -> int:
+        return len(self._queue)
+
+    def submit(self, request: Request, now: float) -> None:
+        if len(self._queue) >= self.config.max_queue:
+            raise QueueFullError(
+                f"admission queue full ({self.config.max_queue}); "
+                f"request {request.request_id} rejected — retry with "
+                f"backoff or raise SchedulerConfig.max_queue")
+        self._queue.append(_Queued(request, now))
+
+    def cancel(self, request_id: int) -> Optional[Tuple[Request, float]]:
+        """Remove a still-queued request; (request, submit_ts) or None."""
+        for i, q in enumerate(self._queue):
+            if q.request.request_id == request_id:
+                del self._queue[i]
+                return q.request, q.submit_ts
+        return None
+
+    def expire(self, now: float) -> List[Tuple[Request, float]]:
+        """Pop queued requests whose deadline elapsed while waiting."""
+        expired, kept = [], deque()
+        for q in self._queue:
+            d = q.request.deadline_s
+            if d is not None and now - q.submit_ts > d:
+                expired.append((q.request, q.submit_ts))
+            else:
+                kept.append(q)
+        self._queue = kept
+        return expired
+
+    def pop_admissible(self, free_slots: int,
+                       decoding: bool) -> List[Tuple[Request, float]]:
+        """FCFS batch for this tick: up to ``free_slots`` requests, capped
+        at ``max_prefills_per_tick`` while decode traffic is in flight
+        (the starvation cap). Stops at the first head the admission hook
+        defers — no queue jumping."""
+        cap = free_slots
+        if decoding:
+            cap = min(cap, self.config.max_prefills_per_tick)
+        admitted: List[Tuple[Request, float]] = []
+        hook = self.config.admission_hook
+        while self._queue and len(admitted) < cap:
+            head = self._queue[0]
+            if hook is not None and not hook(head.request):
+                break
+            self._queue.popleft()
+            admitted.append((head.request, head.submit_ts))
+        return admitted

@@ -1,0 +1,68 @@
+"""Fixed-capacity decode slot pool with free-list allocation.
+
+Each slot is one row of the engine's batched KV cache
+(``[max_slots, max_len]`` per layer): a request holds exactly one slot
+from prefill to retirement, and the pool's invariant — every slot is
+either free or owned by exactly one request — is what the scheduler
+tests mean by "no slot leaks". Allocation always hands out the LOWEST
+free slot id so runs are deterministic (the same arrival order always
+produces the same slot assignment, and therefore the same decode batch
+layout).
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import List, Optional
+
+__all__ = ["SlotError", "SlotPool"]
+
+
+class SlotError(RuntimeError):
+    """A slot-pool invariant was violated (double release, foreign id)."""
+
+
+class SlotPool:
+    def __init__(self, capacity: int):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self._free: List[int] = list(range(capacity))  # already a heap
+        self._active: set = set()
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def active_count(self) -> int:
+        return len(self._active)
+
+    @property
+    def occupancy(self) -> float:
+        """Active fraction in [0, 1] — the slot-occupancy histogram feed."""
+        return len(self._active) / self.capacity
+
+    def allocate(self) -> Optional[int]:
+        """Lowest free slot id, or None when the pool is exhausted."""
+        if not self._free:
+            return None
+        slot = heapq.heappop(self._free)
+        self._active.add(slot)
+        return slot
+
+    def release(self, slot: int) -> None:
+        if slot not in self._active:
+            raise SlotError(
+                f"release of slot {slot} which is not active "
+                f"(double release or foreign id; active={sorted(self._active)})")
+        self._active.remove(slot)
+        heapq.heappush(self._free, slot)
+
+    def check(self) -> None:
+        """Assert the no-leak invariant; raises :class:`SlotError`."""
+        if len(self._free) + len(self._active) != self.capacity or \
+                set(self._free) & self._active:
+            raise SlotError(
+                f"slot leak: {len(self._free)} free + "
+                f"{len(self._active)} active != capacity {self.capacity}")

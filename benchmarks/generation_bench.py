@@ -14,7 +14,12 @@ GPT-2 124M:
     dispatch noise ADDS in a difference and inflated bs1 past the
     physical bound — see bench_decode); each row carries its fraction
     of the weight+KV read-bandwidth bound (decode reads every
-    parameter once per token).
+    parameter once per token);
+  * serving mode — mixed prompt lengths through the continuous-batching
+    InferenceEngine vs. lockstep generate() at matched load: tokens/sec
+    plus p50/p95 per-request latency (lockstep has one latency — every
+    request waits for the longest; continuous batching retires short
+    requests as they finish).
 
 Usage: ``PYTHONPATH=/root/repo:/root/.axon_site python benchmarks/generation_bench.py``
 """
@@ -203,11 +208,86 @@ def bench_decode(model, params, batch, prompt_len=128, chain=None):
     return tps
 
 
+def _pctl(values, p):
+    values = sorted(values)
+    return values[max(0, min(len(values) - 1,
+                             -(-int(p * len(values)) // 100) - 1))]
+
+
+def bench_serving(model, params, n_requests=32, max_new=32, max_slots=8,
+                  prompt_lens=(64, 128, 256, 512)):
+    """Serving-mode row: the SAME mixed-length request set through (a)
+    lockstep ``generate()`` — every prompt padded into one batch, every
+    request finishing with the longest — and (b) the continuous-batching
+    engine, which retires each request on ITS OWN last token and refills
+    the slot mid-flight. Matched load: identical prompts, identical
+    per-request token budgets. Lockstep's per-request latency is one
+    number (the whole batch), so the interesting deltas are the p50
+    request latency and aggregate tokens/s."""
+    from apex_tpu.serving import EngineConfig, InferenceEngine, Request
+
+    rng = np.random.RandomState(0)
+    lens = [prompt_lens[i % len(prompt_lens)] for i in range(n_requests)]
+    prompts = [rng.randint(0, 50304, size=n).tolist() for n in lens]
+    max_len = max(lens) + max_new
+
+    # -- lockstep generate(): slots = batch rows for comparability; each
+    # sub-batch is padded to ITS longest prompt and nobody retires early
+    t0 = time.perf_counter()
+    for i in range(0, n_requests, max_slots):
+        group = prompts[i:i + max_slots]
+        width = max(len(p) for p in group)
+        batch = np.zeros((len(group), width), np.int32)
+        for r, p in enumerate(group):
+            batch[r, :len(p)] = p
+        out = generate(model, params, jnp.asarray(batch), max_new,
+                       max_len=width + max_new)
+        np.asarray(out)
+    dt_lock = time.perf_counter() - t0
+    total_new = n_requests * max_new
+    print(json.dumps({
+        "metric": "gpt2_124m_serving_lockstep_tokens_per_sec",
+        "value": round(total_new / dt_lock, 1), "unit": "tokens/sec",
+        "vs_baseline": 1.0,
+        "config": {"n_requests": n_requests, "max_new": max_new,
+                   "prompt_lens": list(prompt_lens),
+                   "p50_request_latency_s": round(dt_lock, 3),
+                   "p95_request_latency_s": round(dt_lock, 3),
+                   "method": "batched generate(), zero-padded prompts; "
+                             "every request waits for the whole batch"}}))
+
+    # -- continuous batching: same requests, per-request retirement
+    engine = InferenceEngine(model, params, EngineConfig(
+        max_slots=max_slots, max_len=max_len))
+    reqs = [Request(prompt=p, max_new_tokens=max_new) for p in prompts]
+    t0 = time.perf_counter()
+    results = engine.serve(reqs)
+    dt_engine = time.perf_counter() - t0
+    lat = [r.total_s for r in results]
+    generated = sum(r.new_tokens for r in results)
+    print(json.dumps({
+        "metric": "gpt2_124m_serving_engine_tokens_per_sec",
+        "value": round(generated / dt_engine, 1), "unit": "tokens/sec",
+        "vs_baseline": round((generated / dt_engine)
+                             / (total_new / dt_lock), 3),
+        "config": {"n_requests": n_requests, "max_new": max_new,
+                   "max_slots": max_slots,
+                   "prompt_lens": list(prompt_lens),
+                   "p50_request_latency_s": round(_pctl(lat, 50), 3),
+                   "p95_request_latency_s": round(_pctl(lat, 95), 3),
+                   "decode_retraces": engine.decode_retraces,
+                   "prefill_compiles": engine.prefill_compiles,
+                   "method": "continuous batching (InferenceEngine): "
+                             "per-step admission/retirement, bucketed "
+                             "prefill, one jitted decode program"}}))
+
+
 def main():
     model, params = _model()
     bench_prefill(model, params)
     for b in (1, 8, 32):
         bench_decode(model, params, batch=b)
+    bench_serving(model, params)
 
 
 if __name__ == "__main__":

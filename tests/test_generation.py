@@ -158,6 +158,24 @@ class TestGenerate:
 
 
 class TestGuards:
+    def test_max_new_tokens_zero_rejected(self):
+        """max_new_tokens=0 would make total == prompt_len, so the
+        first-token write (out.at[:, prompt_len]) silently clamps onto
+        the last prompt slot — must raise instead."""
+        model = _model()
+        params = model.init(jax.random.PRNGKey(0))
+        prompt = jnp.zeros((1, 3), jnp.int32)
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            generate(model, params, prompt, max_new_tokens=0)
+
+    def test_top_k_below_one_rejected(self):
+        model = _model()
+        params = model.init(jax.random.PRNGKey(0))
+        prompt = jnp.zeros((1, 3), jnp.int32)
+        with pytest.raises(ValueError, match="top_k"):
+            generate(model, params, prompt, max_new_tokens=2,
+                     temperature=1.0, top_k=0, rng=jax.random.PRNGKey(0))
+
     def test_position_overflow_rejected(self):
         model = _model()   # max_position_embeddings=32
         params = model.init(jax.random.PRNGKey(0))

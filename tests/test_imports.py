@@ -34,6 +34,7 @@ MODULES = [
     "apex_tpu.parallel.multiproc",
     "apex_tpu.resilience",
     "apex_tpu.rnn",
+    "apex_tpu.serving",
     "apex_tpu.testing_faults",
     "apex_tpu.training",
     "apex_tpu.transformer",
