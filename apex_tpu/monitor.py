@@ -7,8 +7,11 @@ run's ``TrainingResult.telemetry``), step-time p50/p95, throughput/MFU
 trajectory, the serving-request section (per-request latency quantiles
 and finish-reason counts from an ``InferenceEngine``'s
 ``kind="request"`` rows, reconciling with its ``requests_*`` counters),
-and the incident timeline (skips, rollbacks, retraces, preemptions).
-``--json`` emits the raw report dict instead.
+the serving-incidents section (engine restarts, recovered requests,
+quarantined slots, breaker transitions, shed requests — reconciling
+key-for-key with the supervisor's counters), and the incident timeline
+(skips, rollbacks, retraces, preemptions). ``--json`` emits the raw
+report dict instead.
 
 Thin shim over :mod:`apex_tpu.observability.report` so the command
 reads ``apex_tpu.monitor`` while the logic lives with the subsystem.

@@ -17,6 +17,12 @@ wrote during a run and folds it into one report dict / text page:
   count and finish-reason split (these reconcile exactly with the
   engine's ``requests_*`` counters), plus queue/prefill/decode/total
   latency quantiles and per-request tokens/s.
+- **serving incidents** — the supervisor/quarantine event stream
+  (engine restarts, recovered requests, quarantined slots, breaker
+  transitions, shed requests): per-type counts that reconcile
+  key-for-key with the registry counters
+  (:data:`SERVING_INCIDENT_COUNTERS` names the mapping; the tier-1
+  serving-resilience tests assert it).
 
 Pure stdlib on purpose: no jax import, so the CLI works on a laptop far
 away from the TPU that wrote the log.
@@ -31,10 +37,32 @@ from typing import Dict, List, Optional
 
 from apex_tpu.observability.registry import percentile
 
-__all__ = ["read_records", "build_report", "render_report", "main"]
+__all__ = ["read_records", "build_report", "render_report", "main",
+           "SERVING_INCIDENT_COUNTERS", "SERVING_SHED_COUNTERS"]
 
 #: number of windows in the throughput/MFU trajectory
 _TRAJECTORY_WINDOWS = 5
+
+#: serving incident event -> registry counter: each event in the stream
+#: is counted by exactly one increment of its counter at the same site,
+#: so the report's per-type event counts reconcile key-for-key with the
+#: final counter snapshot
+SERVING_INCIDENT_COUNTERS = {
+    "engine_restart": "engine_restarts",
+    "tick_failure": "tick_failures",
+    "slot_quarantined": "slots_quarantined",
+    "request_recovered": "requests_recovered",
+    "breaker_open": "breaker_opens",
+    "breaker_half_open": "breaker_half_opens",
+    "breaker_closed": "breaker_closes",
+}
+
+#: ``request_shed`` events carry a ``reason`` field; each reason maps to
+#: its own counter
+SERVING_SHED_COUNTERS = {
+    "breaker": "requests_shed_breaker",
+    "deadline": "requests_shed_deadline",
+}
 
 
 def read_records(path: str) -> List[dict]:
@@ -110,6 +138,24 @@ def _request_summary(requests: List[dict]) -> Optional[dict]:
     }
 
 
+def _serving_incidents(events: List[dict]) -> Optional[dict]:
+    """Fold supervisor/quarantine incident events into per-type counts
+    (plus the shed split by reason) — the monitor's serving-incidents
+    section, reconciling with :data:`SERVING_INCIDENT_COUNTERS`."""
+    counts: Dict[str, int] = {}
+    shed: Dict[str, int] = {}
+    for e in events:
+        name = e.get("event")
+        if name in SERVING_INCIDENT_COUNTERS:
+            counts[name] = counts.get(name, 0) + 1
+        elif name == "request_shed":
+            reason = str(e.get("reason", "?"))
+            shed[reason] = shed.get(reason, 0) + 1
+    if not counts and not shed:
+        return None
+    return {"counts": counts, "shed_by_reason": shed}
+
+
 def build_report(path: str) -> dict:
     """Fold one JSONL metric log into a report dict."""
     records = read_records(path)
@@ -148,6 +194,7 @@ def build_report(path: str) -> dict:
         "throughput_trajectory": _trajectory(steps, "tokens_per_s"),
         "mfu_trajectory": _trajectory(steps, "mfu"),
         "requests": _request_summary(requests),
+        "serving_incidents": _serving_incidents(events),
         "timeline": sorted(events, key=lambda e: e.get("seq", 0)),
     }
     return report
@@ -202,6 +249,17 @@ def render_report(report: dict) -> str:
                   _render_stat_line("decode", req["decode_s"], "s"),
                   _render_stat_line("total", req["total_s"], "s"),
                   _render_stat_line("tokens/s", req["tokens_per_s"])]
+    inc = report.get("serving_incidents")
+    if inc:
+        total = sum(inc["counts"].values()) + \
+            sum(inc["shed_by_reason"].values())
+        lines += ["", f"serving incidents ({total}):"]
+        lines += [f"  {name} = {n}"
+                  for name, n in sorted(inc["counts"].items())]
+        if inc["shed_by_reason"]:
+            split = " ".join(f"{k}={v}" for k, v in sorted(
+                inc["shed_by_reason"].items()))
+            lines.append(f"  request_shed: {split}")
     for key, label in (("throughput_trajectory", "tokens/s trajectory"),
                        ("mfu_trajectory", "mfu trajectory")):
         traj = report[key]

@@ -8,13 +8,17 @@ The request-level layer above :mod:`apex_tpu.models.generation`: where
 single jitted batched decode program that never retraces. FCFS
 scheduling with bucketed prefill and backpressure lives in
 :mod:`~apex_tpu.serving.scheduler`; request/result types in
-:mod:`~apex_tpu.serving.request`. See docs/serving.md.
+:mod:`~apex_tpu.serving.request`. :class:`EngineSupervisor`
+(:mod:`~apex_tpu.serving.supervisor`) is the resilience layer: engine
+restarts with in-flight request recovery, slot quarantine, a circuit
+breaker, and deadline-aware load shedding. See docs/serving.md.
 """
 
 from apex_tpu.serving.engine import EngineConfig, InferenceEngine
 from apex_tpu.serving.request import (
     FINISH_CANCELLED,
     FINISH_EOS,
+    FINISH_ERROR,
     FINISH_LENGTH,
     FINISH_REASONS,
     FINISH_REJECTED,
@@ -24,6 +28,7 @@ from apex_tpu.serving.request import (
     SamplingParams,
 )
 from apex_tpu.serving.scheduler import (
+    DeadlineExpiredError,
     FCFSScheduler,
     QueueFullError,
     SchedulerConfig,
@@ -31,16 +36,31 @@ from apex_tpu.serving.scheduler import (
     prefill_buckets,
 )
 from apex_tpu.serving.slots import SlotError, SlotPool
+from apex_tpu.serving.supervisor import (
+    BREAKER_CLOSED,
+    BREAKER_HALF_OPEN,
+    BREAKER_OPEN,
+    EngineSupervisor,
+    EngineUnavailableError,
+    SupervisorConfig,
+)
 
 __all__ = [
     "InferenceEngine",
     "EngineConfig",
+    "EngineSupervisor",
+    "SupervisorConfig",
+    "EngineUnavailableError",
+    "BREAKER_CLOSED",
+    "BREAKER_OPEN",
+    "BREAKER_HALF_OPEN",
     "Request",
     "RequestResult",
     "SamplingParams",
     "FCFSScheduler",
     "SchedulerConfig",
     "QueueFullError",
+    "DeadlineExpiredError",
     "bucket_for",
     "prefill_buckets",
     "SlotPool",
@@ -50,5 +70,6 @@ __all__ = [
     "FINISH_CANCELLED",
     "FINISH_TIMEOUT",
     "FINISH_REJECTED",
+    "FINISH_ERROR",
     "FINISH_REASONS",
 ]

@@ -35,6 +35,18 @@ Architecture (docs/serving.md has the full walkthrough):
   JSONL record plus latency/occupancy histograms into an attached
   :class:`~apex_tpu.observability.MetricsRegistry` (rendered by
   ``python -m apex_tpu.monitor``).
+- **Decode-output integrity**: the jitted decode step also returns a
+  per-slot ``isfinite(logits)`` flag (one cheap in-jit reduction —
+  resilience's off-critical-path watchdog idea applied per slot). A row
+  with non-finite logits or an out-of-vocab token is **quarantined**:
+  its request retires with ``finish_reason="error"``, its KV row is
+  scrubbed and the slot released — co-tenant rows keep serving,
+  unperturbed (rows are independent through the vmap'd flat-cache
+  attention, so one poisoned row cannot contaminate the others).
+  Tick-level failures (decode/prefill exceptions, hung ticks) and
+  admission control under overload are the
+  :class:`~apex_tpu.serving.supervisor.EngineSupervisor`'s job —
+  docs/serving.md#robustness has the full fault model.
 """
 
 from __future__ import annotations
@@ -60,6 +72,7 @@ from apex_tpu.observability import MetricsRegistry
 from apex_tpu.serving.request import (
     FINISH_CANCELLED,
     FINISH_EOS,
+    FINISH_ERROR,
     FINISH_LENGTH,
     FINISH_REJECTED,
     FINISH_TIMEOUT,
@@ -67,6 +80,7 @@ from apex_tpu.serving.request import (
     RequestResult,
 )
 from apex_tpu.serving.scheduler import (
+    DeadlineExpiredError,
     FCFSScheduler,
     QueueFullError,
     SchedulerConfig,
@@ -85,7 +99,8 @@ _LOG = get_logger(__name__)
 #: against the per-request records key-for-key
 _COUNTERS = ("requests_submitted", "requests_eos", "requests_length",
              "requests_cancelled", "requests_timeout", "requests_rejected",
-             "prefills", "decode_steps", "tokens_generated")
+             "requests_error", "prefills", "decode_steps",
+             "tokens_generated", "slots_quarantined")
 
 
 @dataclass
@@ -168,9 +183,15 @@ class InferenceEngine:
     """
 
     def __init__(self, model, params, config: Optional[EngineConfig] = None,
-                 *, metrics: Optional[MetricsRegistry] = None):
+                 *, metrics: Optional[MetricsRegistry] = None,
+                 faults=None):
         self.model = model
         self.config = config or EngineConfig()
+        #: optional ServingFaultInjector (apex_tpu.testing_faults) — hook
+        #: points are host-side on purpose: injected faults must never
+        #: retrace the compiled decode step
+        self._faults = faults
+        self._closed = False
         c = model.config
         if (c.position_embedding_type == "learned"
                 and self.config.max_len > c.max_position_embeddings):
@@ -212,7 +233,17 @@ class InferenceEngine:
             logits, caches = decode_step(model, params, caches, tokens,
                                          positions)
             nxt = _sample_tokens(logits, temps, topks, seeds, positions + 1)
-            return nxt, caches
+            # per-slot integrity flag: one cheap in-jit reduction so the
+            # host can quarantine a poisoned row without fetching logits
+            finite = jnp.all(jnp.isfinite(logits), axis=-1)
+            return nxt, finite, caches
+
+        def _scrub(caches, slot):
+            # zero one slot's KV rows across every layer — quarantine
+            # hygiene, so a poisoned row's NaNs can never reach a future
+            # occupant even through a masked-weight * NaN-value product
+            return [(k.at[slot].set(0.0), v.at[slot].set(0.0))
+                    for k, v in caches]
 
         def _prefill(params, caches, prompt, slot, prompt_len,
                      temp, topk, seed):
@@ -245,6 +276,8 @@ class InferenceEngine:
             jax.jit(_prefill, donate_argnums=donate_args),
             budget=None, expected_compiles=len(self.buckets),
             name="serving_prefill", metrics=self.metrics)
+        self._scrub_fn = jax.jit(
+            _scrub, donate_argnums=(0,) if donate else ())
 
     # -- introspection ----------------------------------------------------
 
@@ -259,6 +292,12 @@ class InferenceEngine:
         return self._prefill_fn.compiles
 
     @property
+    def decode_compiles(self) -> int:
+        """Decode-step compilations (warmup included) — the supervisor
+        exempts compile ticks from its hung-tick wall-clock budget."""
+        return self._decode_fn.compiles
+
+    @property
     def active_count(self) -> int:
         return self.slots.active_count
 
@@ -266,14 +305,31 @@ class InferenceEngine:
     def queued_count(self) -> int:
         return self.scheduler.depth
 
+    def inflight(self) -> List:
+        """Snapshot of active (admitted, non-terminal) requests as
+        ``(request, generated_tokens, submit_ts)`` tuples in slot order —
+        what the supervisor re-prefills after an engine restart."""
+        return [(rec.request, list(rec.tokens), rec.submit_ts)
+                for _, rec in sorted(self._active.items())]
+
     # -- request lifecycle ------------------------------------------------
 
-    def submit(self, request: Request) -> int:
+    def submit(self, request: Request, *, resubmission: bool = False) -> int:
         """Enqueue; returns the request id. Raises
         :class:`~apex_tpu.serving.scheduler.QueueFullError` when the
-        bounded queue is full (the rejection is also recorded: counter,
-        ``request_rejected`` event, and a terminal ``kind="request"``
-        record with ``finish_reason="rejected"``)."""
+        bounded queue is full, and
+        :class:`~apex_tpu.serving.scheduler.DeadlineExpiredError` when
+        the request's deadline already elapsed (stale ``arrival_ts``) —
+        both rejections are also recorded: counter, ``request_rejected``
+        event (with a ``reason``), and a terminal ``kind="request"``
+        record with ``finish_reason="rejected"``.
+
+        ``resubmission=True`` is the supervisor's restart-continuation
+        path: the request was already counted at its ORIGINAL submit, so
+        ``requests_submitted`` is not incremented again (one arrival ==
+        one count == one terminal record)."""
+        if self._closed:
+            raise RuntimeError("engine is closed")
         if request.request_id in self.completed:
             raise ValueError(
                 f"request id {request.request_id} already completed")
@@ -283,12 +339,17 @@ class InferenceEngine:
                 f"({request.max_new_tokens}) exceeds the engine's max_len "
                 f"({self.config.max_len})")
         now = time.monotonic()
-        self.metrics.inc("requests_submitted")
+        if not resubmission:
+            self.metrics.inc("requests_submitted")
         try:
             self.scheduler.submit(request, now)
         except QueueFullError:
             self._finish(request, [], FINISH_REJECTED, submit_ts=now,
-                         now=now)
+                         now=now, detail="queue_full")
+            raise
+        except DeadlineExpiredError:
+            self._finish(request, [], FINISH_REJECTED, submit_ts=now,
+                         now=now, detail="deadline_expired")
             raise
         return request.request_id
 
@@ -314,6 +375,8 @@ class InferenceEngine:
         admit+prefill FCFS (decode-starvation capped), then one batched
         decode step over all active slots. Returns the requests that
         reached a terminal state during this tick."""
+        if self._closed:
+            raise RuntimeError("engine is closed")
         finished: List[RequestResult] = []
         now = time.monotonic()
         self._expire(now, finished)
@@ -356,9 +419,23 @@ class InferenceEngine:
         return [self.completed[i] for i in ids if i in self.completed]
 
     def close(self) -> None:
-        """Flush the metrics registry (final counter snapshot — what the
-        monitor report reconciles against the request records)."""
+        """Release every slot and flush the metrics registry (final
+        counter snapshot — what the monitor report reconciles against
+        the request records). Idempotent: a second ``close()`` is a
+        no-op, so exception paths can close unconditionally."""
+        if self._closed:
+            return
+        self._closed = True
+        self._active.clear()
+        self.slots.reset()
         self.metrics.flush()
+
+    def __enter__(self) -> "InferenceEngine":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.close()
+        return False
 
     # -- tick phases ------------------------------------------------------
 
@@ -395,13 +472,22 @@ class InferenceEngine:
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :request.prompt_len] = request.prompt
         sp = request.sampling
-        first, self._caches = self._prefill_fn(
-            self._params, self._caches, jnp.asarray(padded),
-            jnp.int32(slot), jnp.int32(request.prompt_len),
-            jnp.float32(sp.temperature),
-            jnp.int32(sp.top_k if sp.top_k is not None else self._vocab),
-            jnp.int32(sp.seed))
-        first = int(np.asarray(first))
+        try:
+            if self._faults is not None:
+                self._faults.before_prefill()
+            first, self._caches = self._prefill_fn(
+                self._params, self._caches, jnp.asarray(padded),
+                jnp.int32(slot), jnp.int32(request.prompt_len),
+                jnp.float32(sp.temperature),
+                jnp.int32(sp.top_k if sp.top_k is not None else self._vocab),
+                jnp.int32(sp.seed))
+            first = int(np.asarray(first))
+        except Exception:
+            # keep the pool invariant even as the failure propagates: the
+            # slot never held committed state (nothing scattered, or the
+            # scatter's result was discarded with the raised call)
+            self.slots.release(slot)
+            raise
         rec.prefill_end = time.monotonic()
         rec.tokens.append(first)
         rec.last_token = first
@@ -418,28 +504,57 @@ class InferenceEngine:
     def _decode_tick(self, finished: List[RequestResult]) -> None:
         if not self._active:
             return
-        nxt, self._caches = self._decode_fn(
+        if self._faults is not None:
+            self._faults.before_decode()
+        nxt, finite, self._caches = self._decode_fn(
             self._params, self._caches,
             jnp.asarray(self._tokens_h), jnp.asarray(self._positions_h),
             jnp.asarray(self._temps_h), jnp.asarray(self._topks_h),
             jnp.asarray(self._seeds_h))
         nxt = np.asarray(nxt)
+        finite = np.asarray(finite)
+        if self._faults is not None:
+            nxt, finite = self._faults.corrupt_decode(nxt, finite)
         self.metrics.inc("decode_steps")
-        self.metrics.inc("tokens_generated", len(self._active))
         self.metrics.observe("decode_batch_size", len(self._active))
         now = time.monotonic()
         for slot in sorted(self._active):
             rec = self._active[slot]
-            rec.position += 1            # last_token's K/V are now cached
             token = int(nxt[slot])
+            # integrity check, off the critical path: non-finite logits
+            # or an out-of-vocab token mean THIS row is poisoned —
+            # quarantine it alone, co-tenant rows keep their clean step
+            if not bool(finite[slot]) or not 0 <= token < self._vocab:
+                cause = ("nonfinite_logits" if not bool(finite[slot])
+                         else "out_of_vocab_token")
+                finished.append(self._quarantine(rec, cause, now))
+                continue
+            rec.position += 1            # last_token's K/V are now cached
             rec.tokens.append(token)
             rec.last_token = token
+            self.metrics.inc("tokens_generated")
             self._sync_slot(rec)
             done = self._finish_reason(rec, token)
             if done is not None:
                 finished.append(self._retire(rec, done, now))
 
     # -- retirement & bookkeeping ----------------------------------------
+
+    def _quarantine(self, rec: _Active, cause: str,
+                    now: float) -> RequestResult:
+        """Retire ONE poisoned slot and keep the batch serving: scrub the
+        row's KV (NaNs must not outlive the occupant — a masked attention
+        weight times a NaN value is still NaN), release the slot, and
+        finish the request with ``finish_reason="error"`` — co-tenants
+        are untouched and the decode program never retraces."""
+        slot = rec.slot
+        self._caches = self._scrub_fn(self._caches, jnp.int32(slot))
+        self.metrics.inc("slots_quarantined")
+        log_event(_LOG, "slot_quarantined", slot=slot,
+                  request_id=rec.request.request_id, cause=cause)
+        self.metrics.event("slot_quarantined", slot=slot,
+                           request_id=rec.request.request_id, cause=cause)
+        return self._retire(rec, FINISH_ERROR, now)
 
     def _finish_reason(self, rec: _Active, token: int) -> Optional[str]:
         if rec.request.eos_token is not None and \
@@ -477,7 +592,8 @@ class InferenceEngine:
 
     def _finish(self, request: Request, tokens: List[int], reason: str, *,
                 submit_ts: float, now: float, prefill_start: float = 0.0,
-                prefill_end: float = 0.0) -> RequestResult:
+                prefill_end: float = 0.0,
+                detail: Optional[str] = None) -> RequestResult:
         if prefill_start:
             queue_s = prefill_start - submit_ts
             prefill_s = prefill_end - prefill_start
@@ -500,13 +616,15 @@ class InferenceEngine:
         if tps is not None:
             self.metrics.observe("request_tokens_per_s", tps)
         self.metrics.emit_record(result.record(wall=time.time()))
-        if reason in (FINISH_REJECTED, FINISH_TIMEOUT, FINISH_CANCELLED):
+        if reason in (FINISH_REJECTED, FINISH_TIMEOUT, FINISH_CANCELLED,
+                      FINISH_ERROR):
+            extra = {"reason": detail} if detail else {}
             log_event(_LOG, f"request_{reason}",
                       request_id=request.request_id,
                       prompt_len=request.prompt_len,
                       new_tokens=result.new_tokens,
-                      total_s=result.total_s)
+                      total_s=result.total_s, **extra)
             self.metrics.event(f"request_{reason}",
                                request_id=request.request_id,
-                               new_tokens=result.new_tokens)
+                               new_tokens=result.new_tokens, **extra)
         return result

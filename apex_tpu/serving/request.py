@@ -21,16 +21,19 @@ from typing import List, Optional, Sequence
 
 __all__ = ["SamplingParams", "Request", "RequestResult",
            "FINISH_EOS", "FINISH_LENGTH", "FINISH_CANCELLED",
-           "FINISH_TIMEOUT", "FINISH_REJECTED", "FINISH_REASONS"]
+           "FINISH_TIMEOUT", "FINISH_REJECTED", "FINISH_ERROR",
+           "FINISH_REASONS"]
 
 #: terminal outcomes a request can reach (RequestResult.finish_reason)
 FINISH_EOS = "eos"              # emitted its eos_token
 FINISH_LENGTH = "length"        # hit max_new_tokens
 FINISH_CANCELLED = "cancelled"  # cancel() — queued or mid-decode
 FINISH_TIMEOUT = "timeout"      # deadline_s elapsed — queued or mid-decode
-FINISH_REJECTED = "rejected"    # bounded queue was full at submit()
+FINISH_REJECTED = "rejected"    # queue full / expired deadline / shed at submit
+FINISH_ERROR = "error"          # engine fault: quarantined slot or retry
+#                                 budget exhausted — never silently lost
 FINISH_REASONS = (FINISH_EOS, FINISH_LENGTH, FINISH_CANCELLED,
-                  FINISH_TIMEOUT, FINISH_REJECTED)
+                  FINISH_TIMEOUT, FINISH_REJECTED, FINISH_ERROR)
 
 _REQUEST_IDS = itertools.count()
 
@@ -66,6 +69,13 @@ class Request:
     ``timeout`` — queued requests never silently rot behind a long
     backlog. ``request_id`` is assigned process-wide; pass an explicit id
     to correlate with an external system.
+
+    ``arrival_ts`` is an optional ``time.monotonic()`` stamp of when the
+    request entered the wider system (an API gateway, a prior engine
+    incarnation). When set, ``deadline_s`` counts from it instead of
+    from ``submit()`` — so a request that spent its whole budget in
+    transit fast-fails at admission, and the supervisor's restart
+    continuations keep honoring the ORIGINAL deadline.
     """
 
     prompt: Sequence[int]
@@ -74,6 +84,7 @@ class Request:
     eos_token: Optional[int] = None
     deadline_s: Optional[float] = None
     request_id: int = field(default_factory=lambda: next(_REQUEST_IDS))
+    arrival_ts: Optional[float] = None
 
     def __post_init__(self):
         self.prompt = [int(t) for t in self.prompt]

@@ -32,12 +32,18 @@ from typing import Callable, Deque, List, Optional, Tuple
 
 from apex_tpu.serving.request import Request
 
-__all__ = ["QueueFullError", "SchedulerConfig", "FCFSScheduler",
-           "prefill_buckets", "bucket_for"]
+__all__ = ["QueueFullError", "DeadlineExpiredError", "SchedulerConfig",
+           "FCFSScheduler", "prefill_buckets", "bucket_for"]
 
 
 class QueueFullError(RuntimeError):
     """The bounded admission queue is full — shed load upstream."""
+
+
+class DeadlineExpiredError(RuntimeError):
+    """The request's deadline had already elapsed at submit time (its
+    ``arrival_ts`` is older than ``deadline_s``) — fast-fail instead of
+    queuing work that can only ever finish as a timeout."""
 
 
 def prefill_buckets(max_len: int) -> Tuple[int, ...]:
@@ -112,12 +118,28 @@ class FCFSScheduler:
         return len(self._queue)
 
     def submit(self, request: Request, now: float) -> None:
+        # deadline fast-fail: a request whose budget elapsed before it
+        # reached the queue (stale arrival_ts) can only ever time out —
+        # reject it at the edge instead of letting it rot in line
+        start = request.arrival_ts if request.arrival_ts is not None \
+            else now
+        if request.deadline_s is not None and \
+                now - start > request.deadline_s:
+            raise DeadlineExpiredError(
+                f"request {request.request_id} deadline "
+                f"({request.deadline_s}s) already elapsed "
+                f"{now - start - request.deadline_s:.3f}s before submit")
         if len(self._queue) >= self.config.max_queue:
             raise QueueFullError(
                 f"admission queue full ({self.config.max_queue}); "
                 f"request {request.request_id} rejected — retry with "
                 f"backoff or raise SchedulerConfig.max_queue")
-        self._queue.append(_Queued(request, now))
+        self._queue.append(_Queued(request, start))
+
+    def snapshot(self) -> List[Tuple[Request, float]]:
+        """Queued (request, submit_ts) pairs in FCFS order, non-popping —
+        the supervisor's restart path uses this to requeue survivors."""
+        return [(q.request, q.submit_ts) for q in self._queue]
 
     def cancel(self, request_id: int) -> Optional[Tuple[Request, float]]:
         """Remove a still-queued request; (request, submit_ts) or None."""

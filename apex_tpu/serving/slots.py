@@ -59,6 +59,15 @@ class SlotPool:
         self._active.remove(slot)
         heapq.heappush(self._free, slot)
 
+    def reset(self) -> None:
+        """Return EVERY slot to the free list — the supervisor's engine
+        rebuild / ``close()`` path, where all in-flight occupants are
+        being retired at once. Re-asserts the no-leak invariant after
+        the rebuild; safe to call on an already-clean pool."""
+        self._free = list(range(self.capacity))
+        self._active.clear()
+        self.check()
+
     def check(self) -> None:
         """Assert the no-leak invariant; raises :class:`SlotError`."""
         if len(self._free) + len(self._active) != self.capacity or \

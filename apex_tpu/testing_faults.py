@@ -22,19 +22,30 @@ Fault schedules key on the injector's own **call counter** (one tick per
 train-step invocation), not on the training-state step number: after a
 rollback the re-run of the same state steps proceeds clean, modelling
 transient faults — a schedule keyed on state steps would re-trip forever.
+
+:class:`ServingFaultInjector` is the serving-side sibling, driving every
+recovery path of :class:`apex_tpu.serving.EngineSupervisor` and the
+engine's slot quarantine deterministically: poisoned decode output on
+slot N at decode call M, decode/prefill exceptions, hung ticks. The same
+transient-fault convention holds — counters are the INJECTOR's and keep
+advancing across engine rebuilds, so a schedule fires once and the
+restarted engine proceeds clean.
 """
 
 from __future__ import annotations
 
 import os
+import time
 from dataclasses import dataclass
-from typing import Any, Dict, Iterable, Optional
+from typing import Any, Dict, Iterable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 __all__ = ["FaultInjector", "StepFaults", "poison_batch",
-           "corrupt_checkpoint"]
+           "corrupt_checkpoint", "InjectedEngineFault",
+           "ServingFaultInjector"]
 
 
 @dataclass
@@ -120,3 +131,95 @@ class FaultInjector:
             raise IOError(
                 f"injected checkpoint write failure at step {step} "
                 f"({remaining - 1} failures remaining)")
+
+
+class InjectedEngineFault(RuntimeError):
+    """Deterministic serving-path fault raised by
+    :class:`ServingFaultInjector` — the stand-in for a real decode/prefill
+    blow-up (XLA error, device OOM, lost collective)."""
+
+
+class ServingFaultInjector:
+    """Scripted serving faults for ``InferenceEngine``/``EngineSupervisor``.
+
+    Pass one as ``faults=`` to either; the engine calls the three hooks
+    from fixed host-side points. All injection is deliberately OFF the
+    compiled path — a fault must never retrace the decode program, and a
+    restarted engine re-running the same positions proceeds clean because
+    the schedule keys on the injector's own monotonically-advancing call
+    counters (mirroring :class:`FaultInjector`'s transient-fault
+    convention).
+
+    Args:
+      poison_decode: ``{decode_call: (slot, kind)}`` — corrupt the decode
+        OUTPUT for one slot after the jitted step returns. ``kind``
+        ``"nonfinite"`` clears the slot's in-jit ``isfinite`` flag (what
+        NaN logits look like to the host); ``"oov"`` replaces the sampled
+        token with an out-of-vocab id. Both drive the engine's
+        quarantine path.
+      decode_raise_calls: decode call indices that raise
+        :class:`InjectedEngineFault` before the step runs.
+      prefill_raise_calls: prefill call indices that raise likewise.
+      decode_hang: ``{decode_call: seconds}`` — sleep before the step,
+        simulating a hung tick for the supervisor's wall-clock budget.
+    """
+
+    def __init__(self, *,
+                 poison_decode: Optional[Dict[int, Tuple[int, str]]] = None,
+                 decode_raise_calls: Iterable[int] = (),
+                 prefill_raise_calls: Iterable[int] = (),
+                 decode_hang: Optional[Dict[int, float]] = None):
+        self.poison_decode = dict(poison_decode or {})
+        for call, (_, kind) in self.poison_decode.items():
+            if kind not in ("nonfinite", "oov"):
+                raise ValueError(
+                    f"poison_decode[{call}] kind must be 'nonfinite' or "
+                    f"'oov', got {kind!r}")
+        self.decode_raise_calls = frozenset(
+            int(c) for c in decode_raise_calls)
+        self.prefill_raise_calls = frozenset(
+            int(c) for c in prefill_raise_calls)
+        self.decode_hang = dict(decode_hang or {})
+        self.decode_calls = 0
+        self.prefill_calls = 0
+        self.log = []   # what actually fired, in order, for tests
+
+    # -- engine hook points ------------------------------------------------
+    def before_decode(self) -> None:
+        """Called right before the jitted decode step; may sleep (hung
+        tick) or raise (decode failure)."""
+        call = self.decode_calls
+        self.decode_calls += 1
+        hang = self.decode_hang.get(call)
+        if hang:
+            self.log.append(("hang", call, hang))
+            time.sleep(hang)
+        if call in self.decode_raise_calls:
+            self.log.append(("decode_raise", call))
+            raise InjectedEngineFault(
+                f"injected decode failure at decode call {call}")
+
+    def corrupt_decode(self, tokens: np.ndarray, finite: np.ndarray
+                       ) -> Tuple[np.ndarray, np.ndarray]:
+        """Called with the decode step's host-side outputs; returns the
+        (possibly corrupted) pair the engine's integrity check consumes."""
+        spec = self.poison_decode.get(self.decode_calls - 1)
+        if spec is not None:
+            slot, kind = spec
+            tokens = np.array(tokens)    # device views are read-only
+            finite = np.array(finite)
+            if kind == "nonfinite":
+                finite[slot] = False
+            else:
+                tokens[slot] = -1        # out-of-vocab sentinel
+            self.log.append(("poison", self.decode_calls - 1, slot, kind))
+        return tokens, finite
+
+    def before_prefill(self) -> None:
+        """Called right before the jitted prefill; may raise."""
+        call = self.prefill_calls
+        self.prefill_calls += 1
+        if call in self.prefill_raise_calls:
+            self.log.append(("prefill_raise", call))
+            raise InjectedEngineFault(
+                f"injected prefill failure at prefill call {call}")

@@ -1,13 +1,20 @@
-"""Continuous-batching serving demo — mixed traffic, one engine.
+"""Continuous-batching serving demo — mixed traffic, faults, recovery.
 
-Drives :class:`apex_tpu.serving.InferenceEngine` (docs/serving.md) with
-requests of very different shapes — short greedy, long sampled, a
+Act 1 drives :class:`apex_tpu.serving.InferenceEngine` (docs/serving.md)
+with requests of very different shapes — short greedy, long sampled, a
 deadline-bounded request, and a fault-injected mid-flight cancellation —
 while a JSONL metrics registry records one ``kind="request"`` row per
-terminal request. Ends by rendering the run report (the same page
-``python -m apex_tpu.monitor serving.jsonl`` prints) and verifying the
-engine's two structural invariants: token-exact greedy agreement with
-per-request ``generate()`` and a decode step that never retraced.
+terminal request, and verifies the engine's two structural invariants:
+token-exact greedy agreement with per-request ``generate()`` and a
+decode step that never retraced.
+
+Act 2 is the robustness demo (docs/serving.md#robustness): an injected
+decode exception CRASHES the engine mid-flight; the
+:class:`~apex_tpu.serving.EngineSupervisor` rebuilds it and re-prefills
+every in-flight request from prompt + tokens already generated — the
+final outputs are token-exact as if nothing had happened, and the run
+report's incident timeline shows the restart/recovery events
+reconciling with the registry counters.
 
 Run (from the repo root): PYTHONPATH=. python examples/serve.py
 """
@@ -21,14 +28,20 @@ import numpy as np
 
 from apex_tpu.models import GPTModel, TransformerConfig, generate
 from apex_tpu.observability import JsonlSink, MetricsRegistry
-from apex_tpu.observability.report import build_report, render_report
+from apex_tpu.observability.report import (
+    SERVING_INCIDENT_COUNTERS,
+    build_report,
+    render_report,
+)
 from apex_tpu.serving import (
     EngineConfig,
+    EngineSupervisor,
     InferenceEngine,
     Request,
     SamplingParams,
     SchedulerConfig,
 )
+from apex_tpu.testing_faults import ServingFaultInjector
 
 
 def main():
@@ -94,8 +107,38 @@ def main():
           f"{engine.decode_retraces}; prefill compiles: "
           f"{engine.prefill_compiles} (buckets: {engine.buckets})")
 
+    # ---- act 2: engine crash + supervised recovery ----------------------
+    print("\n=== act 2: injected engine crash, supervised recovery ===")
+    crash_reqs = [Request(prompt=prompts[0], max_new_tokens=16),
+                  Request(prompt=prompts[2], max_new_tokens=24)]
+    # decode call 3 raises inside the jitted step — with a bare engine
+    # this would kill every in-flight request and leak the slots
+    injector = ServingFaultInjector(decode_raise_calls={3})
+    supervisor = EngineSupervisor(
+        model, params, EngineConfig(max_slots=4, max_len=128),
+        metrics=registry, faults=injector)
+    with supervisor:
+        recovered = supervisor.serve(crash_reqs)
+    assert supervisor.restarts == 1
+    for req, res in zip(crash_reqs, recovered):
+        ref = generate(model, params, jnp.asarray([req.prompt], jnp.int32),
+                       req.max_new_tokens, max_len=128)
+        assert res.tokens == np.asarray(
+            ref[0, req.prompt_len:]).tolist(), req.request_id
+        print(f"request {req.request_id}: {res.finish_reason}, "
+              f"{res.new_tokens} tokens — token-exact across the restart")
+    counters = registry.counters()
+    print(f"engine_restarts={counters['engine_restarts']} "
+          f"requests_recovered={counters['requests_recovered']} "
+          f"tick_failures={counters['tick_failures']}")
+
     print(f"\n=== run report ({log_path}) ===")
-    print(render_report(build_report(log_path)))
+    report = build_report(log_path)
+    print(render_report(report))
+    # incident counts reconcile key-for-key with the registry counters
+    inc = report["serving_incidents"]
+    for event, counter in SERVING_INCIDENT_COUNTERS.items():
+        assert inc["counts"].get(event, 0) == report["counters"][counter]
 
 
 if __name__ == "__main__":
