@@ -1,0 +1,52 @@
+"""apex_tpu.loadtest — scenario-driven load testing and the SLO gate.
+
+The measurement leg that closes the serving loop: PR 4 built the
+continuous-batching engine, PR 5 made it survive faults, and this
+package makes both claims *numbers* — a declarative
+:class:`Scenario` (traffic phases, mixes, deadlines, fault schedule,
+declared SLOs) is materialized by a seeded open-loop
+:class:`TrafficGenerator`, replayed by :func:`run_scenario` against the
+engine-under-:class:`~apex_tpu.serving.EngineSupervisor`, scored by
+:mod:`apex_tpu.observability.slo`, and gated against a committed
+baseline (:mod:`~apex_tpu.loadtest.gate`).
+
+CLI: ``python -m apex_tpu.loadtest scenario.json`` runs and prints the
+verdict; ``--check`` turns it into a regression gate (nonzero exit on
+SLO violation or baseline regression); ``--from-log`` re-scores an
+existing run log without running anything. See docs/loadtest.md.
+"""
+
+from apex_tpu.loadtest.gate import (
+    DEFAULT_BASELINE,
+    Regression,
+    compare_to_baseline,
+    load_baseline,
+    update_baseline,
+)
+from apex_tpu.loadtest.generator import ScheduledRequest, TrafficGenerator
+from apex_tpu.loadtest.runner import ScenarioRun, build_model, run_scenario
+from apex_tpu.loadtest.scenario import (
+    EngineKnobs,
+    FaultSchedule,
+    LoadPhase,
+    ModelSpec,
+    Scenario,
+)
+
+__all__ = [
+    "Scenario",
+    "LoadPhase",
+    "ModelSpec",
+    "EngineKnobs",
+    "FaultSchedule",
+    "TrafficGenerator",
+    "ScheduledRequest",
+    "ScenarioRun",
+    "build_model",
+    "run_scenario",
+    "DEFAULT_BASELINE",
+    "Regression",
+    "load_baseline",
+    "update_baseline",
+    "compare_to_baseline",
+]

@@ -1,0 +1,222 @@
+"""Scenario execution: generator -> supervised engine -> JSONL -> SLOs.
+
+:func:`run_scenario` is the harness's engine room. It materializes the
+scenario's arrival schedule (:mod:`~apex_tpu.loadtest.generator`),
+builds the model under test, wraps an
+:class:`~apex_tpu.serving.InferenceEngine` in an
+:class:`~apex_tpu.serving.EngineSupervisor` (with the scenario's fault
+schedule driving a :class:`~apex_tpu.testing_faults.\
+ServingFaultInjector`), and replays the schedule **open-loop** against
+wall clock: a request is submitted the moment its arrival time passes,
+whether or not the engine kept up — queue growth, shedding, and
+deadline misses are the signal, not an error.
+
+Everything observable flows through one
+:class:`~apex_tpu.observability.MetricsRegistry`: the scenario record
+(name, seed, declared SLOs — so the log scores itself in
+``python -m apex_tpu.monitor``), every ``kind="request"`` row and
+incident event the serving tier already emits, and the final counter
+snapshot. The returned :class:`ScenarioRun` carries the in-memory
+record stream plus the scored :class:`~apex_tpu.observability.slo.\
+SLOReport`, and the same records land in ``log_path`` when given.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from apex_tpu.loadtest.generator import ScheduledRequest, TrafficGenerator
+from apex_tpu.loadtest.scenario import ModelSpec, Scenario
+from apex_tpu.observability import (
+    InMemorySink,
+    JsonlSink,
+    MetricsRegistry,
+)
+from apex_tpu.observability.slo import (
+    SLOReport,
+    SLOSpec,
+    evaluate_slos,
+    measure_slo_metrics,
+)
+from apex_tpu.serving import (
+    DeadlineExpiredError,
+    EngineConfig,
+    EngineSupervisor,
+    EngineUnavailableError,
+    QueueFullError,
+    RequestResult,
+    SchedulerConfig,
+    SupervisorConfig,
+)
+from apex_tpu.utils.logging import get_logger, log_event
+
+__all__ = ["ScenarioRun", "build_model", "run_scenario"]
+
+_LOG = get_logger(__name__)
+
+#: while no arrival is due and nothing is in flight, sleep at most this
+#: long per wait slice (keeps the loop responsive to the next arrival
+#: without busy-spinning)
+_IDLE_SLEEP_S = 0.005
+
+
+def build_model(spec: ModelSpec):
+    """Build the (seeded) model under test from its scenario spec —
+    same construction the serving tests use, so a scenario's weights are
+    reproducible across runs and machines."""
+    import jax  # deferred: scenario loading/scoring stays jax-free
+
+    from apex_tpu.models import GPTModel, TransformerConfig
+
+    model = GPTModel(TransformerConfig(
+        num_layers=spec.num_layers, hidden_size=spec.hidden_size,
+        num_attention_heads=spec.num_attention_heads,
+        vocab_size=spec.vocab_size,
+        max_position_embeddings=spec.max_position_embeddings,
+        hidden_dropout=0.0, attention_dropout=0.0))
+    params = model.init(jax.random.PRNGKey(spec.param_seed))
+    return model, params
+
+
+@dataclass
+class ScenarioRun:
+    """Everything one scenario execution produced."""
+
+    scenario: Scenario
+    schedule: List[ScheduledRequest]
+    results: Dict[int, RequestResult]     # request_id -> terminal result
+    records: List[dict]                   # the full JSONL record stream
+    counters: Dict[str, int]
+    wall_s: float
+    aborted: bool = False                 # hit the max_wall_s guard
+    slo: Optional[SLOReport] = None
+    log_path: Optional[str] = None
+    ticks: int = 0
+    engine_restarts: int = 0
+    submitted: int = 0                    # arrivals actually offered
+    metrics_by_name: Dict[str, Optional[float]] = field(
+        default_factory=dict)
+
+    @property
+    def ok(self) -> bool:
+        """SLO verdict (vacuously true when no SLOs are declared)."""
+        return self.slo.ok if self.slo is not None else True
+
+
+def _build_supervisor(scenario: Scenario, model, params,
+                      metrics: MetricsRegistry) -> EngineSupervisor:
+    from apex_tpu.testing_faults import ServingFaultInjector
+
+    knobs = scenario.engine
+    engine_cfg = EngineConfig(
+        max_slots=knobs.max_slots, max_len=knobs.max_len,
+        scheduler=SchedulerConfig(
+            max_queue=knobs.max_queue,
+            max_prefills_per_tick=knobs.max_prefills_per_tick))
+    sup_cfg = SupervisorConfig(**scenario.supervisor)
+    faults = None
+    if not scenario.faults.empty:
+        faults = ServingFaultInjector(**scenario.faults.injector_kwargs())
+    return EngineSupervisor(model, params, engine_cfg,
+                            supervisor=sup_cfg, metrics=metrics,
+                            faults=faults)
+
+
+def run_scenario(scenario: Scenario, *, model=None, params=None,
+                 metrics: Optional[MetricsRegistry] = None,
+                 log_path: Optional[str] = None) -> ScenarioRun:
+    """Execute ``scenario`` and score it against its declared SLOs.
+
+    ``model``/``params`` default to :func:`build_model` of the
+    scenario's model spec (pass them to reuse an already-built model,
+    e.g. a test fixture). ``metrics`` defaults to a fresh registry;
+    ``log_path`` attaches a JSONL sink so the run is
+    ``python -m apex_tpu.monitor``-able afterwards. An
+    :class:`~apex_tpu.observability.InMemorySink` is always attached:
+    the SLO verdict is computed from the very records the sinks saw.
+    """
+    if (model is None) != (params is None):
+        raise ValueError("pass both model and params, or neither")
+    if model is None:
+        model, params = build_model(scenario.model)
+    registry = metrics if metrics is not None else MetricsRegistry()
+    mem = InMemorySink()
+    registry.add_sink(mem)
+    if log_path is not None:
+        registry.add_sink(JsonlSink(log_path))
+    # the log's self-description: name + seed for provenance, the SLO
+    # spec so the monitor (and --from-log re-scoring) can render a
+    # verdict without the scenario file at hand
+    registry.emit_record({
+        "kind": "scenario", "name": scenario.name, "seed": scenario.seed,
+        "total_requests": scenario.total_requests,
+        "slo": dict(scenario.slo), "wall": time.time()})
+
+    schedule = TrafficGenerator(scenario).schedule()
+    sup = _build_supervisor(scenario, model, params, registry)
+    run = ScenarioRun(scenario=scenario, schedule=schedule, results={},
+                      records=mem.records, counters={}, wall_s=0.0,
+                      log_path=log_path)
+    t0 = time.monotonic()
+    i = 0
+    try:
+        while i < len(schedule) or sup.inflight_count:
+            now = time.monotonic() - t0
+            if now > scenario.max_wall_s:
+                run.aborted = True
+                _abort(sup, scenario, registry, now)
+                break
+            while i < len(schedule) and schedule[i].at_s <= now:
+                req = schedule[i].request
+                # open-loop contract: the deadline clock starts at the
+                # SCHEDULED arrival, not whenever the loop got to it
+                req.arrival_ts = t0 + schedule[i].at_s
+                i += 1
+                run.submitted += 1
+                try:
+                    sup.submit(req)
+                except (EngineUnavailableError, QueueFullError,
+                        DeadlineExpiredError):
+                    pass        # recorded terminally by the supervisor
+            if sup.inflight_count:
+                sup.tick()
+                run.ticks += 1
+            elif i < len(schedule):
+                gap = (t0 + schedule[i].at_s) - time.monotonic()
+                if gap > 0:
+                    time.sleep(min(gap, _IDLE_SLEEP_S))
+    finally:
+        run.wall_s = time.monotonic() - t0
+        sup.close()             # flushes the final counter snapshot
+    run.results = dict(sup.completed)
+    run.counters = registry.counters()
+    run.engine_restarts = sup.restarts
+    if scenario.slo:
+        run.slo = evaluate_slos(mem.records,
+                                SLOSpec.from_dict(scenario.slo))
+        run.metrics_by_name = dict(run.slo.metrics)
+    else:
+        run.metrics_by_name = measure_slo_metrics(mem.records)
+    return run
+
+
+def _abort(sup: EngineSupervisor, scenario: Scenario,
+           registry: MetricsRegistry, now_s: float) -> None:
+    """Wall-budget breach: cancel every non-terminal request (each still
+    reaches exactly one terminal record — conservation holds even for an
+    aborted run) and stamp the abort into the log."""
+    log_event(_LOG, "loadtest_aborted", scenario=scenario.name,
+              wall_s=now_s, budget_s=scenario.max_wall_s,
+              inflight=sup.inflight_count)
+    registry.event("loadtest_aborted", scenario=scenario.name,
+                   wall_s=now_s, budget_s=scenario.max_wall_s,
+                   inflight=sup.inflight_count)
+    for rid in sup.inflight_ids:
+        sup.cancel(rid)
+    # in-flight cancellations retire at the start of the next tick
+    guard = 0
+    while sup.inflight_count and guard < scenario.engine.max_slots + 2:
+        sup.tick()
+        guard += 1

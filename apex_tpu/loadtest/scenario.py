@@ -1,0 +1,406 @@
+"""Declarative load-test scenarios: traffic shape, faults, SLOs.
+
+A :class:`Scenario` is the unit the harness runs and the gate scores —
+one JSON file describing *everything* a capacity/perf measurement needs
+to be repeatable:
+
+- the **model under test** (:class:`ModelSpec` — tiny dims for CI smoke,
+  real dims on hardware; parameters are seeded so two runs serve the
+  same weights);
+- the **engine/supervisor sizing** (:class:`EngineKnobs` plus a
+  validated passthrough dict for
+  :class:`~apex_tpu.serving.SupervisorConfig`);
+- the **traffic**, as ordered :class:`LoadPhase` segments — each an
+  open-loop Poisson arrival process at its own rate with its own
+  prompt-length / output-length / deadline / sampling mixes, so a
+  scenario expresses warmup -> steady -> burst -> overload in one file;
+- an optional **fault schedule** (:class:`FaultSchedule`) that drives
+  :class:`~apex_tpu.testing_faults.ServingFaultInjector` — "inject an
+  engine crash at decode call M, measure recovery" as data, not code;
+- the declared **SLOs** (``{metric: threshold}`` over
+  :data:`apex_tpu.observability.slo.SLO_METRICS`) and the regression
+  ``tolerance`` the baseline gate applies.
+
+This module is stdlib-only (the generator additionally needs just
+:mod:`apex_tpu.serving.request`, which is host-side too): loading and
+validating a scenario, or re-scoring an existing run log with
+``python -m apex_tpu.loadtest --from-log``, runs no model code — jax
+enters only through :mod:`~apex_tpu.loadtest.runner` when a scenario
+actually executes.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+from apex_tpu.observability.slo import SLO_METRICS
+
+__all__ = ["ModelSpec", "EngineKnobs", "LoadPhase", "FaultSchedule",
+           "Scenario"]
+
+#: keys accepted in a scenario's ``"supervisor"`` section — mirrors the
+#: :class:`~apex_tpu.serving.SupervisorConfig` fields so a typo fails at
+#: scenario load, not deep in a run
+_SUPERVISOR_KEYS = frozenset({
+    "max_restarts_per_request", "max_engine_restarts", "breaker_threshold",
+    "breaker_cooldown_s", "hung_tick_s", "shed_deadlines",
+    "service_time_alpha"})
+
+
+def _weighted(data: Dict[Any, Any], what: str) -> Dict[int, float]:
+    """Normalize a ``{value: weight}`` mix (JSON keys arrive as strings)."""
+    if not data:
+        raise ValueError(f"{what} mix must be non-empty")
+    out: Dict[int, float] = {}
+    for key, weight in data.items():
+        value = int(key)
+        w = float(weight)
+        if value < 1:
+            raise ValueError(f"{what} values must be >= 1, got {value}")
+        if w <= 0:
+            raise ValueError(
+                f"{what} weight for {value} must be > 0, got {w}")
+        out[value] = w
+    return out
+
+
+@dataclass(frozen=True)
+class ModelSpec:
+    """The (seeded) model the scenario serves. Defaults are the tier-1
+    smoke size — the same tiny GPT the serving tests use."""
+
+    num_layers: int = 2
+    hidden_size: int = 32
+    num_attention_heads: int = 4
+    vocab_size: int = 64
+    max_position_embeddings: int = 64
+    param_seed: int = 0
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "ModelSpec":
+        return cls(**{k: int(v) for k, v in data.items()})
+
+    def to_dict(self) -> Dict[str, int]:
+        return {"num_layers": self.num_layers,
+                "hidden_size": self.hidden_size,
+                "num_attention_heads": self.num_attention_heads,
+                "vocab_size": self.vocab_size,
+                "max_position_embeddings": self.max_position_embeddings,
+                "param_seed": self.param_seed}
+
+
+@dataclass(frozen=True)
+class EngineKnobs:
+    """Engine/scheduler sizing — the subset of
+    :class:`~apex_tpu.serving.EngineConfig` /
+    :class:`~apex_tpu.serving.SchedulerConfig` a scenario varies."""
+
+    max_slots: int = 4
+    max_len: int = 64
+    max_queue: int = 64
+    max_prefills_per_tick: int = 1
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "EngineKnobs":
+        return cls(**{k: int(v) for k, v in data.items()})
+
+    def to_dict(self) -> Dict[str, int]:
+        return {"max_slots": self.max_slots, "max_len": self.max_len,
+                "max_queue": self.max_queue,
+                "max_prefills_per_tick": self.max_prefills_per_tick}
+
+
+@dataclass(frozen=True)
+class LoadPhase:
+    """One open-loop traffic segment.
+
+    ``n_requests`` arrivals are generated with exponential inter-arrival
+    gaps at ``rate_rps`` (a Poisson process — arrivals do NOT wait for
+    completions; overload is expressed by a rate the engine cannot
+    sustain). Prompt and output lengths draw from ``{value: weight}``
+    mixes; ``deadline_fraction`` of requests carry a deadline uniform in
+    ``[deadline_min_s, deadline_max_s]``; ``greedy_fraction`` decode
+    greedily, the rest sample at a drawn temperature/top-k (``top_ks``
+    entry ``0`` means untruncated).
+    """
+
+    name: str
+    n_requests: int
+    rate_rps: float
+    prompt_lens: Dict[int, float]
+    max_new_tokens: Dict[int, float]
+    deadline_fraction: float = 0.0
+    deadline_min_s: float = 1.0
+    deadline_max_s: float = 1.0
+    greedy_fraction: float = 1.0
+    temperatures: Tuple[float, ...] = (0.7,)
+    top_ks: Tuple[int, ...] = (0,)
+    eos_token: Optional[int] = None
+
+    def __post_init__(self):
+        if self.n_requests < 1:
+            raise ValueError(
+                f"phase {self.name!r}: n_requests must be >= 1, got "
+                f"{self.n_requests}")
+        if self.rate_rps <= 0:
+            raise ValueError(
+                f"phase {self.name!r}: rate_rps must be > 0, got "
+                f"{self.rate_rps}")
+        if not 0.0 <= self.deadline_fraction <= 1.0:
+            raise ValueError(
+                f"phase {self.name!r}: deadline_fraction must be in "
+                f"[0, 1], got {self.deadline_fraction}")
+        if self.deadline_fraction > 0 and not \
+                0 < self.deadline_min_s <= self.deadline_max_s:
+            raise ValueError(
+                f"phase {self.name!r}: need 0 < deadline_min_s <= "
+                f"deadline_max_s, got [{self.deadline_min_s}, "
+                f"{self.deadline_max_s}]")
+        if not 0.0 <= self.greedy_fraction <= 1.0:
+            raise ValueError(
+                f"phase {self.name!r}: greedy_fraction must be in [0, 1], "
+                f"got {self.greedy_fraction}")
+        if self.greedy_fraction < 1.0:
+            if not self.temperatures or \
+                    any(t <= 0 for t in self.temperatures):
+                raise ValueError(
+                    f"phase {self.name!r}: sampled traffic needs positive "
+                    f"temperatures, got {self.temperatures}")
+            if any(k < 0 for k in self.top_ks):
+                raise ValueError(
+                    f"phase {self.name!r}: top_ks must be >= 0 "
+                    f"(0 = untruncated), got {self.top_ks}")
+
+    @property
+    def max_total_len(self) -> int:
+        return max(self.prompt_lens) + max(self.max_new_tokens)
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "LoadPhase":
+        d = dict(data)
+        name = str(d.pop("name", "phase"))
+        eos = d.pop("eos_token", None)
+        phase = cls(
+            name=name,
+            n_requests=int(d.pop("n_requests")),
+            rate_rps=float(d.pop("rate_rps")),
+            prompt_lens=_weighted(d.pop("prompt_lens"),
+                                  f"phase {name!r} prompt_lens"),
+            max_new_tokens=_weighted(d.pop("max_new_tokens"),
+                                     f"phase {name!r} max_new_tokens"),
+            deadline_fraction=float(d.pop("deadline_fraction", 0.0)),
+            deadline_min_s=float(d.pop("deadline_min_s", 1.0)),
+            deadline_max_s=float(d.pop("deadline_max_s", 1.0)),
+            greedy_fraction=float(d.pop("greedy_fraction", 1.0)),
+            temperatures=tuple(float(t)
+                               for t in d.pop("temperatures", (0.7,))),
+            top_ks=tuple(int(k) for k in d.pop("top_ks", (0,))),
+            eos_token=int(eos) if eos is not None else None)
+        if d:
+            raise ValueError(
+                f"phase {name!r}: unknown keys {sorted(d)}")
+        return phase
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "name": self.name, "n_requests": self.n_requests,
+            "rate_rps": self.rate_rps,
+            "prompt_lens": {str(k): v
+                            for k, v in self.prompt_lens.items()},
+            "max_new_tokens": {str(k): v
+                               for k, v in self.max_new_tokens.items()}}
+        if self.deadline_fraction > 0:
+            out["deadline_fraction"] = self.deadline_fraction
+            out["deadline_min_s"] = self.deadline_min_s
+            out["deadline_max_s"] = self.deadline_max_s
+        if self.greedy_fraction < 1.0:
+            out["greedy_fraction"] = self.greedy_fraction
+            out["temperatures"] = list(self.temperatures)
+            out["top_ks"] = list(self.top_ks)
+        if self.eos_token is not None:
+            out["eos_token"] = self.eos_token
+        return out
+
+
+@dataclass(frozen=True)
+class FaultSchedule:
+    """Plain-data mirror of :class:`~apex_tpu.testing_faults.\
+ServingFaultInjector`'s schedule (kept jax-free here; the runner builds
+    the injector). Call indices are the INJECTOR's own monotonically
+    advancing decode/prefill counters — they keep counting across engine
+    rebuilds, so a scheduled fault fires exactly once."""
+
+    decode_raise_calls: Tuple[int, ...] = ()
+    prefill_raise_calls: Tuple[int, ...] = ()
+    decode_hang: Dict[int, float] = field(default_factory=dict)
+    poison_decode: Dict[int, Tuple[int, str]] = field(default_factory=dict)
+
+    @property
+    def empty(self) -> bool:
+        return not (self.decode_raise_calls or self.prefill_raise_calls
+                    or self.decode_hang or self.poison_decode)
+
+    def injector_kwargs(self) -> Dict[str, Any]:
+        """Constructor kwargs for ``ServingFaultInjector``."""
+        return {"decode_raise_calls": self.decode_raise_calls,
+                "prefill_raise_calls": self.prefill_raise_calls,
+                "decode_hang": dict(self.decode_hang),
+                "poison_decode": dict(self.poison_decode)}
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "FaultSchedule":
+        return cls(
+            decode_raise_calls=tuple(
+                int(c) for c in data.get("decode_raise_calls", ())),
+            prefill_raise_calls=tuple(
+                int(c) for c in data.get("prefill_raise_calls", ())),
+            decode_hang={int(k): float(v)
+                         for k, v in data.get("decode_hang", {}).items()},
+            poison_decode={int(k): (int(v[0]), str(v[1]))
+                           for k, v in data.get("poison_decode",
+                                                {}).items()})
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        if self.decode_raise_calls:
+            out["decode_raise_calls"] = list(self.decode_raise_calls)
+        if self.prefill_raise_calls:
+            out["prefill_raise_calls"] = list(self.prefill_raise_calls)
+        if self.decode_hang:
+            out["decode_hang"] = {str(k): v
+                                  for k, v in self.decode_hang.items()}
+        if self.poison_decode:
+            out["poison_decode"] = {str(k): list(v)
+                                    for k, v in self.poison_decode.items()}
+        return out
+
+
+@dataclass(frozen=True)
+class Scenario:
+    """One complete load-test description; see the module docstring.
+
+    ``seed`` drives every random draw the traffic generator makes;
+    ``slo`` declares the objectives the run is scored against;
+    ``tolerance`` is the relative slack the regression gate allows
+    against the committed baseline; ``max_wall_s`` is the harness's own
+    runaway guard — a scenario that cannot finish inside it is aborted
+    (remaining requests cancelled, recorded terminally, and the abort
+    stamped into the log as an event).
+    """
+
+    name: str
+    phases: Tuple[LoadPhase, ...]
+    seed: int = 0
+    description: str = ""
+    model: ModelSpec = field(default_factory=ModelSpec)
+    engine: EngineKnobs = field(default_factory=EngineKnobs)
+    supervisor: Dict[str, Any] = field(default_factory=dict)
+    faults: FaultSchedule = field(default_factory=FaultSchedule)
+    slo: Dict[str, float] = field(default_factory=dict)
+    tolerance: float = 0.25
+    max_wall_s: float = 300.0
+
+    def __post_init__(self):
+        if not self.name:
+            raise ValueError("scenario name must be non-empty")
+        if not self.phases:
+            raise ValueError(f"scenario {self.name!r} needs >= 1 phase")
+        if self.tolerance < 0:
+            raise ValueError(
+                f"tolerance must be >= 0, got {self.tolerance}")
+        if self.max_wall_s <= 0:
+            raise ValueError(
+                f"max_wall_s must be > 0, got {self.max_wall_s}")
+        unknown = set(self.supervisor) - _SUPERVISOR_KEYS
+        if unknown:
+            raise ValueError(
+                f"unknown supervisor knobs {sorted(unknown)}; known: "
+                f"{sorted(_SUPERVISOR_KEYS)}")
+        for metric in self.slo:
+            if metric not in SLO_METRICS:
+                raise ValueError(
+                    f"unknown SLO metric {metric!r}; known: "
+                    f"{sorted(SLO_METRICS)}")
+        for phase in self.phases:
+            if phase.max_total_len > self.engine.max_len:
+                raise ValueError(
+                    f"phase {phase.name!r}: worst-case prompt + "
+                    f"max_new_tokens ({phase.max_total_len}) exceeds "
+                    f"engine max_len ({self.engine.max_len})")
+            for k in phase.top_ks:
+                if k > self.model.vocab_size:
+                    raise ValueError(
+                        f"phase {phase.name!r}: top_k {k} exceeds vocab "
+                        f"size {self.model.vocab_size}")
+            if phase.eos_token is not None and not \
+                    0 <= phase.eos_token < self.model.vocab_size:
+                raise ValueError(
+                    f"phase {phase.name!r}: eos_token {phase.eos_token} "
+                    f"out of vocab [0, {self.model.vocab_size})")
+        if self.engine.max_len > self.model.max_position_embeddings:
+            raise ValueError(
+                f"engine max_len ({self.engine.max_len}) exceeds the "
+                f"model's max_position_embeddings "
+                f"({self.model.max_position_embeddings})")
+
+    @property
+    def total_requests(self) -> int:
+        return sum(p.n_requests for p in self.phases)
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "Scenario":
+        known = {"name", "seed", "description", "model", "engine",
+                 "supervisor", "phases", "faults", "slo", "tolerance",
+                 "max_wall_s"}
+        unknown = set(data) - known
+        if unknown:
+            raise ValueError(
+                f"unknown scenario keys {sorted(unknown)}; known: "
+                f"{sorted(known)}")
+        return cls(
+            name=str(data["name"]),
+            seed=int(data.get("seed", 0)),
+            description=str(data.get("description", "")),
+            model=ModelSpec.from_dict(data.get("model", {})),
+            engine=EngineKnobs.from_dict(data.get("engine", {})),
+            supervisor=dict(data.get("supervisor", {})),
+            phases=tuple(LoadPhase.from_dict(p)
+                         for p in data.get("phases", ())),
+            faults=FaultSchedule.from_dict(data.get("faults", {})),
+            slo={str(k): float(v)
+                 for k, v in data.get("slo", {}).items()},
+            tolerance=float(data.get("tolerance", 0.25)),
+            max_wall_s=float(data.get("max_wall_s", 300.0)))
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "name": self.name, "seed": self.seed,
+            "model": self.model.to_dict(),
+            "engine": self.engine.to_dict(),
+            "phases": [p.to_dict() for p in self.phases],
+            "tolerance": self.tolerance, "max_wall_s": self.max_wall_s}
+        if self.description:
+            out["description"] = self.description
+        if self.supervisor:
+            out["supervisor"] = dict(self.supervisor)
+        if not self.faults.empty:
+            out["faults"] = self.faults.to_dict()
+        if self.slo:
+            out["slo"] = dict(self.slo)
+        return out
+
+    @classmethod
+    def load(cls, path: str) -> "Scenario":
+        """Parse and validate a scenario JSON file."""
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+        if not isinstance(data, dict):
+            raise ValueError(f"{path}: scenario must be a JSON object")
+        return cls.from_dict(data)
+
+    def save(self, path: str) -> None:
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(self.to_dict(), f, indent=2, sort_keys=True)
+            f.write("\n")

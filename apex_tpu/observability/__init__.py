@@ -19,6 +19,11 @@ subsystem of a pre-training stack; this package is that subsystem here.
   (every-N-steps or on watchdog incident).
 - :func:`build_report` / :func:`render_report` — fold a run's JSONL log
   into the report ``python -m apex_tpu.monitor`` prints.
+- :class:`SLOSpec` / :func:`evaluate_slos`
+  (:mod:`~apex_tpu.observability.slo`) — declared service-level
+  objectives (TTFT/TPOT/latency percentiles, goodput, error budget,
+  recovery time) scored from the run log; the monitor renders the
+  verdict and ``python -m apex_tpu.loadtest --check`` gates on it.
 """
 
 from apex_tpu.observability.registry import (
@@ -38,6 +43,14 @@ from apex_tpu.observability.report import (
     read_records,
     render_report,
 )
+from apex_tpu.observability.slo import (
+    SLO_METRICS,
+    SLOObjective,
+    SLOReport,
+    SLOSpec,
+    evaluate_slos,
+    measure_slo_metrics,
+)
 
 __all__ = [
     "MetricsRegistry",
@@ -53,4 +66,10 @@ __all__ = [
     "build_report",
     "read_records",
     "render_report",
+    "SLO_METRICS",
+    "SLOSpec",
+    "SLOObjective",
+    "SLOReport",
+    "evaluate_slos",
+    "measure_slo_metrics",
 ]

@@ -23,6 +23,15 @@ wrote during a run and folds it into one report dict / text page:
   key-for-key with the registry counters
   (:data:`SERVING_INCIDENT_COUNTERS` names the mapping; the tier-1
   serving-resilience tests assert it).
+- **SLO verdict** — when the log carries a ``kind="scenario"`` record
+  with a declared ``"slo"`` section (what the loadtest runner embeds),
+  or when the caller passes a spec (``--slo spec.json``), the report
+  scores the run with :mod:`apex_tpu.observability.slo`: per-objective
+  measured-vs-threshold lines and an overall PASS/FAIL.
+
+Readers are defensive by contract: run logs outlive the writers that
+produced them, so records missing newer fields (a pre-TTFT request row,
+a step row without ``step``) must degrade to "no data" — never raise.
 
 Pure stdlib on purpose: no jax import, so the CLI works on a laptop far
 away from the TPU that wrote the log.
@@ -36,6 +45,7 @@ import sys
 from typing import Dict, List, Optional
 
 from apex_tpu.observability.registry import percentile
+from apex_tpu.observability.slo import SLOSpec, evaluate_slos
 
 __all__ = ["read_records", "build_report", "render_report", "main",
            "SERVING_INCIDENT_COUNTERS", "SERVING_SHED_COUNTERS"]
@@ -98,7 +108,7 @@ def _trajectory(steps: List[dict], key: str) -> List[dict]:
     a coarse trend line (is throughput decaying? did MFU recover after
     the rollback?)."""
     pts = [(r["step"], r[key]) for r in steps
-           if key in r and r[key] == r[key]]
+           if "step" in r and key in r and r[key] == r[key]]
     if not pts:
         return []
     pts.sort()
@@ -114,27 +124,31 @@ def _trajectory(steps: List[dict], key: str) -> List[dict]:
 def _request_summary(requests: List[dict]) -> Optional[dict]:
     """Fold ``kind="request"`` serving rows into the report's requests
     section. ``by_finish_reason`` counts reconcile with the engine's
-    ``requests_<reason>`` counters — same increment sites."""
+    ``requests_<reason>`` counters — same increment sites. Every field
+    read is guarded: rows written by an older engine (no ``ttft_s`` /
+    ``tpot_s``) fold into "no data" for those stats, never a KeyError."""
     if not requests:
         return None
     by_reason: Dict[str, int] = {}
     for r in requests:
         reason = str(r.get("finish_reason", "?"))
         by_reason[reason] = by_reason.get(reason, 0) + 1
+
+    def _field(key):
+        return _stats([r[key] for r in requests
+                       if isinstance(r.get(key), (int, float))])
+
     return {
         "count": len(requests),
         "by_finish_reason": by_reason,
         "new_tokens": sum(int(r.get("new_tokens", 0)) for r in requests),
-        "queue_s": _stats([r["queue_s"] for r in requests
-                           if "queue_s" in r]),
-        "prefill_s": _stats([r["prefill_s"] for r in requests
-                             if "prefill_s" in r]),
-        "decode_s": _stats([r["decode_s"] for r in requests
-                            if "decode_s" in r]),
-        "total_s": _stats([r["total_s"] for r in requests
-                           if "total_s" in r]),
-        "tokens_per_s": _stats([r["tokens_per_s"] for r in requests
-                                if "tokens_per_s" in r]),
+        "queue_s": _field("queue_s"),
+        "prefill_s": _field("prefill_s"),
+        "decode_s": _field("decode_s"),
+        "total_s": _field("total_s"),
+        "ttft_s": _field("ttft_s"),
+        "tpot_s": _field("tpot_s"),
+        "tokens_per_s": _field("tokens_per_s"),
     }
 
 
@@ -156,12 +170,23 @@ def _serving_incidents(events: List[dict]) -> Optional[dict]:
     return {"counts": counts, "shed_by_reason": shed}
 
 
-def build_report(path: str) -> dict:
-    """Fold one JSONL metric log into a report dict."""
+def build_report(path: str,
+                 slo_spec: Optional[Dict[str, float]] = None) -> dict:
+    """Fold one JSONL metric log into a report dict.
+
+    ``slo_spec`` (``{metric: threshold}``, see
+    :data:`apex_tpu.observability.slo.SLO_METRICS`) scores the run's SLO
+    verdict; when omitted, the spec embedded in the log's
+    ``kind="scenario"`` record (if any) is used — a loadtest run log
+    scores itself."""
     records = read_records(path)
     steps = [r for r in records if r.get("kind") == "step"]
     events = [r for r in records if r.get("kind") == "event"]
     requests = [r for r in records if r.get("kind") == "request"]
+    scenario = None
+    for r in records:       # later wins, like the counter snapshots
+        if r.get("kind") == "scenario":
+            scenario = r
     counters: Dict[str, int] = {}
     gauges: Dict[str, float] = {}
     histograms: Dict[str, dict] = {}
@@ -196,7 +221,17 @@ def build_report(path: str) -> dict:
         "requests": _request_summary(requests),
         "serving_incidents": _serving_incidents(events),
         "timeline": sorted(events, key=lambda e: e.get("seq", 0)),
+        "scenario": ({k: scenario[k] for k in ("name", "seed")
+                      if k in scenario} if scenario else None),
+        "slo": None,
     }
+    spec = slo_spec
+    if spec is None and scenario is not None and \
+            isinstance(scenario.get("slo"), dict):
+        spec = scenario["slo"]
+    if spec:
+        report["slo"] = evaluate_slos(records,
+                                      SLOSpec.from_dict(spec)).as_dict()
     return report
 
 
@@ -248,7 +283,25 @@ def render_report(report: dict) -> str:
                   _render_stat_line("prefill", req["prefill_s"], "s"),
                   _render_stat_line("decode", req["decode_s"], "s"),
                   _render_stat_line("total", req["total_s"], "s"),
+                  _render_stat_line("ttft", req.get("ttft_s"), "s"),
+                  _render_stat_line("tpot", req.get("tpot_s"), "s"),
                   _render_stat_line("tokens/s", req["tokens_per_s"])]
+    slo = report.get("slo")
+    if slo:
+        verdict = "PASS" if slo["ok"] else "FAIL"
+        n_fail = sum(1 for o in slo["objectives"] if not o["ok"])
+        head = (f"slo verdict: {verdict} "
+                f"({len(slo['objectives'])} objectives"
+                + (f", {n_fail} violated)" if n_fail else ")"))
+        lines += ["", head]
+        for o in slo["objectives"]:
+            cmp_ = "<=" if o["direction"] == "max" else ">="
+            measured = ("(no data)" if o["measured"] is None
+                        else _fmt(o["measured"]))
+            lines.append(
+                f"  {'ok ' if o['ok'] else 'VIOLATED':<9}"
+                f"{o['name']:<16} measured={measured:<10} "
+                f"{cmp_} {_fmt(o['threshold'])}")
     inc = report.get("serving_incidents")
     if inc:
         total = sum(inc["counts"].values()) + \
@@ -289,9 +342,22 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("path", help="path to the run's .jsonl metric log")
     parser.add_argument("--json", action="store_true",
                         help="emit the report as JSON instead of text")
+    parser.add_argument("--slo", metavar="SPEC.json", default=None,
+                        help="score the run against this SLO spec "
+                             "({metric: threshold} JSON) instead of the "
+                             "one embedded in the log's scenario record")
     args = parser.parse_args(argv)
+    spec = None
+    if args.slo is not None:
+        try:
+            with open(args.slo, "r", encoding="utf-8") as f:
+                spec = json.load(f)
+        except (OSError, ValueError) as exc:
+            print(f"apex_tpu.monitor: cannot read SLO spec {args.slo}: "
+                  f"{exc}", file=sys.stderr)
+            return 2
     try:
-        report = build_report(args.path)
+        report = build_report(args.path, slo_spec=spec)
     except OSError as exc:
         print(f"apex_tpu.monitor: cannot read {args.path}: {exc}",
               file=sys.stderr)

@@ -135,7 +135,8 @@ class _Active:
     """Host-side state of a request holding a slot."""
 
     __slots__ = ("request", "slot", "tokens", "last_token", "position",
-                 "submit_ts", "prefill_start", "prefill_end", "cancelled")
+                 "submit_ts", "prefill_start", "prefill_end",
+                 "first_token_ts", "last_token_ts", "cancelled")
 
     def __init__(self, request: Request, slot: int, submit_ts: float):
         self.request = request
@@ -146,6 +147,8 @@ class _Active:
         self.submit_ts = submit_ts
         self.prefill_start = 0.0
         self.prefill_end = 0.0
+        self.first_token_ts = 0.0   # when token #1 reached the host (TTFT)
+        self.last_token_ts = 0.0    # latest token arrival (TPOT numerator)
         self.cancelled = False
 
 
@@ -491,6 +494,8 @@ class InferenceEngine:
         rec.prefill_end = time.monotonic()
         rec.tokens.append(first)
         rec.last_token = first
+        # token #1 lands with the prefill result — TTFT is submit -> here
+        rec.first_token_ts = rec.last_token_ts = rec.prefill_end
         rec.position = request.prompt_len
         self._active[slot] = rec
         self.admission_log.append(request.request_id)
@@ -532,6 +537,7 @@ class InferenceEngine:
             rec.position += 1            # last_token's K/V are now cached
             rec.tokens.append(token)
             rec.last_token = token
+            rec.last_token_ts = now
             self.metrics.inc("tokens_generated")
             self._sync_slot(rec)
             done = self._finish_reason(rec, token)
@@ -588,11 +594,14 @@ class InferenceEngine:
         return self._finish(
             rec.request, rec.tokens, reason, submit_ts=rec.submit_ts,
             now=now, prefill_start=rec.prefill_start,
-            prefill_end=rec.prefill_end)
+            prefill_end=rec.prefill_end,
+            first_token_ts=rec.first_token_ts,
+            last_token_ts=rec.last_token_ts)
 
     def _finish(self, request: Request, tokens: List[int], reason: str, *,
                 submit_ts: float, now: float, prefill_start: float = 0.0,
-                prefill_end: float = 0.0,
+                prefill_end: float = 0.0, first_token_ts: float = 0.0,
+                last_token_ts: float = 0.0,
                 detail: Optional[str] = None) -> RequestResult:
         if prefill_start:
             queue_s = prefill_start - submit_ts
@@ -600,11 +609,18 @@ class InferenceEngine:
             decode_s = now - prefill_end
         else:                       # never left the queue
             queue_s, prefill_s, decode_s = now - submit_ts, 0.0, 0.0
+        # SLO primitives, from the engine's own token timestamps: TTFT is
+        # submit -> first token on the host; TPOT is the mean inter-token
+        # interval (needs >= 2 tokens to define an interval)
+        ttft_s = (first_token_ts - submit_ts
+                  if tokens and first_token_ts else None)
+        tpot_s = ((last_token_ts - first_token_ts) / (len(tokens) - 1)
+                  if len(tokens) >= 2 and first_token_ts else None)
         result = RequestResult(
             request_id=request.request_id, prompt_len=request.prompt_len,
             tokens=list(tokens), finish_reason=reason, queue_s=queue_s,
             prefill_s=prefill_s, decode_s=decode_s,
-            total_s=now - submit_ts)
+            total_s=now - submit_ts, ttft_s=ttft_s, tpot_s=tpot_s)
         self.completed[request.request_id] = result
         self.metrics.inc(f"requests_{reason}")
         for name, value in (("request_queue_s", result.queue_s),
@@ -615,6 +631,10 @@ class InferenceEngine:
         tps = result.tokens_per_s
         if tps is not None:
             self.metrics.observe("request_tokens_per_s", tps)
+        if result.ttft_s is not None:
+            self.metrics.observe("request_ttft_s", result.ttft_s)
+        if result.tpot_s is not None:
+            self.metrics.observe("request_tpot_s", result.tpot_s)
         self.metrics.emit_record(result.record(wall=time.time()))
         if reason in (FINISH_REJECTED, FINISH_TIMEOUT, FINISH_CANCELLED,
                       FINISH_ERROR):

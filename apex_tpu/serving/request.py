@@ -117,6 +117,14 @@ class RequestResult:
     ``queue_s`` (submit -> prefill start), ``prefill_s``, ``decode_s``
     (first decode participation -> finish) and ``total_s`` (submit ->
     finish); a request that never left the queue has zero prefill/decode.
+
+    ``ttft_s`` (time to first token: submit -> the first generated token
+    materializing on the host) and ``tpot_s`` (time per output token: the
+    mean inter-token interval over the decode stream) are the serving
+    SLO primitives (:mod:`apex_tpu.observability.slo`) — stamped from
+    the engine's own token timestamps, NOT reconstructed by adding the
+    coarse queue/prefill buckets. ``None`` when unmeasurable: ``ttft_s``
+    for a request that produced no token, ``tpot_s`` below two tokens.
     """
 
     request_id: int
@@ -127,6 +135,8 @@ class RequestResult:
     prefill_s: float = 0.0
     decode_s: float = 0.0
     total_s: float = 0.0
+    ttft_s: Optional[float] = None
+    tpot_s: Optional[float] = None
 
     @property
     def new_tokens(self) -> int:
@@ -151,6 +161,13 @@ class RequestResult:
                "queue_s": self.queue_s, "prefill_s": self.prefill_s,
                "decode_s": self.decode_s, "total_s": self.total_s,
                "wall": wall}
+        # optional fields are OMITTED (not null) when unmeasured, so the
+        # records stay readable by pre-TTFT report readers and the
+        # summary's per-field guards
+        if self.ttft_s is not None:
+            rec["ttft_s"] = self.ttft_s
+        if self.tpot_s is not None:
+            rec["tpot_s"] = self.tpot_s
         tps = self.tokens_per_s
         if tps is not None:
             rec["tokens_per_s"] = tps

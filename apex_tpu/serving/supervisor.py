@@ -206,6 +206,13 @@ class EngineSupervisor:
         """Admitted-or-queued requests not yet terminal."""
         return len(self._tracked)
 
+    @property
+    def inflight_ids(self) -> List[int]:
+        """Ids of admitted-or-queued requests not yet terminal — what a
+        driver must cancel to drain the supervisor early (the loadtest
+        wall-budget abort path)."""
+        return sorted(self._tracked)
+
     # -- admission --------------------------------------------------------
 
     def submit(self, request: Request) -> int:
@@ -515,13 +522,19 @@ class EngineSupervisor:
             tr = self._tracked.pop(rid)
             res = self.engine.completed[rid]
             if tr.prefix or tr.restarts:
+                # ttft_s only survives when no token predates this engine
+                # incarnation (the original first-token timestamp died
+                # with the crashed engine); tpot_s — the decode cadence —
+                # stays meaningful for the continuation stream
                 res = RequestResult(
                     request_id=rid, prompt_len=tr.request.prompt_len,
                     tokens=tr.prefix + res.tokens,
                     finish_reason=res.finish_reason,
                     queue_s=res.queue_s, prefill_s=res.prefill_s,
                     decode_s=res.decode_s,
-                    total_s=now - tr.first_submit_ts)
+                    total_s=now - tr.first_submit_ts,
+                    ttft_s=None if tr.prefix else res.ttft_s,
+                    tpot_s=res.tpot_s)
             self.completed[rid] = res
             service = res.prefill_s + res.decode_s
             if service > 0 and res.finish_reason in (FINISH_EOS,

@@ -223,13 +223,35 @@ def bench_serving(model, params, n_requests=32, max_new=32, max_slots=8,
     the slot mid-flight. Matched load: identical prompts, identical
     per-request token budgets. Lockstep's per-request latency is one
     number (the whole batch), so the interesting deltas are the p50
-    request latency and aggregate tokens/s."""
-    from apex_tpu.serving import EngineConfig, InferenceEngine, Request
+    request latency and aggregate tokens/s.
 
-    rng = np.random.RandomState(0)
-    lens = [prompt_lens[i % len(prompt_lens)] for i in range(n_requests)]
-    prompts = [rng.randint(0, 50304, size=n).tolist() for n in lens]
-    max_len = max(lens) + max_new
+    The request set comes from the loadtest traffic generator (one
+    seeded source of synthetic serving traffic — the same code path
+    ``python -m apex_tpu.loadtest`` scenarios replay — mirroring how
+    FLOP math was unified into ``apex_tpu/utils/flops.py``): a single
+    phase with a uniform mix over ``prompt_lens``, greedy, arrival
+    times unused (both arms consume the whole set at once)."""
+    from apex_tpu.loadtest import (
+        EngineKnobs, LoadPhase, ModelSpec, Scenario, TrafficGenerator)
+    from apex_tpu.serving import EngineConfig, InferenceEngine
+
+    c = model.config
+    max_len = max(prompt_lens) + max_new
+    scenario = Scenario(
+        name="bench_serving", seed=0,
+        model=ModelSpec(
+            num_layers=c.num_layers, hidden_size=c.hidden_size,
+            num_attention_heads=c.num_attention_heads,
+            vocab_size=c.vocab_size,
+            max_position_embeddings=c.max_position_embeddings),
+        engine=EngineKnobs(max_slots=max_slots, max_len=max_len,
+                           max_queue=n_requests),
+        phases=(LoadPhase(
+            name="bench", n_requests=n_requests, rate_rps=1e6,
+            prompt_lens={n: 1.0 for n in prompt_lens},
+            max_new_tokens={max_new: 1.0}),))
+    reqs = TrafficGenerator(scenario).requests()
+    prompts = [list(r.prompt) for r in reqs]
 
     # -- lockstep generate(): slots = batch rows for comparability; each
     # sub-batch is padded to ITS longest prompt and nobody retires early
@@ -253,13 +275,14 @@ def bench_serving(model, params, n_requests=32, max_new=32, max_slots=8,
                    "prompt_lens": list(prompt_lens),
                    "p50_request_latency_s": round(dt_lock, 3),
                    "p95_request_latency_s": round(dt_lock, 3),
-                   "method": "batched generate(), zero-padded prompts; "
+                   "method": "batched generate(), zero-padded prompts "
+                             "from the loadtest traffic generator; "
                              "every request waits for the whole batch"}}))
 
-    # -- continuous batching: same requests, per-request retirement
+    # -- continuous batching: the SAME generated requests, per-request
+    # retirement
     engine = InferenceEngine(model, params, EngineConfig(
         max_slots=max_slots, max_len=max_len))
-    reqs = [Request(prompt=p, max_new_tokens=max_new) for p in prompts]
     t0 = time.perf_counter()
     results = engine.serve(reqs)
     dt_engine = time.perf_counter() - t0
@@ -277,9 +300,10 @@ def bench_serving(model, params, n_requests=32, max_new=32, max_slots=8,
                    "p95_request_latency_s": round(_pctl(lat, 95), 3),
                    "decode_retraces": engine.decode_retraces,
                    "prefill_compiles": engine.prefill_compiles,
-                   "method": "continuous batching (InferenceEngine): "
-                             "per-step admission/retirement, bucketed "
-                             "prefill, one jitted decode program"}}))
+                   "method": "continuous batching (InferenceEngine), "
+                             "same generated request set: per-step "
+                             "admission/retirement, bucketed prefill, "
+                             "one jitted decode program"}}))
 
 
 def main():

@@ -62,6 +62,7 @@ def test_invalid_groups_rejected():
         GPTModel(_cfg(num_query_groups=3)).init(jax.random.PRNGKey(0))
 
 
+@pytest.mark.slow  # generation cache parity: the slow-tier class
 def test_cached_decode_matches_full_forward():
     model = GPTModel(_cfg())
     params = model.init(jax.random.PRNGKey(0))
@@ -109,6 +110,7 @@ def _train(tp, steps=3):
     return losses, params
 
 
+@pytest.mark.slow  # TP model parity: the slow-tier class (ROADMAP tiers)
 def test_tp2_matches_unsharded():
     """Sharded GQA training reproduces the single-rank run: the grouped QKV
     layout keeps whole K/V groups per TP rank."""

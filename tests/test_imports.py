@@ -22,12 +22,14 @@ MODULES = [
     "apex_tpu.data",
     "apex_tpu.fp16_utils",
     "apex_tpu.fused_dense",
+    "apex_tpu.loadtest",
     "apex_tpu.mlp",
     "apex_tpu.monitor",
     "apex_tpu.multi_tensor_apply",
     "apex_tpu.native",
     "apex_tpu.normalization",
     "apex_tpu.observability",
+    "apex_tpu.observability.slo",
     "apex_tpu.ops",
     "apex_tpu.optimizers",
     "apex_tpu.parallel",

@@ -217,6 +217,7 @@ class TestExpertParallelTraining:
     spec-aware gradient sync (expert grads divided by the data-axis size
     instead of pmean'd, which would mix different experts)."""
 
+    @pytest.mark.slow  # whole-model EP-vs-dense parity: slow-tier class
     def test_ep_training_matches_dense(self):
         from apex_tpu.models import GPTModel, TransformerConfig
         from apex_tpu.optimizers import FusedAdam

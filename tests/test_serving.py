@@ -403,8 +403,53 @@ class TestEngine:
         hists = reg.histograms()
         for name in ("request_queue_s", "request_prefill_s",
                      "request_decode_s", "request_total_s",
+                     "request_ttft_s", "request_tpot_s",
                      "slot_occupancy", "decode_batch_size"):
             assert name in hists and hists[name].count > 0, name
+
+    def test_ttft_tpot_first_class(self, small):
+        """Satellite contract: TTFT/TPOT are stamped from the engine's
+        own token timestamps — not reconstructed by adding the coarse
+        queue/prefill/decode buckets — and land in the JSONL record."""
+        model, params = small
+        reg = MetricsRegistry([InMemorySink()])
+        eng = InferenceEngine(model, params,
+                              EngineConfig(max_slots=2, max_len=16),
+                              metrics=reg)
+        multi, single = [
+            Request(prompt=p, max_new_tokens=n) for p, n in
+            zip(_prompts([4, 3], seed=23), (5, 1))]
+        results = {r.request_id: r for r in eng.serve([multi, single])}
+        res = results[multi.request_id]
+        # first token arrives with the prefill result: TTFT brackets the
+        # queue+prefill span and precedes the total latency
+        assert res.ttft_s is not None and 0 < res.ttft_s <= res.total_s
+        assert res.ttft_s == pytest.approx(
+            res.queue_s + res.prefill_s, abs=0.05)
+        # 5 tokens -> 4 inter-token gaps spanning the decode phase
+        assert res.tpot_s is not None and res.tpot_s >= 0
+        assert res.tpot_s * (res.new_tokens - 1) <= res.decode_s + 0.05
+        # a single-token request has a TTFT but no inter-token interval
+        one = results[single.request_id]
+        assert one.ttft_s is not None and one.tpot_s is None
+        sink = reg._sinks[0]
+        recs = {r["request_id"]: r for r in sink.of_kind("request")}
+        assert recs[multi.request_id]["ttft_s"] == res.ttft_s
+        assert recs[multi.request_id]["tpot_s"] == res.tpot_s
+        assert "tpot_s" not in recs[single.request_id]
+
+    def test_rejected_request_has_no_ttft(self, small):
+        model, params = small
+        eng = InferenceEngine(model, params, EngineConfig(
+            max_slots=1, max_len=16,
+            scheduler=SchedulerConfig(max_queue=1)))
+        p = _prompts([3, 3], seed=29)
+        eng.submit(Request(prompt=p[0], max_new_tokens=2))
+        rejected = Request(prompt=p[1], max_new_tokens=2)
+        with pytest.raises(QueueFullError):   # queue of 1 already full
+            eng.submit(rejected)
+        res = eng.completed[rejected.request_id]
+        assert res.ttft_s is None and res.tpot_s is None
 
 
 @pytest.mark.slow
