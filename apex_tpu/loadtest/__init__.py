@@ -28,6 +28,7 @@ from apex_tpu.loadtest.runner import ScenarioRun, build_model, run_scenario
 from apex_tpu.loadtest.scenario import (
     EngineKnobs,
     FaultSchedule,
+    FleetSpec,
     LoadPhase,
     ModelSpec,
     Scenario,
@@ -39,6 +40,7 @@ __all__ = [
     "ModelSpec",
     "EngineKnobs",
     "FaultSchedule",
+    "FleetSpec",
     "TrafficGenerator",
     "ScheduledRequest",
     "ScenarioRun",

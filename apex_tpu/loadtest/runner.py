@@ -105,8 +105,14 @@ class ScenarioRun:
         return self.slo.ok if self.slo is not None else True
 
 
-def _build_supervisor(scenario: Scenario, model, params,
-                      metrics: MetricsRegistry) -> EngineSupervisor:
+def _build_serving(scenario: Scenario, model, params,
+                   metrics: MetricsRegistry):
+    """The serving tier under test: a single
+    :class:`~apex_tpu.serving.EngineSupervisor`, or — when the scenario
+    declares a ``fleet`` block — a
+    :class:`~apex_tpu.serving.fleet.ReplicaFleet` (the fault schedule
+    then drives replica 0's injector). Both expose the same driving
+    surface, so the replay loop below is tier-agnostic."""
     from apex_tpu.testing_faults import ServingFaultInjector
 
     knobs = scenario.engine
@@ -119,6 +125,16 @@ def _build_supervisor(scenario: Scenario, model, params,
     faults = None
     if not scenario.faults.empty:
         faults = ServingFaultInjector(**scenario.faults.injector_kwargs())
+    if scenario.fleet is not None:
+        from apex_tpu.serving.fleet import FleetConfig, ReplicaFleet
+
+        fl = scenario.fleet
+        return ReplicaFleet(
+            model, params, engine_cfg, supervisor=sup_cfg,
+            fleet=FleetConfig(n_replicas=fl.n_replicas,
+                              migrate_on_drain=fl.migrate_on_drain,
+                              probe_on_rebuild=fl.probe_on_rebuild),
+            metrics=metrics, faults=faults)
     return EngineSupervisor(model, params, engine_cfg,
                             supervisor=sup_cfg, metrics=metrics,
                             faults=faults)
@@ -155,19 +171,38 @@ def run_scenario(scenario: Scenario, *, model=None, params=None,
         "slo": dict(scenario.slo), "wall": time.time()})
 
     schedule = TrafficGenerator(scenario).schedule()
-    sup = _build_supervisor(scenario, model, params, registry)
+    sup = _build_serving(scenario, model, params, registry)
     run = ScenarioRun(scenario=scenario, schedule=schedule, results={},
                       records=mem.records, counters={}, wall_s=0.0,
                       log_path=log_path)
+    # the fleet-level fault schedule: draining restarts at fixed offsets
+    drains = sorted(scenario.fleet.drain_restarts) \
+        if scenario.fleet is not None else []
+    d = 0
     t0 = time.monotonic()
     i = 0
     try:
-        while i < len(schedule) or sup.inflight_count:
+        while i < len(schedule) or sup.inflight_count or d < len(drains):
             now = time.monotonic() - t0
             if now > scenario.max_wall_s:
                 run.aborted = True
                 _abort(sup, scenario, registry, now)
                 break
+            while d < len(drains) and drains[d][0] <= now:
+                at_s, replica = drains[d]
+                d += 1
+                try:
+                    sup.drain_restart(replica)
+                except RuntimeError as exc:
+                    # another drain still in progress (or replica not
+                    # active): skip rather than stack — N-1 capacity is
+                    # the invariant; the skip is stamped into the log
+                    log_event(_LOG, "drain_restart_skipped",
+                              replica_id=replica, at_s=at_s,
+                              reason=str(exc))
+                    registry.event("drain_restart_skipped",
+                                   replica_id=replica, at_s=at_s,
+                                   reason=str(exc))
             while i < len(schedule) and schedule[i].at_s <= now:
                 req = schedule[i].request
                 # open-loop contract: the deadline clock starts at the
@@ -187,6 +222,8 @@ def run_scenario(scenario: Scenario, *, model=None, params=None,
                 gap = (t0 + schedule[i].at_s) - time.monotonic()
                 if gap > 0:
                     time.sleep(min(gap, _IDLE_SLEEP_S))
+            elif d < len(drains):
+                time.sleep(_IDLE_SLEEP_S)  # waiting on a scheduled drain
     finally:
         run.wall_s = time.monotonic() - t0
         sup.close()             # flushes the final counter snapshot

@@ -38,7 +38,7 @@ from typing import Any, Dict, Optional, Tuple
 from apex_tpu.observability.slo import SLO_METRICS
 
 __all__ = ["ModelSpec", "EngineKnobs", "LoadPhase", "FaultSchedule",
-           "Scenario"]
+           "FleetSpec", "Scenario"]
 
 #: keys accepted in a scenario's ``"supervisor"`` section — mirrors the
 #: :class:`~apex_tpu.serving.SupervisorConfig` fields so a typo fails at
@@ -278,6 +278,65 @@ ServingFaultInjector`'s schedule (kept jax-free here; the runner builds
 
 
 @dataclass(frozen=True)
+class FleetSpec:
+    """Optional ``"fleet"`` scenario block: run the traffic against a
+    :class:`~apex_tpu.serving.fleet.ReplicaFleet` of ``n_replicas``
+    supervised engines instead of a single supervisor.
+
+    ``drain_restarts`` is the fleet-level fault kind: each
+    ``(at_s, replica)`` entry schedules a DRAINING restart of that
+    replica at ``at_s`` seconds into the run — the runner quiesces it,
+    migrates or finishes its in-flight work, rebuilds and health-probes
+    it, all while the rest of the fleet keeps serving (capacity >= N-1).
+    The scenario's regular ``faults`` schedule applies to replica 0.
+    """
+
+    n_replicas: int = 2
+    migrate_on_drain: bool = True
+    probe_on_rebuild: bool = True
+    drain_restarts: Tuple[Tuple[float, int], ...] = ()
+
+    def __post_init__(self):
+        if self.n_replicas < 1:
+            raise ValueError(
+                f"fleet n_replicas must be >= 1, got {self.n_replicas}")
+        for at_s, replica in self.drain_restarts:
+            if at_s < 0:
+                raise ValueError(
+                    f"drain_restart at_s must be >= 0, got {at_s}")
+            if not 0 <= replica < self.n_replicas:
+                raise ValueError(
+                    f"drain_restart replica {replica} out of range "
+                    f"[0, {self.n_replicas})")
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "FleetSpec":
+        d = dict(data)
+        spec = cls(
+            n_replicas=int(d.pop("n_replicas", 2)),
+            migrate_on_drain=bool(d.pop("migrate_on_drain", True)),
+            probe_on_rebuild=bool(d.pop("probe_on_rebuild", True)),
+            drain_restarts=tuple(
+                (float(e["at_s"]), int(e["replica"]))
+                for e in d.pop("drain_restarts", ())))
+        if d:
+            raise ValueError(f"unknown fleet keys {sorted(d)}")
+        return spec
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"n_replicas": self.n_replicas}
+        if not self.migrate_on_drain:
+            out["migrate_on_drain"] = False
+        if not self.probe_on_rebuild:
+            out["probe_on_rebuild"] = False
+        if self.drain_restarts:
+            out["drain_restarts"] = [
+                {"at_s": at_s, "replica": replica}
+                for at_s, replica in self.drain_restarts]
+        return out
+
+
+@dataclass(frozen=True)
 class Scenario:
     """One complete load-test description; see the module docstring.
 
@@ -298,6 +357,7 @@ class Scenario:
     engine: EngineKnobs = field(default_factory=EngineKnobs)
     supervisor: Dict[str, Any] = field(default_factory=dict)
     faults: FaultSchedule = field(default_factory=FaultSchedule)
+    fleet: Optional[FleetSpec] = None
     slo: Dict[str, float] = field(default_factory=dict)
     tolerance: float = 0.25
     max_wall_s: float = 300.0
@@ -352,8 +412,8 @@ class Scenario:
     @classmethod
     def from_dict(cls, data: Dict[str, Any]) -> "Scenario":
         known = {"name", "seed", "description", "model", "engine",
-                 "supervisor", "phases", "faults", "slo", "tolerance",
-                 "max_wall_s"}
+                 "supervisor", "phases", "faults", "fleet", "slo",
+                 "tolerance", "max_wall_s"}
         unknown = set(data) - known
         if unknown:
             raise ValueError(
@@ -369,6 +429,8 @@ class Scenario:
             phases=tuple(LoadPhase.from_dict(p)
                          for p in data.get("phases", ())),
             faults=FaultSchedule.from_dict(data.get("faults", {})),
+            fleet=(FleetSpec.from_dict(data["fleet"])
+                   if data.get("fleet") is not None else None),
             slo={str(k): float(v)
                  for k, v in data.get("slo", {}).items()},
             tolerance=float(data.get("tolerance", 0.25)),
@@ -387,6 +449,8 @@ class Scenario:
             out["supervisor"] = dict(self.supervisor)
         if not self.faults.empty:
             out["faults"] = self.faults.to_dict()
+        if self.fleet is not None:
+            out["fleet"] = self.fleet.to_dict()
         if self.slo:
             out["slo"] = dict(self.slo)
         return out

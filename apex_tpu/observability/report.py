@@ -48,7 +48,8 @@ from apex_tpu.observability.registry import percentile
 from apex_tpu.observability.slo import SLOSpec, evaluate_slos
 
 __all__ = ["read_records", "build_report", "render_report", "main",
-           "SERVING_INCIDENT_COUNTERS", "SERVING_SHED_COUNTERS"]
+           "SERVING_INCIDENT_COUNTERS", "SERVING_SHED_COUNTERS",
+           "FLEET_INCIDENT_COUNTERS"]
 
 #: number of windows in the throughput/MFU trajectory
 _TRAJECTORY_WINDOWS = 5
@@ -72,6 +73,16 @@ SERVING_INCIDENT_COUNTERS = {
 SERVING_SHED_COUNTERS = {
     "breaker": "requests_shed_breaker",
     "deadline": "requests_shed_deadline",
+    "fleet": "requests_shed_fleet",
+}
+
+#: fleet incident event -> registry counter — same one-increment-per-
+#: event contract as :data:`SERVING_INCIDENT_COUNTERS`, so the monitor's
+#: fleet section reconciles key-for-key with the counter snapshot
+FLEET_INCIDENT_COUNTERS = {
+    "replica_drain": "replica_drains",
+    "replica_rebuild": "replica_rebuilds",
+    "request_migrated": "requests_migrated",
 }
 
 
@@ -170,6 +181,35 @@ def _serving_incidents(events: List[dict]) -> Optional[dict]:
     return {"counts": counts, "shed_by_reason": shed}
 
 
+def _fleet_section(requests: List[dict], events: List[dict],
+                   counters: Dict[str, int]) -> Optional[dict]:
+    """Fold fleet telemetry into the monitor's fleet section: terminal
+    requests grouped by the ``replica_id`` that retired them, dispatch
+    counters (``fleet_dispatches`` and its per-replica split — the split
+    sums to the total by construction), and drain/rebuild/migration
+    incident counts reconciling with :data:`FLEET_INCIDENT_COUNTERS`.
+    ``None`` when the log carries no fleet signal (a single-engine run,
+    or a pre-fleet log whose request rows have no ``replica_id``)."""
+    by_replica: Dict[str, int] = {}
+    for r in requests:
+        rid = r.get("replica_id")
+        if isinstance(rid, int):
+            by_replica[str(rid)] = by_replica.get(str(rid), 0) + 1
+    counts: Dict[str, int] = {}
+    for e in events:
+        name = e.get("event")
+        if name in FLEET_INCIDENT_COUNTERS:
+            counts[name] = counts.get(name, 0) + 1
+    dispatch = {name: n for name, n in counters.items()
+                if name == "fleet_dispatches"
+                or (name.startswith("replica")
+                    and name.endswith("_dispatches"))}
+    if not by_replica and not counts and not dispatch:
+        return None
+    return {"requests_by_replica": by_replica, "counts": counts,
+            "dispatches": dispatch}
+
+
 def build_report(path: str,
                  slo_spec: Optional[Dict[str, float]] = None) -> dict:
     """Fold one JSONL metric log into a report dict.
@@ -220,6 +260,7 @@ def build_report(path: str,
         "mfu_trajectory": _trajectory(steps, "mfu"),
         "requests": _request_summary(requests),
         "serving_incidents": _serving_incidents(events),
+        "fleet": _fleet_section(requests, events, counters),
         "timeline": sorted(events, key=lambda e: e.get("seq", 0)),
         "scenario": ({k: scenario[k] for k in ("name", "seed")
                       if k in scenario} if scenario else None),
@@ -302,6 +343,22 @@ def render_report(report: dict) -> str:
                 f"  {'ok ' if o['ok'] else 'VIOLATED':<9}"
                 f"{o['name']:<16} measured={measured:<10} "
                 f"{cmp_} {_fmt(o['threshold'])}")
+    fleet = report.get("fleet")
+    if fleet:
+        lines += ["", "fleet:"]
+        if fleet["dispatches"]:
+            total = fleet["dispatches"].get("fleet_dispatches", 0)
+            split = " ".join(
+                f"{k}={v}" for k, v in sorted(fleet["dispatches"].items())
+                if k != "fleet_dispatches")
+            lines.append(f"  dispatches: {total}"
+                         + (f" ({split})" if split else ""))
+        if fleet["requests_by_replica"]:
+            split = " ".join(f"replica{k}={v}" for k, v in sorted(
+                fleet["requests_by_replica"].items()))
+            lines.append(f"  requests by replica: {split}")
+        lines += [f"  {name} = {n}"
+                  for name, n in sorted(fleet["counts"].items())]
     inc = report.get("serving_incidents")
     if inc:
         total = sum(inc["counts"].values()) + \

@@ -11,10 +11,21 @@ scheduling with bucketed prefill and backpressure lives in
 :mod:`~apex_tpu.serving.request`. :class:`EngineSupervisor`
 (:mod:`~apex_tpu.serving.supervisor`) is the resilience layer: engine
 restarts with in-flight request recovery, slot quarantine, a circuit
-breaker, and deadline-aware load shedding. See docs/serving.md.
+breaker, and deadline-aware load shedding.
+:mod:`~apex_tpu.serving.fleet` scales it out: :class:`ReplicaFleet`
+routes traffic across N supervised replicas with least-loaded dispatch
+and draining restarts, and :class:`ShardedEngine` runs the decode step
+tensor-parallel over the device mesh. See docs/serving.md.
 """
 
 from apex_tpu.serving.engine import EngineConfig, InferenceEngine
+from apex_tpu.serving.fleet import (
+    FleetConfig,
+    FleetUnavailableError,
+    ReplicaFleet,
+    Router,
+    ShardedEngine,
+)
 from apex_tpu.serving.request import (
     FINISH_CANCELLED,
     FINISH_EOS,
@@ -51,6 +62,11 @@ __all__ = [
     "EngineSupervisor",
     "SupervisorConfig",
     "EngineUnavailableError",
+    "ReplicaFleet",
+    "Router",
+    "FleetConfig",
+    "FleetUnavailableError",
+    "ShardedEngine",
     "BREAKER_CLOSED",
     "BREAKER_OPEN",
     "BREAKER_HALF_OPEN",

@@ -187,9 +187,12 @@ class InferenceEngine:
 
     def __init__(self, model, params, config: Optional[EngineConfig] = None,
                  *, metrics: Optional[MetricsRegistry] = None,
-                 faults=None):
+                 faults=None, replica_id: Optional[int] = None):
         self.model = model
         self.config = config or EngineConfig()
+        #: fleet replica label stamped on every RequestResult / JSONL
+        #: record this engine emits (None = single-engine deployment)
+        self.replica_id = replica_id
         #: optional ServingFaultInjector (apex_tpu.testing_faults) — hook
         #: points are host-side on purpose: injected faults must never
         #: retrace the compiled decode step
@@ -232,55 +235,69 @@ class InferenceEngine:
         if donate is None:
             donate = jax.default_backend() != "cpu"
 
-        def _decode(params, caches, tokens, positions, temps, topks, seeds):
-            logits, caches = decode_step(model, params, caches, tokens,
-                                         positions)
-            nxt = _sample_tokens(logits, temps, topks, seeds, positions + 1)
-            # per-slot integrity flag: one cheap in-jit reduction so the
-            # host can quarantine a poisoned row without fetching logits
-            finite = jnp.all(jnp.isfinite(logits), axis=-1)
-            return nxt, finite, caches
-
-        def _scrub(caches, slot):
-            # zero one slot's KV rows across every layer — quarantine
-            # hygiene, so a poisoned row's NaNs can never reach a future
-            # occupant even through a masked-weight * NaN-value product
-            return [(k.at[slot].set(0.0), v.at[slot].set(0.0))
-                    for k, v in caches]
-
-        def _prefill(params, caches, prompt, slot, prompt_len,
-                     temp, topk, seed):
-            # the EXACT prefill generate() runs (4D per-layer list -> the
-            # cache_index==0 causal-flash fast path), at the bucket-padded
-            # length; pad rows are causally invisible to real rows and
-            # their K/V land beyond the row's live length, so they are
-            # never read back
-            small = init_kv_caches(model, 1, prompt.shape[1], stacked=False)
-            logits, small = _cached_forward(model, params, small, prompt, 0,
-                                            last_index=prompt_len - 1)
-            flat = flatten_decode_caches(small, c.num_layers)
-            new = [
-                (jax.lax.dynamic_update_slice(bk, fk, (slot, 0, 0)),
-                 jax.lax.dynamic_update_slice(bv, fv, (slot, 0, 0)))
-                for (bk, bv), (fk, fv) in zip(caches, flat)]
-            first = _sample_tokens(logits[0], temp[None], topk[None],
-                                   seed[None], prompt_len[None])
-            return first[0], new
-
-        donate_args = (1,) if donate else ()
+        decode_fn, prefill_fn, scrub_fn = self._build_step_fns(donate)
         self._decode_fn = RetraceWatchdog(
-            jax.jit(_decode, donate_argnums=donate_args),
+            decode_fn,
             budget=self.config.retrace_budget, expected_compiles=1,
             name="serving_decode", metrics=self.metrics)
         # one jit whose compile count is bounded by the bucket set (each
         # distinct padded prompt shape is one entry); budget=None — bucket
         # compiles are expected, the TEST asserts compiles <= buckets
         self._prefill_fn = RetraceWatchdog(
-            jax.jit(_prefill, donate_argnums=donate_args),
-            budget=None, expected_compiles=len(self.buckets),
+            prefill_fn, budget=None, expected_compiles=len(self.buckets),
             name="serving_prefill", metrics=self.metrics)
-        self._scrub_fn = jax.jit(
-            _scrub, donate_argnums=(0,) if donate else ())
+        self._scrub_fn = scrub_fn
+
+    # -- step programs (overridable: ShardedEngine wraps these bodies in
+    # -- shard_map over the device mesh) ----------------------------------
+
+    def _decode_body(self, params, caches, tokens, positions, temps,
+                     topks, seeds):
+        logits, caches = decode_step(self.model, params, caches, tokens,
+                                     positions)
+        nxt = _sample_tokens(logits, temps, topks, seeds, positions + 1)
+        # per-slot integrity flag: one cheap in-jit reduction so the
+        # host can quarantine a poisoned row without fetching logits
+        finite = jnp.all(jnp.isfinite(logits), axis=-1)
+        return nxt, finite, caches
+
+    def _scrub_body(self, caches, slot):
+        # zero one slot's KV rows across every layer — quarantine
+        # hygiene, so a poisoned row's NaNs can never reach a future
+        # occupant even through a masked-weight * NaN-value product
+        return [(k.at[slot].set(0.0), v.at[slot].set(0.0))
+                for k, v in caches]
+
+    def _prefill_body(self, params, caches, prompt, slot, prompt_len,
+                      temp, topk, seed):
+        # the EXACT prefill generate() runs (4D per-layer list -> the
+        # cache_index==0 causal-flash fast path), at the bucket-padded
+        # length; pad rows are causally invisible to real rows and
+        # their K/V land beyond the row's live length, so they are
+        # never read back
+        model = self.model
+        small = init_kv_caches(model, 1, prompt.shape[1], stacked=False)
+        logits, small = _cached_forward(model, params, small, prompt, 0,
+                                        last_index=prompt_len - 1)
+        flat = flatten_decode_caches(small, model.config.num_layers)
+        new = [
+            (jax.lax.dynamic_update_slice(bk, fk, (slot, 0, 0)),
+             jax.lax.dynamic_update_slice(bv, fv, (slot, 0, 0)))
+            for (bk, bv), (fk, fv) in zip(caches, flat)]
+        first = _sample_tokens(logits[0], temp[None], topk[None],
+                               seed[None], prompt_len[None])
+        return first[0], new
+
+    def _build_step_fns(self, donate: bool):
+        """Compile the three device programs: ``(decode, prefill, scrub)``.
+        The base engine jits the bodies directly (single-chip);
+        :class:`~apex_tpu.serving.fleet.ShardedEngine` overrides this to
+        wrap each body in ``shard_map`` over the tensor axis first."""
+        donate_args = (1,) if donate else ()
+        return (jax.jit(self._decode_body, donate_argnums=donate_args),
+                jax.jit(self._prefill_body, donate_argnums=donate_args),
+                jax.jit(self._scrub_body,
+                        donate_argnums=(0,) if donate else ()))
 
     # -- introspection ----------------------------------------------------
 
@@ -620,7 +637,8 @@ class InferenceEngine:
             request_id=request.request_id, prompt_len=request.prompt_len,
             tokens=list(tokens), finish_reason=reason, queue_s=queue_s,
             prefill_s=prefill_s, decode_s=decode_s,
-            total_s=now - submit_ts, ttft_s=ttft_s, tpot_s=tpot_s)
+            total_s=now - submit_ts, ttft_s=ttft_s, tpot_s=tpot_s,
+            replica_id=self.replica_id)
         self.completed[request.request_id] = result
         self.metrics.inc(f"requests_{reason}")
         for name, value in (("request_queue_s", result.queue_s),

@@ -1,0 +1,38 @@
+"""apex_tpu.serving.fleet — horizontally scaled serving.
+
+The fleet layer turns one supervised engine into a serving TIER:
+:class:`ReplicaFleet` runs N :class:`~apex_tpu.serving.EngineSupervisor`
+replicas behind a single ``submit()`` front door with least-loaded
+dispatch (:class:`Router`), fleet-wide admission control (an open
+breaker removes a replica from the dispatch set;
+:class:`FleetUnavailableError` only when none remain), and draining
+restarts that migrate in-flight work token-exact to peers so a rebuild
+never drops capacity below N−1. :class:`ShardedEngine` is the
+scale-up counterpart: the same engine with its decode/prefill programs
+tensor-parallel over the device mesh and the flat KV slot pool sharded
+on the heads axis. See docs/serving.md#fleet.
+"""
+
+from apex_tpu.serving.fleet.router import (
+    REPLICA_ACTIVE,
+    REPLICA_DRAINING,
+    REPLICA_FAILED,
+    REPLICA_PROBING,
+    FleetConfig,
+    FleetUnavailableError,
+    ReplicaFleet,
+    Router,
+)
+from apex_tpu.serving.fleet.sharded import ShardedEngine
+
+__all__ = [
+    "ReplicaFleet",
+    "Router",
+    "FleetConfig",
+    "FleetUnavailableError",
+    "ShardedEngine",
+    "REPLICA_ACTIVE",
+    "REPLICA_DRAINING",
+    "REPLICA_PROBING",
+    "REPLICA_FAILED",
+]

@@ -1,0 +1,649 @@
+"""Replica fleet: N supervised engines behind one ``submit()`` front door.
+
+PRs 4–6 built ONE supervised engine: continuous batching, crash-only
+restart recovery, admission control, and a load-test gate — all on a
+single chip. The "millions of users" leg of the ROADMAP needs the same
+semantics horizontally: :class:`ReplicaFleet` runs ``n_replicas``
+:class:`~apex_tpu.serving.EngineSupervisor`-wrapped replicas (each a
+full engine: own slot pool, own KV caches, own jitted programs — or a
+:class:`~apex_tpu.serving.fleet.ShardedEngine` spanning the device
+mesh) behind a single front door, composing the existing primitives the
+way TorchTitan composes parallelism primitives into one entry point:
+
+- **Least-loaded dispatch** (:class:`Router`): each submit goes to the
+  replica minimizing ``queue_depth × EWMA(service_s)`` — the SAME
+  service-time estimate the supervisor's deadline shedding maintains
+  (:attr:`~apex_tpu.serving.EngineSupervisor.service_estimate_s`), so
+  routing and shedding agree about how loaded a replica is. Ties break
+  by depth then replica id, keeping runs deterministic.
+- **Sticky routing**: an admitted request stays on its replica;
+  ``cancel()`` and result harvesting follow it there (and through a
+  migration to wherever it went).
+- **Fleet-wide admission control**: a replica with an OPEN circuit
+  breaker leaves the dispatch set instead of fast-failing the caller —
+  traffic flows to healthy peers, while the sick replica keeps ticking
+  so its breaker can half-open and probe.
+  :class:`FleetUnavailableError` (recorded terminally, like every
+  rejection in this stack) only when NO replica is dispatchable.
+- **Draining restarts**: :meth:`ReplicaFleet.drain_restart` quiesces a
+  replica — dispatch stops, in-flight work either finishes in place or
+  is handed to a peer through the supervisor's token-exact
+  re-prefill continuations
+  (:meth:`~apex_tpu.serving.EngineSupervisor.detach_for_migration`) —
+  then rebuilds it from scratch (fresh supervisor, fresh engine, fresh
+  jit; the service-time EWMA is CARRIED so the rebuilt replica does not
+  shed blind), health-probes it with a real one-token request, and
+  rejoins it to the dispatch set. Only one replica may drain at a time,
+  so a rebuild never drops fleet capacity below N−1.
+
+Telemetry follows the serving contract: fleet counters
+(``fleet_dispatches`` = Σ ``replica<i>_dispatches``, ``replica_drains``,
+``replica_rebuilds``, ``requests_migrated``, ``requests_shed_fleet``)
+are incremented at the same sites as their ``kind="event"`` incident
+records, every terminal request record carries the ``replica_id`` that
+retired it, and ``python -m apex_tpu.monitor`` renders a fleet section
+reconciling the two key-for-key.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from apex_tpu.observability import MetricsRegistry
+from apex_tpu.serving.engine import EngineConfig
+from apex_tpu.serving.request import (
+    FINISH_EOS,
+    FINISH_LENGTH,
+    FINISH_REJECTED,
+    Request,
+    RequestResult,
+    SamplingParams,
+)
+from apex_tpu.serving.scheduler import DeadlineExpiredError, QueueFullError
+from apex_tpu.serving.supervisor import (
+    BREAKER_OPEN,
+    EngineSupervisor,
+    EngineUnavailableError,
+    SupervisorConfig,
+)
+from apex_tpu.utils.logging import get_logger, log_event
+
+__all__ = ["FleetUnavailableError", "FleetConfig", "Router", "ReplicaFleet",
+           "REPLICA_ACTIVE", "REPLICA_DRAINING", "REPLICA_PROBING",
+           "REPLICA_FAILED"]
+
+_LOG = get_logger(__name__)
+
+#: replica lifecycle states (``ReplicaFleet.replica_states``)
+REPLICA_ACTIVE = "active"        # in the dispatch set (breaker permitting)
+REPLICA_DRAINING = "draining"    # quiescing: no new dispatches
+REPLICA_PROBING = "probing"      # rebuilt, health probe in flight
+REPLICA_FAILED = "failed"        # rebuild probes exhausted; out for good
+
+#: declared up front so the final snapshot carries every key even when
+#: an incident type never fired — the monitor's fleet section reconciles
+#: these against the event stream key-for-key
+_FLEET_COUNTERS = ("fleet_dispatches", "replica_drains", "replica_rebuilds",
+                   "requests_migrated", "requests_shed_fleet")
+
+
+class FleetUnavailableError(EngineUnavailableError):
+    """No replica is dispatchable: every one is drained, failed, or has
+    an open circuit breaker. The request IS recorded terminally
+    (``finish_reason="rejected"``) — the fleet-wide analogue of the
+    supervisor's fail-fast contract."""
+
+
+@dataclass
+class FleetConfig:
+    """Fleet sizing and drain-lifecycle knobs (docs/serving.md#fleet).
+
+    ``migrate_on_drain`` picks the drain policy: True hands in-flight
+    work to peers immediately (token-exact re-prefill — the drain
+    completes as fast as one rebuild), False lets the draining replica
+    finish its own work first (no migration cost, slower drain).
+    ``probe_on_rebuild`` gates the health probe — a real one-token
+    greedy request served end-to-end before the replica rejoins;
+    ``max_rebuild_probes`` failed probes mark the replica FAILED
+    instead of looping a persistently-broken rebuild forever.
+    """
+
+    n_replicas: int = 2
+    migrate_on_drain: bool = True
+    probe_on_rebuild: bool = True
+    max_rebuild_probes: int = 3
+
+    def __post_init__(self):
+        if self.n_replicas < 1:
+            raise ValueError(
+                f"n_replicas must be >= 1, got {self.n_replicas}")
+        if self.max_rebuild_probes < 1:
+            raise ValueError(
+                f"max_rebuild_probes must be >= 1, got "
+                f"{self.max_rebuild_probes}")
+
+
+class _Replica:
+    """One fleet slot: a supervisor plus its lifecycle state."""
+
+    __slots__ = ("replica_id", "supervisor", "state", "dispatches",
+                 "probe_id", "probe_attempts")
+
+    def __init__(self, replica_id: int, supervisor: EngineSupervisor):
+        self.replica_id = replica_id
+        self.supervisor = supervisor
+        self.state = REPLICA_ACTIVE
+        self.dispatches = 0
+        self.probe_id: Optional[int] = None
+        self.probe_attempts = 0
+
+
+class _FleetTracked:
+    """Fleet-side state of one admitted-and-not-yet-terminal request —
+    survives replica migrations the way the supervisor's ``_Tracked``
+    survives engine rebuilds."""
+
+    __slots__ = ("request", "first_submit_ts", "prefix", "order",
+                 "replica_id", "migrations")
+
+    def __init__(self, request: Request, submit_ts: float, order: int):
+        self.request = request
+        self.first_submit_ts = submit_ts
+        self.prefix: List[int] = []   # tokens recovered from drained peers
+        self.order = order
+        self.replica_id: Optional[int] = None   # current home (sticky)
+        self.migrations = 0
+
+
+class Router:
+    """The dispatch policy: least loaded first.
+
+    Cost of a replica is ``depth × service_s`` where ``depth`` counts
+    everything already committed to it (queued + backlogged + active
+    slots) and ``service_s`` is the supervisor's deadline-shedding EWMA
+    — before the first completion the EWMA is unknown and the replica
+    costs 0, which deliberately attracts traffic to fresh (just
+    rebuilt) replicas. Deterministic: ties break by depth, then id.
+    """
+
+    @staticmethod
+    def depth(replica: _Replica) -> int:
+        sup = replica.supervisor
+        return sup.queued_count + sup.active_count
+
+    @classmethod
+    def cost(cls, replica: _Replica) -> Tuple[float, int, int]:
+        depth = cls.depth(replica)
+        service = replica.supervisor.service_estimate_s
+        return (depth * service if service is not None else 0.0,
+                depth, replica.replica_id)
+
+    @classmethod
+    def pick(cls, candidates: Sequence[_Replica]) -> _Replica:
+        if not candidates:
+            raise ValueError("no candidates to route to")
+        return min(candidates, key=cls.cost)
+
+
+class ReplicaFleet:
+    """Horizontally scaled serving tier; see the module docstring. The
+    driving surface mirrors :class:`~apex_tpu.serving.EngineSupervisor`
+    (``submit`` / ``cancel`` / ``tick`` / ``serve`` / ``close``,
+    results in :attr:`completed`), so the loadtest runner and other
+    drivers work against either unchanged.
+
+    ``faults`` may be a single ``ServingFaultInjector`` (applied to
+    replica 0) or a ``{replica_id: injector}`` dict; injector call
+    counters keep advancing across replica rebuilds, so a scheduled
+    fault fires exactly once fleet-wide.
+    """
+
+    def __init__(self, model, params,
+                 config: Optional[EngineConfig] = None, *,
+                 supervisor: Optional[SupervisorConfig] = None,
+                 fleet: Optional[FleetConfig] = None,
+                 metrics: Optional[MetricsRegistry] = None,
+                 faults=None, router: Optional[Router] = None,
+                 engine_factory=None):
+        self._model = model
+        self._params = params
+        self.config = config or EngineConfig()
+        self.supervisor_config = supervisor or SupervisorConfig()
+        self.fleet = fleet or FleetConfig()
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        self.metrics.declare_counters(*_FLEET_COUNTERS)
+        self.metrics.declare_counters(
+            *(f"replica{i}_dispatches"
+              for i in range(self.fleet.n_replicas)))
+        self.router = router or Router()
+        self._engine_factory = engine_factory
+        if faults is None:
+            self._faults: Dict[int, object] = {}
+        elif isinstance(faults, dict):
+            self._faults = dict(faults)
+        else:
+            self._faults = {0: faults}
+        unknown = set(self._faults) - set(range(self.fleet.n_replicas))
+        if unknown:
+            raise ValueError(
+                f"faults keyed by unknown replica ids {sorted(unknown)}; "
+                f"fleet has replicas 0..{self.fleet.n_replicas - 1}")
+        self.completed: Dict[int, RequestResult] = {}
+        self._tracked: Dict[int, _FleetTracked] = {}
+        #: migrated continuations waiting for a dispatchable peer
+        self._backlog: List[Request] = []
+        self._order = 0
+        self._closed = False
+        self._engine_restarts_base = 0   # restarts of already-rebuilt sups
+        self.replicas: List[_Replica] = [
+            _Replica(i, self._build_supervisor(i))
+            for i in range(self.fleet.n_replicas)]
+
+    def _build_supervisor(self, replica_id: int,
+                          service_s: Optional[float] = None
+                          ) -> EngineSupervisor:
+        return EngineSupervisor(
+            self._model, self._params, self.config,
+            supervisor=self.supervisor_config, metrics=self.metrics,
+            faults=self._faults.get(replica_id), replica_id=replica_id,
+            service_s=service_s, engine_factory=self._engine_factory)
+
+    # -- introspection ----------------------------------------------------
+
+    @property
+    def n_replicas(self) -> int:
+        return len(self.replicas)
+
+    @property
+    def replica_states(self) -> Dict[int, str]:
+        return {r.replica_id: r.state for r in self.replicas}
+
+    @property
+    def restarts(self) -> int:
+        """Engine restarts across the fleet's whole history (rebuilt
+        replicas included) — what the loadtest runner reports."""
+        return self._engine_restarts_base + sum(
+            r.supervisor.restarts for r in self.replicas)
+
+    @property
+    def inflight_count(self) -> int:
+        """Non-terminal client requests plus in-flight health probes —
+        nonzero means :meth:`tick` still has work to advance."""
+        return len(self._tracked) + sum(
+            1 for r in self.replicas if r.probe_id is not None)
+
+    @property
+    def inflight_ids(self) -> List[int]:
+        """Ids of non-terminal CLIENT requests (probes are fleet-internal
+        and excluded) — what a driver cancels to abort early."""
+        return sorted(self._tracked)
+
+    def dispatch_set(self) -> List[_Replica]:
+        """Replicas currently taking new work: ACTIVE and breaker not
+        open. Draining / probing / failed replicas are excluded — that is
+        what makes a restart 'draining' rather than disruptive."""
+        return [r for r in self.replicas
+                if r.state == REPLICA_ACTIVE
+                and r.supervisor.breaker_state != BREAKER_OPEN]
+
+    # -- admission --------------------------------------------------------
+
+    def submit(self, request: Request) -> int:
+        """Route one request to the least-loaded dispatchable replica.
+        Raises :class:`FleetUnavailableError` when no replica can take
+        work (recorded terminally), or whatever the chosen replica's own
+        admission gates raise (queue full, deadline shed — also recorded
+        terminally, by the replica, with its ``replica_id``)."""
+        if self._closed:
+            raise RuntimeError("fleet is closed")
+        now = time.monotonic()
+        candidates = self.dispatch_set()
+        if not candidates:
+            self._shed_fleet(request, now)
+        replica = self.router.pick(candidates)
+        tr = _FleetTracked(request, now, self._order)
+        self._order += 1
+        self._tracked[request.request_id] = tr
+        try:
+            replica.supervisor.submit(request)
+        except Exception:
+            # the replica recorded the rejection terminally (with its
+            # replica_id); keep the fleet's view consistent
+            self._harvest_replica(replica, now)
+            self._tracked.pop(request.request_id, None)
+            raise
+        tr.replica_id = replica.replica_id
+        self._count_dispatch(replica)
+        return request.request_id
+
+    def _count_dispatch(self, replica: _Replica) -> None:
+        replica.dispatches += 1
+        self.metrics.inc("fleet_dispatches")
+        self.metrics.inc(f"replica{replica.replica_id}_dispatches")
+
+    def _shed_fleet(self, request: Request, now: float) -> None:
+        """No dispatchable replica: terminal ``rejected`` record +
+        counters + ``request_shed`` (reason ``fleet``) event, then
+        raise — the same contract as the supervisor's ``_shed``."""
+        self.metrics.inc("requests_submitted")
+        self.metrics.inc("requests_shed_fleet")
+        self.metrics.inc(f"requests_{FINISH_REJECTED}")
+        start = request.arrival_ts if request.arrival_ts is not None \
+            else now
+        result = RequestResult(
+            request_id=request.request_id, prompt_len=request.prompt_len,
+            tokens=[], finish_reason=FINISH_REJECTED,
+            queue_s=now - start, total_s=now - start)
+        self.completed[request.request_id] = result
+        self.metrics.emit_record(result.record(wall=time.time()))
+        states = {r.replica_id: (BREAKER_OPEN
+                                 if r.supervisor.breaker_state ==
+                                 BREAKER_OPEN and r.state == REPLICA_ACTIVE
+                                 else r.state)
+                  for r in self.replicas}
+        log_event(_LOG, "request_shed", request_id=request.request_id,
+                  reason="fleet", replicas=str(states))
+        self.metrics.event("request_shed", request_id=request.request_id,
+                           reason="fleet", replicas=str(states))
+        raise FleetUnavailableError(
+            f"request {request.request_id} shed at the fleet front door: "
+            f"no dispatchable replica (states: {states}) — every replica "
+            f"is draining, failed, or has an open circuit breaker")
+
+    def cancel(self, request_id: int) -> bool:
+        """Cancel wherever the request currently lives: the migration
+        backlog, or (sticky) the replica it was dispatched to."""
+        now = time.monotonic()
+        tr = self._tracked.get(request_id)
+        if tr is None:
+            return False
+        for i, cont in enumerate(self._backlog):
+            if cont.request_id == request_id:
+                del self._backlog[i]
+                self._tracked.pop(request_id)
+                self._retire_fleet(tr, "cancelled", now)
+                return True
+        if tr.replica_id is None:
+            return False
+        replica = self.replicas[tr.replica_id]
+        found = replica.supervisor.cancel(request_id)
+        if found:
+            self._harvest_replica(replica, now)
+        return found
+
+    # -- the fleet tick ---------------------------------------------------
+
+    def tick(self) -> List[RequestResult]:
+        """One fleet iteration: re-home migrated work, tick every live
+        replica (each runs at most one decode step), harvest terminal
+        results, and advance any drain/probe lifecycle. Returns requests
+        that reached a terminal state in the fleet's view."""
+        if self._closed:
+            raise RuntimeError("fleet is closed")
+        before = set(self.completed)
+        self._dispatch_backlog()
+        for replica in self.replicas:
+            if replica.state == REPLICA_FAILED:
+                continue
+            replica.supervisor.tick()
+            self._harvest_replica(replica, time.monotonic())
+        self._advance_drains()
+        return [self.completed[rid] for rid in sorted(
+            set(self.completed) - before)]
+
+    def serve(self, requests: Sequence[Request], *,
+              on_tick: Optional[Callable[["ReplicaFleet", int], None]]
+              = None, max_ticks: Optional[int] = None
+              ) -> List[RequestResult]:
+        """Serve ``requests`` to completion across the fleet. Requests
+        rejected at admission (fleet or replica gates) are terminal
+        immediately with ``finish_reason="rejected"`` — every submitted
+        request reaches exactly one terminal state."""
+        pending = list(requests)
+        ids = [r.request_id for r in pending]
+        ticks = 0
+        while pending or self.inflight_count:
+            while pending:
+                req = pending[0]
+                targets = self.dispatch_set()
+                if targets and all(
+                        Router.depth(t) >= self.config.scheduler.max_queue
+                        for t in targets):
+                    break       # every queue is full: tick, then retry
+                pending.pop(0)
+                try:
+                    self.submit(req)
+                except (EngineUnavailableError, QueueFullError,
+                        DeadlineExpiredError):
+                    pass        # already recorded terminally
+            self.tick()
+            ticks += 1
+            if on_tick is not None:
+                on_tick(self, ticks)
+            if max_ticks is not None and ticks >= max_ticks:
+                break
+        return [self.completed[i] for i in ids if i in self.completed]
+
+    # -- draining restarts ------------------------------------------------
+
+    def drain_restart(self, replica_id: int) -> None:
+        """Begin a draining restart of one replica: quiesce (leave the
+        dispatch set), migrate or finish its in-flight work, rebuild,
+        health-probe, rejoin. Progress happens across :meth:`tick`
+        calls; fleet capacity never drops below N−1 because only one
+        replica may be draining/probing at a time (a second request
+        raises ``RuntimeError`` instead of silently stacking drains)."""
+        if self._closed:
+            raise RuntimeError("fleet is closed")
+        if not 0 <= replica_id < len(self.replicas):
+            raise ValueError(f"no replica {replica_id} "
+                             f"(fleet has 0..{len(self.replicas) - 1})")
+        replica = self.replicas[replica_id]
+        if replica.state != REPLICA_ACTIVE:
+            raise RuntimeError(
+                f"replica {replica_id} is {replica.state}, not active")
+        busy = [r.replica_id for r in self.replicas
+                if r.state in (REPLICA_DRAINING, REPLICA_PROBING)]
+        if busy:
+            raise RuntimeError(
+                f"replica {busy[0]} is already draining/probing — one "
+                f"restart at a time keeps fleet capacity at N-1")
+        replica.state = REPLICA_DRAINING
+        self.metrics.inc("replica_drains")
+        inflight = replica.supervisor.inflight_count
+        log_event(_LOG, "replica_drain", replica_id=replica_id,
+                  inflight=inflight,
+                  migrate=self.fleet.migrate_on_drain)
+        self.metrics.event("replica_drain", replica_id=replica_id,
+                           inflight=inflight,
+                           migrate=self.fleet.migrate_on_drain)
+        if self.fleet.migrate_on_drain:
+            self._migrate_from(replica)
+        self._advance_drains()
+
+    def _migrate_from(self, replica: _Replica) -> None:
+        """Detach the draining replica's non-terminal work as token-exact
+        continuations and queue them for peers."""
+        now = time.monotonic()
+        conts = replica.supervisor.detach_for_migration()
+        self._harvest_replica(replica, now)   # detach may retire some
+        for cont, recovered in conts:
+            tr = self._tracked.get(cont.request_id)
+            if tr is None:      # cancelled between snapshot and handover
+                continue
+            tr.prefix += recovered
+            tr.replica_id = None
+            tr.migrations += 1
+            self.metrics.inc("requests_migrated")
+            log_event(_LOG, "request_migrated",
+                      request_id=cont.request_id,
+                      from_replica=replica.replica_id,
+                      tokens_carried=len(recovered))
+            self.metrics.event("request_migrated",
+                               request_id=cont.request_id,
+                               from_replica=replica.replica_id,
+                               tokens_carried=len(recovered))
+            self._backlog.append(cont)
+        self._dispatch_backlog()
+
+    def _dispatch_backlog(self) -> None:
+        """Re-home migrated continuations on the least-loaded peer with
+        queue room; whatever cannot be placed yet stays backlogged (and
+        keeps being retried every tick — never dropped)."""
+        kept: List[Request] = []
+        for cont in self._backlog:
+            tr = self._tracked.get(cont.request_id)
+            if tr is None:
+                continue        # cancelled while backlogged
+            candidates = [r for r in self.dispatch_set()
+                          if Router.depth(r)
+                          < self.config.scheduler.max_queue]
+            if not candidates:
+                kept.append(cont)
+                continue
+            replica = self.router.pick(candidates)
+            try:
+                replica.supervisor.submit(cont, resubmission=True)
+            except (QueueFullError, DeadlineExpiredError,
+                    EngineUnavailableError):
+                # recorded terminally by the replica — harvest below
+                self._harvest_replica(replica, time.monotonic())
+                continue
+            tr.replica_id = replica.replica_id
+            self._count_dispatch(replica)
+        self._backlog = kept
+
+    def _advance_drains(self) -> None:
+        """Move the drain/probe lifecycle forward: rebuild a drained-out
+        replica, then score its health probe."""
+        for replica in self.replicas:
+            if (replica.state == REPLICA_DRAINING
+                    and replica.supervisor.inflight_count == 0):
+                self._rebuild(replica)
+            if replica.state == REPLICA_PROBING:
+                self._check_probe(replica)
+
+    def _rebuild(self, replica: _Replica) -> None:
+        """Tear down the drained supervisor and build a fresh one (new
+        engine, slot pool, jit programs), carrying the service-time EWMA
+        so post-rebuild deadline shedding is not blind."""
+        old = replica.supervisor
+        carried = old.service_estimate_s
+        self._engine_restarts_base += old.restarts
+        old.close()
+        replica.supervisor = self._build_supervisor(
+            replica.replica_id, service_s=carried)
+        self.metrics.inc("replica_rebuilds")
+        log_event(_LOG, "replica_rebuild", replica_id=replica.replica_id,
+                  carried_service_s=carried)
+        self.metrics.event("replica_rebuild",
+                           replica_id=replica.replica_id,
+                           carried_service_s=carried)
+        if self.fleet.probe_on_rebuild:
+            replica.state = REPLICA_PROBING
+            self._launch_probe(replica)
+        else:
+            replica.state = REPLICA_ACTIVE
+
+    def _launch_probe(self, replica: _Replica) -> None:
+        """One-token greedy health probe through the NORMAL submit path —
+        counted and recorded like any request (conservation holds), so a
+        replica only rejoins after serving real work end-to-end."""
+        replica.probe_attempts += 1
+        probe = Request(prompt=[0], max_new_tokens=1,
+                        sampling=SamplingParams())
+        replica.probe_id = probe.request_id
+        try:
+            replica.supervisor.submit(probe)
+        except Exception:       # a probe the engine cannot even queue
+            replica.probe_id = None
+            self._probe_failed(replica)
+
+    def _check_probe(self, replica: _Replica) -> None:
+        if replica.probe_id is None:
+            return
+        res = replica.supervisor.completed.get(replica.probe_id)
+        if res is None:
+            return              # probe still in flight; keep ticking
+        replica.probe_id = None
+        if res.finish_reason in (FINISH_EOS, FINISH_LENGTH):
+            replica.state = REPLICA_ACTIVE
+            replica.probe_attempts = 0
+        else:
+            self._probe_failed(replica)
+
+    def _probe_failed(self, replica: _Replica) -> None:
+        if replica.probe_attempts >= self.fleet.max_rebuild_probes:
+            replica.state = REPLICA_FAILED
+            log_event(_LOG, "replica_failed",
+                      replica_id=replica.replica_id,
+                      probe_attempts=replica.probe_attempts)
+            self.metrics.event("replica_failed",
+                               replica_id=replica.replica_id,
+                               probe_attempts=replica.probe_attempts)
+            return
+        self._rebuild(replica)  # another rebuild + probe round
+
+    # -- harvesting -------------------------------------------------------
+
+    def _harvest_replica(self, replica: _Replica, now: float) -> None:
+        """Pull newly-terminal results from one replica into the fleet's
+        view, stitching migrated requests back together (fleet-side
+        prefix + the replica's continuation tokens, the ORIGINAL prompt
+        length, total latency from the FIRST dispatch)."""
+        sup = replica.supervisor
+        done = [rid for rid in list(self._tracked)
+                if rid in sup.completed]
+        for rid in sorted(done, key=lambda r: self._tracked[r].order):
+            tr = self._tracked.pop(rid)
+            res = sup.completed[rid]
+            if tr.prefix or tr.migrations:
+                res = RequestResult(
+                    request_id=rid, prompt_len=tr.request.prompt_len,
+                    tokens=tr.prefix + res.tokens,
+                    finish_reason=res.finish_reason,
+                    queue_s=res.queue_s, prefill_s=res.prefill_s,
+                    decode_s=res.decode_s,
+                    total_s=now - tr.first_submit_ts,
+                    ttft_s=None if tr.prefix else res.ttft_s,
+                    tpot_s=res.tpot_s, replica_id=res.replica_id)
+            self.completed[rid] = res
+
+    def _retire_fleet(self, tr: _FleetTracked, reason: str,
+                      now: float) -> RequestResult:
+        """Terminal retirement by the fleet itself (cancelled from the
+        migration backlog): one counter, one record, one event — the
+        same contract as a replica-side finish."""
+        rid = tr.request.request_id
+        result = RequestResult(
+            request_id=rid, prompt_len=tr.request.prompt_len,
+            tokens=list(tr.prefix), finish_reason=reason,
+            total_s=now - tr.first_submit_ts)
+        self.completed[rid] = result
+        self.metrics.inc(f"requests_{reason}")
+        self.metrics.emit_record(result.record(wall=time.time()))
+        log_event(_LOG, f"request_{reason}", request_id=rid,
+                  new_tokens=result.new_tokens)
+        self.metrics.event(f"request_{reason}", request_id=rid,
+                           new_tokens=result.new_tokens)
+        return result
+
+    # -- lifecycle --------------------------------------------------------
+
+    def close(self) -> None:
+        """Close every replica (releases slots, flushes the registry).
+        Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        for replica in self.replicas:
+            replica.supervisor.close()
+
+    def __enter__(self) -> "ReplicaFleet":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.close()
+        return False

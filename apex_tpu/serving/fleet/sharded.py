@@ -1,0 +1,130 @@
+"""Tensor-parallel serving engine over the device mesh.
+
+One :class:`~apex_tpu.serving.InferenceEngine` serves from one chip's
+HBM; a model too large (or a batch too hungry) for one chip needs the
+decode step itself spread over the mesh. :class:`ShardedEngine` is the
+same engine — same slot pool, same scheduler, same quarantine and
+telemetry, same host-side arrays — with its three device programs
+(decode / bucketed prefill / quarantine scrub) wrapped in ``shard_map``
+over the ``tensor`` mesh axis (via the :mod:`apex_tpu.utils.sharding`
+shims), reusing the :mod:`apex_tpu.transformer` TP layers the multichip
+training dryruns already hold parity with:
+
+- **Parameters** shard by the model's own partition spec
+  (``model.spec()``): column/row-parallel QKV and MLP blocks, the
+  vocab-sharded embedding doubling as the LM head.
+- **The flat KV slot pool shards on the heads axis**: each rank owns
+  the ``[max_slots, max_len, local_kv_heads * head_dim]`` slice whose
+  head block its QKV projection computes, so prefill's scatter and
+  decode's one-row append stay rank-local — no KV traffic crosses the
+  mesh, exactly like the training-side cache layout under TP.
+- **Logits are gathered to full vocab inside the step** (the same
+  ``all_gather`` the generation path uses), so sampling and the
+  per-slot integrity flags run replicated and every rank agrees on the
+  next token — the host-side engine logic cannot tell it is driving a
+  sharded program.
+
+Parity bar (tier-1/slow tests): decode on a tp=2 CPU mesh is
+TOKEN-EXACT against the unsharded engine, greedy and sampled, with zero
+decode retraces — the same bar every multichip training dryrun meets.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.serving.engine import EngineConfig, InferenceEngine
+from apex_tpu.transformer import parallel_state
+from apex_tpu.utils.sharding import shard_map
+
+__all__ = ["ShardedEngine"]
+
+
+class ShardedEngine(InferenceEngine):
+    """Tensor-parallel :class:`~apex_tpu.serving.InferenceEngine`; see
+    the module docstring. ``mesh`` defaults to the initialized
+    :mod:`~apex_tpu.transformer.parallel_state` mesh
+    (``initialize_model_parallel(tensor_model_parallel_size=tp)``)."""
+
+    def __init__(self, model, params, config: Optional[EngineConfig] = None,
+                 *, mesh=None, metrics=None, faults=None,
+                 replica_id: Optional[int] = None):
+        self.mesh = mesh if mesh is not None else parallel_state.get_mesh()
+        c = model.config
+        self._tp = self.mesh.shape[c.axis_name]
+        if c.kv_heads % self._tp:
+            raise ValueError(
+                f"kv heads ({c.kv_heads}) must be divisible by the "
+                f"tensor-parallel size ({self._tp}); with GQA/MQA keep "
+                f"num_query_groups a multiple of tp")
+        if c.vocab_size % self._tp:
+            raise ValueError(
+                f"vocab_size ({c.vocab_size}) must be divisible by the "
+                f"tensor-parallel size ({self._tp}) — the embedding / LM "
+                f"head shard on the vocab dim (pad the vocab, as training "
+                f"TP does)")
+        if c.sequence_parallel:
+            raise ValueError(
+                "ShardedEngine decodes single tokens per slot — "
+                "sequence_parallel has nothing to shard; build the model "
+                "with sequence_parallel=False for serving")
+        super().__init__(model, params, config, metrics=metrics,
+                         faults=faults, replica_id=replica_id)
+
+    # -- sharding specs ---------------------------------------------------
+
+    def _param_spec(self):
+        """``model.spec()`` reshaped to match the engine's prepared
+        params: the one-time ``preslice_layer_params`` turns the stacked
+        ``[L, ...]`` transformer layers into a per-layer LIST, so the
+        stacked spec's leading (layer) dim is stripped and the per-layer
+        spec repeated."""
+        spec = self.model.spec()
+        layers = self._params.get("transformer", {}).get("layers")
+        if isinstance(layers, (list, tuple)):
+            is_spec = lambda x: isinstance(x, P)           # noqa: E731
+            per_layer = jax.tree_util.tree_map(
+                lambda s: P(*tuple(s)[1:]),
+                spec["transformer"]["layers"], is_leaf=is_spec)
+            spec = dict(spec)
+            spec["transformer"] = dict(spec["transformer"])
+            spec["transformer"]["layers"] = [per_layer] * len(layers)
+        return spec
+
+    def _cache_spec(self):
+        """The flat ``[max_slots, max_len, kv_heads * head_dim]`` pool
+        shards its fused heads*head_dim minor dim over the tensor axis —
+        each rank's contiguous block is exactly the head slice its QKV
+        projection produces."""
+        axis = self.model.config.axis_name
+        pair = (P(None, None, axis), P(None, None, axis))
+        return [pair for _ in range(self.model.config.num_layers)]
+
+    def _build_step_fns(self, donate: bool):
+        """The base engine's step bodies, ``shard_map``-wrapped over the
+        mesh: params by ``model.spec()``, KV pool on the heads axis,
+        tokens/positions/sampling params replicated. The bodies
+        themselves are INHERITED — this class changes where the math
+        runs, not what it computes."""
+        mesh = self.mesh
+        pspec = self._param_spec()
+        cspec = self._cache_spec()
+        rep = P()
+        decode = shard_map(
+            self._decode_body, mesh=mesh,
+            in_specs=(pspec, cspec, rep, rep, rep, rep, rep),
+            out_specs=(rep, rep, cspec))
+        prefill = shard_map(
+            self._prefill_body, mesh=mesh,
+            in_specs=(pspec, cspec, rep, rep, rep, rep, rep, rep),
+            out_specs=(rep, cspec))
+        scrub = shard_map(
+            self._scrub_body, mesh=mesh,
+            in_specs=(cspec, rep), out_specs=cspec)
+        donate_args = (1,) if donate else ()
+        return (jax.jit(decode, donate_argnums=donate_args),
+                jax.jit(prefill, donate_argnums=donate_args),
+                jax.jit(scrub, donate_argnums=(0,) if donate else ()))

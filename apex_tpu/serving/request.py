@@ -125,6 +125,13 @@ class RequestResult:
     the engine's own token timestamps, NOT reconstructed by adding the
     coarse queue/prefill buckets. ``None`` when unmeasurable: ``ttft_s``
     for a request that produced no token, ``tpot_s`` below two tokens.
+
+    ``replica_id`` is the serving replica that retired the request —
+    set by engines running under a :class:`~apex_tpu.serving.fleet.\
+ReplicaFleet`; ``None`` on a single-engine deployment or a fleet-level
+    outcome (shed at the fleet front door, retired mid-migration), and
+    OMITTED from the JSONL record when ``None`` so pre-fleet report
+    readers keep working unchanged.
     """
 
     request_id: int
@@ -137,6 +144,7 @@ class RequestResult:
     total_s: float = 0.0
     ttft_s: Optional[float] = None
     tpot_s: Optional[float] = None
+    replica_id: Optional[int] = None
 
     @property
     def new_tokens(self) -> int:
@@ -164,6 +172,8 @@ class RequestResult:
         # optional fields are OMITTED (not null) when unmeasured, so the
         # records stay readable by pre-TTFT report readers and the
         # summary's per-field guards
+        if self.replica_id is not None:
+            rec["replica_id"] = self.replica_id
         if self.ttft_s is not None:
             rec["ttft_s"] = self.ttft_s
         if self.tpot_s is not None:

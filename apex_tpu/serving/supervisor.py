@@ -166,7 +166,9 @@ class EngineSupervisor:
                  config: Optional[EngineConfig] = None, *,
                  supervisor: Optional[SupervisorConfig] = None,
                  metrics: Optional[MetricsRegistry] = None,
-                 faults=None):
+                 faults=None, replica_id: Optional[int] = None,
+                 service_s: Optional[float] = None,
+                 engine_factory=None):
         self._model = model
         self._params = params
         self.config = config or EngineConfig()
@@ -174,6 +176,9 @@ class EngineSupervisor:
         self.metrics = metrics if metrics is not None else MetricsRegistry()
         self.metrics.declare_counters(*_SUP_COUNTERS)
         self._faults = faults
+        #: fleet replica label, stamped on every result/record this
+        #: supervisor (or its engines) emits; None = standalone
+        self.replica_id = replica_id
         self.completed: Dict[int, RequestResult] = {}
         self._tracked: Dict[int, _Tracked] = {}
         #: restart continuations waiting for queue room in the new engine
@@ -184,12 +189,23 @@ class EngineSupervisor:
         self.breaker_state = BREAKER_CLOSED
         self._breaker_opened_ts = 0.0
         self._consecutive_failures = 0
-        self._service_s: Optional[float] = None
+        # the deadline-shedding EWMA is SUPERVISOR state: it survives
+        # engine rebuilds, and a fleet replica rebuild seeds the fresh
+        # supervisor with the old one's estimate (``service_s=``) so the
+        # first post-restart submits are not admitted blind
+        self._service_s: Optional[float] = service_s
+        #: custom engine constructor, ``(model, params, config, *,
+        #: metrics, faults, replica_id) -> InferenceEngine`` — how a
+        #: fleet runs :class:`~apex_tpu.serving.fleet.ShardedEngine`
+        #: replicas under the same supervision
+        self._engine_factory = engine_factory or InferenceEngine
         self.engine = self._build_engine()
 
     def _build_engine(self) -> InferenceEngine:
-        return InferenceEngine(self._model, self._params, self.config,
-                               metrics=self.metrics, faults=self._faults)
+        return self._engine_factory(self._model, self._params, self.config,
+                                    metrics=self.metrics,
+                                    faults=self._faults,
+                                    replica_id=self.replica_id)
 
     # -- introspection ----------------------------------------------------
 
@@ -213,22 +229,36 @@ class EngineSupervisor:
         wall-budget abort path)."""
         return sorted(self._tracked)
 
+    @property
+    def service_estimate_s(self) -> Optional[float]:
+        """The deadline-shedding EWMA of observed per-request service
+        time (None until the first completion) — also the fleet router's
+        per-replica load weight, and the value carried into a rebuilt
+        replica so it never restarts blind."""
+        return self._service_s
+
     # -- admission --------------------------------------------------------
 
-    def submit(self, request: Request) -> int:
+    def submit(self, request: Request, *, resubmission: bool = False) -> int:
         """Admit one request through the overload gates: circuit breaker
         first, then the deadline-aware shed estimate, then the engine's
         own queue bound and expired-deadline fast-fail. Raises
         :class:`EngineUnavailableError` /
         :class:`~apex_tpu.serving.scheduler.QueueFullError` /
         :class:`~apex_tpu.serving.scheduler.DeadlineExpiredError`; every
-        rejection is recorded terminally."""
+        rejection is recorded terminally.
+
+        ``resubmission=True`` is the fleet's migration path (a request
+        handed over from a draining peer): it was already counted at its
+        ORIGINAL submit, so ``requests_submitted`` is not incremented
+        again — one arrival == one count == one terminal record, however
+        many replicas the request visited."""
         if self._closed:
             raise RuntimeError("supervisor is closed")
         now = time.monotonic()
         self._poll_breaker(now)
         if self.breaker_state == BREAKER_OPEN:
-            self._shed(request, "breaker", now)
+            self._shed(request, "breaker", now, resubmission=resubmission)
         if (self.supervisor.shed_deadlines
                 and request.deadline_s is not None
                 and self._service_s is not None):
@@ -241,12 +271,13 @@ class EngineSupervisor:
             remaining = request.deadline_s - (now - start)
             if projected > remaining:
                 self._shed(request, "deadline", now,
+                           resubmission=resubmission,
                            projected_s=projected, remaining_s=remaining)
         tr = _Tracked(request, now, self._order)
         self._order += 1
         self._tracked[request.request_id] = tr
         try:
-            self.engine.submit(request)
+            self.engine.submit(request, resubmission=resubmission)
         except Exception:
             # QueueFull/DeadlineExpired were recorded terminally by the
             # engine and harvest below; validation errors recorded
@@ -256,11 +287,12 @@ class EngineSupervisor:
             raise
         return request.request_id
 
-    def _shed(self, request: Request, why: str, now: float,
-              **fields) -> None:
+    def _shed(self, request: Request, why: str, now: float, *,
+              resubmission: bool = False, **fields) -> None:
         """Reject at admission: terminal ``rejected`` record + counters +
         ``request_shed`` incident event, then raise."""
-        self.metrics.inc("requests_submitted")
+        if not resubmission:
+            self.metrics.inc("requests_submitted")
         self.metrics.inc(f"requests_shed_{why}")
         self.metrics.inc(f"requests_{FINISH_REJECTED}")
         start = request.arrival_ts if request.arrival_ts is not None \
@@ -268,7 +300,8 @@ class EngineSupervisor:
         result = RequestResult(
             request_id=request.request_id, prompt_len=request.prompt_len,
             tokens=[], finish_reason=FINISH_REJECTED,
-            queue_s=now - start, total_s=now - start)
+            queue_s=now - start, total_s=now - start,
+            replica_id=self.replica_id)
         self.completed[request.request_id] = result
         self.metrics.emit_record(result.record(wall=time.time()))
         log_event(_LOG, "request_shed", request_id=request.request_id,
@@ -474,7 +507,7 @@ class EngineSupervisor:
         result = RequestResult(
             request_id=rid, prompt_len=tr.request.prompt_len,
             tokens=list(tr.prefix), finish_reason=reason,
-            total_s=now - tr.first_submit_ts)
+            total_s=now - tr.first_submit_ts, replica_id=self.replica_id)
         self.completed[rid] = result
         self.metrics.inc(f"requests_{reason}")
         self.metrics.emit_record(result.record(wall=time.time()))
@@ -534,7 +567,7 @@ class EngineSupervisor:
                     decode_s=res.decode_s,
                     total_s=now - tr.first_submit_ts,
                     ttft_s=None if tr.prefix else res.ttft_s,
-                    tpot_s=res.tpot_s)
+                    tpot_s=res.tpot_s, replica_id=res.replica_id)
             self.completed[rid] = res
             service = res.prefill_s + res.decode_s
             if service > 0 and res.finish_reason in (FINISH_EOS,
@@ -543,6 +576,37 @@ class EngineSupervisor:
                 self._service_s = (
                     service if self._service_s is None
                     else a * service + (1.0 - a) * self._service_s)
+
+    # -- migration (the fleet's draining-restart path) --------------------
+
+    def detach_for_migration(self) -> List:
+        """Hand every non-terminal request over to the caller as
+        ``(continuation, recovered_tokens)`` pairs, in arrival order —
+        the fleet's draining-restart path: a peer replica re-prefills
+        each continuation (prompt + tokens already generated) TOKEN-EXACT,
+        exactly like this supervisor's own restart recovery.
+
+        A request with nothing left to do (budget fully generated,
+        deadline already expired) is retired terminally here instead of
+        being handed over. After this call the supervisor tracks nothing;
+        the caller is expected to :meth:`close` and rebuild it. Migration
+        is not a failure: per-request restart budgets are NOT charged."""
+        now = time.monotonic()
+        self._harvest(now)
+        inflight = {req.request_id: toks
+                    for req, toks, _ in self.engine.inflight()}
+        out: List = []
+        for rid in sorted(self._tracked,
+                          key=lambda r: self._tracked[r].order):
+            tr = self._tracked[rid]
+            tr.prefix += inflight.get(rid, [])
+            cont = self._continuation(tr, now)
+            if cont is None:
+                continue        # retired (length/timeout) terminally
+            self._tracked.pop(rid)
+            out.append((cont, list(tr.prefix)))
+        self._backlog = []
+        return out
 
     # -- lifecycle --------------------------------------------------------
 

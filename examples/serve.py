@@ -16,6 +16,14 @@ final outputs are token-exact as if nothing had happened, and the run
 report's incident timeline shows the restart/recovery events
 reconciling with the registry counters.
 
+Act 3 is the fleet demo (docs/serving.md#fleet): a 2-replica
+:class:`~apex_tpu.serving.ReplicaFleet` serves the same traffic while
+replica 0's engine crashes (supervised in-place recovery) AND replica 1
+takes a mid-run DRAINING restart (its in-flight work migrates
+token-exact to replica 0, it rebuilds, health-probes, rejoins) — zero
+dropped requests, every output token-exact, and the run report's fleet
+section reconciles key-for-key with the counters.
+
 Run (from the repo root): PYTHONPATH=. python examples/serve.py
 """
 
@@ -29,6 +37,7 @@ import numpy as np
 from apex_tpu.models import GPTModel, TransformerConfig, generate
 from apex_tpu.observability import JsonlSink, MetricsRegistry
 from apex_tpu.observability.report import (
+    FLEET_INCIDENT_COUNTERS,
     SERVING_INCIDENT_COUNTERS,
     build_report,
     render_report,
@@ -36,7 +45,9 @@ from apex_tpu.observability.report import (
 from apex_tpu.serving import (
     EngineConfig,
     EngineSupervisor,
+    FleetConfig,
     InferenceEngine,
+    ReplicaFleet,
     Request,
     SamplingParams,
     SchedulerConfig,
@@ -132,6 +143,47 @@ def main():
           f"requests_recovered={counters['requests_recovered']} "
           f"tick_failures={counters['tick_failures']}")
 
+    # ---- act 3: a replica fleet rides out a crash AND a drain ----------
+    print("\n=== act 3: 2-replica fleet — replica crash + draining "
+          "restart, zero dropped requests ===")
+    fleet_reqs = [Request(prompt=prompts[i % len(prompts)],
+                          max_new_tokens=12 + 2 * i) for i in range(5)]
+    fleet = ReplicaFleet(
+        model, params, EngineConfig(max_slots=4, max_len=128),
+        fleet=FleetConfig(n_replicas=2), metrics=registry,
+        # replica 0's decode crashes mid-run; its supervisor rebuilds the
+        # engine and recovers in place — the fleet never notices
+        faults={0: ServingFaultInjector(decode_raise_calls={4})})
+    drained = []
+
+    def drain_mid_run(fl, tick):
+        # fleet-level fault injection: a planned rebuild of replica 1
+        # while traffic is in flight — its work migrates to replica 0
+        if tick == 3 and not drained and \
+                fl.replica_states[1] == "active":
+            fl.drain_restart(1)
+            drained.append(tick)
+            print(f"[tick {tick}] draining restart of replica 1 "
+                  f"(states: {fl.replica_states})")
+
+    with fleet:
+        fleet_results = fleet.serve(fleet_reqs, on_tick=drain_mid_run)
+    assert drained, "drain never fired"
+    for req, res in zip(fleet_reqs, fleet_results):
+        assert res.finish_reason == "length", (res.request_id,
+                                               res.finish_reason)
+        ref = generate(model, params, jnp.asarray([req.prompt], jnp.int32),
+                       req.max_new_tokens, max_len=128)
+        assert res.tokens == np.asarray(
+            ref[0, req.prompt_len:]).tolist(), req.request_id
+        print(f"request {req.request_id}: replica={res.replica_id} "
+              f"{res.new_tokens} tokens — token-exact")
+    counters = registry.counters()
+    print(f"fleet_dispatches={counters['fleet_dispatches']} "
+          f"requests_migrated={counters['requests_migrated']} "
+          f"replica_rebuilds={counters['replica_rebuilds']} — "
+          f"zero dropped requests")
+
     print(f"\n=== run report ({log_path}) ===")
     report = build_report(log_path)
     print(render_report(report))
@@ -139,6 +191,13 @@ def main():
     inc = report["serving_incidents"]
     for event, counter in SERVING_INCIDENT_COUNTERS.items():
         assert inc["counts"].get(event, 0) == report["counters"][counter]
+    # ... and so does the fleet section
+    fl = report["fleet"]
+    for event, counter in FLEET_INCIDENT_COUNTERS.items():
+        assert fl["counts"].get(event, 0) == report["counters"][counter]
+    assert sum(v for k, v in fl["dispatches"].items()
+               if k != "fleet_dispatches") == \
+        report["counters"]["fleet_dispatches"]
 
 
 if __name__ == "__main__":

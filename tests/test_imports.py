@@ -37,6 +37,7 @@ MODULES = [
     "apex_tpu.resilience",
     "apex_tpu.rnn",
     "apex_tpu.serving",
+    "apex_tpu.serving.fleet",
     "apex_tpu.testing_faults",
     "apex_tpu.training",
     "apex_tpu.transformer",

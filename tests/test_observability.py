@@ -504,3 +504,50 @@ class TestReportBackCompat:
             [self.FIXTURE, "--json", "--slo", str(spec)]) == 0
         cli = json.loads(capsys.readouterr().out)
         assert cli["slo"]["ok"] is True
+
+    PRE_PR7 = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "data", "pre_pr7_run.jsonl")
+
+    def test_pre_pr7_log_without_replica_id_still_renders(self):
+        """A committed pre-fleet-era log (PR-6 vintage: ttft/tpot
+        present, ``replica_id`` absent, no fleet counters) builds,
+        renders with NO fleet section, and scores its embedded SLO —
+        the readers tolerate the field's absence end-to-end."""
+        report = build_report(self.PRE_PR7)
+        req = report["requests"]
+        assert req["count"] == 3
+        assert req["ttft_s"]["count"] == 2     # newer fields still fold
+        # no replica_id on any row, no fleet counters: no fleet section
+        assert report["fleet"] is None
+        # the embedded scenario SLO scores the old log (goodput 2/3)
+        assert report["slo"]["ok"]
+        text = render_report(report)
+        assert "serving requests" in text
+        assert "fleet:" not in text
+
+    def test_mixed_replica_id_rows_fold_by_replica(self, tmp_path):
+        """Rows with and without ``replica_id`` coexist (a fleet log
+        whose fleet-level sheds carry no replica): the fleet section
+        groups the tagged ones and never raises on the untagged."""
+        log = tmp_path / "mixed.jsonl"
+        rows = [
+            {"kind": "request", "request_id": 0, "finish_reason": "length",
+             "prompt_len": 4, "new_tokens": 2, "total_s": 0.1, "wall": 1.0,
+             "replica_id": 0},
+            {"kind": "request", "request_id": 1, "finish_reason": "length",
+             "prompt_len": 4, "new_tokens": 2, "total_s": 0.1, "wall": 2.0,
+             "replica_id": 1},
+            {"kind": "request", "request_id": 2, "finish_reason":
+             "rejected", "prompt_len": 4, "new_tokens": 0, "wall": 3.0},
+            {"kind": "counters", "wall": 4.0, "values":
+             {"fleet_dispatches": 2, "replica0_dispatches": 1,
+              "replica1_dispatches": 1}},
+        ]
+        log.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+        report = build_report(str(log))
+        fleet = report["fleet"]
+        assert fleet["requests_by_replica"] == {"0": 1, "1": 1}
+        assert fleet["dispatches"]["fleet_dispatches"] == 2
+        text = render_report(report)
+        assert "dispatches: 2" in text
+        assert "replica0=1 replica1=1" in text
