@@ -1,0 +1,57 @@
+"""Distributed checkpoint / resume.
+
+Two on-disk formats behind one manager protocol:
+
+- :class:`CheckpointManager` — the original orbax-backed rotating store
+  (``save_checkpoint``/``load_checkpoint`` for one-shot paths). Restore
+  requires orbax and prefers the save-time layout.
+- :class:`ShardedCheckpointManager` — the sharded format of
+  :mod:`apex_tpu.checkpoint.sharded`: per-shard ``.npy`` files addressed
+  by (param-path, global-shard-index), a JSON manifest with global
+  shapes/specs/per-shard sha256, and a COMMIT marker written last via
+  atomic rename. Saves split into ``snapshot`` (blocking device→host)
+  and ``write_snapshot`` (background-safe); restore is *elastic* — a
+  template sharded over a different mesh (dp=4×tp=2 → dp=2×tp=4, or a
+  single device) is reassembled from the saved shards and re-sharded on
+  device.
+
+:class:`RetryingCheckpointManager` wraps either with retries,
+corruption fallback, partial-directory cleanup, and — for the sharded
+format — an async background writer (:mod:`apex_tpu.checkpoint.retry`).
+``python -m apex_tpu.checkpoint verify <dir>`` is the offline fsck
+(:mod:`apex_tpu.checkpoint.verify`).
+"""
+
+from apex_tpu.checkpoint._orbax import (
+    CheckpointManager,
+    load_checkpoint,
+    save_checkpoint,
+)
+from apex_tpu.checkpoint.manifest import (
+    COMMIT_NAME,
+    FORMAT_NAME,
+    MANIFEST_NAME,
+    CheckpointCorruptionError,
+)
+from apex_tpu.checkpoint.retry import (
+    CheckpointSaveError,
+    RetryingCheckpointManager,
+)
+from apex_tpu.checkpoint.sharded import HostSnapshot, ShardedCheckpointManager
+from apex_tpu.checkpoint.verify import StepReport, verify_directory
+
+__all__ = [
+    "save_checkpoint",
+    "load_checkpoint",
+    "CheckpointManager",
+    "ShardedCheckpointManager",
+    "HostSnapshot",
+    "RetryingCheckpointManager",
+    "CheckpointSaveError",
+    "CheckpointCorruptionError",
+    "StepReport",
+    "verify_directory",
+    "MANIFEST_NAME",
+    "COMMIT_NAME",
+    "FORMAT_NAME",
+]

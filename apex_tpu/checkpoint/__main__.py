@@ -1,0 +1,8 @@
+"""``python -m apex_tpu.checkpoint verify <dir>`` — checkpoint fsck."""
+
+import sys
+
+from apex_tpu.checkpoint.verify import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,172 @@
+"""Distributed checkpoint / resume.
+
+The reference leaves model checkpointing to user scripts
+(``examples/imagenet/main_amp.py:254-260`` uses ``torch.save``) and layers
+three pieces on top (SURVEY.md §5):
+
+- amp scaler state round-trip (``apex/amp/frontend.py:365-404``, recommended
+  flow ``README.md:63-103``),
+- fp32 master groups in ``FP16_Optimizer.state_dict``
+  (``apex/fp16_utils/fp16_optimizer.py:212-273``),
+- sharded optimizer state gather/scatter in ``DistributedFusedAdam``.
+
+On TPU all three collapse into one capability: **save and restore an
+arbitrarily-sharded JAX pytree without gathering it to one host**, provided
+here on orbax — each host writes exactly the array shards it owns (the
+analog of the reference's shard-aware gather/scatter, minus the gather).
+Loss-scaler state, fp32 masters, and ZeRO shards are ordinary pytree leaves,
+so the whole train state round-trips through one call pair.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Any, List, Optional
+
+import jax
+
+__all__ = [
+    "save_checkpoint",
+    "load_checkpoint",
+    "CheckpointManager",
+]
+
+
+_CKPTR = None
+
+
+def _checkpointer():
+    # one long-lived checkpointer: orbax spins up async-IO resources per
+    # instance, so per-call construction leaks in long training loops
+    global _CKPTR
+    if _CKPTR is None:
+        import orbax.checkpoint as ocp
+        _CKPTR = ocp.StandardCheckpointer()
+    return _CKPTR
+
+
+def _as_restore_target(template: Any) -> Any:
+    """Template pytree -> ShapeDtypeStruct pytree carrying shardings, so each
+    leaf is restored with the layout the training state expects."""
+    return jax.tree.map(
+        lambda x: (x if isinstance(x, jax.ShapeDtypeStruct)
+                   else jax.ShapeDtypeStruct(
+                       x.shape, x.dtype,
+                       sharding=getattr(x, "sharding", None))),
+        template)
+
+
+def save_checkpoint(path: str, state: Any, *, force: bool = True) -> None:
+    """Write ``state`` (any pytree of jax.Arrays, sharded or not) to
+    ``path``. Sharded leaves are written distributed: every host persists its
+    own shards (no host gather — contrast the reference's
+    ``DistributedFusedAdam.state_dict`` gather)."""
+    ckptr = _checkpointer()
+    ckptr.save(os.path.abspath(os.fspath(path)), state, force=force)
+    ckptr.wait_until_finished()
+
+
+def load_checkpoint(path: str, template: Optional[Any] = None) -> Any:
+    """Restore a checkpoint. ``template`` (a pytree of arrays or
+    ``jax.ShapeDtypeStruct``, possibly carrying shardings) restores each leaf
+    with the requested sharding/dtype; without it, arrays come back
+    replicated on the default device."""
+    ckptr = _checkpointer()
+    path = os.path.abspath(os.fspath(path))
+    if template is None:
+        return ckptr.restore(path)
+    return ckptr.restore(path, _as_restore_target(template))
+
+
+class CheckpointManager:
+    """Rotating step-indexed checkpoints with resume — the role the
+    reference's AutoResume hook + user save scripts play
+    (``pipeline_parallel/utils.py:142-144``, ``examples/imagenet``).
+
+    ``save(step, state)`` / ``restore(template) -> (step, state) | None``;
+    keeps the newest ``max_to_keep``.
+    """
+
+    def __init__(self, directory: str, *, max_to_keep: int = 3,
+                 save_interval_steps: int = 1):
+        import orbax.checkpoint as ocp
+        self.directory = os.path.abspath(os.fspath(directory))
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                save_interval_steps=save_interval_steps),
+        )
+
+    def save(self, step: int, state: Any, *, force: bool = False) -> bool:
+        """``force=True`` bypasses ``save_interval_steps`` gating (and
+        overwrites an existing step) — the emergency-save path."""
+        import orbax.checkpoint as ocp
+        saved = self._mgr.save(step, args=ocp.args.StandardSave(state),
+                               force=force)
+        return saved
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def all_steps(self) -> List[int]:
+        """Committed checkpoint steps, ascending. Uncommitted (killed
+        mid-write) step directories are excluded by orbax's atomicity
+        protocol, so everything listed here finished its write."""
+        return sorted(self._mgr.all_steps())
+
+    def restore(self, template: Any):
+        step = self._mgr.latest_step()
+        if step is None:
+            return None
+        return step, self.restore_step(step, template)
+
+    def restore_step(self, step: int, template: Any) -> Any:
+        import orbax.checkpoint as ocp
+        return self._mgr.restore(
+            step, args=ocp.args.StandardRestore(_as_restore_target(template)))
+
+    def delete(self, step: int) -> None:
+        self._mgr.delete(step)
+
+    def uncommitted_steps(self) -> List[int]:
+        """Steps with leftover uncommitted write directories (orbax's
+        ``*.orbax-checkpoint-tmp-*`` debris from interrupted saves)."""
+        return sorted(self._partial_dirs())
+
+    def cleanup_partial(self, *, exclude=()) -> List[int]:
+        """Delete uncommitted write directories; returns the steps whose
+        debris was removed. Call only when no save is in flight."""
+        skip = {int(s) for s in exclude}
+        removed = []
+        for step, name in sorted(self._partial_dirs().items()):
+            if step in skip:
+                continue
+            shutil.rmtree(os.path.join(self.directory, name),
+                          ignore_errors=True)
+            removed.append(step)
+        return removed
+
+    def _partial_dirs(self) -> dict:
+        out = {}
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return out
+        for name in names:
+            head, sep, _ = name.partition(".orbax-checkpoint-tmp")
+            if sep and os.path.isdir(os.path.join(self.directory, name)):
+                try:
+                    out[int(head)] = name
+                except ValueError:
+                    continue
+        return out
+
+    def wait_until_finished(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()
+
+

@@ -1,0 +1,426 @@
+"""Sharded checkpoint save/restore with elastic mesh-reshape restore.
+
+The orbax-backed :class:`~apex_tpu.checkpoint.CheckpointManager` treats a
+checkpoint as one opaque blob restored onto the save-time layout; the
+dryrun topologies (pp×tp×cp×MoE×ZeRO, two-slice dp) need the TorchTitan
+property (PAPERS.md 2410.06511) of checkpoints that are **stored sharded
+and re-laid-out on restore** (the cross-replica sharding implication of
+PAPERS.md 2004.13336). :class:`ShardedCheckpointManager` provides it:
+
+- **save** snapshots each leaf's addressable shards to host (replicas
+  deduplicated by global shard index — a dp-replicated leaf is written
+  once per distinct shard, not once per device), serializes each shard
+  to its own file, and records global shape/dtype/PartitionSpec plus a
+  per-shard sha256 in ``manifest.json``; a ``COMMIT`` marker written
+  last via atomic rename makes the step visible
+  (:mod:`apex_tpu.checkpoint.manifest` is the protocol).
+- **restore is elastic**: the target template's shardings — a different
+  mesh shape (dp=4,tp=2 -> dp=2,tp=4), a single device, or no mesh at
+  all — drive reassembly. Each target shard region is rebuilt from the
+  intersecting saved shards via ``jax.make_array_from_callback``, so
+  data moves host->device already in the new layout; no save-time
+  topology information is needed beyond the manifest.
+- every shard read is verified against its manifest sha256; any
+  mismatch, missing file, or torn manifest raises
+  :class:`~apex_tpu.checkpoint.manifest.CheckpointCorruptionError`,
+  which :class:`~apex_tpu.checkpoint.RetryingCheckpointManager` turns
+  into fallback-to-an-older-step.
+
+Asynchrony lives one layer up: :class:`RetryingCheckpointManager` calls
+the two-phase API (:meth:`snapshot` on the critical path, then
+:meth:`write_snapshot` on its background writer, retries included).
+:meth:`save` composes the two phases synchronously for standalone use.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+from io import BytesIO
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from apex_tpu.checkpoint.manifest import (
+    FORMAT_NAME,
+    CheckpointCorruptionError,
+    list_step_dirs,
+    load_manifest,
+    read_commit,
+    sha256_bytes,
+    validate_step_dir,
+    write_commit,
+    write_manifest,
+)
+
+__all__ = ["ShardedCheckpointManager", "HostSnapshot",
+           "CheckpointCorruptionError"]
+
+
+def _spec_entries(sharding) -> Optional[list]:
+    """PartitionSpec of a NamedSharding as JSON-serializable entries
+    (None | axis name | list of axis names), or None when the leaf has no
+    named sharding (informational only — restore is driven by the
+    *target* template, never by this)."""
+    spec = getattr(sharding, "spec", None)
+    if spec is None:
+        return None
+    out = []
+    for entry in tuple(spec):
+        if entry is None or isinstance(entry, str):
+            out.append(entry)
+        else:
+            out.append([str(a) for a in entry])
+    return out
+
+
+def _mesh_axes(sharding) -> Optional[Dict[str, int]]:
+    mesh = getattr(sharding, "mesh", None)
+    if mesh is None:
+        return None
+    try:
+        return {str(k): int(v) for k, v in mesh.shape.items()}
+    except Exception:  # noqa: BLE001 — informational field only
+        return None
+
+
+def _bounds(index: Tuple[slice, ...], shape: Sequence[int]
+            ) -> Tuple[Tuple[int, ...], Tuple[int, ...]]:
+    """Concrete (start, stop) per dim from a shard's slice tuple."""
+    start, stop = [], []
+    for sl, dim in zip(index, shape):
+        start.append(0 if sl.start is None else int(sl.start))
+        stop.append(int(dim) if sl.stop is None else int(sl.stop))
+    return tuple(start), tuple(stop)
+
+
+class HostSnapshot:
+    """Device->host copy of one train-state pytree, shard-structured:
+    the only part of a save that blocks the step loop. Leaves are listed
+    in ``jax.tree_util.keystr`` order with per-shard host arrays and
+    bounds; serialization/checksums happen later, on the writer."""
+
+    __slots__ = ("leaves", "nbytes")
+
+    def __init__(self, leaves: List[dict], nbytes: int):
+        self.leaves = leaves
+        self.nbytes = nbytes
+
+
+class ShardedCheckpointManager:
+    """Step-addressed sharded checkpoints under one root directory.
+
+    API-compatible with :class:`apex_tpu.checkpoint.CheckpointManager`
+    (``save``/``restore``/``restore_step``/``all_steps``/``delete``/…)
+    so :class:`RetryingCheckpointManager` and
+    :func:`apex_tpu.resilience.run_training` drive either; adds the
+    two-phase :meth:`snapshot`/:meth:`write_snapshot` split (async saves),
+    :meth:`uncommitted_steps`/:meth:`cleanup_partial` (interrupted-save
+    debris), and :meth:`verify_step` (deep fsck of one step).
+    """
+
+    #: RetryingCheckpointManager keys on this to run writes on its
+    #: background writer instead of inline
+    supports_async = True
+
+    def __init__(self, directory: str, *, max_to_keep: int = 3,
+                 save_interval_steps: int = 1, fsync: bool = True):
+        self.directory = os.path.abspath(os.fspath(directory))
+        self.max_to_keep = int(max_to_keep)
+        self.save_interval_steps = int(save_interval_steps)
+        self.fsync = bool(fsync)
+        os.makedirs(self.directory, exist_ok=True)
+        self._lock = threading.Lock()  # serializes directory mutation
+
+    # -- step listing ------------------------------------------------------
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, str(int(step)))
+
+    def all_steps(self) -> List[int]:
+        """Committed steps, ascending. Commit = a readable ``COMMIT``
+        marker; anything else is invisible debris (see
+        :meth:`uncommitted_steps`)."""
+        steps = []
+        for step, name in list_step_dirs(self.directory).items():
+            if read_commit(os.path.join(self.directory, name)) is not None:
+                steps.append(step)
+        return sorted(steps)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def uncommitted_steps(self) -> List[int]:
+        """Integer-named child directories with no commit marker — the
+        debris a killed or failed save leaves behind."""
+        out = []
+        for step, name in list_step_dirs(self.directory).items():
+            if read_commit(os.path.join(self.directory, name)) is None:
+                out.append(step)
+        return sorted(out)
+
+    def cleanup_partial(self, *, exclude: Sequence[int] = ()) -> List[int]:
+        """Remove uncommitted step directories (``exclude`` protects
+        steps a writer is mid-save on). Returns the steps removed."""
+        removed = []
+        skip = {int(s) for s in exclude}
+        with self._lock:
+            for step in self.uncommitted_steps():
+                if step in skip:
+                    continue
+                shutil.rmtree(self._step_dir(step), ignore_errors=True)
+                removed.append(step)
+        return removed
+
+    def should_save(self, step: int, *, force: bool = False) -> bool:
+        if force:
+            return True
+        if self.save_interval_steps > 1 and step % self.save_interval_steps:
+            return False
+        return True
+
+    # -- save: snapshot (critical path) + write (background-safe) ----------
+    def snapshot(self, state: Any) -> HostSnapshot:
+        """Copy every leaf's addressable shards to host — the ONLY part
+        of a save the train loop must block on. Replicated copies are
+        deduplicated by global shard index, so a dp-replicated leaf costs
+        one transfer per distinct shard."""
+        flat, _ = jax.tree_util.tree_flatten_with_path(state)
+        leaves: List[dict] = []
+        nbytes = 0
+        for path, leaf in flat:
+            key = jax.tree_util.keystr(path)
+            shards: List[dict] = []
+            if isinstance(leaf, jax.Array) and hasattr(
+                    leaf, "addressable_shards"):
+                shape = tuple(leaf.shape)
+                seen = set()
+                for shard in leaf.addressable_shards:
+                    start, stop = _bounds(shard.index, shape)
+                    if (start, stop) in seen:
+                        continue  # a replica of a shard already captured
+                    seen.add((start, stop))
+                    # np.array (not asarray): on the CPU backend asarray
+                    # can alias the device buffer, and a donated state
+                    # would scribble over an in-flight async write
+                    shards.append({"start": start, "stop": stop,
+                                   "data": np.array(shard.data)})
+                spec = _spec_entries(leaf.sharding)
+                mesh = _mesh_axes(leaf.sharding)
+                dtype = str(np.dtype(leaf.dtype))
+            else:
+                arr = np.array(leaf)
+                shape = tuple(arr.shape)
+                shards.append({"start": tuple(0 for _ in shape),
+                               "stop": shape, "data": arr})
+                spec, mesh, dtype = None, None, str(arr.dtype)
+            nbytes += sum(s["data"].nbytes for s in shards)
+            leaves.append({"path": key, "shape": shape, "dtype": dtype,
+                           "spec": spec, "mesh": mesh, "shards": shards})
+        return HostSnapshot(leaves, nbytes)
+
+    def write_snapshot(self, step: int, snap: HostSnapshot, *,
+                       force: bool = False) -> None:
+        """Serialize + fsync + checksum a :class:`HostSnapshot` into the
+        step directory and commit it. Safe to call from a background
+        writer thread (touches only host memory and the filesystem).
+        An existing committed step is replaced only under ``force`` —
+        the retry/emergency semantics."""
+        step = int(step)
+        step_dir = self._step_dir(step)
+        with self._lock:
+            if os.path.isdir(step_dir):
+                if not force and read_commit(step_dir) is not None:
+                    raise FileExistsError(
+                        f"step {step} already committed at {step_dir} "
+                        f"(pass force=True to replace)")
+                shutil.rmtree(step_dir, ignore_errors=True)
+            os.makedirs(step_dir, exist_ok=True)
+        manifest_leaves: Dict[str, dict] = {}
+        for i, leaf in enumerate(snap.leaves):
+            entries = []
+            for j, shard in enumerate(leaf["shards"]):
+                fname = f"leaf{i:04d}_s{j:02d}.npy"
+                buf = BytesIO()
+                np.save(buf, shard["data"], allow_pickle=False)
+                data = buf.getvalue()
+                with open(os.path.join(step_dir, fname), "wb") as f:
+                    f.write(data)
+                    if self.fsync:
+                        f.flush()
+                        os.fsync(f.fileno())
+                entries.append({
+                    "file": fname,
+                    "index": j,
+                    "start": list(shard["start"]),
+                    "stop": list(shard["stop"]),
+                    "bytes": len(data),
+                    "sha256": sha256_bytes(data),
+                })
+            manifest_leaves[leaf["path"]] = {
+                "shape": list(leaf["shape"]),
+                "dtype": leaf["dtype"],
+                "spec": leaf["spec"],
+                "mesh": leaf["mesh"],
+                "shards": entries,
+            }
+        manifest = {"format": FORMAT_NAME, "step": step,
+                    "leaves": manifest_leaves}
+        sha = write_manifest(step_dir, manifest, fsync=self.fsync)
+        write_commit(step_dir, sha, step, fsync=self.fsync)
+        self._prune()
+
+    def save(self, step: int, state: Any, *, force: bool = False) -> bool:
+        """Synchronous save: snapshot + write + commit. Returns False when
+        gated by ``save_interval_steps``; see
+        :class:`RetryingCheckpointManager` for the async composition."""
+        if not self.should_save(step, force=force):
+            return False
+        self.write_snapshot(step, self.snapshot(state), force=force)
+        return True
+
+    def _prune(self) -> None:
+        if self.max_to_keep <= 0:
+            return
+        steps = self.all_steps()
+        with self._lock:
+            for step in steps[:-self.max_to_keep]:
+                shutil.rmtree(self._step_dir(step), ignore_errors=True)
+
+    # -- restore -----------------------------------------------------------
+    def restore(self, template: Any) -> Optional[Tuple[int, Any]]:
+        step = self.latest_step()
+        if step is None:
+            return None
+        return step, self.restore_step(step, template)
+
+    def restore_step(self, step: int, template: Any) -> Any:
+        """Reassemble the pytree of a committed step onto the layout the
+        ``template`` asks for — leaf by leaf, each target shard region is
+        rebuilt from the intersecting saved shards, so a checkpoint
+        written under dp=4×tp=2 restores onto dp=2×tp=4, a single
+        device, or any other mesh whose global shapes match. Every shard
+        file read is checksum-verified against the manifest."""
+        step_dir = self._step_dir(int(step))
+        manifest = load_manifest(step_dir)
+        leaves = manifest["leaves"]
+        flat, treedef = jax.tree_util.tree_flatten_with_path(template)
+        out = []
+        for path, leaf in flat:
+            key = jax.tree_util.keystr(path)
+            entry = leaves.get(key)
+            if entry is None:
+                raise ValueError(
+                    f"checkpoint step {step} has no leaf {key} — the "
+                    f"template's pytree structure differs from the "
+                    f"saved state")
+            out.append(self._restore_leaf(step_dir, key, entry, leaf))
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    def _restore_leaf(self, step_dir: str, key: str, entry: dict,
+                      template_leaf: Any):
+        shape = tuple(entry["shape"])
+        t_shape = tuple(getattr(template_leaf, "shape",
+                                np.shape(template_leaf)))
+        if t_shape != shape:
+            raise ValueError(
+                f"{key}: checkpoint global shape {shape} != template "
+                f"shape {t_shape} (elastic restore re-shards, it does "
+                f"not reshape)")
+        dtype = np.dtype(entry["dtype"])
+        t_dtype = getattr(template_leaf, "dtype", None)
+        target = np.dtype(t_dtype) if t_dtype is not None else dtype
+        cache: Dict[str, np.ndarray] = {}
+
+        def load_shard(shard: dict) -> np.ndarray:
+            fname = shard["file"]
+            if fname not in cache:
+                fpath = os.path.join(step_dir, fname)
+                try:
+                    with open(fpath, "rb") as f:
+                        data = f.read()
+                except OSError as e:
+                    raise CheckpointCorruptionError(
+                        f"{key}: shard {fname} unreadable: {e}") from e
+                if sha256_bytes(data) != shard.get("sha256"):
+                    raise CheckpointCorruptionError(
+                        f"{key}: shard {fname} sha256 mismatch "
+                        f"(bit rot / torn write)")
+                arr = np.load(BytesIO(data), allow_pickle=False)
+                want = (tuple(shard["stop"][d] - shard["start"][d]
+                              for d in range(len(shape)))
+                        if shape else ())
+                if tuple(arr.shape) != want:
+                    raise CheckpointCorruptionError(
+                        f"{key}: shard {fname} has shape {arr.shape}, "
+                        f"manifest says {want}")
+                cache[fname] = arr
+            return cache[fname]
+
+        def region(index: Tuple[slice, ...]) -> np.ndarray:
+            """Assemble one target region from intersecting saved
+            shards — the re-shard: save-time and restore-time tilings
+            need not align."""
+            start, stop = _bounds(tuple(index), shape)
+            out = np.empty(tuple(b - a for a, b in zip(start, stop)),
+                           dtype=dtype)
+            filled = 0
+            for shard in entry["shards"]:
+                s_start, s_stop = shard["start"], shard["stop"]
+                lo = tuple(max(a, b) for a, b in zip(start, s_start))
+                hi = tuple(min(a, b) for a, b in zip(stop, s_stop))
+                if any(a >= b for a, b in zip(lo, hi)):
+                    continue  # no overlap with this saved shard
+                block = load_shard(shard)
+                src = tuple(slice(a - o, b - o)
+                            for a, b, o in zip(lo, hi, s_start))
+                dst = tuple(slice(a - o, b - o)
+                            for a, b, o in zip(lo, hi, start))
+                out[dst] = block[src]
+                filled += int(np.prod([b - a for a, b in zip(lo, hi)]))
+            if filled < int(np.prod(out.shape)):
+                raise CheckpointCorruptionError(
+                    f"{key}: saved shards cover only {filled} of "
+                    f"{int(np.prod(out.shape))} elements of the "
+                    f"requested region (manifest damaged?)")
+            if target != dtype:
+                out = out.astype(target)
+            return out
+
+        sharding = getattr(template_leaf, "sharding", None)
+        if (isinstance(sharding, jax.sharding.Sharding)
+                and not isinstance(sharding,
+                                   jax.sharding.SingleDeviceSharding)):
+            return jax.make_array_from_callback(
+                shape, sharding, lambda idx: region(idx))
+        # a single-device template leaf (e.g. a step counter next to
+        # mesh-sharded params) restores UNCOMMITTED: committing it to one
+        # device while its siblings commit to the mesh would make the
+        # restored state unjittable ("incompatible devices")
+        whole = region(tuple(slice(0, d) for d in shape))
+        return jax.device_put(whole)
+
+    # -- maintenance -------------------------------------------------------
+    def verify_step(self, step: int, *, deep: bool = True) -> None:
+        """Deep fsck of one committed step; raises
+        :class:`CheckpointCorruptionError` listing every problem."""
+        problems = validate_step_dir(self._step_dir(int(step)), deep=deep)
+        if problems:
+            raise CheckpointCorruptionError(
+                f"step {step}: " + "; ".join(problems))
+
+    def delete(self, step: int) -> None:
+        step_dir = self._step_dir(int(step))
+        if not os.path.isdir(step_dir):
+            raise FileNotFoundError(step_dir)
+        with self._lock:
+            shutil.rmtree(step_dir, ignore_errors=True)
+
+    def wait_until_finished(self) -> None:
+        """Writes here are synchronous; asynchrony (and its drain) lives
+        in :class:`RetryingCheckpointManager`."""
+
+    def close(self) -> None:
+        pass

@@ -23,6 +23,12 @@ wrote during a run and folds it into one report dict / text page:
   key-for-key with the registry counters
   (:data:`SERVING_INCIDENT_COUNTERS` names the mapping; the tier-1
   serving-resilience tests assert it).
+- **checkpoint incidents** — the retrying checkpoint manager's event
+  stream (save retries/failures, restore fallbacks, checksum verify
+  failures, partial-dir cleanups, abandoned async writes): per-type
+  counts reconciling key-for-key with the ``ckpt_*`` counters
+  (:data:`CHECKPOINT_INCIDENT_COUNTERS`), plus snapshot-blocked-time
+  and write-duration histogram summaries.
 - **SLO verdict** — when the log carries a ``kind="scenario"`` record
   with a declared ``"slo"`` section (what the loadtest runner embeds),
   or when the caller passes a spec (``--slo spec.json``), the report
@@ -49,7 +55,7 @@ from apex_tpu.observability.slo import SLOSpec, evaluate_slos
 
 __all__ = ["read_records", "build_report", "render_report", "main",
            "SERVING_INCIDENT_COUNTERS", "SERVING_SHED_COUNTERS",
-           "FLEET_INCIDENT_COUNTERS"]
+           "FLEET_INCIDENT_COUNTERS", "CHECKPOINT_INCIDENT_COUNTERS"]
 
 #: number of windows in the throughput/MFU trajectory
 _TRAJECTORY_WINDOWS = 5
@@ -83,6 +89,21 @@ FLEET_INCIDENT_COUNTERS = {
     "replica_drain": "replica_drains",
     "replica_rebuild": "replica_rebuilds",
     "request_migrated": "requests_migrated",
+}
+
+#: checkpoint incident event -> registry counter, the
+#: :class:`apex_tpu.checkpoint.RetryingCheckpointManager` event stream.
+#: Each event is emitted at the same site its counter (and the
+#: ``ckpt_``-prefixed ``TrainingResult.telemetry`` entry) increments, so
+#: the checkpoints section reconciles key-for-key with the snapshot.
+CHECKPOINT_INCIDENT_COUNTERS = {
+    "checkpoint_save_retry": "ckpt_save_retries",
+    "checkpoint_save_failed": "ckpt_save_failures",
+    "checkpoint_save_abandoned": "ckpt_saves_abandoned",
+    "checkpoint_restore_fallback": "ckpt_restore_fallbacks",
+    "checkpoint_verify_failed": "ckpt_verify_failures",
+    "checkpoint_deleted_corrupt": "ckpt_deleted_corrupt",
+    "checkpoint_partial_cleaned": "ckpt_partials_cleaned",
 }
 
 
@@ -210,6 +231,29 @@ def _fleet_section(requests: List[dict], events: List[dict],
             "dispatches": dispatch}
 
 
+def _checkpoint_section(events: List[dict], counters: Dict[str, int],
+                        histograms: Dict[str, dict]) -> Optional[dict]:
+    """Fold checkpoint telemetry into the monitor's checkpoints section:
+    per-type incident counts (reconciling with
+    :data:`CHECKPOINT_INCIDENT_COUNTERS`), the save-volume counters
+    (``ckpt_save_attempts``), and the snapshot-blocked / write-duration
+    histogram summaries. ``None`` when the log carries no checkpoint
+    signal (a run without a checkpoint manager, or a pre-sharded log)."""
+    counts: Dict[str, int] = {}
+    for e in events:
+        name = e.get("event")
+        if name in CHECKPOINT_INCIDENT_COUNTERS:
+            counts[name] = counts.get(name, 0) + 1
+    ckpt_counters = {name: n for name, n in counters.items()
+                     if name.startswith("ckpt_")}
+    timings = {name: h for name, h in histograms.items()
+               if name in ("ckpt_snapshot_blocked_s", "ckpt_write_s")}
+    if not counts and not ckpt_counters and not timings:
+        return None
+    return {"counts": counts, "counters": ckpt_counters,
+            "timings": timings}
+
+
 def build_report(path: str,
                  slo_spec: Optional[Dict[str, float]] = None) -> dict:
     """Fold one JSONL metric log into a report dict.
@@ -261,6 +305,7 @@ def build_report(path: str,
         "requests": _request_summary(requests),
         "serving_incidents": _serving_incidents(events),
         "fleet": _fleet_section(requests, events, counters),
+        "checkpoints": _checkpoint_section(events, counters, histograms),
         "timeline": sorted(events, key=lambda e: e.get("seq", 0)),
         "scenario": ({k: scenario[k] for k in ("name", "seed")
                       if k in scenario} if scenario else None),
@@ -359,6 +404,24 @@ def render_report(report: dict) -> str:
             lines.append(f"  requests by replica: {split}")
         lines += [f"  {name} = {n}"
                   for name, n in sorted(fleet["counts"].items())]
+    ckpt = report.get("checkpoints")
+    if ckpt:
+        lines += ["", "checkpoints:"]
+        attempts = ckpt["counters"].get("ckpt_save_attempts")
+        if attempts is not None:
+            lines.append(f"  save attempts: {attempts}")
+        lines += [f"  {name} = {n}"
+                  for name, n in sorted(ckpt["counts"].items())]
+        for name, label in (("ckpt_snapshot_blocked_s", "snapshot block"),
+                            ("ckpt_write_s", "write")):
+            h = ckpt["timings"].get(name)
+            if isinstance(h, dict) and h.get("count"):
+                lines.append(
+                    f"  {label:<14} n={h['count']} "
+                    f"mean={_fmt(h.get('mean'), 's')} "
+                    f"max={_fmt(h.get('max'), 's')}"
+                    + (f" p95={_fmt(h['p95'], 's')}"
+                       if "p95" in h else ""))
     inc = report.get("serving_incidents")
     if inc:
         total = sum(inc["counts"].values()) + \

@@ -22,11 +22,15 @@ for apex_tpu, composing the pieces that already exist —
   from *before* the first bad step (suspect newer ones are deleted),
   decay the loss scale, advance the data "retry epoch" so the poisoned
   window is re-seeded, and retry under a bounded ``max_rollbacks`` budget.
-- **retrying, atomic checkpoint I/O** —
-  :class:`apex_tpu.checkpoint.RetryingCheckpointManager`:
-  exponential-backoff save retries, restore fallback to older steps on
-  corruption (orbax's commit protocol already makes a killed write
-  invisible; this covers committed-but-unreadable data).
+- **retrying, atomic, async checkpoint I/O** —
+  :class:`apex_tpu.checkpoint.RetryingCheckpointManager` over the
+  sharded format (default): the step loop blocks only for the
+  device→host snapshot, serialization + fsync + checksum run on a
+  background writer inside the retry loop; restore verifies per-shard
+  checksums and falls back to older steps on corruption, and is
+  *elastic* — it reassembles shards onto a different mesh layout
+  (``ResilienceConfig.checkpoint_format`` selects ``"orbax"`` for the
+  original whole-array format).
 - **preemption hook** — SIGTERM flips a flag; the loop flushes an
   emergency (forced) save and returns cleanly with
   ``status="preempted"``, resumable by the next invocation.
@@ -63,7 +67,11 @@ from jax.sharding import PartitionSpec
 
 from apex_tpu.amp.scaler import LossScaler, LossScalerState, all_finite
 from apex_tpu.analysis.retrace import RetraceWatchdog
-from apex_tpu.checkpoint import CheckpointManager, RetryingCheckpointManager
+from apex_tpu.checkpoint import (
+    CheckpointManager,
+    RetryingCheckpointManager,
+    ShardedCheckpointManager,
+)
 from apex_tpu.observability.step_metrics import StepMetrics
 from apex_tpu.training import sync_data_parallel_grads
 from apex_tpu.transformer.parallel_state import DATA_AXIS
@@ -131,6 +139,19 @@ class ResilienceConfig:
     save_backoff_base: float = 0.5
     save_backoff_max: float = 8.0
     delete_corrupt: bool = True
+    #: on-disk format when the driver builds the manager from
+    #: ``checkpoint_dir``: ``"sharded"`` (elastic mesh-reshape restore,
+    #: per-shard checksums, async-capable) or ``"orbax"`` (the original
+    #: whole-array format).
+    checkpoint_format: str = "sharded"
+    #: with the sharded format, run serialization + fsync + checksum on a
+    #: background writer — the step loop blocks only for the device→host
+    #: snapshot. ``False`` forces fully synchronous saves.
+    checkpoint_async: bool = True
+    #: emergency (preemption) saves first quiesce the async writer:
+    #: ``True`` drains pending writes to commit, ``False`` abandons
+    #: queued ones (the running write still commits atomically).
+    preemption_drain: bool = True
     # -- retrace watchdog -------------------------------------------------
     #: recompilations of ``step_fn`` allowed beyond the warmup trace
     #: before :class:`~apex_tpu.analysis.retrace.RetraceBudgetExceeded`
@@ -471,30 +492,47 @@ def run_training(
         raise ValueError("state must be a make_train_state-style dict with "
                          "a scalar 'step' leaf")
 
+    def _wrap(base) -> RetryingCheckpointManager:
+        return RetryingCheckpointManager(
+            base, max_retries=cfg.save_retries,
+            backoff_base=cfg.save_backoff_base,
+            backoff_max=cfg.save_backoff_max,
+            delete_corrupt=cfg.delete_corrupt,
+            async_writes=cfg.checkpoint_async,
+            drain_on_force=cfg.preemption_drain,
+            metrics=cfg.metrics,
+            before_save=getattr(fault_injector, "before_checkpoint_save",
+                                None))
+
     mgr = None
     own_mgr = False
     if checkpoint_manager is not None:
         mgr = checkpoint_manager
-        if isinstance(mgr, CheckpointManager):
-            mgr = RetryingCheckpointManager(
-                mgr, max_retries=cfg.save_retries,
-                backoff_base=cfg.save_backoff_base,
-                backoff_max=cfg.save_backoff_max,
-                delete_corrupt=cfg.delete_corrupt,
-                before_save=getattr(fault_injector,
-                                    "before_checkpoint_save", None))
+        if isinstance(mgr, (CheckpointManager, ShardedCheckpointManager)):
+            mgr = _wrap(mgr)
+        elif (isinstance(mgr, RetryingCheckpointManager)
+                and cfg.metrics is not None and mgr.metrics is None):
+            # a pre-wrapped manager still reports into the attached
+            # registry, else the monitor's ckpt_* counters cannot
+            # reconcile with the merged telemetry
+            mgr.metrics = cfg.metrics
     elif checkpoint_dir is not None:
-        # orbax-level interval gating stays at 1: the driver decides when
-        # to save, and rollback/emergency saves must never be swallowed
-        mgr = RetryingCheckpointManager(
-            CheckpointManager(checkpoint_dir, max_to_keep=cfg.max_to_keep,
-                              save_interval_steps=1),
-            max_retries=cfg.save_retries,
-            backoff_base=cfg.save_backoff_base,
-            backoff_max=cfg.save_backoff_max,
-            delete_corrupt=cfg.delete_corrupt,
-            before_save=getattr(fault_injector, "before_checkpoint_save",
-                                None))
+        # manager-level interval gating stays at 1: the driver decides
+        # when to save, and rollback/emergency saves must never be
+        # swallowed
+        if cfg.checkpoint_format == "sharded":
+            base = ShardedCheckpointManager(
+                checkpoint_dir, max_to_keep=cfg.max_to_keep,
+                save_interval_steps=1)
+        elif cfg.checkpoint_format == "orbax":
+            base = CheckpointManager(checkpoint_dir,
+                                     max_to_keep=cfg.max_to_keep,
+                                     save_interval_steps=1)
+        else:
+            raise ValueError(
+                f"unknown checkpoint_format {cfg.checkpoint_format!r} "
+                f"(expected 'sharded' or 'orbax')")
+        mgr = _wrap(base)
         own_mgr = True
 
     # a recompilation storm (ragged batch shapes, pytree-structure churn
@@ -524,6 +562,14 @@ def run_training(
         # final counters snapshot reconciles key-for-key even for
         # incident types that never fired
         reg.declare_counters(*telemetry)
+        ckpt_telemetry = getattr(mgr, "telemetry", None) or {}
+        reg.declare_counters(*("ckpt_" + k for k in ckpt_telemetry))
+        for k, v in ckpt_telemetry.items():
+            if v:
+                # a pre-used manager arrives with history: seed the
+                # registry so the final snapshot still equals the merged
+                # telemetry key-for-key
+                reg.inc("ckpt_" + k, v)
         step_metrics = StepMetrics(
             reg, tokens_per_step=cfg.tokens_per_step,
             model_flops_per_step=cfg.model_flops_per_step,
@@ -710,25 +756,33 @@ def run_training(
                 if verdict is not None:
                     _rollback(verdict)
                     continue
-                if (mgr is not None and cfg.save_final
-                        and mgr.manager.latest_step() != host_step):
-                    mgr.save(host_step, state, force=True)
+                if mgr is not None and cfg.save_final:
+                    # settle in-flight async writes before deciding
+                    # whether the final step still needs a (sync) save
+                    mgr.wait_until_finished()
+                    if mgr.manager.latest_step() != host_step:
+                        mgr.save(host_step, state, force=True)
                 break
     finally:
         if isinstance(step_fn, RetraceWatchdog):
             telemetry["retraces"] = step_fn.retraces
         if prof is not None and prof.active:
             prof.stop(host_step)
-        if reg is not None:
-            # the final snapshot is the monitor CLI's reconciliation
-            # anchor — flush even on the TrainingDiverged exit paths
-            reg.flush()
         if mgr is not None:
             try:
                 mgr.wait_until_finished()
             finally:
                 if own_mgr:
                     mgr.close()
+            # merge the (now-quiesced) checkpoint ledger into the run
+            # telemetry under a ckpt_ prefix — the same names the
+            # registry counters carry, so the monitor reconciles both
+            for k, v in (getattr(mgr, "telemetry", None) or {}).items():
+                telemetry["ckpt_" + k] = v
+        if reg is not None:
+            # the final snapshot is the monitor CLI's reconciliation
+            # anchor — flush even on the TrainingDiverged exit paths
+            reg.flush()
 
     return TrainingResult(state, status, host_step, rollbacks, telemetry,
                           history)

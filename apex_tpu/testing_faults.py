@@ -16,7 +16,15 @@ scripted :class:`FaultInjector`:
   driving the same emergency-save-and-exit flow as a real SIGTERM;
 - **post-commit corruption** — :func:`corrupt_checkpoint` garbles a
   committed step directory on disk (bit rot / a writer killed after the
-  data write raced the commit), so restore must fall back to an older step.
+  data write raced the commit), so restore must fall back to an older step;
+- **shard-level corruption** — :func:`corrupt_shard` (bit-flip, truncate,
+  or delete ONE shard file of a committed sharded-format step) and
+  :func:`tear_manifest` (garble the manifest after commit): damage the
+  per-shard sha256 / manifest-sha256 verification must catch, driving
+  checksum-verified fallback instead of a silently-wrong restore;
+- **slow writes** — ``save_delays`` stretches a scheduled save attempt by
+  sleeping in the save hook, pinning an async background write in flight
+  while the test preempts/drains/abandons around it.
 
 Fault schedules key on the injector's own **call counter** (one tick per
 train-step invocation), not on the training-state step number: after a
@@ -44,8 +52,8 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["FaultInjector", "StepFaults", "poison_batch",
-           "corrupt_checkpoint", "InjectedEngineFault",
-           "ServingFaultInjector"]
+           "corrupt_checkpoint", "corrupt_shard", "tear_manifest",
+           "InjectedEngineFault", "ServingFaultInjector"]
 
 
 @dataclass
@@ -81,6 +89,52 @@ def corrupt_checkpoint(directory: str, step: int) -> int:
     return count
 
 
+def corrupt_shard(directory: str, step: int, *, leaf: int = 0,
+                  shard: int = 0, kind: str = "bitflip") -> str:
+    """Damage exactly ONE shard file of a committed sharded-format step
+    (layout of :class:`apex_tpu.checkpoint.ShardedCheckpointManager`):
+    ``"bitflip"`` flips a single bit mid-file, ``"truncate"`` cuts the
+    file in half, ``"missing"`` deletes it. All three leave the manifest
+    and commit marker intact — the step still *claims* to be healthy, so
+    only per-shard checksum/size verification can catch it. Returns the
+    damaged file's path; raises ``FileNotFoundError`` when the addressed
+    shard does not exist (a test bug)."""
+    step_dir = os.path.join(os.path.abspath(os.fspath(directory)), str(step))
+    path = os.path.join(step_dir, f"leaf{int(leaf):04d}_s{int(shard):02d}.npy")
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no shard file {path}")
+    if kind == "missing":
+        os.remove(path)
+    elif kind == "truncate":
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.truncate(max(1, size // 2))
+    elif kind == "bitflip":
+        with open(path, "r+b") as f:
+            data = bytearray(f.read())
+            data[len(data) // 2] ^= 0x40
+            f.seek(0)
+            f.write(data)
+    else:
+        raise ValueError(f"kind must be 'bitflip', 'truncate' or "
+                         f"'missing', got {kind!r}")
+    return path
+
+
+def tear_manifest(directory: str, step: int) -> str:
+    """Truncate a committed step's ``manifest.json`` to half its length —
+    a manifest torn *after* commit (partial overwrite, bit rot). The
+    commit marker still pins the original manifest sha256, so loading
+    must detect the mismatch and treat the step as corrupt. Returns the
+    manifest path."""
+    step_dir = os.path.join(os.path.abspath(os.fspath(directory)), str(step))
+    path = os.path.join(step_dir, "manifest.json")
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(max(1, size // 2))
+    return path
+
+
 class FaultInjector:
     """Scripted fault schedule for :func:`apex_tpu.resilience.run_training`.
 
@@ -91,14 +145,19 @@ class FaultInjector:
         preemption (the driver then emergency-saves and exits cleanly).
       save_failures: ``{checkpoint_step: n}`` — the save hook raises
         ``IOError`` for the first ``n`` attempts at that step.
+      save_delays: ``{checkpoint_step: seconds}`` — the save hook sleeps
+        before the first attempt at that step (one-shot), holding an
+        async background write in flight for preemption-mid-save tests.
     """
 
     def __init__(self, *, nan_grad_calls: Iterable[int] = (),
                  preempt_at_call: Optional[int] = None,
-                 save_failures: Optional[Dict[int, int]] = None):
+                 save_failures: Optional[Dict[int, int]] = None,
+                 save_delays: Optional[Dict[int, float]] = None):
         self.nan_grad_calls = frozenset(int(c) for c in nan_grad_calls)
         self.preempt_at_call = preempt_at_call
         self._save_failures = dict(save_failures or {})
+        self._save_delays = dict(save_delays or {})
         self._call = 0
         self.log = []  # list[StepFaults] — what actually fired, for tests
 
@@ -123,8 +182,13 @@ class FaultInjector:
 
     # -- checkpoint layer --------------------------------------------------
     def before_checkpoint_save(self, step: int) -> None:
-        """Hook for ``RetryingCheckpointManager(before_save=...)``: fail the
-        first scheduled ``n`` attempts at ``step``."""
+        """Hook for ``RetryingCheckpointManager(before_save=...)``: delay
+        and/or fail the first scheduled attempts at ``step``. For async
+        saves this runs on the background writer thread — a delay holds
+        that write in flight without stalling the train loop."""
+        delay = self._save_delays.pop(step, 0.0)
+        if delay > 0:
+            time.sleep(delay)
         remaining = self._save_failures.get(step, 0)
         if remaining > 0:
             self._save_failures[step] = remaining - 1

@@ -62,7 +62,7 @@ with tempfile.TemporaryDirectory() as tmp:
     run_log = os.path.join(tmp, "run.jsonl")
     registry = MetricsRegistry([JsonlSink(run_log)])
     cfg = ResilienceConfig(
-        save_interval_steps=20,       # checkpoint cadence (orbax, atomic)
+        save_interval_steps=20,       # checkpoint cadence (sharded, atomic commit)
         poll_interval_steps=5,        # watchdog device->host sync cadence
         max_consecutive_skips=4,      # divergence = 4 skipped steps in a row
         max_rollbacks=2,              # retry budget before TrainingDiverged

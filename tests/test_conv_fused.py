@@ -160,6 +160,7 @@ class TestResNetFusedParity:
         jax.tree.map(lambda a_, b_: np.testing.assert_allclose(
             a_, b_, atol=1e-2, rtol=5e-2), gf, gu)
 
+    @pytest.mark.slow
     def test_eval_uses_unfused_path(self, interpret):
         """Eval mode must not require the training-stats kernel."""
         m_f = self._build(True)

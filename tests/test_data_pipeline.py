@@ -57,6 +57,7 @@ class TestDiskBatches:
 
 
 class TestPipelineEndToEnd:
+    @pytest.mark.slow
     def test_loader_feeds_jitted_train_step(self, dataset):
         """The full path: disk -> workers -> C++ queue -> device_put ->
         jitted step; loss finite and descending over one pass."""

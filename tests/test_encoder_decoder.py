@@ -141,6 +141,7 @@ class TestEncoderDecoderModel:
         logits = model.apply(params, enc, dec)
         assert logits.shape == (8, 2, 64)
 
+    @pytest.mark.slow
     def test_trains(self):
         from apex_tpu.optimizers import FusedAdam
 

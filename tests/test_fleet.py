@@ -451,6 +451,7 @@ class TestServiceEstimateCarry:
 
 
 class TestFleetSmokeScenario:
+    @pytest.mark.slow
     def test_fleet_smoke_conserves_and_reconciles(self, tmp_path):
         """Acceptance: N=2 replicas, one scheduled draining restart
         mid-run — every submitted request reaches a terminal state

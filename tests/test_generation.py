@@ -65,6 +65,7 @@ class TestDecodeStep:
                                    np.asarray(full).astype(np.float32),
                                    rtol=2e-4, atol=2e-4)
 
+    @pytest.mark.slow
     def test_moe_generate_runs(self):
         model = _model(num_moe_experts=4, moe_capacity_factor=4.0)
         params = model.init(jax.random.PRNGKey(0))

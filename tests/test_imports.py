@@ -19,6 +19,10 @@ MODULES = [
     "apex_tpu.analysis",
     "apex_tpu.analysis.rules",
     "apex_tpu.checkpoint",
+    "apex_tpu.checkpoint.manifest",
+    "apex_tpu.checkpoint.retry",
+    "apex_tpu.checkpoint.sharded",
+    "apex_tpu.checkpoint.verify",
     "apex_tpu.data",
     "apex_tpu.fp16_utils",
     "apex_tpu.fused_dense",

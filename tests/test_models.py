@@ -80,6 +80,7 @@ class TestGPT:
         logits = model.apply(params, b["tokens"])
         assert logits.shape == (16, 8, 128)  # [s, b, vocab/tp] with tp=1
 
+    @pytest.mark.slow
     def test_training_decreases_loss(self):
         losses, _ = _train(tp=1, sp=False, steps=5)
         assert losses[-1] < losses[0]

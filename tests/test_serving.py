@@ -173,6 +173,7 @@ class TestScheduler:
 
 
 class TestEngine:
+    @pytest.mark.slow
     def test_matches_per_request_generate(self, small):
         """More requests than slots: arrivals and retirements happen
         mid-flight, output must still be token-exact vs generate()."""

@@ -227,6 +227,7 @@ class TestQuarantine:
 
 
 class TestSupervisorRecovery:
+    @pytest.mark.slow
     def test_decode_exception_restart_token_exact(self, small):
         """The tentpole acceptance path: a decode exception mid-flight
         kills the engine; the supervisor rebuilds it and re-prefills both

@@ -231,6 +231,7 @@ class TestProfiling:
         stats = device_memory_stats()
         assert isinstance(stats, dict)
 
+    @pytest.mark.slow
     def test_trace_writes_profile(self, tmp_path):
         from apex_tpu.utils import trace
 

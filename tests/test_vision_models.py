@@ -61,6 +61,7 @@ class TestResNet:
         assert logits.dtype == jnp.float32
         assert np.isfinite(np.asarray(logits)).all()
 
+    @pytest.mark.slow
     def test_train_step_descends(self):
         model = resnet18(num_classes=4)
         params, state = model.init(jax.random.PRNGKey(0))
@@ -194,6 +195,7 @@ class TestViT:
         assert logits.shape == (2, 10)
         assert np.isfinite(np.asarray(logits)).all()
 
+    @pytest.mark.slow
     def test_vit_grad_flows(self):
         from apex_tpu.models.vit import ViTConfig, ViTModel, _encoder_config
         enc = _encoder_config(2, 64, 4, ffn_hidden_size=128)
