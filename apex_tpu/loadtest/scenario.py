@@ -95,21 +95,48 @@ class ModelSpec:
 class EngineKnobs:
     """Engine/scheduler sizing — the subset of
     :class:`~apex_tpu.serving.EngineConfig` /
-    :class:`~apex_tpu.serving.SchedulerConfig` a scenario varies."""
+    :class:`~apex_tpu.serving.SchedulerConfig` a scenario varies.
+    ``kv_layout``/``page_size``/``n_pages`` select and size the paged KV
+    pool (docs/serving.md#paged-kv); ``n_pages=None`` fully backs every
+    slot at ``max_len`` — set it lower to overcommit, which is how the
+    ``long_context`` scenario expresses "this mix fits paged but could
+    not fit dense rows in the same HBM"."""
 
     max_slots: int = 4
     max_len: int = 64
     max_queue: int = 64
     max_prefills_per_tick: int = 1
+    kv_layout: str = "paged"
+    page_size: int = 64
+    n_pages: Optional[int] = None
+
+    def __post_init__(self):
+        if self.kv_layout not in ("flat", "paged"):
+            raise ValueError(
+                f"kv_layout must be 'flat' or 'paged', got "
+                f"{self.kv_layout!r}")
 
     @classmethod
     def from_dict(cls, data: Dict[str, Any]) -> "EngineKnobs":
-        return cls(**{k: int(v) for k, v in data.items()})
+        d = dict(data)
+        kw: Dict[str, Any] = {}
+        if "kv_layout" in d:
+            kw["kv_layout"] = str(d.pop("kv_layout"))
+        if "n_pages" in d:
+            n = d.pop("n_pages")
+            kw["n_pages"] = int(n) if n is not None else None
+        kw.update({k: int(v) for k, v in d.items()})
+        return cls(**kw)
 
-    def to_dict(self) -> Dict[str, int]:
-        return {"max_slots": self.max_slots, "max_len": self.max_len,
-                "max_queue": self.max_queue,
-                "max_prefills_per_tick": self.max_prefills_per_tick}
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "max_slots": self.max_slots, "max_len": self.max_len,
+            "max_queue": self.max_queue,
+            "max_prefills_per_tick": self.max_prefills_per_tick,
+            "kv_layout": self.kv_layout, "page_size": self.page_size}
+        if self.n_pages is not None:
+            out["n_pages"] = self.n_pages
+        return out
 
 
 @dataclass(frozen=True)

@@ -17,8 +17,8 @@ from jax import lax
 
 from apex_tpu.utils.sharding import axis_size
 
-__all__ = ["init_kv_caches", "decode_step", "generate",
-           "cast_decode_params", "flatten_decode_caches",
+__all__ = ["init_kv_caches", "init_paged_kv_caches", "decode_step",
+           "generate", "cast_decode_params", "flatten_decode_caches",
            "preslice_layer_params"]
 
 
@@ -121,6 +121,35 @@ def init_kv_caches(model, batch_size: int, max_len: int,
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
 
+def init_paged_kv_caches(model, n_pages: int, page_size: int, dtype=None):
+    """Preallocate the PAGED decode cache: a list of per-layer
+    ``(k_pages, v_pages)`` pairs, each ``[n_pages, page_size,
+    local_kv_heads * head_dim]`` — the serving engine's
+    ``kv_layout="paged"`` pool (docs/serving.md#paged-kv). The pool keeps
+    the flat form's fused heads-minor dim (full-lane page reads, and the
+    dim the sharded engine splits over the tensor axis); slots map onto
+    pool rows through a host-owned page table, so HBM is committed to
+    actual context length instead of ``max_slots * max_len``. Head count
+    is TP-local inside ``shard_map``, exactly as in
+    :func:`init_kv_caches`."""
+    from apex_tpu.transformer.tensor_parallel.mappings import axis_bound
+
+    c = model.config
+    dtype = dtype or c.compute_dtype
+    heads = c.kv_heads
+    if axis_bound(c.axis_name):
+        tp = axis_size(c.axis_name)
+        if heads % tp:
+            raise ValueError(
+                f"kv heads ({heads}) must be divisible by the "
+                f"tensor-parallel size ({tp}); with GQA/MQA keep "
+                f"num_query_groups a multiple of tp")
+        heads //= tp
+    shape = (n_pages, page_size, heads * c.head_dim)
+    return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+            for _ in range(c.num_layers)]
+
+
 def _gather_vocab(logits: jax.Array, axis_name: str) -> jax.Array:
     """Vocab-parallel logits -> full vocab (argmax/categorical need global
     token ids; shard-local winners would be garbage under TP)."""
@@ -132,7 +161,8 @@ def _gather_vocab(logits: jax.Array, axis_name: str) -> jax.Array:
 
 
 def _cached_forward(model, params, caches, tokens: jax.Array, index,
-                    last_only: bool = False, last_index=None):
+                    last_only: bool = False, last_index=None,
+                    paged_state=None):
     """Run ``tokens`` [batch, s] occupying cache slots [index, index+s) ->
     (fp32 full-vocab logits [s, batch, V], new caches). ``last_only``:
     compute the LM head for the FINAL position only (returns [1, b, V]) —
@@ -163,7 +193,8 @@ def _cached_forward(model, params, caches, tokens: jax.Array, index,
     hidden = emb.transpose(1, 0, 2)                                 # [s,b,h]
     hidden = hidden.astype(c.compute_dtype)
     hidden, new_caches = model.transformer.apply(
-        params["transformer"], hidden, kv_caches=caches, cache_index=index)
+        params["transformer"], hidden, kv_caches=caches, cache_index=index,
+        paged_state=paged_state)
     from apex_tpu.models.gpt import lm_head_loss
     if last_only:
         hidden = hidden[-1:]
@@ -175,7 +206,8 @@ def _cached_forward(model, params, caches, tokens: jax.Array, index,
     return logits.astype(jnp.float32), new_caches
 
 
-def decode_step(model, params, caches, tokens: jax.Array, index):
+def decode_step(model, params, caches, tokens: jax.Array, index,
+                paged_state=None):
     """One incremental step: ``tokens`` [batch] at position ``index`` ->
     (fp32 full-vocab logits [batch, V], updated caches). ``caches`` is
     either form :func:`init_kv_caches` produces — the stacked ``(k, v)``
@@ -183,10 +215,14 @@ def decode_step(model, params, caches, tokens: jax.Array, index):
     and the return matches the input form. ``index`` may be a ``[batch]``
     vector of per-row positions on the FLAT list form (continuous
     batching — the serving engine's batched decode over independent
-    slots). MoE models route drop-free on the cache path (prefill and
-    decode; see :func:`generate`)."""
+    slots). With ``paged_state`` (a ``[batch, pages_per_slot]`` page
+    table) ``caches`` is the :func:`init_paged_kv_caches` pool list and
+    ``index`` MUST be the per-row position vector. MoE models route
+    drop-free on the cache path (prefill and decode; see
+    :func:`generate`)."""
     logits, new_caches = _cached_forward(model, params, caches,
-                                         tokens[:, None], index)
+                                         tokens[:, None], index,
+                                         paged_state=paged_state)
     return logits[0], new_caches
 
 

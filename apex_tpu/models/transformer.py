@@ -672,7 +672,7 @@ class ParallelAttention:
     def apply(self, params, hidden, *, encoder_output=None,
               attention_mask=None, kv_lengths=None, kv_cache=None,
               cache_index=None, rng=None, deterministic=True,
-              dropout_seed=None):
+              dropout_seed=None, paged_state=None):
         """hidden: [s(, shard), b, h] -> [s(, shard), b, h]; cross-attention
         reads K/V from ``encoder_output`` [s_enc, b, h].
 
@@ -691,6 +691,13 @@ class ParallelAttention:
         cache form ``cache_index`` may be a ``[b]`` vector of per-row
         offsets (continuous batching; rope rotates each row at its own
         position).
+
+        ``paged_state`` (a ``[b, pages_per_slot]`` int32 page table)
+        switches the cache interpretation to the PAGED pool form: the
+        ``kv_cache`` pair is ``[n_pages, page_size, kv_heads*head_dim]``
+        pools shared by all slots and ``cache_index`` must be the ``[b]``
+        per-row position vector — single-token decode only, served by the
+        fused append+attend op (:mod:`apex_tpu.ops.decode_attention`).
         """
         c = self.config
         dh = c.head_dim
@@ -792,6 +799,35 @@ class ParallelAttention:
                     "kv_cache is for self-attention decode; cross-attention "
                     "K/V are static — precompute them once instead")
             ck, cv = kv_cache
+            if paged_state is not None:
+                # PAGED decode: the cache pair is the global page pool and
+                # ``paged_state`` maps this batch's slots onto it. The
+                # fused op appends each row's K/V at its own position and
+                # attends over its mapped pages in one pass (one HBM read
+                # of the KV stream per step); its reference path replays
+                # the flat s==1 formulation below bit-for-bit on the
+                # gathered logical view, so paged serving stays
+                # token-exact against the flat engine.
+                if s != 1:
+                    raise NotImplementedError(
+                        "paged_state is the single-token decode path "
+                        f"(got s={s}); prefill scatters into pages "
+                        "outside the model — see the serving engine")
+                if attention_mask is not None or kv_lengths is not None:
+                    raise NotImplementedError(
+                        "paged decode derives validity from cache_index; "
+                        "attention_mask/kv_lengths are not supported")
+                from apex_tpu.ops import fused_paged_decode_attention
+                kvh_l = k.shape[1]
+                ctx, ck, cv = fused_paged_decode_attention(
+                    q[:, :, 0, :],
+                    k[:, :, 0, :].reshape(b, kvh_l * dh),
+                    v[:, :, 0, :].reshape(b, kvh_l * dh),
+                    ck, cv, paged_state, cache_index,
+                    queries_per_group=local_heads // kvh_l,
+                    sliding_window=c.sliding_window)
+                out = self.dense.apply(params["dense"], ctx[None])
+                return out, (ck, cv)
             if ck.ndim == 3:
                 # FLAT decode cache [b, S, local_kv_heads*dh]: with the 4D
                 # [b, h, S, d] carry XLA picks a layout whose minor dim is
@@ -927,7 +963,7 @@ class ParallelTransformerLayer:
               enc_dec_attn_mask=None, enc_kv_lengths=None,
               attention_mask=None, kv_lengths=None, kv_cache=None,
               cache_index=None, rng=None, deterministic=True,
-              moe_drop_free=None, attention_seed=None):
+              moe_drop_free=None, attention_seed=None, paged_state=None):
         """``encoder_output`` (decoder layers) must be the FULL encoder
         sequence ``[s_enc, b, h]`` — under sequence parallelism gather it
         first (``gather_from_sequence_parallel_region``), as
@@ -950,7 +986,7 @@ class ParallelTransformerLayer:
             attention_mask=attention_mask, kv_lengths=kv_lengths,
             kv_cache=kv_cache, cache_index=cache_index,
             rng=rngs[2], deterministic=deterministic,
-            dropout_seed=attention_seed)
+            dropout_seed=attention_seed, paged_state=paged_state)
         new_cache = None
         if kv_cache is not None:
             attn_out, new_cache = attn_out
@@ -1050,7 +1086,7 @@ class ParallelTransformer:
               enc_dec_attn_mask=None, enc_kv_lengths=None,
               attention_mask=None, kv_lengths=None, kv_caches=None,
               cache_index=None, rng=None, deterministic=True,
-              final_norm=True, moe_drop_free=None):
+              final_norm=True, moe_drop_free=None, paged_state=None):
         """Returns ``hidden`` — or ``(hidden, moe_aux_loss)`` (aux summed
         over layers) when the config enables MoE, or ``(hidden, new_caches)``
         when decoding with ``kv_caches`` — either ``(k, v)`` stacked
@@ -1084,6 +1120,12 @@ class ParallelTransformer:
             golden = jnp.int32(-1640531527)  # 0x9E3779B9, odd
             return attn_seed_base + jnp.int32(idx) * golden
 
+        if paged_state is not None and not (
+                kv_caches is not None and isinstance(kv_caches, list)):
+            raise NotImplementedError(
+                "paged decode needs the per-layer LIST cache form (each "
+                "entry one layer's page pool pair) — the stacked scan "
+                "form re-slices the whole pool every layer")
         # a LIST means per-layer (k, v) pairs (the stacked scan form is a
         # 2-TUPLE of [L, ...] arrays — do not widen this check to tuple)
         if kv_caches is not None and isinstance(kv_caches, list):
@@ -1130,7 +1172,8 @@ class ParallelTransformer:
                     cache_index=cache_index, rng=layer_rng,
                     deterministic=deterministic,
                     moe_drop_free=moe_drop_free,
-                    attention_seed=_attn_seed(idx))
+                    attention_seed=_attn_seed(idx),
+                    paged_state=paged_state)
                 new_caches.append(new_cache)
             if final_norm:
                 h = _ln(params["final_layernorm"], h, c.layernorm_epsilon,

@@ -80,6 +80,7 @@ SERVING_SHED_COUNTERS = {
     "breaker": "requests_shed_breaker",
     "deadline": "requests_shed_deadline",
     "fleet": "requests_shed_fleet",
+    "pages_exhausted": "requests_shed_pages",
 }
 
 #: fleet incident event -> registry counter — same one-increment-per-
@@ -372,6 +373,20 @@ def render_report(report: dict) -> str:
                   _render_stat_line("ttft", req.get("ttft_s"), "s"),
                   _render_stat_line("tpot", req.get("tpot_s"), "s"),
                   _render_stat_line("tokens/s", req["tokens_per_s"])]
+    gauges = report.get("gauges") or {}
+    if "kv_pages_in_use" in gauges or "kv_pages_free" in gauges:
+        # paged-KV engine state at the final snapshot, reconciled like
+        # the slot metrics: in_use + free == n_pages by the PagePool
+        # invariant, and occupancy is the per-tick mapped fraction
+        if not req:
+            lines += ["", "serving kv cache:"]
+        occ = (report.get("histograms") or {}).get("kv_page_occupancy")
+        line = (f"  kv pages: in_use={int(gauges.get('kv_pages_in_use', 0))}"
+                f" free={int(gauges.get('kv_pages_free', 0))}")
+        if isinstance(occ, dict) and occ.get("count"):
+            line += (f"  occupancy mean={_fmt(occ.get('mean'))} "
+                     f"max={_fmt(occ.get('max'))} n={occ['count']}")
+        lines.append(line)
     slo = report.get("slo")
     if slo:
         verdict = "PASS" if slo["ok"] else "FAIL"

@@ -22,6 +22,10 @@ from apex_tpu.ops.attention import (
     packed_attention_supported,
 )
 from apex_tpu.ops.ring_attention import ring_attention, ulysses_attention
+from apex_tpu.ops.decode_attention import (
+    fused_paged_decode_attention,
+    paged_pages_for,
+)
 from apex_tpu.ops.rope import (
     fused_rope,
     fused_rope_cached,
@@ -49,4 +53,6 @@ __all__ = [
     "packed_attention_supported",
     "ring_attention",
     "ulysses_attention",
+    "fused_paged_decode_attention",
+    "paged_pages_for",
 ]

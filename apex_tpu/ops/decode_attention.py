@@ -1,0 +1,273 @@
+"""Fused decode-step attention over a PAGED KV cache.
+
+The serving engine's decode roofline (PERF.md, BENCH_r05) showed the
+gap to the HBM read-bandwidth bound *growing* with batch — 78%/76%/65%
+at bs 1/8/32 — which indicts the unfused chain, not the cache reads:
+XLA's paged-cache gather materializes a ``[b, S, f]`` temporary (read
+pool + write temp + re-read temp = ~3x the stream), and the per-slot
+append is a separate scatter program. This module is the fused
+alternative (PAPERS.md: "LLM Inference Acceleration via Efficient
+Operation Fusion", arXiv 2502.17728; ClusterFusion++'s whole-block
+decode fusion is the same territory):
+
+- :func:`fused_paged_decode_attention` — ONE jitted region per decode
+  step and layer: the new K/V row lands as a donated in-place one-row
+  scatter, and attention is a single VMEM-resident flash pass over the
+  slot's mapped pages (Pallas kernel, page table scalar-prefetched so
+  each page block DMAs straight from its pool row). The KV stream is
+  read from HBM exactly once per step; the only HBM write is the
+  appended row. No gathered-cache temporary exists in any memory space.
+
+Layouts (see docs/serving.md#paged-kv):
+
+- pool: ``[n_pages, page_size, kv_heads * head_dim]`` per layer — the
+  fused heads-minor dim keeps every page read full-lane, exactly like
+  the flat cache's ``[b, S, h*d]`` form (PERF.md round 5), and is the
+  dim :class:`~apex_tpu.serving.fleet.ShardedEngine` shards over the
+  tensor axis.
+- page table: ``[b, pages_per_slot]`` int32, logical page ``j`` of slot
+  ``r`` lives in pool row ``page_table[r, j]``; unmapped entries hold
+  the out-of-range sentinel ``n_pages`` (reads clamp + mask, scatters
+  drop).
+
+Dispatch follows the repo convention (:mod:`apex_tpu.ops._support`):
+the Pallas kernel on TPU (or under ``APEX_TPU_FORCE_PALLAS=interpret``
+for CI parity), and a pure-``jnp`` reference elsewhere. The reference
+reproduces the flat cache's single-token MXU formulation bit-for-bit on
+the gathered logical view, so the paged engine stays TOKEN-EXACT
+against the flat engine on CPU (the tier-1 parity bar); the kernel's
+flash accumulation is validated against the reference to numerical
+tolerance in interpret mode.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from apex_tpu.ops._support import cdiv, pallas_interpret, use_pallas
+
+__all__ = ["fused_paged_decode_attention", "paged_pages_for"]
+
+#: the masked-score floor the flat decode path uses — shared so paged
+#: and flat softmax see bitwise-identical masked entries
+_NEG = -1e30
+
+
+def paged_pages_for(tokens: int, page_size: int) -> int:
+    """Pages needed to hold ``tokens`` cache rows."""
+    return cdiv(tokens, page_size)
+
+
+def _append_rows(pages, rows, page_table, positions, page_size):
+    """Scatter each slot's new row at its own cache position:
+    ``pages[page_table[r, p // page_size], p % page_size] = rows[r]``.
+    One row per slot; with the pool donated into the jitted step this
+    compiles to an in-place write, never a pool copy. Unmapped sentinel
+    entries (engine bug) drop instead of corrupting a foreign page."""
+    b = rows.shape[0]
+    dest_page = page_table[jnp.arange(b), positions // page_size]
+    dest_row = positions % page_size
+    return pages.at[dest_page, dest_row].set(
+        rows.astype(pages.dtype), mode="drop")
+
+
+# -- reference path (CPU / pallas off) ---------------------------------------
+
+
+def _reference(q, k_new, v_new, k_pages, v_pages, page_table, positions,
+               group, sliding_window):
+    """Gathered-view reference: append, then run the flat cache's
+    single-token MXU formulation (transformer._flat_cache_attention,
+    ``s == 1`` branch) over the logical ``[b, S, f]`` view
+    ``pool[page_table]``. Real rows see the exact same operand values
+    and reduction order as the flat path (padded rows mask to exact
+    zeros), so flat-vs-paged engine parity is bitwise, not approximate."""
+    n_pages, page_size, f = k_pages.shape
+    b, hl, dh = q.shape
+    kvh = f // dh
+    k_pages = _append_rows(k_pages, k_new, page_table, positions, page_size)
+    v_pages = _append_rows(v_pages, v_new, page_table, positions, page_size)
+    pt = jnp.minimum(page_table, n_pages - 1)     # clamp sentinels (masked)
+    ck = k_pages[pt].reshape(b, -1, f)
+    cv = v_pages[pt].reshape(b, -1, f)
+    S = ck.shape[1]
+    slots = jnp.arange(S)[None, :]
+    invalid = slots > positions[:, None]
+    if sliding_window is not None:
+        invalid = jnp.logical_or(
+            invalid, slots <= positions[:, None] - sliding_window)
+    inv_scale = jnp.sqrt(jnp.asarray(dh, jnp.float32)).astype(q.dtype)
+    # K stream through one MXU GEMM per batch (Qblock holds each query
+    # head's vector in its K/V head's row block, zeros elsewhere) — the
+    # same full-lane formulation as the flat path
+    q_tiled = jnp.tile(q.transpose(0, 2, 1), (1, kvh, 1))
+    frow = jnp.arange(kvh * dh)[:, None]
+    jcol = jnp.arange(hl)[None, :]
+    blockmask = (frow // dh == jcol // group).astype(q.dtype)
+    qblock = q_tiled * blockmask                           # [b, f, hl]
+    scores = jnp.einsum("bsf,bfh->bsh", ck.astype(q.dtype),
+                        qblock) / inv_scale                # [b, S, hl]
+    sf = jnp.where(invalid[:, :, None], jnp.asarray(_NEG, jnp.float32),
+                   scores.astype(jnp.float32))
+    sf = sf - jnp.max(sf, axis=1, keepdims=True)
+    e = jnp.exp(sf)
+    probs = (e / jnp.sum(e, axis=1, keepdims=True)).astype(q.dtype)
+    ctx_big = jnp.einsum("bsh,bsf->bhf", probs, cv.astype(q.dtype))
+    sel = (jnp.arange(kvh)[None, :]
+           == (jnp.arange(hl) // group)[:, None]).astype(q.dtype)
+    ctx = jnp.einsum("bjkd,jk->bjd", ctx_big.reshape(b, hl, kvh, dh), sel)
+    return ctx.reshape(b, hl * dh), k_pages, v_pages
+
+
+# -- Pallas kernel -----------------------------------------------------------
+
+
+def _decode_kernel(pt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_ref, l_ref, acc_ref, *, page_size, group,
+                   sliding_window):
+    """One (slot, page-block) grid cell of the streaming decode pass.
+
+    The page table is scalar-prefetched, so block ``(r, j)``'s K/V page
+    DMAs directly from pool row ``page_table[r, j]`` into VMEM — the
+    gather never exists as an array. Softmax is the standard flash
+    recurrence over page blocks (running max / normalizer / weighted
+    accumulator in VMEM scratch, carried across the slot's inner grid
+    iterations); the final block rescales and writes the context row.
+    Pages past the slot's valid length are skipped (their DMA is the
+    residual cost of the rectangular grid — ~one page per slot in
+    steady state since the engine allocates pages on demand)."""
+    r = pl.program_id(0)
+    j = pl.program_id(1)
+    pos = pos_ref[r]                         # append index == last valid
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    @pl.when(j * page_size <= pos)
+    def _accumulate():
+        hl, dh = q_ref.shape[1], q_ref.shape[2]
+        kvh = hl // group
+        qh = q_ref[0].reshape(kvh, group, dh).astype(jnp.float32)
+        kb = k_ref[0].reshape(page_size, kvh, dh).astype(jnp.float32)
+        vb = v_ref[0].reshape(page_size, kvh, dh).astype(jnp.float32)
+        s_blk = jax.lax.dot_general(
+            qh, kb, (((2,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)  # [kvh, group, page_size]
+        s_blk = s_blk / jnp.sqrt(jnp.float32(dh))
+        row = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, 1, page_size), 2)
+        invalid = row > pos
+        if sliding_window is not None:
+            invalid = jnp.logical_or(invalid, row <= pos - sliding_window)
+        s_blk = jnp.where(invalid, _NEG, s_blk)
+        m_prev, l_prev = m_ref[...], l_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s_blk, axis=-1))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s_blk - m_new[..., None])    # [kvh, group, page_size]
+        l_ref[...] = l_prev * alpha + jnp.sum(p, axis=-1)
+        pv = jax.lax.dot_general(
+            p, vb, (((2,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)  # [kvh, group, dh]
+        acc_ref[...] = acc_ref[...] * alpha[..., None] + pv
+        m_ref[...] = m_new
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _finish():
+        hl, dh = q_ref.shape[1], q_ref.shape[2]
+        # l > 0 always: position `pos` itself is valid by construction
+        ctx = acc_ref[...] / l_ref[...][..., None]
+        o_ref[...] = ctx.reshape(1, hl * dh).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("group", "sliding_window"))
+def _pallas(q, k_new, v_new, k_pages, v_pages, page_table, positions,
+            group, sliding_window):
+    n_pages, page_size, f = k_pages.shape
+    b, hl, dh = q.shape
+    kvh = f // dh
+    pages_per_slot = page_table.shape[1]
+    # append first (donated in-place row write); the kernel then streams
+    # pages that already contain the new row — one read of the stream,
+    # one row written, no ordering hazard (the row's page is mapped)
+    k_pages = _append_rows(k_pages, k_new, page_table, positions, page_size)
+    v_pages = _append_rows(v_pages, v_new, page_table, positions, page_size)
+    pt = jnp.minimum(page_table, n_pages - 1).astype(jnp.int32)
+
+    kernel = functools.partial(
+        _decode_kernel, page_size=page_size, group=group,
+        sliding_window=sliding_window)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, pages_per_slot),
+        in_specs=[
+            pl.BlockSpec((1, hl, dh), lambda r, j, pt, pos: (r, 0, 0)),
+            pl.BlockSpec((1, page_size, f),
+                         lambda r, j, pt, pos: (pt[r, j], 0, 0)),
+            pl.BlockSpec((1, page_size, f),
+                         lambda r, j, pt, pos: (pt[r, j], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, hl * dh), lambda r, j, pt, pos: (r, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((kvh, group), jnp.float32),       # running max
+            pltpu.VMEM((kvh, group), jnp.float32),       # normalizer
+            pltpu.VMEM((kvh, group, dh), jnp.float32),   # weighted acc
+        ])
+    ctx = pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hl * dh), q.dtype),
+        interpret=pallas_interpret(),
+    )(pt, positions.astype(jnp.int32), q, k_pages, v_pages)
+    return ctx, k_pages, v_pages
+
+
+def fused_paged_decode_attention(q, k_new, v_new, k_pages, v_pages,
+                                 page_table, positions, *,
+                                 queries_per_group: int = 1,
+                                 sliding_window=None):
+    """One fused decode step for one layer over the paged KV pool.
+
+    Args:
+      q: ``[b, local_heads, head_dim]`` — this step's query vectors
+        (one token per slot, rope already applied).
+      k_new, v_new: ``[b, kv_heads * head_dim]`` — this step's K/V rows.
+      k_pages, v_pages: ``[n_pages, page_size, kv_heads * head_dim]`` —
+        the layer's page pool.
+      page_table: ``[b, pages_per_slot]`` int32 — pool rows backing each
+        slot's logical pages; unmapped entries hold the sentinel
+        ``n_pages``.
+      positions: ``[b]`` int32 — each slot's append index (tokens
+        already cached). The new row lands at ``positions[r]`` — its
+        page MUST be mapped (the engine allocates on demand before the
+        step) — and attention covers logical rows ``[0, positions[r]]``.
+      queries_per_group: query heads per K/V head (GQA/MQA grouping).
+      sliding_window: optional Mistral-style local-attention window.
+
+    Returns ``(ctx [b, local_heads * head_dim], k_pages, v_pages)`` —
+    the context rows and the pools with the new rows appended.
+    """
+    if q.ndim != 3:
+        raise ValueError(f"q must be [b, heads, head_dim], got {q.shape}")
+    if k_pages.ndim != 3 or k_pages.shape != v_pages.shape:
+        raise ValueError(
+            f"pools must be matching [n_pages, page_size, kv_heads * "
+            f"head_dim], got {k_pages.shape} / {v_pages.shape}")
+    b, hl, dh = q.shape
+    if hl % queries_per_group:
+        raise ValueError(
+            f"heads ({hl}) not divisible by queries_per_group "
+            f"({queries_per_group})")
+    if k_pages.shape[-1] != (hl // queries_per_group) * dh:
+        raise ValueError(
+            f"pool minor dim {k_pages.shape[-1]} != kv_heads * head_dim "
+            f"({hl // queries_per_group} * {dh})")
+    fn = _pallas if use_pallas() else _reference
+    return fn(q, k_new, v_new, k_pages, v_pages, page_table,
+              positions, queries_per_group, sliding_window)

@@ -46,7 +46,7 @@ from apex_tpu.serving.scheduler import (
     bucket_for,
     prefill_buckets,
 )
-from apex_tpu.serving.slots import SlotError, SlotPool
+from apex_tpu.serving.slots import PageError, PagePool, SlotError, SlotPool
 from apex_tpu.serving.supervisor import (
     BREAKER_CLOSED,
     BREAKER_HALF_OPEN,
@@ -81,6 +81,8 @@ __all__ = [
     "prefill_buckets",
     "SlotPool",
     "SlotError",
+    "PagePool",
+    "PageError",
     "FINISH_EOS",
     "FINISH_LENGTH",
     "FINISH_CANCELLED",

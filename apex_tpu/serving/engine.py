@@ -66,6 +66,7 @@ from apex_tpu.models.generation import (
     decode_step,
     flatten_decode_caches,
     init_kv_caches,
+    init_paged_kv_caches,
     preslice_layer_params,
 )
 from apex_tpu.observability import MetricsRegistry
@@ -87,7 +88,7 @@ from apex_tpu.serving.scheduler import (
     bucket_for,
     prefill_buckets,
 )
-from apex_tpu.serving.slots import SlotPool
+from apex_tpu.serving.slots import PagePool, SlotPool
 from apex_tpu.utils.logging import get_logger, log_event
 
 __all__ = ["EngineConfig", "InferenceEngine"]
@@ -100,7 +101,8 @@ _LOG = get_logger(__name__)
 _COUNTERS = ("requests_submitted", "requests_eos", "requests_length",
              "requests_cancelled", "requests_timeout", "requests_rejected",
              "requests_error", "prefills", "decode_steps",
-             "tokens_generated", "slots_quarantined")
+             "tokens_generated", "slots_quarantined",
+             "requests_shed_pages")
 
 
 @dataclass
@@ -114,6 +116,17 @@ class EngineConfig:
     donates the KV-cache buffers into the jitted steps so decode updates
     in place on TPU; ``None`` auto-disables it on the CPU backend (which
     cannot donate and would warn every compile).
+
+    KV layout (docs/serving.md#paged-kv): ``kv_layout="paged"`` (the
+    default) backs slots with a shared page pool — ``n_pages`` pages of
+    ``page_size`` tokens per layer — so HBM is committed to actual
+    context length and ``max_slots`` can exceed what dense rows would
+    fit; decode runs the fused append+attend kernel. ``n_pages=None``
+    sizes the pool to fully back every slot at ``max_len`` (same
+    capacity as flat — no admission behavior change); size it below that
+    to overcommit, and the engine sheds ``pages_exhausted`` when a
+    request's worst case can never fit. ``kv_layout="flat"`` keeps the
+    dense ``[max_slots, max_len]`` rows for bisection.
     """
 
     max_slots: int = 8
@@ -121,6 +134,9 @@ class EngineConfig:
     scheduler: SchedulerConfig = field(default_factory=SchedulerConfig)
     retrace_budget: Optional[int] = 0
     donate_caches: Optional[bool] = None
+    kv_layout: str = "paged"
+    page_size: int = 64
+    n_pages: Optional[int] = None
 
     def __post_init__(self):
         if self.max_slots < 1:
@@ -129,6 +145,20 @@ class EngineConfig:
             raise ValueError(
                 f"max_len must be >= 2 (one prompt + one generated token), "
                 f"got {self.max_len}")
+        if self.kv_layout not in ("flat", "paged"):
+            raise ValueError(
+                f"kv_layout must be 'flat' or 'paged', got "
+                f"{self.kv_layout!r}")
+        if self.page_size < 1:
+            raise ValueError(
+                f"page_size must be >= 1, got {self.page_size}")
+        if self.n_pages is not None and self.n_pages < 1:
+            raise ValueError(f"n_pages must be >= 1, got {self.n_pages}")
+
+    @property
+    def pages_per_slot(self) -> int:
+        """Page-table width: pages covering one slot at ``max_len``."""
+        return -(-self.max_len // self.page_size)
 
 
 class _Active:
@@ -220,9 +250,28 @@ class InferenceEngine:
         if c.compute_dtype != jnp.float32:
             params = cast_decode_params(params, c.compute_dtype)
         self._params = preslice_layer_params(params, c.num_layers)
-        self._caches = init_kv_caches(
-            model, self.config.max_slots, self.config.max_len,
-            stacked=False, flat=True)
+        if self.config.kv_layout == "paged":
+            pps = self.config.pages_per_slot
+            n_pages = (self.config.n_pages if self.config.n_pages is not None
+                       else self.config.max_slots * pps)
+            self.pages: Optional[PagePool] = PagePool(
+                n_pages, self.config.page_size, pps)
+            self._caches = init_paged_kv_caches(
+                model, n_pages, self.config.page_size)
+            # host page table; n_pages is the unmapped sentinel (reads
+            # clamp+mask, scatters drop — see ops/decode_attention.py)
+            self._page_table_h = np.full(
+                (self.config.max_slots, pps), n_pages, np.int32)
+            #: worst-case pages promised to admitted requests — admission
+            #: only lets a request in when its full total_len reservation
+            #: fits, so decode-time extends can NEVER exhaust the pool
+            #: (no mid-flight eviction policy needed; see _admit)
+            self._reserved_pages = 0
+        else:
+            self.pages = None
+            self._caches = init_kv_caches(
+                model, self.config.max_slots, self.config.max_len,
+                stacked=False, flat=True)
 
         n = self.config.max_slots
         self._tokens_h = np.zeros(n, np.int32)
@@ -288,16 +337,76 @@ class InferenceEngine:
                                seed[None], prompt_len[None])
         return first[0], new
 
+    def _paged_decode_body(self, params, caches, page_table, tokens,
+                           positions, temps, topks, seeds):
+        # same decode step over the PAGED pool: one fused append+attend
+        # per layer (apex_tpu.ops.decode_attention) instead of the flat
+        # row scatter + masked read; with the pool donated the appends
+        # are in-place row writes, so per step the KV traffic is one
+        # read of the mapped stream plus one row
+        logits, caches = decode_step(self.model, params, caches, tokens,
+                                     positions, paged_state=page_table)
+        nxt = _sample_tokens(logits, temps, topks, seeds, positions + 1)
+        finite = jnp.all(jnp.isfinite(logits), axis=-1)
+        return nxt, finite, caches
+
+    def _paged_scrub_body(self, caches, page_row):
+        # zero exactly the quarantined slot's mapped pages across every
+        # layer (``page_row`` is its fixed-width table row; sentinel
+        # entries drop) — same NaN-hygiene contract as the flat scrub,
+        # but foreign slots' pages are never touched
+        return [(k.at[page_row].set(0.0, mode="drop"),
+                 v.at[page_row].set(0.0, mode="drop"))
+                for k, v in caches]
+
+    def _paged_prefill_body(self, params, caches, page_row, prompt,
+                            prompt_len, temp, topk, seed):
+        # identical prefill compute to the flat body (same 4D small-cache
+        # forward, so greedy outputs stay token-exact); only the landing
+        # differs — the flattened rows scatter into this slot's freshly
+        # mapped pages. Chunks past the mapped count (bucket padding)
+        # carry the sentinel and drop; garbage rows inside the last
+        # mapped page are causally masked by the row's position forever.
+        model = self.model
+        small = init_kv_caches(model, 1, prompt.shape[1], stacked=False)
+        logits, small = _cached_forward(model, params, small, prompt, 0,
+                                        last_index=prompt_len - 1)
+        flat = flatten_decode_caches(small, model.config.num_layers)
+        ps = self.config.page_size
+        bucket = prompt.shape[1]
+        n_chunks = -(-bucket // ps)
+        pad = n_chunks * ps - bucket
+        dest = page_row[:n_chunks]
+        new = []
+        for (bk, bv), (fk, fv) in zip(caches, flat):
+            fk1 = jnp.pad(fk[0], ((0, pad), (0, 0)))
+            fv1 = jnp.pad(fv[0], ((0, pad), (0, 0)))
+            new.append(
+                (bk.at[dest].set(fk1.reshape(n_chunks, ps, -1)
+                                 .astype(bk.dtype), mode="drop"),
+                 bv.at[dest].set(fv1.reshape(n_chunks, ps, -1)
+                                 .astype(bv.dtype), mode="drop")))
+        first = _sample_tokens(logits[0], temp[None], topk[None],
+                               seed[None], prompt_len[None])
+        return first[0], new
+
     def _build_step_fns(self, donate: bool):
         """Compile the three device programs: ``(decode, prefill, scrub)``.
         The base engine jits the bodies directly (single-chip);
         :class:`~apex_tpu.serving.fleet.ShardedEngine` overrides this to
-        wrap each body in ``shard_map`` over the tensor axis first."""
+        wrap each body in ``shard_map`` over the tensor axis first. The
+        body triple is picked by ``kv_layout`` — both layouts keep the
+        caches as argument 1 so donation and the watchdogs are shared."""
         donate_args = (1,) if donate else ()
-        return (jax.jit(self._decode_body, donate_argnums=donate_args),
-                jax.jit(self._prefill_body, donate_argnums=donate_args),
-                jax.jit(self._scrub_body,
-                        donate_argnums=(0,) if donate else ()))
+        if self.pages is not None:
+            bodies = (self._paged_decode_body, self._paged_prefill_body,
+                      self._paged_scrub_body)
+        else:
+            bodies = (self._decode_body, self._prefill_body,
+                      self._scrub_body)
+        return (jax.jit(bodies[0], donate_argnums=donate_args),
+                jax.jit(bodies[1], donate_argnums=donate_args),
+                jax.jit(bodies[2], donate_argnums=(0,) if donate else ()))
 
     # -- introspection ----------------------------------------------------
 
@@ -404,6 +513,11 @@ class InferenceEngine:
         self._admit(finished)
         self._decode_tick(finished)
         self.metrics.observe("slot_occupancy", self.slots.occupancy)
+        if self.pages is not None:
+            self.metrics.set_gauge("kv_pages_in_use",
+                                   self.pages.in_use_count)
+            self.metrics.set_gauge("kv_pages_free", self.pages.free_count)
+            self.metrics.observe("kv_page_occupancy", self.pages.occupancy)
         return finished
 
     def serve(self, requests: Sequence[Request], *,
@@ -448,6 +562,12 @@ class InferenceEngine:
         self._closed = True
         self._active.clear()
         self.slots.reset()
+        if self.pages is not None:
+            # the page free list resets WITH the slot pool — a rebuild
+            # that reused this registry must start from a full pool
+            self.pages.reset()
+            self._reserved_pages = 0
+            self._page_table_h[:] = self.pages.n_pages
         self.metrics.flush()
 
     def __enter__(self) -> "InferenceEngine":
@@ -477,12 +597,57 @@ class InferenceEngine:
                     rec, FINISH_CANCELLED, time.monotonic()))
 
     def _admit(self, finished: List[RequestResult]) -> None:
+        shed: List = []
+        predicate = None
+        if self.pages is not None:
+            # pages-aware admission: a request enters only when its
+            # WORST-CASE page need (total_len) fits alongside every
+            # other admitted request's reservation — so decode-time
+            # on-demand extends can never exhaust the pool and there is
+            # no mid-flight eviction policy to get wrong. A head that
+            # can never fit (need > n_pages) is shed as
+            # ``pages_exhausted``; one that merely must wait defers
+            # (FCFS head-blocking, like a full slot pool).
+            planned = 0
+
+            def predicate(request):
+                nonlocal planned
+                need = self.pages.pages_for(request.total_len)
+                if need > self.pages.n_pages:
+                    return "shed"
+                if need <= (self.pages.n_pages - self._reserved_pages
+                            - planned):
+                    planned += need
+                    return "admit"
+                return "defer"
+
         batch = self.scheduler.pop_admissible(
-            self.slots.free_count, decoding=bool(self._active))
+            self.slots.free_count, decoding=bool(self._active),
+            predicate=predicate, shed=shed)
+        now = time.monotonic()
+        for request, submit_ts in shed:
+            finished.append(self._shed_pages(request, submit_ts, now))
         for request, submit_ts in batch:
             slot = self.slots.allocate()
             assert slot is not None  # pop_admissible respects free_count
             self._prefill_into(request, slot, submit_ts, finished)
+
+    def _shed_pages(self, request: Request, submit_ts: float,
+                    now: float) -> RequestResult:
+        """Reject a request whose worst-case page reservation exceeds the
+        whole pool — its own shed counter + ``request_shed`` reason, the
+        supervisor-shed convention, instead of a prefill-time failure."""
+        need = self.pages.pages_for(request.total_len)
+        self.metrics.inc("requests_shed_pages")
+        log_event(_LOG, "request_shed", request_id=request.request_id,
+                  reason="pages_exhausted", pages_needed=need,
+                  n_pages=self.pages.n_pages)
+        self.metrics.event("request_shed", request_id=request.request_id,
+                           reason="pages_exhausted", pages_needed=need,
+                           n_pages=self.pages.n_pages)
+        return self._finish(request, [], FINISH_REJECTED,
+                            submit_ts=submit_ts, now=now,
+                            detail="pages_exhausted")
 
     def _prefill_into(self, request: Request, slot: int, submit_ts: float,
                       finished: List[RequestResult]) -> None:
@@ -492,21 +657,55 @@ class InferenceEngine:
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :request.prompt_len] = request.prompt
         sp = request.sampling
+        if self.pages is not None:
+            # commit the worst-case reservation, then physically map only
+            # the prompt's pages (decode extends on demand). _admit's
+            # predicate guaranteed the reservation fits, so a None here
+            # is a broken invariant, not load.
+            need = self.pages.pages_for(request.total_len)
+            mapped = self.pages.map_slot(slot, request.prompt_len)
+            if mapped is None:
+                self.slots.release(slot)
+                raise RuntimeError(
+                    f"page pool exhausted at prefill despite admission "
+                    f"reservation (slot {slot}, "
+                    f"free={self.pages.free_count}) — reservation "
+                    f"accounting is broken")
+            self._reserved_pages += need
+            row = self._page_table_h[slot]
+            row[:] = self.pages.n_pages
+            row[:len(mapped)] = mapped
         try:
             if self._faults is not None:
                 self._faults.before_prefill()
-            first, self._caches = self._prefill_fn(
-                self._params, self._caches, jnp.asarray(padded),
-                jnp.int32(slot), jnp.int32(request.prompt_len),
-                jnp.float32(sp.temperature),
-                jnp.int32(sp.top_k if sp.top_k is not None else self._vocab),
-                jnp.int32(sp.seed))
+            if self.pages is not None:
+                first, self._caches = self._prefill_fn(
+                    self._params, self._caches,
+                    jnp.asarray(self._page_table_h[slot]),
+                    jnp.asarray(padded), jnp.int32(request.prompt_len),
+                    jnp.float32(sp.temperature),
+                    jnp.int32(sp.top_k if sp.top_k is not None
+                              else self._vocab),
+                    jnp.int32(sp.seed))
+            else:
+                first, self._caches = self._prefill_fn(
+                    self._params, self._caches, jnp.asarray(padded),
+                    jnp.int32(slot), jnp.int32(request.prompt_len),
+                    jnp.float32(sp.temperature),
+                    jnp.int32(sp.top_k if sp.top_k is not None
+                              else self._vocab),
+                    jnp.int32(sp.seed))
             first = int(np.asarray(first))
         except Exception:
-            # keep the pool invariant even as the failure propagates: the
-            # slot never held committed state (nothing scattered, or the
-            # scatter's result was discarded with the raised call)
+            # keep the pool invariants even as the failure propagates:
+            # the slot never held committed state (nothing scattered, or
+            # the scatter's result was discarded with the raised call)
             self.slots.release(slot)
+            if self.pages is not None:
+                self.pages.release_slot(slot)
+                self._reserved_pages -= self.pages.pages_for(
+                    request.total_len)
+                self._page_table_h[slot, :] = self.pages.n_pages
             raise
         rec.prefill_end = time.monotonic()
         rec.tokens.append(first)
@@ -524,15 +723,25 @@ class InferenceEngine:
             finished.append(self._retire(rec, done, time.monotonic()))
 
     def _decode_tick(self, finished: List[RequestResult]) -> None:
+        if self.pages is not None:
+            self._extend_pages(finished)
         if not self._active:
             return
         if self._faults is not None:
             self._faults.before_decode()
-        nxt, finite, self._caches = self._decode_fn(
-            self._params, self._caches,
-            jnp.asarray(self._tokens_h), jnp.asarray(self._positions_h),
-            jnp.asarray(self._temps_h), jnp.asarray(self._topks_h),
-            jnp.asarray(self._seeds_h))
+        if self.pages is not None:
+            nxt, finite, self._caches = self._decode_fn(
+                self._params, self._caches,
+                jnp.asarray(self._page_table_h),
+                jnp.asarray(self._tokens_h), jnp.asarray(self._positions_h),
+                jnp.asarray(self._temps_h), jnp.asarray(self._topks_h),
+                jnp.asarray(self._seeds_h))
+        else:
+            nxt, finite, self._caches = self._decode_fn(
+                self._params, self._caches,
+                jnp.asarray(self._tokens_h), jnp.asarray(self._positions_h),
+                jnp.asarray(self._temps_h), jnp.asarray(self._topks_h),
+                jnp.asarray(self._seeds_h))
         nxt = np.asarray(nxt)
         finite = np.asarray(finite)
         if self._faults is not None:
@@ -561,6 +770,33 @@ class InferenceEngine:
             if done is not None:
                 finished.append(self._retire(rec, done, now))
 
+    def _extend_pages(self, finished: List[RequestResult]) -> None:
+        """On-demand page growth before the decode step: every active
+        slot must have the page backing row ``position`` mapped (the
+        fused kernel appends there). Admission reserved each request's
+        worst case, so the extend cannot fail — the defensive branch
+        retires the slot as an error rather than corrupting a foreign
+        page, and counts the shed so the monitor surfaces it."""
+        now = time.monotonic()
+        for slot in sorted(self._active):
+            rec = self._active[slot]
+            fresh = self.pages.extend_slot(slot, rec.position + 1)
+            if fresh is None:
+                self.metrics.inc("requests_shed_pages")
+                log_event(_LOG, "request_shed",
+                          request_id=rec.request.request_id,
+                          reason="pages_exhausted", mid_flight=True)
+                self.metrics.event("request_shed",
+                                   request_id=rec.request.request_id,
+                                   reason="pages_exhausted",
+                                   mid_flight=True)
+                finished.append(self._retire(rec, FINISH_ERROR, now))
+                continue
+            if fresh:
+                row = self._page_table_h[slot]
+                pages = self.pages.slot_pages(slot)
+                row[len(pages) - len(fresh):len(pages)] = fresh
+
     # -- retirement & bookkeeping ----------------------------------------
 
     def _quarantine(self, rec: _Active, cause: str,
@@ -571,7 +807,11 @@ class InferenceEngine:
         finish the request with ``finish_reason="error"`` — co-tenants
         are untouched and the decode program never retraces."""
         slot = rec.slot
-        self._caches = self._scrub_fn(self._caches, jnp.int32(slot))
+        if self.pages is not None:
+            self._caches = self._scrub_fn(
+                self._caches, jnp.asarray(self._page_table_h[slot]))
+        else:
+            self._caches = self._scrub_fn(self._caches, jnp.int32(slot))
         self.metrics.inc("slots_quarantined")
         log_event(_LOG, "slot_quarantined", slot=slot,
                   request_id=rec.request.request_id, cause=cause)
@@ -607,6 +847,11 @@ class InferenceEngine:
                 now: float) -> RequestResult:
         del self._active[rec.slot]
         self.slots.release(rec.slot)
+        if self.pages is not None:
+            self.pages.release_slot(rec.slot)
+            self._reserved_pages -= self.pages.pages_for(
+                rec.request.total_len)
+            self._page_table_h[rec.slot, :] = self.pages.n_pages
         self._clear_slot(rec.slot)
         return self._finish(
             rec.request, rec.tokens, reason, submit_ts=rec.submit_ts,

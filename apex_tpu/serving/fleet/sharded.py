@@ -95,10 +95,12 @@ class ShardedEngine(InferenceEngine):
         return spec
 
     def _cache_spec(self):
-        """The flat ``[max_slots, max_len, kv_heads * head_dim]`` pool
-        shards its fused heads*head_dim minor dim over the tensor axis —
-        each rank's contiguous block is exactly the head slice its QKV
-        projection produces."""
+        """Both KV pool layouts — flat ``[max_slots, max_len, kv_heads *
+        head_dim]`` rows and the paged ``[n_pages, page_size, kv_heads *
+        head_dim]`` pool — shard the same fused heads*head_dim minor dim
+        over the tensor axis: each rank's contiguous block is exactly
+        the head slice its QKV projection produces (page tables stay
+        host-side/replicated; the mapping is identical on every rank)."""
         axis = self.model.config.axis_name
         pair = (P(None, None, axis), P(None, None, axis))
         return [pair for _ in range(self.model.config.num_layers)]
@@ -106,24 +108,40 @@ class ShardedEngine(InferenceEngine):
     def _build_step_fns(self, donate: bool):
         """The base engine's step bodies, ``shard_map``-wrapped over the
         mesh: params by ``model.spec()``, KV pool on the heads axis,
-        tokens/positions/sampling params replicated. The bodies
-        themselves are INHERITED — this class changes where the math
-        runs, not what it computes."""
+        tokens/positions/sampling params — and, under ``kv_layout=
+        "paged"``, the page table — replicated. The bodies themselves
+        are INHERITED — this class changes where the math runs, not what
+        it computes."""
         mesh = self.mesh
         pspec = self._param_spec()
         cspec = self._cache_spec()
         rep = P()
-        decode = shard_map(
-            self._decode_body, mesh=mesh,
-            in_specs=(pspec, cspec, rep, rep, rep, rep, rep),
-            out_specs=(rep, rep, cspec))
-        prefill = shard_map(
-            self._prefill_body, mesh=mesh,
-            in_specs=(pspec, cspec, rep, rep, rep, rep, rep, rep),
-            out_specs=(rep, cspec))
-        scrub = shard_map(
-            self._scrub_body, mesh=mesh,
-            in_specs=(cspec, rep), out_specs=cspec)
+        if self.pages is not None:
+            # paged bodies take one extra replicated arg (the page
+            # table / the slot's table row) right after the pool
+            decode = shard_map(
+                self._paged_decode_body, mesh=mesh,
+                in_specs=(pspec, cspec, rep, rep, rep, rep, rep, rep),
+                out_specs=(rep, rep, cspec))
+            prefill = shard_map(
+                self._paged_prefill_body, mesh=mesh,
+                in_specs=(pspec, cspec, rep, rep, rep, rep, rep, rep),
+                out_specs=(rep, cspec))
+            scrub = shard_map(
+                self._paged_scrub_body, mesh=mesh,
+                in_specs=(cspec, rep), out_specs=cspec)
+        else:
+            decode = shard_map(
+                self._decode_body, mesh=mesh,
+                in_specs=(pspec, cspec, rep, rep, rep, rep, rep),
+                out_specs=(rep, rep, cspec))
+            prefill = shard_map(
+                self._prefill_body, mesh=mesh,
+                in_specs=(pspec, cspec, rep, rep, rep, rep, rep, rep),
+                out_specs=(rep, cspec))
+            scrub = shard_map(
+                self._scrub_body, mesh=mesh,
+                in_specs=(cspec, rep), out_specs=cspec)
         donate_args = (1,) if donate else ()
         return (jax.jit(decode, donate_argnums=donate_args),
                 jax.jit(prefill, donate_argnums=donate_args),

@@ -161,12 +161,23 @@ class FCFSScheduler:
         self._queue = kept
         return expired
 
-    def pop_admissible(self, free_slots: int,
-                       decoding: bool) -> List[Tuple[Request, float]]:
+    def pop_admissible(self, free_slots: int, decoding: bool, *,
+                       predicate: Optional[Callable[[Request], str]] = None,
+                       shed: Optional[List[Tuple[Request, float]]] = None
+                       ) -> List[Tuple[Request, float]]:
         """FCFS batch for this tick: up to ``free_slots`` requests, capped
         at ``max_prefills_per_tick`` while decode traffic is in flight
         (the starvation cap). Stops at the first head the admission hook
-        defers — no queue jumping."""
+        defers — no queue jumping.
+
+        ``predicate(request)`` refines admission per request (the
+        engine's pages-aware policy): ``"admit"`` pops and admits,
+        ``"defer"`` head-blocks like the admission hook (resources will
+        free up — wait, FCFS honest), ``"shed"`` pops the request
+        WITHOUT admitting it and appends ``(request, submit_ts)`` to the
+        caller's ``shed`` list (it can never be satisfied — the caller
+        records the rejection). The predicate runs after the admission
+        hook and only counts admitted requests against the cap."""
         cap = free_slots
         if decoding:
             cap = min(cap, self.config.max_prefills_per_tick)
@@ -176,6 +187,19 @@ class FCFSScheduler:
             head = self._queue[0]
             if hook is not None and not hook(head.request):
                 break
+            if predicate is not None:
+                verdict = predicate(head.request)
+                if verdict == "defer":
+                    break
+                if verdict == "shed":
+                    self._queue.popleft()
+                    if shed is not None:
+                        shed.append((head.request, head.submit_ts))
+                    continue
+                if verdict != "admit":
+                    raise ValueError(
+                        f"admission predicate must return 'admit', "
+                        f"'defer', or 'shed'; got {verdict!r}")
             self._queue.popleft()
             admitted.append((head.request, head.submit_ts))
         return admitted

@@ -1,25 +1,38 @@
 """Fixed-capacity decode slot pool with free-list allocation.
 
-Each slot is one row of the engine's batched KV cache
-(``[max_slots, max_len]`` per layer): a request holds exactly one slot
-from prefill to retirement, and the pool's invariant — every slot is
-either free or owned by exactly one request — is what the scheduler
-tests mean by "no slot leaks". Allocation always hands out the LOWEST
-free slot id so runs are deterministic (the same arrival order always
-produces the same slot assignment, and therefore the same decode batch
-layout).
+Each slot is one row of the engine's batched KV cache: a request holds
+exactly one slot from prefill to retirement, and the pool's invariant —
+every slot is either free or owned by exactly one request — is what the
+scheduler tests mean by "no slot leaks". Allocation always hands out
+the LOWEST free slot id so runs are deterministic (the same arrival
+order always produces the same slot assignment, and therefore the same
+decode batch layout).
+
+Under the paged KV layout (``kv_layout="paged"``, docs/serving.md), a
+slot row no longer reserves ``max_len`` cache memory; instead each slot
+maps a variable number of fixed-size pages out of a shared
+:class:`PagePool`, so HBM is committed to *actual* context length and
+long-context mixes stop being bounded by ``max_slots × max_len``. The
+PagePool mirrors SlotPool's discipline exactly — lowest-first free
+heap, one-owner invariant, :meth:`PagePool.check` as the leak assert —
+but allocation is per-slot *lists* of pages that grow on demand during
+decode and are returned wholesale at retirement.
 """
 
 from __future__ import annotations
 
 import heapq
-from typing import List, Optional
+from typing import Dict, List, Optional
 
-__all__ = ["SlotError", "SlotPool"]
+__all__ = ["SlotError", "SlotPool", "PageError", "PagePool"]
 
 
 class SlotError(RuntimeError):
     """A slot-pool invariant was violated (double release, foreign id)."""
+
+
+class PageError(RuntimeError):
+    """A page-pool invariant was violated (leak, foreign page, double map)."""
 
 
 class SlotPool:
@@ -75,3 +88,123 @@ class SlotPool:
             raise SlotError(
                 f"slot leak: {len(self._free)} free + "
                 f"{len(self._active)} active != capacity {self.capacity}")
+
+
+class PagePool:
+    """Free-list allocator for the global KV page pool.
+
+    Host-side bookkeeping only — the device arrays live in the engine.
+    ``n_pages`` pool rows are handed out lowest-first as per-slot page
+    lists; every page is either on the free heap or in exactly one
+    slot's list (the page analogue of the slot no-leak invariant, and
+    what "no page leaks" asserts in the tests). ``pages_per_slot``
+    bounds one slot's list — it is the page-table width, i.e. the
+    paged engine's ``max_len`` in pages.
+    """
+
+    def __init__(self, n_pages: int, page_size: int, pages_per_slot: int):
+        if n_pages < 1 or page_size < 1 or pages_per_slot < 1:
+            raise ValueError(
+                f"n_pages/page_size/pages_per_slot must be >= 1, got "
+                f"{n_pages}/{page_size}/{pages_per_slot}")
+        self.n_pages = n_pages
+        self.page_size = page_size
+        self.pages_per_slot = pages_per_slot
+        self._free: List[int] = list(range(n_pages))  # already a heap
+        self._owned: Dict[int, List[int]] = {}        # slot -> mapped pages
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def in_use_count(self) -> int:
+        return self.n_pages - len(self._free)
+
+    @property
+    def occupancy(self) -> float:
+        """Mapped fraction in [0, 1] — the kv_page_occupancy feed."""
+        return self.in_use_count / self.n_pages
+
+    def pages_for(self, tokens: int) -> int:
+        """Pages needed to hold ``tokens`` cache rows."""
+        return -(-tokens // self.page_size)
+
+    def slot_pages(self, slot: int) -> List[int]:
+        """The pages currently mapped to ``slot`` (logical order)."""
+        return list(self._owned.get(slot, ()))
+
+    def map_slot(self, slot: int, tokens: int) -> Optional[List[int]]:
+        """Map a fresh slot with enough pages for ``tokens`` rows.
+
+        Returns the page list (logical order), or None when the pool
+        cannot supply them — the caller sheds with ``pages_exhausted``
+        rather than partially mapping. A slot may only be mapped once
+        between releases.
+        """
+        if slot in self._owned:
+            raise PageError(f"slot {slot} is already mapped")
+        need = self.pages_for(max(tokens, 1))
+        if need > self.pages_per_slot:
+            raise PageError(
+                f"slot {slot} needs {need} pages > pages_per_slot "
+                f"{self.pages_per_slot}")
+        if need > len(self._free):
+            return None
+        pages = [heapq.heappop(self._free) for _ in range(need)]
+        self._owned[slot] = pages
+        return pages
+
+    def extend_slot(self, slot: int, tokens: int) -> Optional[List[int]]:
+        """Grow ``slot`` to cover ``tokens`` rows (decode on-demand path).
+
+        Returns the NEWLY mapped pages (possibly empty), or None when
+        the pool is exhausted — the slot keeps its existing pages and
+        the caller decides whether to retire it.
+        """
+        if slot not in self._owned:
+            raise PageError(f"extend of unmapped slot {slot}")
+        have = self._owned[slot]
+        need = self.pages_for(tokens)
+        if need > self.pages_per_slot:
+            raise PageError(
+                f"slot {slot} needs {need} pages > pages_per_slot "
+                f"{self.pages_per_slot}")
+        grow = need - len(have)
+        if grow <= 0:
+            return []
+        if grow > len(self._free):
+            return None
+        fresh = [heapq.heappop(self._free) for _ in range(grow)]
+        have.extend(fresh)
+        return fresh
+
+    def release_slot(self, slot: int) -> List[int]:
+        """Return all of ``slot``'s pages to the free heap; returns the
+        released page list (the scrub path zeroes exactly these rows)."""
+        if slot not in self._owned:
+            raise PageError(
+                f"release of unmapped slot {slot} "
+                f"(double release or foreign id; "
+                f"mapped={sorted(self._owned)})")
+        pages = self._owned.pop(slot)
+        for p in pages:
+            heapq.heappush(self._free, p)
+        return pages
+
+    def reset(self) -> None:
+        """Return EVERY page to the free heap — engine rebuild/close
+        path, mirroring :meth:`SlotPool.reset`."""
+        self._free = list(range(self.n_pages))
+        self._owned.clear()
+        self.check()
+
+    def check(self) -> None:
+        """Assert the no-leak invariant; raises :class:`PageError`."""
+        owned = [p for pages in self._owned.values() for p in pages]
+        if len(self._free) + len(owned) != self.n_pages or \
+                set(self._free) & set(owned) or \
+                len(set(owned)) != len(owned):
+            raise PageError(
+                f"page leak: {len(self._free)} free + {len(owned)} owned "
+                f"!= n_pages {self.n_pages} (or duplicate mapping)")

@@ -27,6 +27,7 @@ Usage: ``PYTHONPATH=/root/repo:/root/.axon_site python benchmarks/generation_ben
 import sys, os
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+import functools
 import json
 import time
 
@@ -208,6 +209,145 @@ def bench_decode(model, params, batch, prompt_len=128, chain=None):
     return tps
 
 
+def _paged_read_bytes(model, batch, tokens_streamed):
+    """HBM bytes one PAGED decode step must read: every parameter plus
+    only the pages actually streamed (``pages_for(pos+1)`` per slot —
+    the kernel skips pages past each slot's valid length, where the flat
+    layout always reads the full static ``S`` window). This is the paged
+    roofline numerator: the bound counts the bytes the layout makes
+    mandatory, so flat and paged rows are held to their OWN floor."""
+    c = model.config
+    itemsize = jnp.dtype(c.compute_dtype).itemsize
+    n_params = sum(
+        np.prod(s.shape) for s in jax.tree.leaves(
+            jax.eval_shape(model.init, jax.random.PRNGKey(0))))
+    kv_bytes = (c.num_layers * 2 * batch * c.kv_heads * tokens_streamed
+                * c.head_dim * itemsize)
+    return n_params * itemsize + kv_bytes
+
+
+def bench_decode_paged(model, params, batch, prompt_len=128, page_size=32,
+                       mode="fused", chain=None, unroll=8, flat_tps=None):
+    """Decode-only tokens/sec over the PAGED KV pool, fused vs unfused.
+
+    Same instrument philosophy as :func:`bench_decode` — prefill outside
+    the timed region, data-dependent steps, dispatch bias LOW — but the
+    chain is a host loop of jitted programs each UNROLLING ``unroll``
+    decode steps (never ``lax.scan``: the fused path's ``pallas_call``
+    inside a scan body is exactly the APX007 interpret-mode partitioner
+    trap, and on hardware the unrolled form is what the serving engine
+    dispatches anyway — one program per tick). ``mode="fused"`` is the
+    shipped dispatch (the Pallas append+attend kernel on TPU);
+    ``mode="unfused"`` forces ``APEX_TPU_FORCE_PALLAS=off`` so the same
+    paged layout runs the XLA reference — separate append scatter plus a
+    gather that materializes the ``[b, S, f]`` temporary. The delta
+    between the two rows is the fusion win at identical bytes-mandatory.
+
+    ``pct_of_read_bw_bound`` divides by the paged layout's ACTUAL
+    mandatory bytes (:func:`_paged_read_bytes`): pages holding
+    ``pos + 1`` tokens per slot, averaged over the cycled write
+    positions — not the flat path's full static window."""
+    from apex_tpu.models.generation import init_paged_kv_caches
+    from apex_tpu.ops import _support
+
+    c = model.config
+    S = prompt_len + 160                     # match bench_decode rows
+    assert S % page_size == 0 and (S - prompt_len) % unroll == 0
+    pps = S // page_size
+    n_pages = batch * pps
+    chain = chain or {1: 512, 8: 256}.get(batch, 160)
+    chain -= chain % unroll
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (batch, prompt_len),
+                                0, 50304)
+    if c.compute_dtype != jnp.float32:
+        params = cast_decode_params(params, c.compute_dtype)
+
+    @jax.jit
+    def prefill(params, caches, prompt):
+        logits, caches = _cached_forward(model, params, caches, prompt, 0,
+                                         last_only=True)
+        first = jnp.argmax(logits[-1], axis=-1).astype(prompt.dtype)
+        return caches, first
+
+    dense, first = prefill(params, init_kv_caches(model, batch, S), prompt)
+    # dense prefill rows -> fully-mapped pages: slot r's logical page j
+    # is pool row r*pps + j (identity mapping; the engine's on-demand
+    # table is host state the instrument doesn't need)
+    caches = []
+    for k, v in flatten_decode_caches(dense, c.num_layers):
+        caches.append(tuple(
+            x.reshape(batch * pps, page_size, x.shape[-1]) for x in (k, v)))
+    del dense
+    page_table = jnp.arange(n_pages, dtype=jnp.int32).reshape(batch, pps)
+    params = preslice_layer_params(params, c.num_layers)
+
+    prev = os.environ.get("APEX_TPU_FORCE_PALLAS")
+    try:
+        if mode == "unfused":
+            os.environ["APEX_TPU_FORCE_PALLAS"] = "off"
+        _support.pallas_mode.cache_clear()
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def paged_chain(params, caches, tok, pos):
+            for t in range(unroll):
+                logits, caches = decode_step(model, params, caches, tok,
+                                             pos + t,
+                                             paged_state=page_table)
+                tok = jnp.argmax(logits, -1).astype(tok.dtype)
+            return tok, caches
+
+        # write positions cycle in [prompt_len, S): steady-state streams
+        # a nearly-full pool, chain length stays dispatch-amortization
+        bases = prompt_len + (np.arange(chain // unroll) * unroll) \
+            % (S - prompt_len)
+        pos0 = jnp.full((batch,), int(bases[0]), jnp.int32)
+        tok, caches = paged_chain(params, caches, first, pos0)  # compile
+        jax.block_until_ready(tok)
+        t0 = time.perf_counter()
+        for base in bases:
+            tok, caches = paged_chain(
+                params, caches, tok, jnp.full((batch,), int(base),
+                                              jnp.int32))
+        jax.block_until_ready(tok)
+        dt = (time.perf_counter() - t0) / chain
+    finally:
+        if mode == "unfused":
+            if prev is None:
+                os.environ.pop("APEX_TPU_FORCE_PALLAS", None)
+            else:
+                os.environ["APEX_TPU_FORCE_PALLAS"] = prev
+        _support.pallas_mode.cache_clear()
+
+    tps = batch / dt
+    # mandatory stream per step, averaged over the cycled positions:
+    # pages_for(pos+1) pages of page_size rows each
+    all_pos = (bases[:, None] + np.arange(unroll)[None, :]).ravel()
+    tokens_streamed = float(np.mean(
+        (all_pos // page_size + 1) * page_size))
+    row = {
+        "metric": f"gpt2_124m_decode_paged_{mode}_bs{batch}"
+                  f"_tokens_per_sec_per_chip",
+        "value": round(tps, 1), "unit": "tokens/sec",
+        "vs_baseline": round(tps / flat_tps, 3) if flat_tps else 1.0,
+        "config": {"prompt_len": prompt_len, "decode_only": True,
+                   "kv_layout": "paged", "mode": mode,
+                   "page_size": page_size, "pages_per_slot": pps,
+                   "n_pages": n_pages, "cache_len": S,
+                   "avg_tokens_streamed": round(tokens_streamed, 1),
+                   "method": f"host loop of jitted {unroll}-step unrolled "
+                             f"paged decode programs, {chain} steps total "
+                             f"(prefill untimed; dispatch biases tok/s "
+                             f"low); vs_baseline = vs the flat-layout "
+                             f"bench_decode row"}}
+    bw = _hbm_bw()
+    if bw is not None:
+        bound_steps = bw / _paged_read_bytes(model, batch, tokens_streamed)
+        row["pct_of_read_bw_bound"] = round(tps / (batch * bound_steps), 3)
+        row["config"]["hbm_bw_gbps"] = round(bw / 1e9)
+    print(json.dumps(row))
+    return tps
+
+
 def _pctl(values, p):
     values = sorted(values)
     return values[max(0, min(len(values) - 1,
@@ -310,7 +450,10 @@ def main():
     model, params = _model()
     bench_prefill(model, params)
     for b in (1, 8, 32):
-        bench_decode(model, params, batch=b)
+        flat = bench_decode(model, params, batch=b)
+        for mode in ("fused", "unfused"):
+            bench_decode_paged(model, params, batch=b, mode=mode,
+                               flat_tps=flat)
     bench_serving(model, params)
 
 

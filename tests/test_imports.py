@@ -35,6 +35,7 @@ MODULES = [
     "apex_tpu.observability",
     "apex_tpu.observability.slo",
     "apex_tpu.ops",
+    "apex_tpu.ops.decode_attention",
     "apex_tpu.optimizers",
     "apex_tpu.parallel",
     "apex_tpu.parallel.multiproc",

@@ -131,6 +131,27 @@ class TestScenarioSchema:
         with pytest.raises(ValueError, match="weight"):
             Scenario.from_dict(d)
 
+    def test_kv_layout_knobs_round_trip(self):
+        d = _scenario_dict(engine={
+            "max_slots": 4, "max_len": 32, "max_queue": 16,
+            "kv_layout": "paged", "page_size": 8, "n_pages": 12})
+        scn = Scenario.from_dict(d)
+        assert scn.engine.kv_layout == "paged"
+        assert scn.engine.page_size == 8
+        assert scn.engine.n_pages == 12
+        again = Scenario.from_dict(scn.to_dict())
+        assert again.to_dict() == scn.to_dict()
+        # flat opt-out survives too, and n_pages=None stays absent
+        flat = Scenario.from_dict(_scenario_dict(engine={
+            "max_slots": 4, "max_len": 32, "kv_layout": "flat"}))
+        assert flat.engine.kv_layout == "flat"
+        assert "n_pages" not in flat.to_dict()["engine"]
+
+    def test_bad_kv_layout_rejected(self):
+        with pytest.raises(ValueError, match="kv_layout"):
+            Scenario.from_dict(_scenario_dict(engine={
+                "max_slots": 4, "max_len": 32, "kv_layout": "ragged"}))
+
     def test_fault_schedule_round_trip(self):
         fs = FaultSchedule.from_dict({
             "decode_raise_calls": [3], "decode_hang": {"5": 1.5},
