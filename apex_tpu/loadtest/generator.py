@@ -77,11 +77,16 @@ class TrafficGenerator:
         out: List[ScheduledRequest] = []
         t = 0.0
         for phase in self.scenario.phases:
+            # the phase's shared prompt opening, drawn ONCE — when the
+            # knob is 0 no draw happens at all, so schedules of
+            # pre-existing scenarios stay byte-identical
+            shared = [rng.randrange(self.scenario.model.vocab_size)
+                      for _ in range(phase.shared_prefix_len)]
             for _ in range(phase.n_requests):
                 t += rng.expovariate(phase.rate_rps)
                 out.append(ScheduledRequest(
                     at_s=t, phase=phase.name,
-                    request=self._request(phase, rng)))
+                    request=self._request(phase, rng, shared)))
         return out
 
     def requests(self) -> List[Request]:
@@ -89,10 +94,13 @@ class TrafficGenerator:
         (the benchmark's ``generate()`` arm) needs."""
         return [s.request for s in self.schedule()]
 
-    def _request(self, phase: LoadPhase, rng: random.Random) -> Request:
+    def _request(self, phase: LoadPhase, rng: random.Random,
+                 shared: List[int]) -> Request:
         prompt_len = _choose(rng, phase.prompt_lens)
-        prompt = [rng.randrange(self.scenario.model.vocab_size)
-                  for _ in range(prompt_len)]
+        # scenario validation caps shared_prefix_len at the shortest
+        # prompt length, so the suffix draw count is never negative
+        prompt = shared + [rng.randrange(self.scenario.model.vocab_size)
+                           for _ in range(prompt_len - len(shared))]
         max_new = _choose(rng, phase.max_new_tokens)
         # draw order is fixed and unconditional draws come first, so a
         # mix change in one field cannot shift another field's stream

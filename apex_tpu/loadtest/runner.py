@@ -120,6 +120,8 @@ def _build_serving(scenario: Scenario, model, params,
         max_slots=knobs.max_slots, max_len=knobs.max_len,
         kv_layout=knobs.kv_layout, page_size=knobs.page_size,
         n_pages=knobs.n_pages,
+        prefix_cache=knobs.prefix_cache,
+        prefix_lru_capacity=knobs.prefix_lru_capacity,
         scheduler=SchedulerConfig(
             max_queue=knobs.max_queue,
             max_prefills_per_tick=knobs.max_prefills_per_tick))

@@ -100,7 +100,10 @@ class EngineKnobs:
     pool (docs/serving.md#paged-kv); ``n_pages=None`` fully backs every
     slot at ``max_len`` — set it lower to overcommit, which is how the
     ``long_context`` scenario expresses "this mix fits paged but could
-    not fit dense rows in the same HBM"."""
+    not fit dense rows in the same HBM". ``prefix_cache`` /
+    ``prefix_lru_capacity`` drive the paged engine's shared-prefix
+    interning (docs/serving.md#prefix-cache) — turning the cache off is
+    how the ``shared_prefix`` scenario measures its own speedup."""
 
     max_slots: int = 4
     max_len: int = 64
@@ -109,12 +112,18 @@ class EngineKnobs:
     kv_layout: str = "paged"
     page_size: int = 64
     n_pages: Optional[int] = None
+    prefix_cache: bool = True
+    prefix_lru_capacity: int = 32
 
     def __post_init__(self):
         if self.kv_layout not in ("flat", "paged"):
             raise ValueError(
                 f"kv_layout must be 'flat' or 'paged', got "
                 f"{self.kv_layout!r}")
+        if self.prefix_lru_capacity < 0:
+            raise ValueError(
+                f"prefix_lru_capacity must be >= 0, got "
+                f"{self.prefix_lru_capacity}")
 
     @classmethod
     def from_dict(cls, data: Dict[str, Any]) -> "EngineKnobs":
@@ -125,6 +134,8 @@ class EngineKnobs:
         if "n_pages" in d:
             n = d.pop("n_pages")
             kw["n_pages"] = int(n) if n is not None else None
+        if "prefix_cache" in d:
+            kw["prefix_cache"] = bool(d.pop("prefix_cache"))
         kw.update({k: int(v) for k, v in d.items()})
         return cls(**kw)
 
@@ -136,6 +147,10 @@ class EngineKnobs:
             "kv_layout": self.kv_layout, "page_size": self.page_size}
         if self.n_pages is not None:
             out["n_pages"] = self.n_pages
+        if not self.prefix_cache:
+            out["prefix_cache"] = False
+        if self.prefix_lru_capacity != 32:
+            out["prefix_lru_capacity"] = self.prefix_lru_capacity
         return out
 
 
@@ -150,7 +165,10 @@ class LoadPhase:
     mixes; ``deadline_fraction`` of requests carry a deadline uniform in
     ``[deadline_min_s, deadline_max_s]``; ``greedy_fraction`` decode
     greedily, the rest sample at a drawn temperature/top-k (``top_ks``
-    entry ``0`` means untruncated).
+    entry ``0`` means untruncated). ``shared_prefix_len`` > 0 makes
+    every prompt in the phase open with the SAME ``shared_prefix_len``
+    seeded tokens (drawn once at phase start) — the multi-turn /
+    system-prompt traffic shape the engine's prefix cache exists for.
     """
 
     name: str
@@ -165,6 +183,7 @@ class LoadPhase:
     temperatures: Tuple[float, ...] = (0.7,)
     top_ks: Tuple[int, ...] = (0,)
     eos_token: Optional[int] = None
+    shared_prefix_len: int = 0
 
     def __post_init__(self):
         if self.n_requests < 1:
@@ -199,6 +218,15 @@ class LoadPhase:
                 raise ValueError(
                     f"phase {self.name!r}: top_ks must be >= 0 "
                     f"(0 = untruncated), got {self.top_ks}")
+        if self.shared_prefix_len < 0:
+            raise ValueError(
+                f"phase {self.name!r}: shared_prefix_len must be >= 0, "
+                f"got {self.shared_prefix_len}")
+        if self.shared_prefix_len > min(self.prompt_lens):
+            raise ValueError(
+                f"phase {self.name!r}: shared_prefix_len "
+                f"({self.shared_prefix_len}) exceeds the shortest "
+                f"prompt length in the mix ({min(self.prompt_lens)})")
 
     @property
     def max_total_len(self) -> int:
@@ -224,7 +252,8 @@ class LoadPhase:
             temperatures=tuple(float(t)
                                for t in d.pop("temperatures", (0.7,))),
             top_ks=tuple(int(k) for k in d.pop("top_ks", (0,))),
-            eos_token=int(eos) if eos is not None else None)
+            eos_token=int(eos) if eos is not None else None,
+            shared_prefix_len=int(d.pop("shared_prefix_len", 0)))
         if d:
             raise ValueError(
                 f"phase {name!r}: unknown keys {sorted(d)}")
@@ -248,6 +277,8 @@ class LoadPhase:
             out["top_ks"] = list(self.top_ks)
         if self.eos_token is not None:
             out["eos_token"] = self.eos_token
+        if self.shared_prefix_len > 0:
+            out["shared_prefix_len"] = self.shared_prefix_len
         return out
 
 

@@ -387,6 +387,19 @@ def render_report(report: dict) -> str:
             line += (f"  occupancy mean={_fmt(occ.get('mean'))} "
                      f"max={_fmt(occ.get('max'))} n={occ['count']}")
         lines.append(line)
+        counters = report.get("counters") or {}
+        hits = counters.get("prefix_hits", 0)
+        misses = counters.get("prefix_misses", 0)
+        if hits or misses:
+            # prefix-cache effectiveness, derived from the same counters
+            # the engine reconciles against prefills (hits + misses ==
+            # paged prefills when prefix_cache is on)
+            rate = hits / (hits + misses)
+            lines.append(
+                f"  prefix cache: hits={hits} misses={misses} "
+                f"hit_rate={rate:.1%} "
+                f"pages_shared={counters.get('prefix_pages_shared', 0)} "
+                f"evictions={counters.get('prefix_evictions', 0)}")
     slo = report.get("slo")
     if slo:
         verdict = "PASS" if slo["ok"] else "FAIL"

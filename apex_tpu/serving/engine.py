@@ -80,6 +80,7 @@ from apex_tpu.serving.request import (
     Request,
     RequestResult,
 )
+from apex_tpu.serving.prefix import prefix_hash_chain, prefix_salt
 from apex_tpu.serving.scheduler import (
     DeadlineExpiredError,
     FCFSScheduler,
@@ -102,7 +103,12 @@ _COUNTERS = ("requests_submitted", "requests_eos", "requests_length",
              "requests_cancelled", "requests_timeout", "requests_rejected",
              "requests_error", "prefills", "decode_steps",
              "tokens_generated", "slots_quarantined",
-             "requests_shed_pages")
+             "requests_shed_pages",
+             # prefix cache (docs/serving.md#prefix-cache): hits + misses
+             # == paged prefills when prefix_cache is on, so hit_rate is
+             # derivable; pages_shared counts prefill pages NOT recomputed
+             "prefix_hits", "prefix_misses", "prefix_pages_shared",
+             "prefix_evictions")
 
 
 @dataclass
@@ -127,6 +133,16 @@ class EngineConfig:
     to overcommit, and the engine sheds ``pages_exhausted`` when a
     request's worst case can never fit. ``kv_layout="flat"`` keeps the
     dense ``[max_slots, max_len]`` rows for bisection.
+
+    Prefix cache (docs/serving.md#prefix-cache, paged layout only):
+    ``prefix_cache=True`` interns each prompt's page-aligned prefix into
+    the pool's content-addressed index, so a later prompt sharing that
+    prefix maps the interned pages refcounted and prefills ONLY its
+    suffix — token-exact, and admission reserves just the suffix +
+    worst-case-new pages, so the hit rate directly raises effective
+    capacity. ``prefix_lru_capacity`` bounds the index (entries; evicted
+    LRU-first under page pressure). ``prefix_cache=False`` restores the
+    PR 9 one-owner pool bit-for-bit.
     """
 
     max_slots: int = 8
@@ -137,6 +153,8 @@ class EngineConfig:
     kv_layout: str = "paged"
     page_size: int = 64
     n_pages: Optional[int] = None
+    prefix_cache: bool = True
+    prefix_lru_capacity: int = 32
 
     def __post_init__(self):
         if self.max_slots < 1:
@@ -154,6 +172,10 @@ class EngineConfig:
                 f"page_size must be >= 1, got {self.page_size}")
         if self.n_pages is not None and self.n_pages < 1:
             raise ValueError(f"n_pages must be >= 1, got {self.n_pages}")
+        if self.prefix_lru_capacity < 0:
+            raise ValueError(
+                f"prefix_lru_capacity must be >= 0, got "
+                f"{self.prefix_lru_capacity}")
 
     @property
     def pages_per_slot(self) -> int:
@@ -166,7 +188,8 @@ class _Active:
 
     __slots__ = ("request", "slot", "tokens", "last_token", "position",
                  "submit_ts", "prefill_start", "prefill_end",
-                 "first_token_ts", "last_token_ts", "cancelled")
+                 "first_token_ts", "last_token_ts", "cancelled",
+                 "reserved_pages")
 
     def __init__(self, request: Request, slot: int, submit_ts: float):
         self.request = request
@@ -174,6 +197,7 @@ class _Active:
         self.tokens: List[int] = []
         self.last_token = 0
         self.position = 0       # cache rows written for this slot
+        self.reserved_pages = 0  # worst-case pages minus shared-prefix hit
         self.submit_ts = submit_ts
         self.prefill_start = 0.0
         self.prefill_end = 0.0
@@ -255,7 +279,13 @@ class InferenceEngine:
             n_pages = (self.config.n_pages if self.config.n_pages is not None
                        else self.config.max_slots * pps)
             self.pages: Optional[PagePool] = PagePool(
-                n_pages, self.config.page_size, pps)
+                n_pages, self.config.page_size, pps,
+                lru_capacity=(self.config.prefix_lru_capacity
+                              if self.config.prefix_cache else 0))
+            #: salt for the prompt-prefix hash chains — keyed by the
+            #: model fingerprint only (K/V are sampling-invariant)
+            self._prefix_salt = prefix_salt(c)
+            self._evictions_seen = 0
             self._caches = init_paged_kv_caches(
                 model, n_pages, self.config.page_size)
             # host page table; n_pages is the unmapped sentinel (reads
@@ -284,7 +314,8 @@ class InferenceEngine:
         if donate is None:
             donate = jax.default_backend() != "cpu"
 
-        decode_fn, prefill_fn, scrub_fn = self._build_step_fns(donate)
+        decode_fn, prefill_fn, suffix_fn, scrub_fn = \
+            self._build_step_fns(donate)
         self._decode_fn = RetraceWatchdog(
             decode_fn,
             budget=self.config.retrace_budget, expected_compiles=1,
@@ -295,6 +326,11 @@ class InferenceEngine:
         self._prefill_fn = RetraceWatchdog(
             prefill_fn, budget=None, expected_compiles=len(self.buckets),
             name="serving_prefill", metrics=self.metrics)
+        # suffix prefill (prefix-cache hits) buckets exactly like full
+        # prefill, so its compile count has the same bound
+        self._suffix_fn = None if suffix_fn is None else RetraceWatchdog(
+            suffix_fn, budget=None, expected_compiles=len(self.buckets),
+            name="serving_suffix_prefill", metrics=self.metrics)
         self._scrub_fn = scrub_fn
 
     # -- step programs (overridable: ShardedEngine wraps these bodies in
@@ -388,25 +424,107 @@ class InferenceEngine:
                                  .astype(bv.dtype), mode="drop")))
         first = _sample_tokens(logits[0], temp[None], topk[None],
                                seed[None], prompt_len[None])
-        return first[0], new
+        # finite flag gates publishing these pages to the prefix-intern
+        # index: a poisoned prefill must never become a shared prefix
+        return first[0], jnp.all(jnp.isfinite(logits)), new
+
+    def _suffix_prefill_body(self, params, caches, page_row, suffix,
+                             start, suffix_len, prompt_len, temp, topk,
+                             seed, skip_first):
+        """Prefill ONLY the suffix of a prefix-cache hit.
+
+        The slot's page table already maps the shared prefix pages for
+        tokens ``[0, start)``; this body gathers those rows into a
+        small 4D cache, runs the suffix forward at ``cache_index=start``
+        (offset-causal mask + rope at the absolute offset — the same
+        mid-cache path the flat engine's vectorized decode uses), and
+        scatters the suffix K/V into the slot's PRIVATE pages row by
+        row. Shared pages are never written: when ``skip_first`` is set
+        (a fully page-aligned hit, whose one-token "suffix" is a
+        recompute of the prompt's LAST token purely to produce first-
+        token logits), the recomputed row's scatter is masked so the
+        boundary page keeps its original bitwise K/V — the copy-on-write
+        seam with the copy elided, since the row is already resident.
+        """
+        model = self.model
+        ps = self.config.page_size
+        pps = self.config.pages_per_slot
+        n_pages = self.pages.n_pages
+        bucket = suffix.shape[1]
+        s0 = pps * ps
+        # static length s0 + bucket keeps the suffix update in-bounds for
+        # any traced start (no dynamic_update_slice clamping)
+        small = init_kv_caches(model, 1, s0 + bucket, stacked=False)
+        valid_page = page_row < n_pages
+        clamped = jnp.clip(page_row, 0, n_pages - 1)
+        filled = []
+        for (bk, bv), (sk, sv) in zip(caches, small):
+            h, d = sk.shape[1], sk.shape[3]
+
+            def place(pool, sm):
+                g = pool[clamped]                       # [pps, ps, h*d]
+                # sentinel rows must read as EXACT zeros (a clamped
+                # gather could otherwise import a co-tenant's transient
+                # NaN into causally masked positions: 0-weight * NaN
+                # is still NaN)
+                g = jnp.where(valid_page[:, None, None], g, 0.0)
+                g = g.reshape(s0, h, d).transpose(1, 0, 2)[None]
+                return sm.at[:, :, :s0, :].set(g.astype(sm.dtype))
+
+            filled.append((place(bk, sk), place(bv, sv)))
+        logits, filled = _cached_forward(model, params, filled, suffix,
+                                         start, last_index=suffix_len - 1)
+        # scatter the suffix K/V into the slot's pages, one row per
+        # suffix position (rows can straddle page boundaries, so the
+        # whole-page chunk scatter of the miss path does not apply)
+        idx = jnp.arange(bucket)
+        pos = start + idx
+        dest_page = page_row[jnp.clip(pos // ps, 0, pps - 1)]
+        dest_off = pos % ps
+        valid = (idx < suffix_len) & ~(skip_first & (idx == 0))
+        dest_page = jnp.where(valid, dest_page, n_pages)  # drop pads
+        new = []
+        for (bk, bv), (fk, fv) in zip(caches, filled):
+            h, d = fk.shape[1], fk.shape[3]
+
+            def rows(f):
+                r = jax.lax.dynamic_slice_in_dim(f, start, bucket, axis=2)
+                return r[0].transpose(1, 0, 2).reshape(bucket, h * d)
+
+            new.append(
+                (bk.at[dest_page, dest_off].set(
+                    rows(fk).astype(bk.dtype), mode="drop"),
+                 bv.at[dest_page, dest_off].set(
+                     rows(fv).astype(bv.dtype), mode="drop")))
+        first = _sample_tokens(logits[0], temp[None], topk[None],
+                               seed[None], prompt_len[None])
+        return first[0], jnp.all(jnp.isfinite(logits)), new
 
     def _build_step_fns(self, donate: bool):
-        """Compile the three device programs: ``(decode, prefill, scrub)``.
-        The base engine jits the bodies directly (single-chip);
-        :class:`~apex_tpu.serving.fleet.ShardedEngine` overrides this to
-        wrap each body in ``shard_map`` over the tensor axis first. The
-        body triple is picked by ``kv_layout`` — both layouts keep the
-        caches as argument 1 so donation and the watchdogs are shared."""
+        """Compile the device programs:
+        ``(decode, prefill, suffix_prefill, scrub)`` —
+        ``suffix_prefill`` is None under the flat layout (no pages, no
+        prefix cache). The base engine jits the bodies directly
+        (single-chip); :class:`~apex_tpu.serving.fleet.ShardedEngine`
+        overrides this to wrap each body in ``shard_map`` over the
+        tensor axis first. The bodies are picked by ``kv_layout`` — both
+        layouts keep the caches as argument 1 so donation and the
+        watchdogs are shared."""
         donate_args = (1,) if donate else ()
         if self.pages is not None:
-            bodies = (self._paged_decode_body, self._paged_prefill_body,
-                      self._paged_scrub_body)
-        else:
-            bodies = (self._decode_body, self._prefill_body,
-                      self._scrub_body)
-        return (jax.jit(bodies[0], donate_argnums=donate_args),
-                jax.jit(bodies[1], donate_argnums=donate_args),
-                jax.jit(bodies[2], donate_argnums=(0,) if donate else ()))
+            return (jax.jit(self._paged_decode_body,
+                            donate_argnums=donate_args),
+                    jax.jit(self._paged_prefill_body,
+                            donate_argnums=donate_args),
+                    jax.jit(self._suffix_prefill_body,
+                            donate_argnums=donate_args),
+                    jax.jit(self._paged_scrub_body,
+                            donate_argnums=(0,) if donate else ()))
+        return (jax.jit(self._decode_body, donate_argnums=donate_args),
+                jax.jit(self._prefill_body, donate_argnums=donate_args),
+                None,
+                jax.jit(self._scrub_body,
+                        donate_argnums=(0,) if donate else ()))
 
     # -- introspection ----------------------------------------------------
 
@@ -518,6 +636,10 @@ class InferenceEngine:
                                    self.pages.in_use_count)
             self.metrics.set_gauge("kv_pages_free", self.pages.free_count)
             self.metrics.observe("kv_page_occupancy", self.pages.occupancy)
+            delta = self.pages.evictions - self._evictions_seen
+            if delta:
+                self.metrics.inc("prefix_evictions", delta)
+                self._evictions_seen = self.pages.evictions
         return finished
 
     def serve(self, requests: Sequence[Request], *,
@@ -596,28 +718,72 @@ class InferenceEngine:
                 finished.append(self._retire(
                     rec, FINISH_CANCELLED, time.monotonic()))
 
+    def _plan_prefix(self, request: Request):
+        """Match ``request``'s page-aligned prompt prefix against the
+        intern index: ``(chain, shared_pages, skip_first)``. The chain is
+        always computed (the miss path interns it); ``shared_pages`` is
+        the longest currently-interned leading run (empty on a miss or
+        with ``prefix_cache=False``). ``skip_first`` marks the fully
+        page-aligned hit, whose suffix prefill is a single recompute of
+        the prompt's last token with its K/V scatter masked (the COW
+        seam — the boundary row already lives, bitwise, in the last
+        shared page). A match is trimmed when its suffix bucket would
+        overrun ``max_len`` (only possible for non-power-of-two page
+        sizes) so the static bucket set keeps holding."""
+        ps = self.config.page_size
+        chain = prefix_hash_chain(request.prompt, ps, self._prefix_salt)
+        if not self.config.prefix_cache or not chain:
+            return chain, [], False
+        pages, matched = self.pages.match_prefix(chain)
+        max_len = self.config.max_len
+        while matched:
+            start = (request.prompt_len - 1
+                     if matched * ps == request.prompt_len
+                     else matched * ps)
+            if start + bucket_for(request.prompt_len - start,
+                                  max_len) <= max_len:
+                break
+            matched -= 1
+        if matched == 0:
+            return chain, [], False
+        return chain, pages[:matched], \
+            matched * ps == request.prompt_len
+
     def _admit(self, finished: List[RequestResult]) -> None:
         shed: List = []
         predicate = None
         if self.pages is not None:
             # pages-aware admission: a request enters only when its
-            # WORST-CASE page need (total_len) fits alongside every
-            # other admitted request's reservation — so decode-time
-            # on-demand extends can never exhaust the pool and there is
-            # no mid-flight eviction policy to get wrong. A head that
-            # can never fit (need > n_pages) is shed as
-            # ``pages_exhausted``; one that merely must wait defers
-            # (FCFS head-blocking, like a full slot pool).
-            planned = 0
+            # WORST-CASE page need (total_len, minus the shared-prefix
+            # pages a cache hit maps refcounted) fits alongside every
+            # other admitted request's outstanding reservation — so
+            # decode-time on-demand extends can never exhaust the pool.
+            # ``reclaimable`` pages (held only by the intern index) count
+            # as capacity since allocation evicts entries under
+            # pressure, but this request's own shared pages are
+            # subtracted from that pot first: mapping PINS them, so they
+            # stop being evictable. A head that can never fit
+            # (need > n_pages) is shed as ``pages_exhausted``; one that
+            # merely must wait defers (FCFS head-blocking).
+            planned = 0          # private pages promised this tick
+            planned_shared = 0   # reclaimable pages pinned this tick
 
             def predicate(request):
-                nonlocal planned
+                nonlocal planned, planned_shared
                 need = self.pages.pages_for(request.total_len)
                 if need > self.pages.n_pages:
                     return "shed"
-                if need <= (self.pages.n_pages - self._reserved_pages
-                            - planned):
-                    planned += need
+                _, shared_pages, _ = self._plan_prefix(request)
+                shared = len(shared_pages)
+                pool = self.pages
+                avail = (pool.free_count
+                         + max(0, pool.reclaimable_count
+                               - planned_shared - shared)
+                         - (self._reserved_pages - pool.owned_count)
+                         - planned)
+                if need - shared <= avail:
+                    planned += need - shared
+                    planned_shared += shared
                     return "admit"
                 return "defer"
 
@@ -653,24 +819,37 @@ class InferenceEngine:
                       finished: List[RequestResult]) -> None:
         rec = _Active(request, slot, submit_ts)
         rec.prefill_start = time.monotonic()
-        bucket = bucket_for(request.prompt_len, self.config.max_len)
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, :request.prompt_len] = request.prompt
         sp = request.sampling
+        topk = jnp.int32(sp.top_k if sp.top_k is not None else self._vocab)
+        chain, shared_pages, skip_first = (), [], False
+        shared_used = 0
         if self.pages is not None:
-            # commit the worst-case reservation, then physically map only
-            # the prompt's pages (decode extends on demand). _admit's
-            # predicate guaranteed the reservation fits, so a None here
-            # is a broken invariant, not load.
-            need = self.pages.pages_for(request.total_len)
-            mapped = self.pages.map_slot(slot, request.prompt_len)
+            # re-match the prefix NOW (the predicate's match may have
+            # been reshaped by a later head's intern eviction), commit
+            # the worst-case reservation minus the shared pages, then
+            # physically map only the prompt's pages (decode extends on
+            # demand)
+            chain, shared_pages, skip_first = self._plan_prefix(request)
+            shared_used = len(shared_pages)
+            need = self.pages.pages_for(request.total_len) - shared_used
+            mapped = self.pages.map_slot(slot, request.prompt_len,
+                                         shared=shared_pages or None)
             if mapped is None:
                 self.slots.release(slot)
+                if self.config.prefix_cache:
+                    # an intern eviction between the admission predicate
+                    # and this map changed what's reclaimable — FCFS
+                    # honest, the request retries from the FRONT of the
+                    # queue on a later tick (co-tenant retirements will
+                    # unpin pages)
+                    self.scheduler.requeue_front(request, submit_ts)
+                    return
                 raise RuntimeError(
                     f"page pool exhausted at prefill despite admission "
                     f"reservation (slot {slot}, "
                     f"free={self.pages.free_count}) — reservation "
                     f"accounting is broken")
+            rec.reserved_pages = need
             self._reserved_pages += need
             row = self._page_table_h[slot]
             row[:] = self.pages.n_pages
@@ -678,22 +857,44 @@ class InferenceEngine:
         try:
             if self._faults is not None:
                 self._faults.before_prefill()
-            if self.pages is not None:
-                first, self._caches = self._prefill_fn(
+            finite = True
+            if self.pages is not None and shared_used:
+                # prefix-cache hit: prefill ONLY the suffix (bucketed
+                # like a full prefill). start is the first token NOT
+                # covered by shared pages — or, fully covered, the
+                # prompt's last token recomputed for its logits only
+                ps = self.config.page_size
+                start = (request.prompt_len - 1 if skip_first
+                         else shared_used * ps)
+                suffix_len = request.prompt_len - start
+                bucket = bucket_for(suffix_len, self.config.max_len)
+                suffix = np.zeros((1, bucket), np.int32)
+                suffix[0, :suffix_len] = request.prompt[start:]
+                first, finite, self._caches = self._suffix_fn(
+                    self._params, self._caches,
+                    jnp.asarray(self._page_table_h[slot]),
+                    jnp.asarray(suffix), jnp.int32(start),
+                    jnp.int32(suffix_len), jnp.int32(request.prompt_len),
+                    jnp.float32(sp.temperature), topk,
+                    jnp.int32(sp.seed), jnp.bool_(skip_first))
+            elif self.pages is not None:
+                bucket = bucket_for(request.prompt_len, self.config.max_len)
+                padded = np.zeros((1, bucket), np.int32)
+                padded[0, :request.prompt_len] = request.prompt
+                first, finite, self._caches = self._prefill_fn(
                     self._params, self._caches,
                     jnp.asarray(self._page_table_h[slot]),
                     jnp.asarray(padded), jnp.int32(request.prompt_len),
-                    jnp.float32(sp.temperature),
-                    jnp.int32(sp.top_k if sp.top_k is not None
-                              else self._vocab),
+                    jnp.float32(sp.temperature), topk,
                     jnp.int32(sp.seed))
             else:
+                bucket = bucket_for(request.prompt_len, self.config.max_len)
+                padded = np.zeros((1, bucket), np.int32)
+                padded[0, :request.prompt_len] = request.prompt
                 first, self._caches = self._prefill_fn(
                     self._params, self._caches, jnp.asarray(padded),
                     jnp.int32(slot), jnp.int32(request.prompt_len),
-                    jnp.float32(sp.temperature),
-                    jnp.int32(sp.top_k if sp.top_k is not None
-                              else self._vocab),
+                    jnp.float32(sp.temperature), topk,
                     jnp.int32(sp.seed))
             first = int(np.asarray(first))
         except Exception:
@@ -703,10 +904,24 @@ class InferenceEngine:
             self.slots.release(slot)
             if self.pages is not None:
                 self.pages.release_slot(slot)
-                self._reserved_pages -= self.pages.pages_for(
-                    request.total_len)
+                self._reserved_pages -= rec.reserved_pages
                 self._page_table_h[slot, :] = self.pages.n_pages
             raise
+        if self.pages is not None and self.config.prefix_cache:
+            if shared_used:
+                self.metrics.inc("prefix_hits")
+                self.metrics.inc("prefix_pages_shared", shared_used)
+            else:
+                self.metrics.inc("prefix_misses")
+            # publish the prompt's full pages (shared run + freshly
+            # prefilled privates) so later prompts hit; gated on finite
+            # logits — a poisoned prefill must never be shared. On an
+            # exact repeat this is a no-op; a longer prompt upgrades the
+            # subsumed shorter entry.
+            if chain and bool(np.asarray(finite)):
+                self.pages.intern_prefix(
+                    chain,
+                    [int(p) for p in self._page_table_h[slot][:len(chain)]])
         rec.prefill_end = time.monotonic()
         rec.tokens.append(first)
         rec.last_token = first
@@ -805,19 +1020,25 @@ class InferenceEngine:
         row's KV (NaNs must not outlive the occupant — a masked attention
         weight times a NaN value is still NaN), release the slot, and
         finish the request with ``finish_reason="error"`` — co-tenants
-        are untouched and the decode program never retraces."""
+        are untouched and the decode program never retraces.
+
+        Under the paged layout only the pages this release actually
+        FREES are scrubbed (``_retire(scrub=True)``): shared prefix
+        pages still referenced by co-tenant slots or the intern index
+        hold exclusively pre-intern prefill data (interned pages are
+        never written again — decode appends land past the prompt's full
+        pages, and interning is gated on finite prefill logits), so they
+        are clean by construction and co-tenants keep token-exact
+        streams; they are zeroed when their LAST reference drops."""
         slot = rec.slot
-        if self.pages is not None:
-            self._caches = self._scrub_fn(
-                self._caches, jnp.asarray(self._page_table_h[slot]))
-        else:
+        if self.pages is None:
             self._caches = self._scrub_fn(self._caches, jnp.int32(slot))
         self.metrics.inc("slots_quarantined")
         log_event(_LOG, "slot_quarantined", slot=slot,
                   request_id=rec.request.request_id, cause=cause)
         self.metrics.event("slot_quarantined", slot=slot,
                            request_id=rec.request.request_id, cause=cause)
-        return self._retire(rec, FINISH_ERROR, now)
+        return self._retire(rec, FINISH_ERROR, now, scrub=True)
 
     def _finish_reason(self, rec: _Active, token: int) -> Optional[str]:
         if rec.request.eos_token is not None and \
@@ -843,15 +1064,24 @@ class InferenceEngine:
         self._topks_h[slot] = self._vocab
         self._seeds_h[slot] = 0
 
-    def _retire(self, rec: _Active, reason: str,
-                now: float) -> RequestResult:
+    def _retire(self, rec: _Active, reason: str, now: float, *,
+                scrub: bool = False) -> RequestResult:
         del self._active[rec.slot]
         self.slots.release(rec.slot)
         if self.pages is not None:
-            self.pages.release_slot(rec.slot)
-            self._reserved_pages -= self.pages.pages_for(
-                rec.request.total_len)
+            # release returns only the pages whose LAST reference this
+            # drop removed — shared prefix pages outlive the slot
+            freed = self.pages.release_slot(rec.slot)
+            self._reserved_pages -= rec.reserved_pages
             self._page_table_h[rec.slot, :] = self.pages.n_pages
+            if scrub and freed:
+                # fixed-width row (sentinel-padded) through the same
+                # scrub program — no new compile shapes
+                row = np.full(self.config.pages_per_slot,
+                              self.pages.n_pages, np.int32)
+                row[:len(freed)] = freed
+                self._caches = self._scrub_fn(self._caches,
+                                              jnp.asarray(row))
         self._clear_slot(rec.slot)
         return self._finish(
             rec.request, rec.tokens, reason, submit_ts=rec.submit_ts,

@@ -16,6 +16,18 @@ way TorchTitan composes parallelism primitives into one entry point:
   (:attr:`~apex_tpu.serving.EngineSupervisor.service_estimate_s`), so
   routing and shedding agree about how loaded a replica is. Ties break
   by depth then replica id, keeping runs deterministic.
+- **Prefix-affinity dispatch**: the router hashes each prompt's
+  page-aligned prefix with the SAME chain the engine's prefix cache
+  interns (:func:`~apex_tpu.serving.prefix.prefix_hash_chain`) and
+  folds a BOUNDED discount into the least-loaded cost for replicas
+  that recently served a matching prefix — their intern index likely
+  still holds the pages, so the request prefills only its suffix
+  there. Bounded means multiplicative, at most
+  ``prefix_affinity_weight < 1``: a hot replica's cost can shrink but
+  never reach zero, so load still sheds to cold peers. Residency is
+  tracked from dispatch history (bounded LRU per replica) and
+  invalidated on rebuild — a rebuilt replica has an empty intern
+  index, so stale affinity would route misses at it.
 - **Sticky routing**: an admitted request stays on its replica;
   ``cancel()`` and result harvesting follow it there (and through a
   migration to wherever it went).
@@ -48,11 +60,17 @@ reconciling the two key-for-key.
 from __future__ import annotations
 
 import time
+from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from apex_tpu.observability import MetricsRegistry
 from apex_tpu.serving.engine import EngineConfig
+from apex_tpu.serving.prefix import (
+    common_chain_len,
+    prefix_hash_chain,
+    prefix_salt,
+)
 from apex_tpu.serving.request import (
     FINISH_EOS,
     FINISH_LENGTH,
@@ -108,12 +126,16 @@ class FleetConfig:
     greedy request served end-to-end before the replica rejoins;
     ``max_rebuild_probes`` failed probes mark the replica FAILED
     instead of looping a persistently-broken rebuild forever.
+    ``prefix_affinity_weight`` caps the routing discount for replicas
+    with a resident matching prefix (0 disables affinity; must stay
+    < 1 so load always dominates a full-prefix match).
     """
 
     n_replicas: int = 2
     migrate_on_drain: bool = True
     probe_on_rebuild: bool = True
     max_rebuild_probes: int = 3
+    prefix_affinity_weight: float = 0.3
 
     def __post_init__(self):
         if self.n_replicas < 1:
@@ -123,6 +145,10 @@ class FleetConfig:
             raise ValueError(
                 f"max_rebuild_probes must be >= 1, got "
                 f"{self.max_rebuild_probes}")
+        if not 0.0 <= self.prefix_affinity_weight < 1.0:
+            raise ValueError(
+                f"prefix_affinity_weight must be in [0, 1), got "
+                f"{self.prefix_affinity_weight}")
 
 
 class _Replica:
@@ -158,7 +184,7 @@ class _FleetTracked:
 
 
 class Router:
-    """The dispatch policy: least loaded first.
+    """The dispatch policy: least loaded first, prefix-affinity aware.
 
     Cost of a replica is ``depth × service_s`` where ``depth`` counts
     everything already committed to it (queued + backlogged + active
@@ -166,7 +192,36 @@ class Router:
     — before the first completion the EWMA is unknown and the replica
     costs 0, which deliberately attracts traffic to fresh (just
     rebuilt) replicas. Deterministic: ties break by depth, then id.
+
+    When the fleet hands :meth:`pick` a prefix hash chain, the cost is
+    discounted multiplicatively for replicas whose recent dispatch
+    history (:meth:`note_dispatch`, a bounded per-replica LRU of
+    chains) contains a matching prefix run:
+    ``cost × (1 − weight × share)`` with ``share`` the matched fraction
+    of the request's chain. The discount is BOUNDED by
+    ``affinity_weight < 1`` — a perfect match shrinks the cost by at
+    most that factor, so a deeply-loaded hot replica still loses to an
+    idle cold one and affinity can never starve the fleet onto one
+    replica. On exact cost-and-depth ties the better match wins (that
+    is what routes a cold fleet's repeat prefixes together before any
+    EWMA exists). :meth:`invalidate` forgets a replica's residency when
+    its engine is rebuilt (fresh intern index — nothing is resident).
     """
+
+    def __init__(self, affinity_weight: float = 0.0,
+                 residency_capacity: int = 128):
+        if not 0.0 <= affinity_weight < 1.0:
+            raise ValueError(
+                f"affinity_weight must be in [0, 1), got "
+                f"{affinity_weight}")
+        if residency_capacity < 1:
+            raise ValueError(
+                f"residency_capacity must be >= 1, got "
+                f"{residency_capacity}")
+        self.affinity_weight = affinity_weight
+        self.residency_capacity = residency_capacity
+        self._resident: Dict[int, "OrderedDict[Tuple[int, ...], None]"] \
+            = {}
 
     @staticmethod
     def depth(replica: _Replica) -> int:
@@ -180,11 +235,56 @@ class Router:
         return (depth * service if service is not None else 0.0,
                 depth, replica.replica_id)
 
-    @classmethod
-    def pick(cls, candidates: Sequence[_Replica]) -> _Replica:
+    def affinity(self, replica_id: int,
+                 chain: Optional[Sequence[int]]) -> float:
+        """Matched fraction of ``chain`` best resident on a replica,
+        in [0, 1] — 0 when no chain, no residency, or no common run."""
+        if not chain:
+            return 0.0
+        resident = self._resident.get(replica_id)
+        if not resident:
+            return 0.0
+        best = 0
+        for r in resident:
+            n = common_chain_len(r, chain)
+            if n > best:
+                best = n
+        return best / len(chain)
+
+    def pick(self, candidates: Sequence[_Replica],
+             chain: Optional[Sequence[int]] = None) -> _Replica:
         if not candidates:
             raise ValueError("no candidates to route to")
-        return min(candidates, key=cls.cost)
+        w = self.affinity_weight
+
+        def key(replica: _Replica):
+            base, depth, rid = self.cost(replica)
+            share = self.affinity(replica.replica_id, chain) \
+                if w > 0.0 else 0.0
+            # -share: on exact (cost, depth) ties prefer the replica
+            # holding the longer resident run — replica id still breaks
+            # true ties, keeping routing deterministic
+            return (base * (1.0 - w * share), depth, -share, rid)
+
+        return min(candidates, key=key)
+
+    def note_dispatch(self, replica_id: int,
+                      chain: Optional[Sequence[int]]) -> None:
+        """Record that a prompt with this chain was dispatched to the
+        replica — its engine will intern the prefix on prefill, so the
+        run becomes resident there. Bounded LRU per replica."""
+        if not chain:
+            return
+        resident = self._resident.setdefault(replica_id, OrderedDict())
+        resident[tuple(chain)] = None
+        resident.move_to_end(tuple(chain))
+        while len(resident) > self.residency_capacity:
+            resident.popitem(last=False)
+
+    def invalidate(self, replica_id: int) -> None:
+        """Forget a replica's residency (engine rebuilt: empty intern
+        index)."""
+        self._resident.pop(replica_id, None)
 
 
 class ReplicaFleet:
@@ -217,8 +317,16 @@ class ReplicaFleet:
         self.metrics.declare_counters(
             *(f"replica{i}_dispatches"
               for i in range(self.fleet.n_replicas)))
-        self.router = router or Router()
+        self.router = router if router is not None else Router(
+            affinity_weight=self.fleet.prefix_affinity_weight)
         self._engine_factory = engine_factory
+        # affinity chains only mean something when replicas actually
+        # intern prefixes — flat layout / prefix_cache=False fleets
+        # route purely least-loaded (chain stays None)
+        self._route_chains = (self.config.kv_layout == "paged"
+                              and self.config.prefix_cache
+                              and self.router.affinity_weight > 0.0)
+        self._route_salt = prefix_salt(model.config)
         if faults is None:
             self._faults: Dict[int, object] = {}
         elif isinstance(faults, dict):
@@ -288,6 +396,15 @@ class ReplicaFleet:
                 if r.state == REPLICA_ACTIVE
                 and r.supervisor.breaker_state != BREAKER_OPEN]
 
+    def _chain_for(self, request: Request) -> Optional[Tuple[int, ...]]:
+        """The request's prefix hash chain for affinity routing — the
+        SAME chain (same salt, same page size) the target engine will
+        look up and intern, or None when affinity is off."""
+        if not self._route_chains:
+            return None
+        return prefix_hash_chain(request.prompt, self.config.page_size,
+                                 self._route_salt) or None
+
     # -- admission --------------------------------------------------------
 
     def submit(self, request: Request) -> int:
@@ -302,7 +419,8 @@ class ReplicaFleet:
         candidates = self.dispatch_set()
         if not candidates:
             self._shed_fleet(request, now)
-        replica = self.router.pick(candidates)
+        chain = self._chain_for(request)
+        replica = self.router.pick(candidates, chain=chain)
         tr = _FleetTracked(request, now, self._order)
         self._order += 1
         self._tracked[request.request_id] = tr
@@ -316,6 +434,7 @@ class ReplicaFleet:
             raise
         tr.replica_id = replica.replica_id
         self._count_dispatch(replica)
+        self.router.note_dispatch(replica.replica_id, chain)
         return request.request_id
 
     def _count_dispatch(self, replica: _Replica) -> None:
@@ -503,7 +622,12 @@ class ReplicaFleet:
             if not candidates:
                 kept.append(cont)
                 continue
-            replica = self.router.pick(candidates)
+            # the continuation's prompt is the stitched original-plus-
+            # recovered-tokens the peer will actually prefill, so its
+            # chain (a superset of the original's) is the right
+            # affinity key
+            chain = self._chain_for(cont)
+            replica = self.router.pick(candidates, chain=chain)
             try:
                 replica.supervisor.submit(cont, resubmission=True)
             except (QueueFullError, DeadlineExpiredError,
@@ -513,6 +637,7 @@ class ReplicaFleet:
                 continue
             tr.replica_id = replica.replica_id
             self._count_dispatch(replica)
+            self.router.note_dispatch(replica.replica_id, chain)
         self._backlog = kept
 
     def _advance_drains(self) -> None:
@@ -533,6 +658,10 @@ class ReplicaFleet:
         carried = old.service_estimate_s
         self._engine_restarts_base += old.restarts
         old.close()
+        # the fresh engine's intern index is empty — stale affinity
+        # would keep routing this replica's old prefixes at a replica
+        # that now misses on all of them
+        self.router.invalidate(replica.replica_id)
         replica.supervisor = self._build_supervisor(
             replica.replica_id, service_s=carried)
         self.metrics.inc("replica_rebuilds")

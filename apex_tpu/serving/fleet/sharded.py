@@ -126,7 +126,16 @@ class ShardedEngine(InferenceEngine):
             prefill = shard_map(
                 self._paged_prefill_body, mesh=mesh,
                 in_specs=(pspec, cspec, rep, rep, rep, rep, rep, rep),
-                out_specs=(rep, cspec))
+                out_specs=(rep, rep, cspec))
+            # suffix prefill (prefix-cache hit): the gather/scatter of
+            # shared pages is rank-local on each rank's head slice, so
+            # sharding follows the pool spec; everything scalar — start,
+            # lengths, sampling, the skip_first flag — replicates
+            suffix = shard_map(
+                self._suffix_prefill_body, mesh=mesh,
+                in_specs=(pspec, cspec, rep, rep, rep, rep, rep, rep,
+                          rep, rep, rep),
+                out_specs=(rep, rep, cspec))
             scrub = shard_map(
                 self._paged_scrub_body, mesh=mesh,
                 in_specs=(cspec, rep), out_specs=cspec)
@@ -139,10 +148,13 @@ class ShardedEngine(InferenceEngine):
                 self._prefill_body, mesh=mesh,
                 in_specs=(pspec, cspec, rep, rep, rep, rep, rep, rep),
                 out_specs=(rep, cspec))
+            suffix = None
             scrub = shard_map(
                 self._scrub_body, mesh=mesh,
                 in_specs=(cspec, rep), out_specs=cspec)
         donate_args = (1,) if donate else ()
         return (jax.jit(decode, donate_argnums=donate_args),
                 jax.jit(prefill, donate_argnums=donate_args),
+                None if suffix is None else
+                jax.jit(suffix, donate_argnums=donate_args),
                 jax.jit(scrub, donate_argnums=(0,) if donate else ()))

@@ -136,6 +136,17 @@ class FCFSScheduler:
                 f"backoff or raise SchedulerConfig.max_queue")
         self._queue.append(_Queued(request, start))
 
+    def requeue_front(self, request: Request, submit_ts: float) -> None:
+        """Put a popped request BACK at the head of the line, keeping its
+        original ``submit_ts`` (deadline clock keeps running). Used when
+        the engine discovers, after ``pop_admissible`` said yes, that the
+        resources it predicted are gone (a concurrent intern-index
+        eviction reshaped the page pool) — FCFS honesty demands the
+        request retries from the front, not the back. Deliberately
+        bypasses ``max_queue``: the request already held a queue
+        position."""
+        self._queue.appendleft(_Queued(request, submit_ts))
+
     def snapshot(self) -> List[Tuple[Request, float]]:
         """Queued (request, submit_ts) pairs in FCFS order, non-popping —
         the supervisor's restart path uses this to requeue survivors."""

@@ -133,31 +133,31 @@ class TestRouter:
     def test_least_loaded_wins(self):
         a = _stub_replica(0, queued=4, active=2, service=0.5)   # cost 3.0
         b = _stub_replica(1, queued=1, active=1, service=0.5)   # cost 1.0
-        assert Router.pick([a, b]).replica_id == 1
+        assert Router().pick([a, b]).replica_id == 1
 
     def test_ewma_weighs_depth(self):
         # deeper-but-faster beats shallower-but-slower
         fast = _stub_replica(0, queued=4, active=0, service=0.1)  # 0.4
         slow = _stub_replica(1, queued=1, active=0, service=1.0)  # 1.0
-        assert Router.pick([fast, slow]).replica_id == 0
+        assert Router().pick([fast, slow]).replica_id == 0
 
     def test_unknown_service_attracts_traffic(self):
         # a fresh (just rebuilt) replica has no EWMA yet: cost 0 — it
         # deliberately wins over any measured replica
         fresh = _stub_replica(1, queued=3, active=0, service=None)
         busy = _stub_replica(0, queued=1, active=0, service=0.01)
-        assert Router.pick([busy, fresh]).replica_id == 1
+        assert Router().pick([busy, fresh]).replica_id == 1
 
     def test_ties_break_by_depth_then_id(self):
         a = _stub_replica(0, queued=2, active=0, service=None)
         b = _stub_replica(1, queued=1, active=0, service=None)
-        assert Router.pick([a, b]).replica_id == 1
+        assert Router().pick([a, b]).replica_id == 1
         c = _stub_replica(2, queued=1, active=0, service=None)
-        assert Router.pick([b, c]).replica_id == 1  # id breaks the tie
+        assert Router().pick([b, c]).replica_id == 1  # id breaks the tie
 
     def test_empty_candidates_rejected(self):
         with pytest.raises(ValueError, match="no candidates"):
-            Router.pick([])
+            Router().pick([])
 
 
 class TestFleetConfig:

@@ -43,6 +43,7 @@ MODULES = [
     "apex_tpu.rnn",
     "apex_tpu.serving",
     "apex_tpu.serving.fleet",
+    "apex_tpu.serving.prefix",
     "apex_tpu.testing_faults",
     "apex_tpu.training",
     "apex_tpu.transformer",
