@@ -101,6 +101,12 @@ class TrafficGenerator:
         # prompt length, so the suffix draw count is never negative
         prompt = shared + [rng.randrange(self.scenario.model.vocab_size)
                            for _ in range(prompt_len - len(shared))]
+        if phase.prompt_period > 0:
+            # repeated-text shape: tile the prompt's first period across
+            # its full length (period 0 draws nothing extra, so existing
+            # scenarios keep byte-identical schedules)
+            period = prompt[:phase.prompt_period]
+            prompt = (period * (prompt_len // len(period) + 1))[:prompt_len]
         max_new = _choose(rng, phase.max_new_tokens)
         # draw order is fixed and unconditional draws come first, so a
         # mix change in one field cannot shift another field's stream

@@ -122,6 +122,8 @@ def _build_serving(scenario: Scenario, model, params,
         n_pages=knobs.n_pages,
         prefix_cache=knobs.prefix_cache,
         prefix_lru_capacity=knobs.prefix_lru_capacity,
+        kv_dtype=knobs.kv_dtype,
+        speculation=knobs.speculation,
         scheduler=SchedulerConfig(
             max_queue=knobs.max_queue,
             max_prefills_per_tick=knobs.max_prefills_per_tick))

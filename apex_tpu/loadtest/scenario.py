@@ -103,7 +103,12 @@ class EngineKnobs:
     not fit dense rows in the same HBM". ``prefix_cache`` /
     ``prefix_lru_capacity`` drive the paged engine's shared-prefix
     interning (docs/serving.md#prefix-cache) — turning the cache off is
-    how the ``shared_prefix`` scenario measures its own speedup."""
+    how the ``shared_prefix`` scenario measures its own speedup.
+    ``kv_dtype="int8"`` serves from the quantized pool
+    (docs/serving.md#kv-quantization) and ``speculation=k`` turns on
+    k-row speculative verify windows
+    (docs/serving.md#speculative-decoding) — both paged-only, like the
+    engine knobs they mirror."""
 
     max_slots: int = 4
     max_len: int = 64
@@ -114,6 +119,8 @@ class EngineKnobs:
     n_pages: Optional[int] = None
     prefix_cache: bool = True
     prefix_lru_capacity: int = 32
+    kv_dtype: str = "bf16"
+    speculation: int = 0
 
     def __post_init__(self):
         if self.kv_layout not in ("flat", "paged"):
@@ -124,6 +131,24 @@ class EngineKnobs:
             raise ValueError(
                 f"prefix_lru_capacity must be >= 0, got "
                 f"{self.prefix_lru_capacity}")
+        # mirror EngineConfig's validation so a bad scenario fails at
+        # parse time, not at engine construction mid-run
+        if self.kv_dtype not in ("bf16", "int8"):
+            raise ValueError(
+                f"kv_dtype must be 'bf16' or 'int8', got "
+                f"{self.kv_dtype!r}")
+        if self.kv_dtype == "int8" and self.kv_layout != "paged":
+            raise ValueError(
+                "kv_dtype='int8' needs kv_layout='paged' (scales are "
+                "per-page)")
+        if self.speculation < 0 or self.speculation == 1:
+            raise ValueError(
+                f"speculation must be 0 (off) or a window >= 2, got "
+                f"{self.speculation}")
+        if self.speculation and self.kv_layout != "paged":
+            raise ValueError(
+                "speculation needs kv_layout='paged' (the windowed "
+                "verify rides the paged kernel)")
 
     @classmethod
     def from_dict(cls, data: Dict[str, Any]) -> "EngineKnobs":
@@ -131,6 +156,8 @@ class EngineKnobs:
         kw: Dict[str, Any] = {}
         if "kv_layout" in d:
             kw["kv_layout"] = str(d.pop("kv_layout"))
+        if "kv_dtype" in d:
+            kw["kv_dtype"] = str(d.pop("kv_dtype"))
         if "n_pages" in d:
             n = d.pop("n_pages")
             kw["n_pages"] = int(n) if n is not None else None
@@ -151,6 +178,10 @@ class EngineKnobs:
             out["prefix_cache"] = False
         if self.prefix_lru_capacity != 32:
             out["prefix_lru_capacity"] = self.prefix_lru_capacity
+        if self.kv_dtype != "bf16":
+            out["kv_dtype"] = self.kv_dtype
+        if self.speculation:
+            out["speculation"] = self.speculation
         return out
 
 
@@ -169,6 +200,10 @@ class LoadPhase:
     every prompt in the phase open with the SAME ``shared_prefix_len``
     seeded tokens (drawn once at phase start) — the multi-turn /
     system-prompt traffic shape the engine's prefix cache exists for.
+    ``prompt_period`` > 0 makes each prompt PERIODIC (its tokens repeat
+    with that period) — the repeated-text traffic shape whose n-gram
+    structure the self-speculative drafter exploits
+    (docs/serving.md#speculative-decoding).
     """
 
     name: str
@@ -184,6 +219,7 @@ class LoadPhase:
     top_ks: Tuple[int, ...] = (0,)
     eos_token: Optional[int] = None
     shared_prefix_len: int = 0
+    prompt_period: int = 0
 
     def __post_init__(self):
         if self.n_requests < 1:
@@ -227,6 +263,10 @@ class LoadPhase:
                 f"phase {self.name!r}: shared_prefix_len "
                 f"({self.shared_prefix_len}) exceeds the shortest "
                 f"prompt length in the mix ({min(self.prompt_lens)})")
+        if self.prompt_period < 0:
+            raise ValueError(
+                f"phase {self.name!r}: prompt_period must be >= 0, "
+                f"got {self.prompt_period}")
 
     @property
     def max_total_len(self) -> int:
@@ -253,7 +293,8 @@ class LoadPhase:
                                for t in d.pop("temperatures", (0.7,))),
             top_ks=tuple(int(k) for k in d.pop("top_ks", (0,))),
             eos_token=int(eos) if eos is not None else None,
-            shared_prefix_len=int(d.pop("shared_prefix_len", 0)))
+            shared_prefix_len=int(d.pop("shared_prefix_len", 0)),
+            prompt_period=int(d.pop("prompt_period", 0)))
         if d:
             raise ValueError(
                 f"phase {name!r}: unknown keys {sorted(d)}")
@@ -279,6 +320,8 @@ class LoadPhase:
             out["eos_token"] = self.eos_token
         if self.shared_prefix_len > 0:
             out["shared_prefix_len"] = self.shared_prefix_len
+        if self.prompt_period > 0:
+            out["prompt_period"] = self.prompt_period
         return out
 
 

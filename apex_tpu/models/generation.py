@@ -121,7 +121,8 @@ def init_kv_caches(model, batch_size: int, max_len: int,
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
 
-def init_paged_kv_caches(model, n_pages: int, page_size: int, dtype=None):
+def init_paged_kv_caches(model, n_pages: int, page_size: int, dtype=None,
+                         *, quantized: bool = False):
     """Preallocate the PAGED decode cache: a list of per-layer
     ``(k_pages, v_pages)`` pairs, each ``[n_pages, page_size,
     local_kv_heads * head_dim]`` — the serving engine's
@@ -131,7 +132,13 @@ def init_paged_kv_caches(model, n_pages: int, page_size: int, dtype=None):
     pool rows through a host-owned page table, so HBM is committed to
     actual context length instead of ``max_slots * max_len``. Head count
     is TP-local inside ``shard_map``, exactly as in
-    :func:`init_kv_caches`."""
+    :func:`init_kv_caches`.
+
+    ``quantized=True`` (``kv_dtype="int8"``,
+    docs/serving.md#kv-quantization): pools are int8 and each of k/v
+    nests as a ``(pages, scales)`` pair, ``scales`` the per-(page,
+    kv-head) float32 sidecar ``[n_pages, local_kv_heads]`` the fused
+    decode op dequantizes from — halving the decode-step HBM stream."""
     from apex_tpu.transformer.tensor_parallel.mappings import axis_bound
 
     c = model.config
@@ -146,6 +153,13 @@ def init_paged_kv_caches(model, n_pages: int, page_size: int, dtype=None):
                 f"num_query_groups a multiple of tp")
         heads //= tp
     shape = (n_pages, page_size, heads * c.head_dim)
+    if quantized:
+        sshape = (n_pages, heads)
+        return [((jnp.zeros(shape, jnp.int8),
+                  jnp.zeros(sshape, jnp.float32)),
+                 (jnp.zeros(shape, jnp.int8),
+                  jnp.zeros(sshape, jnp.float32)))
+                for _ in range(c.num_layers)]
     return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
             for _ in range(c.num_layers)]
 

@@ -807,27 +807,41 @@ class ParallelAttention:
                 # of the KV stream per step); its reference path replays
                 # the flat s==1 formulation below bit-for-bit on the
                 # gathered logical view, so paged serving stays
-                # token-exact against the flat engine.
-                if s != 1:
-                    raise NotImplementedError(
-                        "paged_state is the single-token decode path "
-                        f"(got s={s}); prefill scatters into pages "
-                        "outside the model — see the serving engine")
+                # token-exact against the flat engine. ``s > 1`` is the
+                # speculative verify window: each slot appends/attends a
+                # window of ``s`` rows starting at its own cache_index
+                # (window query t masks to rows <= index + t). With an
+                # int8 pool each of ck/cv is a ``(pages, scales)`` pair
+                # and the op carries the per-page scales through.
                 if attention_mask is not None or kv_lengths is not None:
                     raise NotImplementedError(
                         "paged decode derives validity from cache_index; "
                         "attention_mask/kv_lengths are not supported")
                 from apex_tpu.ops import fused_paged_decode_attention
+                k_scales = v_scales = None
+                if isinstance(ck, (tuple, list)):
+                    ck, k_scales = ck
+                    cv, v_scales = cv
                 kvh_l = k.shape[1]
-                ctx, ck, cv = fused_paged_decode_attention(
-                    q[:, :, 0, :],
-                    k[:, :, 0, :].reshape(b, kvh_l * dh),
-                    v[:, :, 0, :].reshape(b, kvh_l * dh),
-                    ck, cv, paged_state, cache_index,
+                # [b, hl, s, dh] -> windowed [b, s, hl, dh] / [b, s, f]
+                qw = q.transpose(0, 2, 1, 3)
+                kw = k.transpose(0, 2, 1, 3).reshape(b, s, kvh_l * dh)
+                vw = v.transpose(0, 2, 1, 3).reshape(b, s, kvh_l * dh)
+                res = fused_paged_decode_attention(
+                    qw, kw, vw, ck, cv, paged_state, cache_index,
                     queries_per_group=local_heads // kvh_l,
-                    sliding_window=c.sliding_window)
-                out = self.dense.apply(params["dense"], ctx[None])
-                return out, (ck, cv)
+                    sliding_window=c.sliding_window,
+                    k_scales=k_scales, v_scales=v_scales)
+                if k_scales is not None:
+                    ctx, ck, cv, k_scales, v_scales = res
+                    new = ((ck, k_scales), (cv, v_scales))
+                else:
+                    ctx, ck, cv = res
+                    new = (ck, cv)
+                # ctx [b, s, hl*dh] -> [s, b, hl*dh] for the dense proj
+                out = self.dense.apply(params["dense"],
+                                       ctx.transpose(1, 0, 2))
+                return out, new
             if ck.ndim == 3:
                 # FLAT decode cache [b, S, local_kv_heads*dh]: with the 4D
                 # [b, h, S, d] carry XLA picks a layout whose minor dim is
@@ -1129,6 +1143,14 @@ class ParallelTransformer:
         # a LIST means per-layer (k, v) pairs (the stacked scan form is a
         # 2-TUPLE of [L, ...] arrays — do not widen this check to tuple)
         if kv_caches is not None and isinstance(kv_caches, list):
+            # quantized paged entries nest one level deeper: each of
+            # k/v is a (pages, scales) pair — validate on the pages
+            k0 = kv_caches[0][0] if (
+                isinstance(kv_caches[0], (tuple, list))
+                and len(kv_caches[0]) == 2) else None
+            if (isinstance(k0, (tuple, list)) and len(k0) == 2
+                    and paged_state is not None):
+                k0 = k0[0]
             if (len(kv_caches) != c.num_layers
                     # entries must be (k, v) PAIRS: a stacked (k, v) pair
                     # that became a [k, v] list in a serialization
@@ -1139,7 +1161,7 @@ class ParallelTransformer:
                     # actually catches it
                     or not isinstance(kv_caches[0], (tuple, list))
                     or len(kv_caches[0]) != 2
-                    or getattr(kv_caches[0][0], "ndim", 0) not in (3, 4)):
+                    or getattr(k0, "ndim", 0) not in (3, 4)):
                 raise ValueError(
                     f"list-form kv_caches must hold num_layers "
                     f"({c.num_layers}) per-layer (k, v) pairs of "

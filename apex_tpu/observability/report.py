@@ -400,6 +400,26 @@ def render_report(report: dict) -> str:
                 f"hit_rate={rate:.1%} "
                 f"pages_shared={counters.get('prefix_pages_shared', 0)} "
                 f"evictions={counters.get('prefix_evictions', 0)}")
+        if "kv_bytes_per_step" in gauges:
+            # decode-roofline denominator at the final snapshot — the
+            # dtype- and page-aware stream size int8 KV shrinks
+            lines.append(
+                f"  kv bytes/step (final): "
+                f"{int(gauges['kv_bytes_per_step']):,}")
+        proposed = counters.get("draft_tokens_proposed", 0)
+        if proposed:
+            # speculative decoding: accepted/proposed is the fleet-wide
+            # acceptance rate, reconciling key-for-key with the
+            # spec_accept_rate histogram's per-step observations
+            accepted = counters.get("draft_tokens_accepted", 0)
+            line = (f"  speculation: proposed={proposed} "
+                    f"accepted={accepted} "
+                    f"accept_rate={accepted / proposed:.1%}")
+            acc = (report.get("histograms") or {}).get("spec_accept_rate")
+            if isinstance(acc, dict) and acc.get("count"):
+                line += (f" per-step mean={_fmt(acc.get('mean'))} "
+                         f"n={acc['count']}")
+            lines.append(line)
     slo = report.get("slo")
     if slo:
         verdict = "PASS" if slo["ok"] else "FAIL"

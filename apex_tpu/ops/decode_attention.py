@@ -11,12 +11,29 @@ Operation Fusion", arXiv 2502.17728; ClusterFusion++'s whole-block
 decode fusion is the same territory):
 
 - :func:`fused_paged_decode_attention` — ONE jitted region per decode
-  step and layer: the new K/V row lands as a donated in-place one-row
-  scatter, and attention is a single VMEM-resident flash pass over the
-  slot's mapped pages (Pallas kernel, page table scalar-prefetched so
-  each page block DMAs straight from its pool row). The KV stream is
-  read from HBM exactly once per step; the only HBM write is the
-  appended row. No gathered-cache temporary exists in any memory space.
+  step and layer: the new K/V rows land as a donated in-place scatter,
+  and attention is a single VMEM-resident flash pass over the slot's
+  mapped pages (Pallas kernel, page table scalar-prefetched so each
+  page block DMAs straight from its pool row). The KV stream is read
+  from HBM exactly once per step; the only HBM writes are the appended
+  rows. No gathered-cache temporary exists in any memory space.
+
+Two extensions raise the effective bandwidth ceiling past the PR 9
+roofline (docs/serving.md#kv-quantization, #speculative-decoding):
+
+- **Query windows** (``q`` rank 4): each slot appends and attends over
+  ``w`` consecutive rows in one pass — the verify step of
+  self-speculative decoding, which amortizes one read of the KV stream
+  over up to ``w`` emitted tokens. ``w == 1`` reproduces the PR 9
+  single-token step bit-for-bit (the window formulation degenerates to
+  the same arrays and the same reduction order).
+- **int8 pools with per-(page, kv-head) scales** (``k_scales`` /
+  ``v_scales``): pages are the quantization blocks. Appends quantize
+  with RESCALE-ON-APPEND — a page's scale only ever grows (scatter-max
+  of the incoming rows' absmax), resident int8 rows are rescaled by
+  ``old/new``, and the new rows quantize at the final scale — and the
+  kernel dequantizes inline on the VMEM-resident block, so the HBM
+  stream is half the bf16 bytes with no new read site.
 
 Layouts (see docs/serving.md#paged-kv):
 
@@ -25,10 +42,14 @@ Layouts (see docs/serving.md#paged-kv):
   the flat cache's ``[b, S, h*d]`` form (PERF.md round 5), and is the
   dim :class:`~apex_tpu.serving.fleet.ShardedEngine` shards over the
   tensor axis.
+- scale sidecar: ``[n_pages, kv_heads]`` float32 per pool — sharded
+  ``P(None, tensor)`` so each rank's scales cover exactly its head
+  slice (per-head absmax is rank-local under TP).
 - page table: ``[b, pages_per_slot]`` int32, logical page ``j`` of slot
   ``r`` lives in pool row ``page_table[r, j]``; unmapped entries hold
   the out-of-range sentinel ``n_pages`` (reads clamp + mask, scatters
-  drop).
+  drop). Window rows past the table's span also clamp to the sentinel,
+  so an over-long window can never corrupt the slot's own last page.
 
 Dispatch follows the repo convention (:mod:`apex_tpu.ops._support`):
 the Pallas kernel on TPU (or under ``APEX_TPU_FORCE_PALLAS=interpret``
@@ -51,11 +72,17 @@ from jax.experimental.pallas import tpu as pltpu
 
 from apex_tpu.ops._support import cdiv, pallas_interpret, use_pallas
 
-__all__ = ["fused_paged_decode_attention", "paged_pages_for"]
+__all__ = ["fused_paged_decode_attention", "paged_pages_for",
+           "paged_quant_fill", "paged_quant_scatter"]
 
 #: the masked-score floor the flat decode path uses — shared so paged
 #: and flat softmax see bitwise-identical masked entries
 _NEG = -1e30
+
+#: int8 quantization range: symmetric, -127..127 (keeping -128 out of
+#: the code domain makes the scale exactly absmax/127 and negation
+#: lossless)
+_QMAX = 127.0
 
 
 def paged_pages_for(tokens: int, page_size: int) -> int:
@@ -63,56 +90,170 @@ def paged_pages_for(tokens: int, page_size: int) -> int:
     return cdiv(tokens, page_size)
 
 
+def _window_dest(page_table, positions, w, page_size):
+    """Scatter coordinates for a ``w``-row append window per slot:
+    row ``t`` of slot ``r`` lands at logical position
+    ``positions[r] + t``. Positions past the table's span map to the
+    sentinel ``n_pages`` (a plain gather would CLAMP to the table's
+    last column and corrupt the slot's own final page)."""
+    b = page_table.shape[0]
+    pps = page_table.shape[1]
+    idx = positions[:, None] + jnp.arange(w)[None, :]        # [b, w]
+    page_idx = idx // page_size
+    dest_page = jnp.take_along_axis(
+        page_table, jnp.clip(page_idx, 0, pps - 1), axis=1)
+    dest_page = jnp.where(page_idx < pps, dest_page,
+                          jnp.int32(2 ** 30))  # past any pool: drops
+    return dest_page.astype(jnp.int32), (idx % page_size).astype(jnp.int32)
+
+
 def _append_rows(pages, rows, page_table, positions, page_size):
-    """Scatter each slot's new row at its own cache position:
-    ``pages[page_table[r, p // page_size], p % page_size] = rows[r]``.
-    One row per slot; with the pool donated into the jitted step this
-    compiles to an in-place write, never a pool copy. Unmapped sentinel
-    entries (engine bug) drop instead of corrupting a foreign page."""
-    b = rows.shape[0]
-    dest_page = page_table[jnp.arange(b), positions // page_size]
-    dest_row = positions % page_size
+    """Scatter each slot's ``w`` new rows at their cache positions.
+    One window per slot; with the pool donated into the jitted step this
+    compiles to in-place writes, never a pool copy. Unmapped sentinel
+    entries (and window rows past the table) drop instead of corrupting
+    a foreign page."""
+    b, w, f = rows.shape
+    dest_page, dest_row = _window_dest(page_table, positions, w, page_size)
     return pages.at[dest_page, dest_row].set(
         rows.astype(pages.dtype), mode="drop")
+
+
+# -- int8 page quantization --------------------------------------------------
+
+
+def paged_quant_scatter(pages, scales, rows, dest_page, dest_row):
+    """Rescale-on-append row scatter into an int8 pool.
+
+    ``rows`` ``[n, kv_heads * head_dim]`` land at
+    ``(dest_page[i], dest_row[i])``; out-of-range ``dest_page`` drops
+    the row (sentinel convention). Scale lifecycle: a page's per-kv-head
+    scale MONOTONICALLY grows to cover the incoming rows' absmax
+    (scatter-max), resident int8 rows of touched pages are rescaled by
+    ``old/new`` (duplicate destinations write identical values, so the
+    scatter stays deterministic), and the new rows quantize at the
+    final scale. A zero scale means "nothing valid resident": the ratio
+    rescale then zeroes whatever bits the recycled page held.
+
+    Returns ``(pages, scales)``.
+    """
+    n_pages, ps, f = pages.shape
+    kvh = scales.shape[1]
+    dh = f // kvh
+    rf = rows.astype(jnp.float32).reshape(-1, kvh, dh)
+    want = jnp.max(jnp.abs(rf), axis=-1) / _QMAX             # [n, kvh]
+    new_scales = scales.at[dest_page].max(want, mode="drop")
+    cf = jnp.clip(dest_page, 0, n_pages - 1)
+    ns = new_scales[cf]                                      # [n, kvh]
+    safe = jnp.where(ns > 0.0, ns, 1.0)
+    ratio = scales[cf] / safe                                # old/new <= 1
+    resident = pages[cf].astype(jnp.float32) \
+        * jnp.repeat(ratio, dh, axis=-1)[:, None, :]
+    pages = pages.at[dest_page].set(
+        jnp.clip(jnp.round(resident), -_QMAX, _QMAX).astype(pages.dtype),
+        mode="drop")
+    q = jnp.clip(jnp.round(rf / safe[:, :, None]), -_QMAX, _QMAX)
+    pages = pages.at[dest_page, dest_row].set(
+        q.reshape(-1, f).astype(pages.dtype), mode="drop")
+    return pages, new_scales
+
+
+def paged_quant_fill(pages, scales, chunks, dest_page):
+    """Whole-page overwrite into an int8 pool (the prefill chunk path):
+    ``chunks`` ``[n, page_size, f]`` REPLACE pages ``dest_page`` —
+    content and scale alike (``.set``, not ``.max``: a freshly mapped
+    page owes nothing to its previous occupant). Sentinel destinations
+    drop. Returns ``(pages, scales)``."""
+    n, ps, f = chunks.shape
+    kvh = scales.shape[1]
+    dh = f // kvh
+    cf = chunks.astype(jnp.float32).reshape(n, ps, kvh, dh)
+    amax = jnp.max(jnp.abs(cf), axis=(1, 3))                 # [n, kvh]
+    scale = amax / _QMAX
+    safe = jnp.where(scale > 0.0, scale, 1.0)
+    q = jnp.clip(jnp.round(cf / safe[:, None, :, None]), -_QMAX, _QMAX)
+    pages = pages.at[dest_page].set(
+        q.reshape(n, ps, f).astype(pages.dtype), mode="drop")
+    scales = scales.at[dest_page].set(scale, mode="drop")
+    return pages, scales
+
+
+def _quant_append(pages, scales, rows, page_table, positions, page_size):
+    """Windowed rescale-on-append: the int8 counterpart of
+    :func:`_append_rows`."""
+    b, w, f = rows.shape
+    dest_page, dest_row = _window_dest(page_table, positions, w, page_size)
+    return paged_quant_scatter(pages, scales, rows.reshape(b * w, f),
+                               dest_page.reshape(-1), dest_row.reshape(-1))
+
+
+def _dequant_view(pages_g, scales_g, dh, dtype):
+    """Gathered int8 pages ``[b, pps, ps, f]`` + gathered scales
+    ``[b, pps, kvh]`` -> dequantized ``[b, pps, ps, f]`` in ``dtype``."""
+    sc = jnp.repeat(scales_g, dh, axis=-1)[:, :, None, :]    # [b,pps,1,f]
+    return (pages_g.astype(jnp.float32) * sc).astype(dtype)
 
 
 # -- reference path (CPU / pallas off) ---------------------------------------
 
 
-def _reference(q, k_new, v_new, k_pages, v_pages, page_table, positions,
-               group, sliding_window):
+def _reference(q, k_new, v_new, k_pages, v_pages, k_scales, v_scales,
+               page_table, positions, group, sliding_window):
     """Gathered-view reference: append, then run the flat cache's
     single-token MXU formulation (transformer._flat_cache_attention,
     ``s == 1`` branch) over the logical ``[b, S, f]`` view
-    ``pool[page_table]``. Real rows see the exact same operand values
+    ``pool[page_table]``, with the ``w`` window queries folded into the
+    query-head axis (every einsum reduction is per-query-column
+    independent, so ``w`` windowed queries are bitwise-identical to
+    ``w`` sequential single-row calls — and ``w == 1`` is the PR 9
+    reference unchanged). Real rows see the exact same operand values
     and reduction order as the flat path (padded rows mask to exact
     zeros), so flat-vs-paged engine parity is bitwise, not approximate."""
     n_pages, page_size, f = k_pages.shape
-    b, hl, dh = q.shape
+    b, w, hl, dh = q.shape
     kvh = f // dh
-    k_pages = _append_rows(k_pages, k_new, page_table, positions, page_size)
-    v_pages = _append_rows(v_pages, v_new, page_table, positions, page_size)
+    if k_scales is not None:
+        k_pages, k_scales = _quant_append(
+            k_pages, k_scales, k_new, page_table, positions, page_size)
+        v_pages, v_scales = _quant_append(
+            v_pages, v_scales, v_new, page_table, positions, page_size)
+    else:
+        k_pages = _append_rows(k_pages, k_new, page_table, positions,
+                               page_size)
+        v_pages = _append_rows(v_pages, v_new, page_table, positions,
+                               page_size)
     pt = jnp.minimum(page_table, n_pages - 1)     # clamp sentinels (masked)
-    ck = k_pages[pt].reshape(b, -1, f)
-    cv = v_pages[pt].reshape(b, -1, f)
+    if k_scales is not None:
+        ck = _dequant_view(k_pages[pt], k_scales[pt], dh, q.dtype)
+        cv = _dequant_view(v_pages[pt], v_scales[pt], dh, q.dtype)
+        ck = ck.reshape(b, -1, f)
+        cv = cv.reshape(b, -1, f)
+    else:
+        ck = k_pages[pt].reshape(b, -1, f)
+        cv = v_pages[pt].reshape(b, -1, f)
     S = ck.shape[1]
-    slots = jnp.arange(S)[None, :]
-    invalid = slots > positions[:, None]
+    slots = jnp.arange(S)
+    # per-query validity: window query t of slot r covers logical rows
+    # [0, positions[r] + t]
+    t = (jnp.arange(w * hl) // hl)[None, None, :]
+    lim = positions[:, None, None] + t
+    invalid = slots[None, :, None] > lim
     if sliding_window is not None:
         invalid = jnp.logical_or(
-            invalid, slots <= positions[:, None] - sliding_window)
+            invalid, slots[None, :, None] <= lim - sliding_window)
     inv_scale = jnp.sqrt(jnp.asarray(dh, jnp.float32)).astype(q.dtype)
     # K stream through one MXU GEMM per batch (Qblock holds each query
     # head's vector in its K/V head's row block, zeros elsewhere) — the
     # same full-lane formulation as the flat path
-    q_tiled = jnp.tile(q.transpose(0, 2, 1), (1, kvh, 1))
+    qq = q.reshape(b, w * hl, dh)
+    q_tiled = jnp.tile(qq.transpose(0, 2, 1), (1, kvh, 1))
     frow = jnp.arange(kvh * dh)[:, None]
-    jcol = jnp.arange(hl)[None, :]
-    blockmask = (frow // dh == jcol // group).astype(q.dtype)
-    qblock = q_tiled * blockmask                           # [b, f, hl]
+    jcol = jnp.arange(w * hl)[None, :]
+    blockmask = (frow // dh == (jcol % hl) // group).astype(q.dtype)
+    qblock = q_tiled * blockmask                           # [b, f, w*hl]
     scores = jnp.einsum("bsf,bfh->bsh", ck.astype(q.dtype),
-                        qblock) / inv_scale                # [b, S, hl]
-    sf = jnp.where(invalid[:, :, None], jnp.asarray(_NEG, jnp.float32),
+                        qblock) / inv_scale                # [b, S, w*hl]
+    sf = jnp.where(invalid, jnp.asarray(_NEG, jnp.float32),
                    scores.astype(jnp.float32))
     sf = sf - jnp.max(sf, axis=1, keepdims=True)
     e = jnp.exp(sf)
@@ -120,16 +261,16 @@ def _reference(q, k_new, v_new, k_pages, v_pages, page_table, positions,
     ctx_big = jnp.einsum("bsh,bsf->bhf", probs, cv.astype(q.dtype))
     sel = (jnp.arange(kvh)[None, :]
            == (jnp.arange(hl) // group)[:, None]).astype(q.dtype)
-    ctx = jnp.einsum("bjkd,jk->bjd", ctx_big.reshape(b, hl, kvh, dh), sel)
-    return ctx.reshape(b, hl * dh), k_pages, v_pages
+    ctx = jnp.einsum("bwjkd,jk->bwjd",
+                     ctx_big.reshape(b, w, hl, kvh, dh), sel)
+    return ctx.reshape(b, w, hl * dh), k_pages, v_pages, k_scales, v_scales
 
 
 # -- Pallas kernel -----------------------------------------------------------
 
 
-def _decode_kernel(pt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
-                   m_ref, l_ref, acc_ref, *, page_size, group,
-                   sliding_window):
+def _decode_kernel(pt_ref, pos_ref, q_ref, k_ref, v_ref, *rest,
+                   page_size, group, window, quantized, sliding_window):
     """One (slot, page-block) grid cell of the streaming decode pass.
 
     The page table is scalar-prefetched, so block ``(r, j)``'s K/V page
@@ -137,13 +278,21 @@ def _decode_kernel(pt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
     gather never exists as an array. Softmax is the standard flash
     recurrence over page blocks (running max / normalizer / weighted
     accumulator in VMEM scratch, carried across the slot's inner grid
-    iterations); the final block rescales and writes the context row.
-    Pages past the slot's valid length are skipped (their DMA is the
-    residual cost of the rectangular grid — ~one page per slot in
+    iterations); the final block rescales and writes the context rows.
+    The ``window`` query rows fold into the per-kv-head query block
+    (``group * window`` rows), each masked to its own validity limit
+    ``pos + t``. Quantized pools dequantize the VMEM-resident block
+    in-register from the gathered per-page scales — HBM still streams
+    int8. Pages past the slot's valid length are skipped (their DMA is
+    the residual cost of the rectangular grid — ~one page per slot in
     steady state since the engine allocates pages on demand)."""
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        o_ref, m_ref, l_ref, acc_ref = rest
     r = pl.program_id(0)
     j = pl.program_id(1)
-    pos = pos_ref[r]                         # append index == last valid
+    pos = pos_ref[r]                  # first window row's append index
 
     @pl.when(j == 0)
     def _init():
@@ -151,123 +300,193 @@ def _decode_kernel(pt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    @pl.when(j * page_size <= pos)
+    @pl.when(j * page_size <= pos + (window - 1))
     def _accumulate():
-        hl, dh = q_ref.shape[1], q_ref.shape[2]
+        w, hl, dh = q_ref.shape[1], q_ref.shape[2], q_ref.shape[3]
         kvh = hl // group
-        qh = q_ref[0].reshape(kvh, group, dh).astype(jnp.float32)
+        # [w, kvh, group, dh] -> [kvh, w*group, dh]: per-kv-head query
+        # block with the window folded in
+        qh = q_ref[0].reshape(w, kvh, group, dh).transpose(1, 0, 2, 3) \
+            .reshape(kvh, w * group, dh).astype(jnp.float32)
         kb = k_ref[0].reshape(page_size, kvh, dh).astype(jnp.float32)
         vb = v_ref[0].reshape(page_size, kvh, dh).astype(jnp.float32)
+        if quantized:
+            kb = kb * ks_ref[0, 0][None, :, None]
+            vb = vb * vs_ref[0, 0][None, :, None]
         s_blk = jax.lax.dot_general(
             qh, kb, (((2,), (2,)), ((0,), (1,))),
-            preferred_element_type=jnp.float32)  # [kvh, group, page_size]
+            preferred_element_type=jnp.float32)  # [kvh, w*group, ps]
         s_blk = s_blk / jnp.sqrt(jnp.float32(dh))
         row = j * page_size + jax.lax.broadcasted_iota(
             jnp.int32, (1, 1, page_size), 2)
-        invalid = row > pos
+        t = jax.lax.broadcasted_iota(
+            jnp.int32, (1, w * group, 1), 1) // group
+        lim = pos + t
+        invalid = row > lim
         if sliding_window is not None:
-            invalid = jnp.logical_or(invalid, row <= pos - sliding_window)
+            invalid = jnp.logical_or(invalid, row <= lim - sliding_window)
         s_blk = jnp.where(invalid, _NEG, s_blk)
         m_prev, l_prev = m_ref[...], l_ref[...]
         m_new = jnp.maximum(m_prev, jnp.max(s_blk, axis=-1))
         alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s_blk - m_new[..., None])    # [kvh, group, page_size]
+        p = jnp.exp(s_blk - m_new[..., None])    # [kvh, w*group, ps]
         l_ref[...] = l_prev * alpha + jnp.sum(p, axis=-1)
         pv = jax.lax.dot_general(
             p, vb, (((2,), (0,)), ((0,), (1,))),
-            preferred_element_type=jnp.float32)  # [kvh, group, dh]
+            preferred_element_type=jnp.float32)  # [kvh, w*group, dh]
         acc_ref[...] = acc_ref[...] * alpha[..., None] + pv
         m_ref[...] = m_new
 
     @pl.when(j == pl.num_programs(1) - 1)
     def _finish():
-        hl, dh = q_ref.shape[1], q_ref.shape[2]
-        # l > 0 always: position `pos` itself is valid by construction
-        ctx = acc_ref[...] / l_ref[...][..., None]
-        o_ref[...] = ctx.reshape(1, hl * dh).astype(o_ref.dtype)
+        w, hl, dh = q_ref.shape[1], q_ref.shape[2], q_ref.shape[3]
+        kvh = hl // group
+        # l > 0 for every real window row: row `pos + t` itself is valid
+        # by construction (garbage rows past the slot's window are
+        # normalized over whatever survived the mask — the engine never
+        # reads them)
+        l = jnp.where(l_ref[...] > 0.0, l_ref[...], 1.0)
+        ctx = acc_ref[...] / l[..., None]        # [kvh, w*group, dh]
+        ctx = ctx.reshape(kvh, w, group, dh).transpose(1, 0, 2, 3)
+        o_ref[...] = ctx.reshape(1, w, hl * dh).astype(o_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("group", "sliding_window"))
-def _pallas(q, k_new, v_new, k_pages, v_pages, page_table, positions,
-            group, sliding_window):
+def _pallas(q, k_new, v_new, k_pages, v_pages, k_scales, v_scales,
+            page_table, positions, group, sliding_window):
     n_pages, page_size, f = k_pages.shape
-    b, hl, dh = q.shape
+    b, w, hl, dh = q.shape
     kvh = f // dh
     pages_per_slot = page_table.shape[1]
-    # append first (donated in-place row write); the kernel then streams
-    # pages that already contain the new row — one read of the stream,
-    # one row written, no ordering hazard (the row's page is mapped)
-    k_pages = _append_rows(k_pages, k_new, page_table, positions, page_size)
-    v_pages = _append_rows(v_pages, v_new, page_table, positions, page_size)
+    # append first (donated in-place row writes); the kernel then
+    # streams pages that already contain the new rows — one read of the
+    # stream, w rows written, no ordering hazard (the rows' pages are
+    # mapped)
+    quantized = k_scales is not None
+    if quantized:
+        k_pages, k_scales = _quant_append(
+            k_pages, k_scales, k_new, page_table, positions, page_size)
+        v_pages, v_scales = _quant_append(
+            v_pages, v_scales, v_new, page_table, positions, page_size)
+    else:
+        k_pages = _append_rows(k_pages, k_new, page_table, positions,
+                               page_size)
+        v_pages = _append_rows(v_pages, v_new, page_table, positions,
+                               page_size)
     pt = jnp.minimum(page_table, n_pages - 1).astype(jnp.int32)
 
     kernel = functools.partial(
-        _decode_kernel, page_size=page_size, group=group,
-        sliding_window=sliding_window)
+        _decode_kernel, page_size=page_size, group=group, window=w,
+        quantized=quantized, sliding_window=sliding_window)
+    in_specs = [
+        pl.BlockSpec((1, w, hl, dh), lambda r, j, pt, pos: (r, 0, 0, 0)),
+        pl.BlockSpec((1, page_size, f),
+                     lambda r, j, pt, pos: (pt[r, j], 0, 0)),
+        pl.BlockSpec((1, page_size, f),
+                     lambda r, j, pt, pos: (pt[r, j], 0, 0)),
+    ]
+    inputs = [pt, positions.astype(jnp.int32), q, k_pages, v_pages]
+    if quantized:
+        # per-page scales, pre-gathered to the table layout so block
+        # (r, j) reads its own page's row — tiny f32 sidecar next to
+        # the int8 stream
+        in_specs += [
+            pl.BlockSpec((1, 1, kvh), lambda r, j, pt, pos: (r, j, 0)),
+            pl.BlockSpec((1, 1, kvh), lambda r, j, pt, pos: (r, j, 0)),
+        ]
+        inputs += [k_scales[pt], v_scales[pt]]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, pages_per_slot),
-        in_specs=[
-            pl.BlockSpec((1, hl, dh), lambda r, j, pt, pos: (r, 0, 0)),
-            pl.BlockSpec((1, page_size, f),
-                         lambda r, j, pt, pos: (pt[r, j], 0, 0)),
-            pl.BlockSpec((1, page_size, f),
-                         lambda r, j, pt, pos: (pt[r, j], 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, hl * dh), lambda r, j, pt, pos: (r, 0)),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, w, hl * dh),
+                               lambda r, j, pt, pos: (r, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((kvh, group), jnp.float32),       # running max
-            pltpu.VMEM((kvh, group), jnp.float32),       # normalizer
-            pltpu.VMEM((kvh, group, dh), jnp.float32),   # weighted acc
+            pltpu.VMEM((kvh, w * group), jnp.float32),      # running max
+            pltpu.VMEM((kvh, w * group), jnp.float32),      # normalizer
+            pltpu.VMEM((kvh, w * group, dh), jnp.float32),  # weighted acc
         ])
     ctx = pl.pallas_call(
         kernel, grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, hl * dh), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, w, hl * dh), q.dtype),
         interpret=pallas_interpret(),
-    )(pt, positions.astype(jnp.int32), q, k_pages, v_pages)
-    return ctx, k_pages, v_pages
+    )(*inputs)
+    return ctx, k_pages, v_pages, k_scales, v_scales
 
 
 def fused_paged_decode_attention(q, k_new, v_new, k_pages, v_pages,
                                  page_table, positions, *,
                                  queries_per_group: int = 1,
-                                 sliding_window=None):
+                                 sliding_window=None,
+                                 k_scales=None, v_scales=None):
     """One fused decode step for one layer over the paged KV pool.
 
     Args:
-      q: ``[b, local_heads, head_dim]`` — this step's query vectors
-        (one token per slot, rope already applied).
-      k_new, v_new: ``[b, kv_heads * head_dim]`` — this step's K/V rows.
+      q: ``[b, local_heads, head_dim]`` (single-token decode) or
+        ``[b, w, local_heads, head_dim]`` (a ``w``-row verify window —
+        speculative decoding) — query vectors, rope already applied.
+      k_new, v_new: ``[b, kv_heads * head_dim]`` (or
+        ``[b, w, kv_heads * head_dim]``) — this step's K/V rows.
       k_pages, v_pages: ``[n_pages, page_size, kv_heads * head_dim]`` —
-        the layer's page pool.
+        the layer's page pool (bf16/f32, or int8 with scales).
       page_table: ``[b, pages_per_slot]`` int32 — pool rows backing each
         slot's logical pages; unmapped entries hold the sentinel
         ``n_pages``.
       positions: ``[b]`` int32 — each slot's append index (tokens
-        already cached). The new row lands at ``positions[r]`` — its
-        page MUST be mapped (the engine allocates on demand before the
-        step) — and attention covers logical rows ``[0, positions[r]]``.
+        already cached). Window row ``t`` lands at ``positions[r] + t``
+        — its page MUST be mapped for rows the engine will read back
+        (rows past the table clamp to the sentinel and drop) — and
+        window query ``t`` attends over logical rows
+        ``[0, positions[r] + t]``.
       queries_per_group: query heads per K/V head (GQA/MQA grouping).
       sliding_window: optional Mistral-style local-attention window.
+      k_scales, v_scales: ``[n_pages, kv_heads]`` float32 per-page
+        scale sidecars — REQUIRED with int8 pools, forbidden otherwise.
 
-    Returns ``(ctx [b, local_heads * head_dim], k_pages, v_pages)`` —
-    the context rows and the pools with the new rows appended.
+    Returns ``(ctx, k_pages, v_pages)`` — plus ``k_scales, v_scales``
+    when quantized. ``ctx`` is ``[b, local_heads * head_dim]`` for
+    rank-3 ``q``, else ``[b, w, local_heads * head_dim]``.
     """
-    if q.ndim != 3:
-        raise ValueError(f"q must be [b, heads, head_dim], got {q.shape}")
+    squeeze = q.ndim == 3
+    if squeeze:
+        q = q[:, None]
+        k_new = k_new[:, None]
+        v_new = v_new[:, None]
+    if q.ndim != 4:
+        raise ValueError(
+            f"q must be [b, heads, head_dim] or [b, w, heads, head_dim], "
+            f"got {q.shape}")
     if k_pages.ndim != 3 or k_pages.shape != v_pages.shape:
         raise ValueError(
             f"pools must be matching [n_pages, page_size, kv_heads * "
             f"head_dim], got {k_pages.shape} / {v_pages.shape}")
-    b, hl, dh = q.shape
+    b, w, hl, dh = q.shape
     if hl % queries_per_group:
         raise ValueError(
             f"heads ({hl}) not divisible by queries_per_group "
             f"({queries_per_group})")
-    if k_pages.shape[-1] != (hl // queries_per_group) * dh:
+    kvh = hl // queries_per_group
+    if k_pages.shape[-1] != kvh * dh:
         raise ValueError(
             f"pool minor dim {k_pages.shape[-1]} != kv_heads * head_dim "
-            f"({hl // queries_per_group} * {dh})")
+            f"({kvh} * {dh})")
+    if (k_scales is None) != (v_scales is None):
+        raise ValueError("pass both k_scales and v_scales, or neither")
+    if (k_pages.dtype == jnp.int8) != (k_scales is not None):
+        raise ValueError(
+            f"int8 pools need scale sidecars (and only int8 pools take "
+            f"them); pool dtype {k_pages.dtype}, "
+            f"scales {'set' if k_scales is not None else 'None'}")
+    if k_scales is not None and k_scales.shape != (k_pages.shape[0], kvh):
+        raise ValueError(
+            f"scales must be [n_pages, kv_heads] = "
+            f"({k_pages.shape[0]}, {kvh}), got {k_scales.shape}")
     fn = _pallas if use_pallas() else _reference
-    return fn(q, k_new, v_new, k_pages, v_pages, page_table,
-              positions, queries_per_group, sliding_window)
+    ctx, k_pages, v_pages, k_scales, v_scales = fn(
+        q, k_new, v_new, k_pages, v_pages, k_scales, v_scales,
+        page_table, positions, queries_per_group, sliding_window)
+    if squeeze:
+        ctx = ctx[:, 0]
+    if k_scales is None:
+        return ctx, k_pages, v_pages
+    return ctx, k_pages, v_pages, k_scales, v_scales

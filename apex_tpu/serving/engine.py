@@ -70,6 +70,10 @@ from apex_tpu.models.generation import (
     preslice_layer_params,
 )
 from apex_tpu.observability import MetricsRegistry
+from apex_tpu.ops.decode_attention import (
+    paged_quant_fill,
+    paged_quant_scatter,
+)
 from apex_tpu.serving.request import (
     FINISH_CANCELLED,
     FINISH_EOS,
@@ -90,6 +94,7 @@ from apex_tpu.serving.scheduler import (
     prefill_buckets,
 )
 from apex_tpu.serving.slots import PagePool, SlotPool
+from apex_tpu.serving.speculation import propose_draft
 from apex_tpu.utils.logging import get_logger, log_event
 
 __all__ = ["EngineConfig", "InferenceEngine"]
@@ -108,7 +113,12 @@ _COUNTERS = ("requests_submitted", "requests_eos", "requests_length",
              # == paged prefills when prefix_cache is on, so hit_rate is
              # derivable; pages_shared counts prefill pages NOT recomputed
              "prefix_hits", "prefix_misses", "prefix_pages_shared",
-             "prefix_evictions")
+             "prefix_evictions",
+             # speculative decoding (docs/serving.md#speculative-decoding):
+             # proposed counts drafted positions beyond the forced first
+             # feed; accepted counts the ones the target agreed with, so
+             # accepted/proposed is the fleet-wide acceptance rate
+             "draft_tokens_proposed", "draft_tokens_accepted")
 
 
 @dataclass
@@ -143,6 +153,19 @@ class EngineConfig:
     capacity. ``prefix_lru_capacity`` bounds the index (entries; evicted
     LRU-first under page pressure). ``prefix_cache=False`` restores the
     PR 9 one-owner pool bit-for-bit.
+
+    Decode-roofline knobs (paged layout only):
+    ``kv_dtype="int8"`` (docs/serving.md#kv-quantization) stores the
+    page pools int8 with per-(page, kv-head) scale sidecars — half the
+    decode HBM stream, dequantized inline in the fused kernel;
+    ``"bf16"`` (default) is the exact path and the bisection baseline.
+    ``speculation=k`` (docs/serving.md#speculative-decoding, ``k >= 2``)
+    turns each decode tick into a k-row self-speculative verify window:
+    n-gram drafts ride the batched step and every accepted draft is one
+    more token per KV-stream read. 0 disables (the PR 9 single-token
+    step). Both knobs keep greedy streams token-exact against the
+    defaults; speculation keeps SAMPLED streams exact too (the
+    acceptance rule reproduces the sequential per-position sampling).
     """
 
     max_slots: int = 8
@@ -155,6 +178,8 @@ class EngineConfig:
     n_pages: Optional[int] = None
     prefix_cache: bool = True
     prefix_lru_capacity: int = 32
+    kv_dtype: str = "bf16"
+    speculation: int = 0
 
     def __post_init__(self):
         if self.max_slots < 1:
@@ -176,6 +201,22 @@ class EngineConfig:
             raise ValueError(
                 f"prefix_lru_capacity must be >= 0, got "
                 f"{self.prefix_lru_capacity}")
+        if self.kv_dtype not in ("bf16", "int8"):
+            raise ValueError(
+                f"kv_dtype must be 'bf16' or 'int8', got "
+                f"{self.kv_dtype!r}")
+        if self.kv_dtype == "int8" and self.kv_layout != "paged":
+            raise ValueError(
+                "kv_dtype='int8' needs kv_layout='paged' — the scales "
+                "are per-page sidecars")
+        if self.speculation < 0 or self.speculation == 1:
+            raise ValueError(
+                f"speculation is 0 (off) or a verify window >= 2, got "
+                f"{self.speculation}")
+        if self.speculation and self.kv_layout != "paged":
+            raise ValueError(
+                "speculation needs kv_layout='paged' — the verify "
+                "window rides the fused paged kernel")
 
     @property
     def pages_per_slot(self) -> int:
@@ -286,8 +327,21 @@ class InferenceEngine:
             #: model fingerprint only (K/V are sampling-invariant)
             self._prefix_salt = prefix_salt(c)
             self._evictions_seen = 0
+            self._quantized = self.config.kv_dtype == "int8"
             self._caches = init_paged_kv_caches(
-                model, n_pages, self.config.page_size)
+                model, n_pages, self.config.page_size,
+                quantized=self._quantized)
+            # HBM bytes one decode step streams per mapped page (K + V
+            # across all layers, plus the f32 scale sidecars when
+            # quantized) — the kv_bytes_per_step gauge's unit, computed
+            # from the GLOBAL head count so the number means the same
+            # thing sharded and unsharded
+            f_dim = c.kv_heads * c.head_dim
+            item = 1 if self._quantized else jnp.dtype(
+                c.compute_dtype).itemsize
+            self._page_read_bytes = 2 * c.num_layers * (
+                self.config.page_size * f_dim * item
+                + (c.kv_heads * 4 if self._quantized else 0))
             # host page table; n_pages is the unmapped sentinel (reads
             # clamp+mask, scatters drop — see ops/decode_attention.py)
             self._page_table_h = np.full(
@@ -299,6 +353,7 @@ class InferenceEngine:
             self._reserved_pages = 0
         else:
             self.pages = None
+            self._quantized = False
             self._caches = init_kv_caches(
                 model, self.config.max_slots, self.config.max_len,
                 stacked=False, flat=True)
@@ -309,12 +364,20 @@ class InferenceEngine:
         self._temps_h = np.zeros(n, np.float32)
         self._topks_h = np.full(n, self._vocab, np.int32)
         self._seeds_h = np.zeros(n, np.int32)
+        #: speculation host state: per-slot verify window (row 0 is the
+        #: token being fed — the sequential step's _tokens_h — rows 1..
+        #: the n-gram draft, padded by repeating the last real feed) and
+        #: its valid length
+        self._spec = self.config.speculation
+        if self._spec:
+            self._window_h = np.zeros((n, self._spec), np.int32)
+            self._wlen_h = np.ones(n, np.int32)
 
         donate = self.config.donate_caches
         if donate is None:
             donate = jax.default_backend() != "cpu"
 
-        decode_fn, prefill_fn, suffix_fn, scrub_fn = \
+        decode_fn, prefill_fn, suffix_fn, scrub_fn, reset_fn = \
             self._build_step_fns(donate)
         self._decode_fn = RetraceWatchdog(
             decode_fn,
@@ -332,6 +395,7 @@ class InferenceEngine:
             suffix_fn, budget=None, expected_compiles=len(self.buckets),
             name="serving_suffix_prefill", metrics=self.metrics)
         self._scrub_fn = scrub_fn
+        self._reset_scales_fn = reset_fn
 
     # -- step programs (overridable: ShardedEngine wraps these bodies in
     # -- shard_map over the device mesh) ----------------------------------
@@ -386,14 +450,54 @@ class InferenceEngine:
         finite = jnp.all(jnp.isfinite(logits), axis=-1)
         return nxt, finite, caches
 
+    def _spec_decode_body(self, params, caches, page_table, windows,
+                          positions, temps, topks, seeds):
+        # speculative decode: each slot feeds a k-token verify window
+        # (row 0 = the sequential step's token, rows 1.. the draft) in
+        # ONE forward — one read of the mapped KV stream buys up to k
+        # target samples. Sampling is per-position with the SAME
+        # fold_in(seed, position) keys the sequential step would use,
+        # and every _sample_tokens op is row-independent, so row j of
+        # the [n, k] output is bitwise what a sequential step at
+        # position + j would emit given the same fed tokens — the host
+        # acceptance loop then consumes exactly the prefix the
+        # sequential engine would have produced.
+        n, k = windows.shape
+        logits, caches = _cached_forward(
+            self.model, params, caches, windows, positions,
+            paged_state=page_table)                       # [k, n, V]
+        lf = logits.transpose(1, 0, 2).reshape(n * k, -1)
+        steps = (positions[:, None] + 1 + jnp.arange(k)[None, :]).reshape(-1)
+        nxt = _sample_tokens(lf, jnp.repeat(temps, k), jnp.repeat(topks, k),
+                             jnp.repeat(seeds, k), steps)
+        finite = jnp.all(jnp.isfinite(logits), axis=-1).T  # [n, k]
+        return nxt.reshape(n, k), finite, caches
+
     def _paged_scrub_body(self, caches, page_row):
         # zero exactly the quarantined slot's mapped pages across every
         # layer (``page_row`` is its fixed-width table row; sentinel
         # entries drop) — same NaN-hygiene contract as the flat scrub,
-        # but foreign slots' pages are never touched
+        # but foreign slots' pages are never touched. Quantized pools
+        # zero the scale sidecar too, so a recycled page starts from a
+        # clean rescale baseline (slots.PagePool.check asserts this).
+        if self._quantized:
+            return [((k.at[page_row].set(0, mode="drop"),
+                      ks.at[page_row].set(0.0, mode="drop")),
+                     (v.at[page_row].set(0, mode="drop"),
+                      vs.at[page_row].set(0.0, mode="drop")))
+                    for (k, ks), (v, vs) in caches]
         return [(k.at[page_row].set(0.0, mode="drop"),
                  v.at[page_row].set(0.0, mode="drop"))
                 for k, v in caches]
+
+    def _reset_scales_body(self, caches, page_row):
+        # zero ONLY the scale sidecar for freshly allocated pages (the
+        # int8 payload is overwritten before it can be read, but a
+        # stale scale from the previous tenant would poison the
+        # scatter-max rescale floor). No-op program for bf16 pools.
+        return [((k, ks.at[page_row].set(0.0, mode="drop")),
+                 (v, vs.at[page_row].set(0.0, mode="drop")))
+                for (k, ks), (v, vs) in caches]
 
     def _paged_prefill_body(self, params, caches, page_row, prompt,
                             prompt_len, temp, topk, seed):
@@ -414,9 +518,21 @@ class InferenceEngine:
         pad = n_chunks * ps - bucket
         dest = page_row[:n_chunks]
         new = []
-        for (bk, bv), (fk, fv) in zip(caches, flat):
+        for cache, (fk, fv) in zip(caches, flat):
             fk1 = jnp.pad(fk[0], ((0, pad), (0, 0)))
             fv1 = jnp.pad(fv[0], ((0, pad), (0, 0)))
+            if self._quantized:
+                # whole-page overwrite: the chunk IS the page content,
+                # so each page's scale comes straight from its own amax
+                # (pad rows are zeros and cannot inflate it)
+                (bk, bks), (bv, bvs) = cache
+                new.append(
+                    (paged_quant_fill(bk, bks,
+                                      fk1.reshape(n_chunks, ps, -1), dest),
+                     paged_quant_fill(bv, bvs,
+                                      fv1.reshape(n_chunks, ps, -1), dest)))
+                continue
+            bk, bv = cache
             new.append(
                 (bk.at[dest].set(fk1.reshape(n_chunks, ps, -1)
                                  .astype(bk.dtype), mode="drop"),
@@ -458,11 +574,16 @@ class InferenceEngine:
         valid_page = page_row < n_pages
         clamped = jnp.clip(page_row, 0, n_pages - 1)
         filled = []
-        for (bk, bv), (sk, sv) in zip(caches, small):
+        for cache, (sk, sv) in zip(caches, small):
             h, d = sk.shape[1], sk.shape[3]
 
-            def place(pool, sm):
+            def place(pool, sm, scales=None):
                 g = pool[clamped]                       # [pps, ps, h*d]
+                if scales is not None:
+                    # dequantize the shared-prefix rows with their pages'
+                    # sidecar scales before they enter the fp forward
+                    sc = jnp.repeat(scales[clamped], d, axis=-1)
+                    g = g.astype(jnp.float32) * sc[:, None, :]
                 # sentinel rows must read as EXACT zeros (a clamped
                 # gather could otherwise import a co-tenant's transient
                 # NaN into causally masked positions: 0-weight * NaN
@@ -471,7 +592,12 @@ class InferenceEngine:
                 g = g.reshape(s0, h, d).transpose(1, 0, 2)[None]
                 return sm.at[:, :, :s0, :].set(g.astype(sm.dtype))
 
-            filled.append((place(bk, sk), place(bv, sv)))
+            if self._quantized:
+                (bk, bks), (bv, bvs) = cache
+                filled.append((place(bk, sk, bks), place(bv, sv, bvs)))
+            else:
+                bk, bv = cache
+                filled.append((place(bk, sk), place(bv, sv)))
         logits, filled = _cached_forward(model, params, filled, suffix,
                                          start, last_index=suffix_len - 1)
         # scatter the suffix K/V into the slot's pages, one row per
@@ -484,47 +610,68 @@ class InferenceEngine:
         valid = (idx < suffix_len) & ~(skip_first & (idx == 0))
         dest_page = jnp.where(valid, dest_page, n_pages)  # drop pads
         new = []
-        for (bk, bv), (fk, fv) in zip(caches, filled):
+        for cache, (fk, fv) in zip(caches, filled):
             h, d = fk.shape[1], fk.shape[3]
 
             def rows(f):
                 r = jax.lax.dynamic_slice_in_dim(f, start, bucket, axis=2)
                 return r[0].transpose(1, 0, 2).reshape(bucket, h * d)
 
-            new.append(
-                (bk.at[dest_page, dest_off].set(
-                    rows(fk).astype(bk.dtype), mode="drop"),
-                 bv.at[dest_page, dest_off].set(
-                     rows(fv).astype(bv.dtype), mode="drop")))
+            if self._quantized:
+                # suffix rows straddle pages, so they go through the
+                # rescale-on-append scatter (sentinel dests drop; the
+                # shared boundary page's scale only grows monotonically,
+                # which every co-tenant's dequant view tolerates)
+                (bk, bks), (bv, bvs) = cache
+                new.append(
+                    (paged_quant_scatter(bk, bks, rows(fk), dest_page,
+                                         dest_off),
+                     paged_quant_scatter(bv, bvs, rows(fv), dest_page,
+                                         dest_off)))
+            else:
+                bk, bv = cache
+                new.append(
+                    (bk.at[dest_page, dest_off].set(
+                        rows(fk).astype(bk.dtype), mode="drop"),
+                     bv.at[dest_page, dest_off].set(
+                         rows(fv).astype(bv.dtype), mode="drop")))
         first = _sample_tokens(logits[0], temp[None], topk[None],
                                seed[None], prompt_len[None])
         return first[0], jnp.all(jnp.isfinite(logits)), new
 
     def _build_step_fns(self, donate: bool):
         """Compile the device programs:
-        ``(decode, prefill, suffix_prefill, scrub)`` —
+        ``(decode, prefill, suffix_prefill, scrub, reset_scales)`` —
         ``suffix_prefill`` is None under the flat layout (no pages, no
-        prefix cache). The base engine jits the bodies directly
+        prefix cache) and ``reset_scales`` is None unless the pool is
+        quantized. The base engine jits the bodies directly
         (single-chip); :class:`~apex_tpu.serving.fleet.ShardedEngine`
         overrides this to wrap each body in ``shard_map`` over the
         tensor axis first. The bodies are picked by ``kv_layout`` — both
         layouts keep the caches as argument 1 so donation and the
-        watchdogs are shared."""
+        watchdogs are shared. With ``speculation`` on, the decode
+        program is the windowed verify body (same arity: the [n] token
+        vector becomes the [n, k] window matrix)."""
         donate_args = (1,) if donate else ()
         if self.pages is not None:
-            return (jax.jit(self._paged_decode_body,
-                            donate_argnums=donate_args),
+            decode_body = (self._spec_decode_body if self._spec
+                           else self._paged_decode_body)
+            return (jax.jit(decode_body, donate_argnums=donate_args),
                     jax.jit(self._paged_prefill_body,
                             donate_argnums=donate_args),
                     jax.jit(self._suffix_prefill_body,
                             donate_argnums=donate_args),
                     jax.jit(self._paged_scrub_body,
-                            donate_argnums=(0,) if donate else ()))
+                            donate_argnums=(0,) if donate else ()),
+                    jax.jit(self._reset_scales_body,
+                            donate_argnums=(0,) if donate else ())
+                    if self._quantized else None)
         return (jax.jit(self._decode_body, donate_argnums=donate_args),
                 jax.jit(self._prefill_body, donate_argnums=donate_args),
                 None,
                 jax.jit(self._scrub_body,
-                        donate_argnums=(0,) if donate else ()))
+                        donate_argnums=(0,) if donate else ()),
+                None)
 
     # -- introspection ----------------------------------------------------
 
@@ -854,6 +1001,11 @@ class InferenceEngine:
             row = self._page_table_h[slot]
             row[:] = self.pages.n_pages
             row[:len(mapped)] = mapped
+            # freshly mapped PRIVATE pages may be recycled (e.g. from a
+            # pressure-evicted intern run) with stale scales; zero them
+            # so the rescale-on-append floor starts clean. Shared pages
+            # keep their scales — that's their dequant key.
+            self._reset_fresh_scales(mapped[shared_used:])
         try:
             if self._faults is not None:
                 self._faults.before_prefill()
@@ -937,7 +1089,41 @@ class InferenceEngine:
         if done is not None:
             finished.append(self._retire(rec, done, time.monotonic()))
 
+    def _reset_fresh_scales(self, pages) -> None:
+        """Zero the scale sidecar for freshly allocated ``pages``
+        (quantized pools only) — one fixed-width sentinel-padded row
+        through a dedicated program, so it never adds a compile shape."""
+        if not self._quantized or len(pages) == 0:
+            return
+        row = np.full(self.config.pages_per_slot, self.pages.n_pages,
+                      np.int32)
+        row[:len(pages)] = pages
+        self._caches = self._reset_scales_fn(self._caches,
+                                             jnp.asarray(row))
+
+    def _build_windows(self) -> None:
+        """Fill the per-slot verify windows for the next speculative
+        step: row 0 is the token the sequential engine would feed
+        (``last_token``), rows ``1..wl-1`` the n-gram draft over the
+        slot's own history, rows past ``wl`` repeat the last real feed
+        (causally invisible padding that cannot inflate an int8 page
+        scale). ``wl`` is clipped so a nearly-finished request cannot
+        overrun its ``max_new_tokens`` page reservation."""
+        k = self._spec
+        for slot in sorted(self._active):
+            rec = self._active[slot]
+            wl = max(1, min(
+                k, rec.request.max_new_tokens - len(rec.tokens)))
+            draft = propose_draft(
+                list(rec.request.prompt) + rec.tokens, wl - 1)
+            window = [rec.last_token] + draft
+            window += [window[-1]] * (k - wl)
+            self._window_h[slot] = window
+            self._wlen_h[slot] = wl
+
     def _decode_tick(self, finished: List[RequestResult]) -> None:
+        if self._spec and self._active:
+            self._build_windows()
         if self.pages is not None:
             self._extend_pages(finished)
         if not self._active:
@@ -945,10 +1131,19 @@ class InferenceEngine:
         if self._faults is not None:
             self._faults.before_decode()
         if self.pages is not None:
+            # roofline gauge: bytes of KV stream one decode step reads
+            # (mapped pages of every active slot, dtype- and sidecar-
+            # aware) — THE denominator speculation and int8 shrink
+            self.metrics.set_gauge(
+                "kv_bytes_per_step",
+                sum(len(self.pages.slot_pages(s)) for s in self._active)
+                * self._page_read_bytes)
+            fed = (jnp.asarray(self._window_h) if self._spec
+                   else jnp.asarray(self._tokens_h))
             nxt, finite, self._caches = self._decode_fn(
                 self._params, self._caches,
                 jnp.asarray(self._page_table_h),
-                jnp.asarray(self._tokens_h), jnp.asarray(self._positions_h),
+                fed, jnp.asarray(self._positions_h),
                 jnp.asarray(self._temps_h), jnp.asarray(self._topks_h),
                 jnp.asarray(self._seeds_h))
         else:
@@ -964,6 +1159,9 @@ class InferenceEngine:
         self.metrics.inc("decode_steps")
         self.metrics.observe("decode_batch_size", len(self._active))
         now = time.monotonic()
+        if self._spec:
+            self._accept_windows(nxt, finite, now, finished)
+            return
         for slot in sorted(self._active):
             rec = self._active[slot]
             token = int(nxt[slot])
@@ -985,6 +1183,59 @@ class InferenceEngine:
             if done is not None:
                 finished.append(self._retire(rec, done, now))
 
+    def _accept_windows(self, nxt, finite, now: float,
+                        finished: List[RequestResult]) -> None:
+        """Consume each slot's verified window: walk positions left to
+        right, keep the target's sample at row ``j`` only while the
+        token FED at row ``j`` was itself the target's previous output
+        — the first disagreement invalidates everything to its right
+        (those rows attended to a token the sequential engine would
+        never have fed; their K/V rows are garbage the next window
+        overwrites). Row 0 is always the sequential feed, so every
+        step emits >= 1 token; a window is never slower than plain
+        decode, only cheaper per token when drafts land."""
+        for slot in sorted(self._active):
+            rec = self._active[slot]
+            wl = int(self._wlen_h[slot])
+            consumed = 0
+            quarantined = done = None
+            for j in range(wl):
+                token = int(nxt[slot, j])
+                if not bool(finite[slot, j]) or \
+                        not 0 <= token < self._vocab:
+                    quarantined = ("nonfinite_logits"
+                                   if not bool(finite[slot, j])
+                                   else "out_of_vocab_token")
+                    break
+                rec.position += 1     # row j's fed K/V are now cached
+                rec.tokens.append(token)
+                rec.last_token = token
+                rec.last_token_ts = now
+                consumed += 1
+                self.metrics.inc("tokens_generated")
+                done = self._finish_reason(rec, token)
+                if done is not None:
+                    break
+                if j + 1 >= wl or int(self._window_h[slot, j + 1]) != token:
+                    break             # draft diverged from the target
+            # rows 1..wl-1 were drafted; the drafts the walk consumed
+            # BEYOND the mandatory row-0 token are the accepted ones
+            proposed = wl - 1
+            accepted = max(0, consumed - 1)
+            if proposed:
+                self.metrics.inc("draft_tokens_proposed", proposed)
+                self.metrics.inc("draft_tokens_accepted", accepted)
+                self.metrics.observe("spec_accept_rate",
+                                     accepted / proposed)
+            if quarantined is not None:
+                # poisoned at any window row: quarantine the slot even
+                # if clean tokens landed first — its KV is suspect
+                finished.append(self._quarantine(rec, quarantined, now))
+                continue
+            self._sync_slot(rec)
+            if done is not None:
+                finished.append(self._retire(rec, done, now))
+
     def _extend_pages(self, finished: List[RequestResult]) -> None:
         """On-demand page growth before the decode step: every active
         slot must have the page backing row ``position`` mapped (the
@@ -995,7 +1246,12 @@ class InferenceEngine:
         now = time.monotonic()
         for slot in sorted(self._active):
             rec = self._active[slot]
-            fresh = self.pages.extend_slot(slot, rec.position + 1)
+            # a speculative step appends K/V for the whole verify
+            # window (positions position..position+wl-1); wl is clipped
+            # to the request's max_new_tokens, so the target stays
+            # within the admission reservation
+            grow = int(self._wlen_h[slot]) if self._spec else 1
+            fresh = self.pages.extend_slot(slot, rec.position + grow)
             if fresh is None:
                 self.metrics.inc("requests_shed_pages")
                 log_event(_LOG, "request_shed",
@@ -1011,6 +1267,7 @@ class InferenceEngine:
                 row = self._page_table_h[slot]
                 pages = self.pages.slot_pages(slot)
                 row[len(pages) - len(fresh):len(pages)] = fresh
+                self._reset_fresh_scales(fresh)
 
     # -- retirement & bookkeeping ----------------------------------------
 
@@ -1063,6 +1320,9 @@ class InferenceEngine:
         self._temps_h[slot] = 0.0
         self._topks_h[slot] = self._vocab
         self._seeds_h[slot] = 0
+        if self._spec:
+            self._window_h[slot] = 0
+            self._wlen_h[slot] = 1
 
     def _retire(self, rec: _Active, reason: str, now: float, *,
                 scrub: bool = False) -> RequestResult:
@@ -1082,6 +1342,9 @@ class InferenceEngine:
                 row[:len(freed)] = freed
                 self._caches = self._scrub_fn(self._caches,
                                               jnp.asarray(row))
+                # PagePool.check() can now assert these free pages hold
+                # zero scales until their next allocation
+                self.pages.note_scrubbed(freed)
         self._clear_slot(rec.slot)
         return self._finish(
             rec.request, rec.tokens, reason, submit_ts=rec.submit_ts,

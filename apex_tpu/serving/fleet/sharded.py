@@ -100,9 +100,17 @@ class ShardedEngine(InferenceEngine):
         head_dim]`` pool — shard the same fused heads*head_dim minor dim
         over the tensor axis: each rank's contiguous block is exactly
         the head slice its QKV projection produces (page tables stay
-        host-side/replicated; the mapping is identical on every rank)."""
+        host-side/replicated; the mapping is identical on every rank).
+        Quantized pools nest the per-page scale sidecar ``[n_pages,
+        kv_heads]`` alongside each int8 pool, sharded on ITS heads dim —
+        every rank holds exactly the scales of the head block it owns,
+        so quantize/rescale/dequant stay rank-local too."""
         axis = self.model.config.axis_name
-        pair = (P(None, None, axis), P(None, None, axis))
+        if getattr(self, "_quantized", False):
+            half = (P(None, None, axis), P(None, axis))
+            pair = (half, half)
+        else:
+            pair = (P(None, None, axis), P(None, None, axis))
         return [pair for _ in range(self.model.config.num_layers)]
 
     def _build_step_fns(self, donate: bool):
@@ -116,11 +124,17 @@ class ShardedEngine(InferenceEngine):
         pspec = self._param_spec()
         cspec = self._cache_spec()
         rep = P()
+        reset = None
         if self.pages is not None:
             # paged bodies take one extra replicated arg (the page
-            # table / the slot's table row) right after the pool
+            # table / the slot's table row) right after the pool. The
+            # speculative verify body has the SAME arity — the [n]
+            # token vector becomes the [n, k] window matrix, still
+            # replicated — so the spec structure is unchanged.
+            decode_body = (self._spec_decode_body if self._spec
+                           else self._paged_decode_body)
             decode = shard_map(
-                self._paged_decode_body, mesh=mesh,
+                decode_body, mesh=mesh,
                 in_specs=(pspec, cspec, rep, rep, rep, rep, rep, rep),
                 out_specs=(rep, rep, cspec))
             prefill = shard_map(
@@ -139,6 +153,10 @@ class ShardedEngine(InferenceEngine):
             scrub = shard_map(
                 self._paged_scrub_body, mesh=mesh,
                 in_specs=(cspec, rep), out_specs=cspec)
+            if self._quantized:
+                reset = shard_map(
+                    self._reset_scales_body, mesh=mesh,
+                    in_specs=(cspec, rep), out_specs=cspec)
         else:
             decode = shard_map(
                 self._decode_body, mesh=mesh,
@@ -157,4 +175,6 @@ class ShardedEngine(InferenceEngine):
                 jax.jit(prefill, donate_argnums=donate_args),
                 None if suffix is None else
                 jax.jit(suffix, donate_argnums=donate_args),
-                jax.jit(scrub, donate_argnums=(0,) if donate else ()))
+                jax.jit(scrub, donate_argnums=(0,) if donate else ()),
+                None if reset is None else
+                jax.jit(reset, donate_argnums=(0,) if donate else ()))

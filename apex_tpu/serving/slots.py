@@ -140,6 +140,11 @@ class PagePool:
         #: cumulative intern-entry evictions (capacity + pressure) — the
         #: engine snapshots deltas into its ``prefix_evictions`` counter
         self.evictions = 0
+        #: free pages the engine's quarantine scrub has zeroed (content
+        #: AND, on quantized pools, the scale sidecar) — tracked so
+        #: :meth:`check` can assert the zero-scale invariant on them;
+        #: membership ends at the page's next allocation
+        self._scrubbed: set = set()
 
     # -- introspection ----------------------------------------------------
 
@@ -292,6 +297,7 @@ class PagePool:
         pages = [heapq.heappop(self._free) for _ in range(k)]
         for p in pages:
             self._refs[p] = self._refs.get(p, 0) + 1
+            self._scrubbed.discard(p)   # allocated: may be written again
         return pages
 
     # -- slot mapping -----------------------------------------------------
@@ -366,6 +372,18 @@ class PagePool:
         self._owned[slot].extend(fresh)
         return fresh
 
+    def note_scrubbed(self, pages: Sequence[int]) -> None:
+        """Record that the engine zeroed these FREE pages (quarantine
+        hygiene). On quantized pools the scrub also zeroes the scale
+        sidecar, and :meth:`check` asserts that stays true until the
+        page is allocated again."""
+        for p in pages:
+            if p in self._refs:
+                raise PageError(
+                    f"scrub of referenced page {p} — the scrub program "
+                    f"must only touch pages whose last reference dropped")
+            self._scrubbed.add(p)
+
     def release_slot(self, slot: int) -> List[int]:
         """Drop all of ``slot``'s references; returns the pages whose
         LAST reference this release dropped (now back on the free heap —
@@ -393,15 +411,36 @@ class PagePool:
         self._shared.clear()
         self._owned.clear()
         self._interned.clear()
+        self._scrubbed.clear()
         self.check()
 
-    def check(self) -> None:
+    def check(self, k_scales=None, v_scales=None) -> None:
         """Assert refcount conservation; raises :class:`PageError`.
 
         Every page's refcount must equal its slot-list memberships plus
         intern-entry memberships; the free heap and the referenced set
         partition ``n_pages`` exactly; no slot maps a page twice or
-        exceeds ``pages_per_slot``."""
+        exceeds ``pages_per_slot``. With a quantized pool's scale
+        sidecars (``k_scales``/``v_scales``, ``[n_pages, kv_heads]``
+        arrays — pass one layer's), additionally asserts every page the
+        scrub zeroed (:meth:`note_scrubbed`) still carries all-zero
+        scales while free — the invariant that keeps a recycled page's
+        rescale floor clean."""
+        import numpy as _np
+        for name, scales in (("k", k_scales), ("v", v_scales)):
+            if scales is None:
+                continue
+            sc = _np.asarray(scales)
+            if sc.shape[0] != self.n_pages:
+                raise PageError(
+                    f"{name}_scales has {sc.shape[0]} rows, pool has "
+                    f"{self.n_pages} pages")
+            stale = [p for p in sorted(self._scrubbed)
+                     if p not in self._refs and sc[p].any()]
+            if stale:
+                raise PageError(
+                    f"scrubbed free pages carry nonzero {name} scales: "
+                    f"{stale[:8]} — scrub/reset must zero the sidecar")
         expect: Dict[int, int] = {}
         holders = list(self._shared.values()) + list(self._owned.values()) \
             + list(self._interned.values())

@@ -14,7 +14,10 @@ GPT-2 124M:
     dispatch noise ADDS in a difference and inflated bs1 past the
     physical bound — see bench_decode); each row carries its fraction
     of the weight+KV read-bandwidth bound (decode reads every
-    parameter once per token);
+    parameter once per token), with the bound dtype- and page-aware —
+    paged rows count only the pages the layout streams, and the int8
+    rows (``kv_dtype="int8"``) count the quantized pool + scale
+    sidecar, not the bf16 stream they replaced;
   * serving mode — mixed prompt lengths through the continuous-batching
     InferenceEngine vs. lockstep generate() at matched load: tokens/sec
     plus p50/p95 per-request latency (lockstep has one latency — every
@@ -190,11 +193,14 @@ def bench_decode(model, params, batch, prompt_len=128, chain=None):
     dt = _time(decode_chain, params, caches, first, steps=2) / chain
     tps = batch / dt
     bw = _hbm_bw()
+    step_bytes = _decode_read_bytes(model, batch, S)
     row = {
         "metric": f"gpt2_124m_decode_bs{batch}_tokens_per_sec_per_chip",
         "value": round(tps, 1), "unit": "tokens/sec", "vs_baseline": 1.0,
         "config": {"prompt_len": prompt_len, "decode_only": True,
                    "cache_len": S,
+                   "kv_dtype": str(jnp.dtype(c.compute_dtype)),
+                   "read_bytes_per_step": int(step_bytes),
                    "method": f"in-jit scan of {chain} decode steps over a "
                              f"prefilled cache (single dispatch; overhead "
                              f"biases tok/s low => pct_of_bound <= 1 by "
@@ -202,32 +208,44 @@ def bench_decode(model, params, batch, prompt_len=128, chain=None):
     if bw is not None:
         # the attention physically reads all S cache slots every step (full
         # static buffer + mask), so the bound counts the full cache
-        bound_steps = bw / _decode_read_bytes(model, batch, S)
+        bound_steps = bw / step_bytes
         row["pct_of_read_bw_bound"] = round(tps / (batch * bound_steps), 3)
         row["config"]["hbm_bw_gbps"] = round(bw / 1e9)
     print(json.dumps(row))
     return tps
 
 
-def _paged_read_bytes(model, batch, tokens_streamed):
+def _paged_read_bytes(model, batch, tokens_streamed, *, page_size,
+                      kv_dtype=None):
     """HBM bytes one PAGED decode step must read: every parameter plus
     only the pages actually streamed (``pages_for(pos+1)`` per slot —
     the kernel skips pages past each slot's valid length, where the flat
     layout always reads the full static ``S`` window). This is the paged
     roofline numerator: the bound counts the bytes the layout makes
-    mandatory, so flat and paged rows are held to their OWN floor."""
+    mandatory, so flat and paged rows are held to their OWN floor.
+
+    Dtype-aware: the KV term uses the POOL's itemsize, not the compute
+    dtype's — ``kv_dtype="int8"`` halves the mandatory stream vs bf16 —
+    plus, when quantized, the per-(page, kv-head) float32 scale sidecar
+    the kernel reads to dequantize (4 bytes per kv head per streamed
+    page, for each of k and v, per layer)."""
     c = model.config
     itemsize = jnp.dtype(c.compute_dtype).itemsize
     n_params = sum(
         np.prod(s.shape) for s in jax.tree.leaves(
             jax.eval_shape(model.init, jax.random.PRNGKey(0))))
+    kv_itemsize = jnp.dtype(kv_dtype or c.compute_dtype).itemsize
     kv_bytes = (c.num_layers * 2 * batch * c.kv_heads * tokens_streamed
-                * c.head_dim * itemsize)
+                * c.head_dim * kv_itemsize)
+    if kv_dtype is not None and jnp.dtype(kv_dtype) == jnp.int8:
+        pages_streamed = tokens_streamed / page_size
+        kv_bytes += c.num_layers * 2 * batch * c.kv_heads * pages_streamed * 4
     return n_params * itemsize + kv_bytes
 
 
 def bench_decode_paged(model, params, batch, prompt_len=128, page_size=32,
-                       mode="fused", chain=None, unroll=8, flat_tps=None):
+                       mode="fused", chain=None, unroll=8, flat_tps=None,
+                       kv_dtype=None):
     """Decode-only tokens/sec over the PAGED KV pool, fused vs unfused.
 
     Same instrument philosophy as :func:`bench_decode` — prefill outside
@@ -246,7 +264,14 @@ def bench_decode_paged(model, params, batch, prompt_len=128, page_size=32,
     ``pct_of_read_bw_bound`` divides by the paged layout's ACTUAL
     mandatory bytes (:func:`_paged_read_bytes`): pages holding
     ``pos + 1`` tokens per slot, averaged over the cycled write
-    positions — not the flat path's full static window."""
+    positions — not the flat path's full static window.
+
+    ``kv_dtype="int8"`` runs the quantized pool (``(pages, scales)``
+    per side, the engine's ``kv_dtype`` knob): the dense prefill is
+    whole-page-quantized outside the timed region and the bound is
+    recomputed against the int8 stream + scale sidecar, so the row
+    shows whether the kernel converts the smaller mandatory stream
+    into steps/sec rather than being flattered by a bf16 denominator."""
     from apex_tpu.models.generation import init_paged_kv_caches
     from apex_tpu.ops import _support
 
@@ -278,6 +303,19 @@ def bench_decode_paged(model, params, batch, prompt_len=128, page_size=32,
         caches.append(tuple(
             x.reshape(batch * pps, page_size, x.shape[-1]) for x in (k, v)))
     del dense
+    quant = kv_dtype is not None and jnp.dtype(kv_dtype) == jnp.int8
+    if quant:
+        # whole-page quantize the prefilled pool (the engine's prefill
+        # chunk path), outside the timed region: int8 pages + per-(page,
+        # kv-head) float32 scale sidecar per side
+        from apex_tpu.ops.decode_attention import paged_quant_fill
+        dest = jnp.arange(n_pages, dtype=jnp.int32)
+        caches = [
+            tuple(paged_quant_fill(jnp.zeros(x.shape, jnp.int8),
+                                   jnp.zeros((n_pages, c.kv_heads),
+                                             jnp.float32), x, dest)
+                  for x in (k, v))
+            for k, v in caches]
     page_table = jnp.arange(n_pages, dtype=jnp.int32).reshape(batch, pps)
     params = preslice_layer_params(params, c.num_layers)
 
@@ -324,16 +362,24 @@ def bench_decode_paged(model, params, batch, prompt_len=128, page_size=32,
     all_pos = (bases[:, None] + np.arange(unroll)[None, :]).ravel()
     tokens_streamed = float(np.mean(
         (all_pos // page_size + 1) * page_size))
+    tag = "_int8" if quant else ""
+    # bytes one step MUST stream under THIS pool dtype — the row's own
+    # roofline denominator, and (sans params) the kv_bytes_per_step
+    # gauge the serving engine exports for the same layout
+    step_bytes = _paged_read_bytes(model, batch, tokens_streamed,
+                                   page_size=page_size, kv_dtype=kv_dtype)
     row = {
-        "metric": f"gpt2_124m_decode_paged_{mode}_bs{batch}"
+        "metric": f"gpt2_124m_decode_paged_{mode}{tag}_bs{batch}"
                   f"_tokens_per_sec_per_chip",
         "value": round(tps, 1), "unit": "tokens/sec",
         "vs_baseline": round(tps / flat_tps, 3) if flat_tps else 1.0,
         "config": {"prompt_len": prompt_len, "decode_only": True,
                    "kv_layout": "paged", "mode": mode,
+                   "kv_dtype": str(jnp.dtype(kv_dtype or c.compute_dtype)),
                    "page_size": page_size, "pages_per_slot": pps,
                    "n_pages": n_pages, "cache_len": S,
                    "avg_tokens_streamed": round(tokens_streamed, 1),
+                   "read_bytes_per_step": int(step_bytes),
                    "method": f"host loop of jitted {unroll}-step unrolled "
                              f"paged decode programs, {chain} steps total "
                              f"(prefill untimed; dispatch biases tok/s "
@@ -341,7 +387,7 @@ def bench_decode_paged(model, params, batch, prompt_len=128, page_size=32,
                              f"bench_decode row"}}
     bw = _hbm_bw()
     if bw is not None:
-        bound_steps = bw / _paged_read_bytes(model, batch, tokens_streamed)
+        bound_steps = bw / step_bytes
         row["pct_of_read_bw_bound"] = round(tps / (batch * bound_steps), 3)
         row["config"]["hbm_bw_gbps"] = round(bw / 1e9)
     print(json.dumps(row))
@@ -533,6 +579,10 @@ def main():
         for mode in ("fused", "unfused"):
             bench_decode_paged(model, params, batch=b, mode=mode,
                                flat_tps=flat)
+        # int8 pool: same fused dispatch, roughly half the mandatory
+        # stream — the quantization win at identical layout
+        bench_decode_paged(model, params, batch=b, mode="fused",
+                           kv_dtype="int8", flat_tps=flat)
     bench_serving(model, params)
     bench_serving_prefix(model, params)
 

@@ -44,6 +44,7 @@ MODULES = [
     "apex_tpu.serving",
     "apex_tpu.serving.fleet",
     "apex_tpu.serving.prefix",
+    "apex_tpu.serving.speculation",
     "apex_tpu.testing_faults",
     "apex_tpu.training",
     "apex_tpu.transformer",

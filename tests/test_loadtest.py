@@ -152,6 +152,55 @@ class TestScenarioSchema:
             Scenario.from_dict(_scenario_dict(engine={
                 "max_slots": 4, "max_len": 32, "kv_layout": "ragged"}))
 
+    def test_kv_dtype_and_speculation_round_trip(self):
+        scn = Scenario.from_dict(_scenario_dict(engine={
+            "max_slots": 4, "max_len": 32, "max_queue": 16,
+            "kv_dtype": "int8", "speculation": 3}))
+        assert scn.engine.kv_dtype == "int8"
+        assert scn.engine.speculation == 3
+        again = Scenario.from_dict(scn.to_dict())
+        assert again.to_dict() == scn.to_dict()
+        # defaults stay absent: a pre-existing scenario file's dict form
+        # is unchanged by the new knobs
+        plain = Scenario.from_dict(_scenario_dict())
+        assert "kv_dtype" not in plain.to_dict()["engine"]
+        assert "speculation" not in plain.to_dict()["engine"]
+
+    def test_bad_kv_dtype_and_speculation_rejected(self):
+        with pytest.raises(ValueError, match="kv_dtype"):
+            Scenario.from_dict(_scenario_dict(engine={
+                "max_slots": 4, "max_len": 32, "kv_dtype": "fp4"}))
+        with pytest.raises(ValueError, match="needs kv_layout='paged'"):
+            Scenario.from_dict(_scenario_dict(engine={
+                "max_slots": 4, "max_len": 32, "kv_layout": "flat",
+                "kv_dtype": "int8"}))
+        with pytest.raises(ValueError, match="speculation"):
+            Scenario.from_dict(_scenario_dict(engine={
+                "max_slots": 4, "max_len": 32, "speculation": 1}))
+        with pytest.raises(ValueError, match="needs kv_layout='paged'"):
+            Scenario.from_dict(_scenario_dict(engine={
+                "max_slots": 4, "max_len": 32, "kv_layout": "flat",
+                "speculation": 2}))
+
+    def test_prompt_period_round_trip_and_validation(self):
+        d = _scenario_dict()
+        d["phases"][0]["prompt_period"] = 4
+        scn = Scenario.from_dict(d)
+        assert scn.phases[0].prompt_period == 4
+        assert Scenario.from_dict(scn.to_dict()).to_dict() == scn.to_dict()
+        assert "prompt_period" not in \
+            Scenario.from_dict(_scenario_dict()).to_dict()["phases"][0]
+        d["phases"][0]["prompt_period"] = -1
+        with pytest.raises(ValueError, match="prompt_period"):
+            Scenario.from_dict(d)
+
+    def test_prompt_period_tiles_prompts(self):
+        d = _scenario_dict()
+        d["phases"][0]["prompt_period"] = 2
+        for s in TrafficGenerator(Scenario.from_dict(d)).schedule():
+            p = s.request.prompt
+            assert p == (p[:2] * len(p))[:len(p)]
+
     def test_fault_schedule_round_trip(self):
         fs = FaultSchedule.from_dict({
             "decode_raise_calls": [3], "decode_hang": {"5": 1.5},

@@ -206,14 +206,24 @@ def _rand_paged_case(seed, b=3, kvh=2, group=2, dh=8, page_size=8, pps=4,
     return q, k_new, v_new, k_pages, v_pages, jnp.asarray(pt), positions
 
 
+def _single(fn, case, group, sliding_window=None):
+    """Call the windowed internals (:func:`_pallas` / :func:`_reference`)
+    on an old-style single-token case: w == 1, no quantization."""
+    q, k_new, v_new, kp, vp, pt, pos = case
+    ctx, kk, vk, _, _ = fn(q[:, None], k_new[:, None], v_new[:, None],
+                           kp, vp, None, None, pt, pos,
+                           group=group, sliding_window=sliding_window)
+    return ctx[:, 0], kk, vk
+
+
 class TestFusedKernelParity:
     def test_interpret_matches_reference(self, monkeypatch):
         monkeypatch.setenv("APEX_TPU_FORCE_PALLAS", "interpret")
         _support.pallas_mode.cache_clear()
         try:
             case = _rand_paged_case(0)
-            ctx_k, kk, vk = _pallas(*case, group=2, sliding_window=None)
-            ctx_r, kr, vr = _reference(*case, group=2, sliding_window=None)
+            ctx_k, kk, vk = _single(_pallas, case, group=2)
+            ctx_r, kr, vr = _single(_reference, case, group=2)
             np.testing.assert_allclose(ctx_k, ctx_r, atol=2e-5, rtol=2e-5)
             # the append is the same scatter on both paths: exact
             np.testing.assert_array_equal(kk, kr)
@@ -226,8 +236,9 @@ class TestFusedKernelParity:
         _support.pallas_mode.cache_clear()
         try:
             case = _rand_paged_case(1)
-            ctx_k, _, _ = _pallas(*case, group=2, sliding_window=5)
-            ctx_r, _, _ = _reference(*case, group=2, sliding_window=5)
+            ctx_k, _, _ = _single(_pallas, case, group=2, sliding_window=5)
+            ctx_r, _, _ = _single(_reference, case, group=2,
+                                  sliding_window=5)
             np.testing.assert_allclose(ctx_k, ctx_r, atol=2e-5, rtol=2e-5)
         finally:
             _support.pallas_mode.cache_clear()
@@ -237,8 +248,8 @@ class TestFusedKernelParity:
         _support.pallas_mode.cache_clear()
         try:
             case = _rand_paged_case(2, kvh=4, group=1)
-            ctx_k, _, _ = _pallas(*case, group=1, sliding_window=None)
-            ctx_r, _, _ = _reference(*case, group=1, sliding_window=None)
+            ctx_k, _, _ = _single(_pallas, case, group=1)
+            ctx_r, _, _ = _single(_reference, case, group=1)
             np.testing.assert_allclose(ctx_k, ctx_r, atol=2e-5, rtol=2e-5)
         finally:
             _support.pallas_mode.cache_clear()
@@ -249,7 +260,7 @@ class TestFusedKernelParity:
         case = _rand_paged_case(3)
         ctx, kk, vk = fused_paged_decode_attention(
             *case, queries_per_group=2)
-        ctx_r, kr, vr = _reference(*case, group=2, sliding_window=None)
+        ctx_r, kr, vr = _single(_reference, case, group=2)
         np.testing.assert_array_equal(ctx, ctx_r)
         np.testing.assert_array_equal(kk, kr)
 
