@@ -54,7 +54,8 @@ class ScheduledRequest:
         r = self.request
         return (round(self.at_s, 9), self.phase, tuple(r.prompt),
                 r.max_new_tokens, r.eos_token, r.deadline_s,
-                r.sampling.temperature, r.sampling.top_k, r.sampling.seed)
+                r.sampling.temperature, r.sampling.top_k, r.sampling.seed,
+                r.sampling.adapter_id)
 
 
 def _choose(rng: random.Random, mix: Dict[int, float]) -> int:
@@ -122,12 +123,21 @@ class TrafficGenerator:
             else 0.7
         top_k = rng.choice(phase.top_ks) if phase.top_ks else 0
         seed = rng.randrange(2 ** 31)
+        # the adapter draw comes LAST and only for phases that declare a
+        # mix, so adapter-free scenarios consume the exact same stream
+        # as before multi-LoRA existed (byte-identical schedules)
+        adapter_id = None
+        if phase.adapter_mix:
+            ids = sorted(phase.adapter_mix)
+            drawn = rng.choices(
+                ids, weights=[phase.adapter_mix[a] for a in ids])[0]
+            adapter_id = None if drawn == "base" else drawn
         if greedy_draw < phase.greedy_fraction:
-            sampling = SamplingParams()          # greedy
+            sampling = SamplingParams(adapter_id=adapter_id)   # greedy
         else:
             sampling = SamplingParams(
                 temperature=temp, top_k=top_k if top_k > 0 else None,
-                seed=seed)
+                seed=seed, adapter_id=adapter_id)
         return Request(prompt=prompt, max_new_tokens=max_new,
                        sampling=sampling, eos_token=phase.eos_token,
                        deadline_s=deadline)

@@ -49,6 +49,7 @@ from apex_tpu.serving import (
     RequestResult,
     SchedulerConfig,
     SupervisorConfig,
+    UnknownAdapterError,
 )
 from apex_tpu.utils.logging import get_logger, log_event
 
@@ -116,6 +117,22 @@ def _build_serving(scenario: Scenario, model, params,
     from apex_tpu.testing_faults import ServingFaultInjector
 
     knobs = scenario.engine
+    adapters = None
+    if knobs.lora_adapters:
+        # seeded adapter store: ids "0".."n-1", each a random rank-r
+        # adapter keyed by the scenario seed — reproducible per-tenant
+        # weights, the same way build_model seeds the base model
+        import jax
+
+        from apex_tpu.lora import AdapterStore, random_adapter
+
+        adapters = AdapterStore(model.config, knobs.lora_rank,
+                                max_adapters=knobs.lora_adapters)
+        keys = jax.random.split(jax.random.PRNGKey(scenario.seed),
+                                knobs.lora_adapters)
+        for ix in range(knobs.lora_adapters):
+            adapters.load(str(ix), random_adapter(
+                model.config, knobs.lora_rank, keys[ix]))
     engine_cfg = EngineConfig(
         max_slots=knobs.max_slots, max_len=knobs.max_len,
         kv_layout=knobs.kv_layout, page_size=knobs.page_size,
@@ -140,10 +157,10 @@ def _build_serving(scenario: Scenario, model, params,
             fleet=FleetConfig(n_replicas=fl.n_replicas,
                               migrate_on_drain=fl.migrate_on_drain,
                               probe_on_rebuild=fl.probe_on_rebuild),
-            metrics=metrics, faults=faults)
+            metrics=metrics, faults=faults, adapters=adapters)
     return EngineSupervisor(model, params, engine_cfg,
                             supervisor=sup_cfg, metrics=metrics,
-                            faults=faults)
+                            faults=faults, adapters=adapters)
 
 
 def run_scenario(scenario: Scenario, *, model=None, params=None,
@@ -219,7 +236,7 @@ def run_scenario(scenario: Scenario, *, model=None, params=None,
                 try:
                     sup.submit(req)
                 except (EngineUnavailableError, QueueFullError,
-                        DeadlineExpiredError):
+                        DeadlineExpiredError, UnknownAdapterError):
                     pass        # recorded terminally by the supervisor
             if sup.inflight_count:
                 sup.tick()

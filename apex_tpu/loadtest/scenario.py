@@ -108,7 +108,11 @@ class EngineKnobs:
     (docs/serving.md#kv-quantization) and ``speculation=k`` turns on
     k-row speculative verify windows
     (docs/serving.md#speculative-decoding) — both paged-only, like the
-    engine knobs they mirror."""
+    engine knobs they mirror. ``lora_adapters``/``lora_rank`` > 0 serve
+    the traffic through a LoRA :class:`~apex_tpu.lora.AdapterStore` of
+    that many seeded rank-``lora_rank`` adapters (ids ``"0"`` ..
+    ``"n-1"``), which phases address via ``adapter_mix``
+    (docs/serving.md#multi-lora)."""
 
     max_slots: int = 4
     max_len: int = 64
@@ -121,6 +125,8 @@ class EngineKnobs:
     prefix_lru_capacity: int = 32
     kv_dtype: str = "bf16"
     speculation: int = 0
+    lora_rank: int = 0
+    lora_adapters: int = 0
 
     def __post_init__(self):
         if self.kv_layout not in ("flat", "paged"):
@@ -149,6 +155,15 @@ class EngineKnobs:
             raise ValueError(
                 "speculation needs kv_layout='paged' (the windowed "
                 "verify rides the paged kernel)")
+        if self.lora_rank < 0 or self.lora_adapters < 0:
+            raise ValueError(
+                f"lora_rank/lora_adapters must be >= 0, got "
+                f"{self.lora_rank}/{self.lora_adapters}")
+        if bool(self.lora_rank) != bool(self.lora_adapters):
+            raise ValueError(
+                f"lora_rank ({self.lora_rank}) and lora_adapters "
+                f"({self.lora_adapters}) must be set together (both 0 "
+                f"= no adapter store)")
 
     @classmethod
     def from_dict(cls, data: Dict[str, Any]) -> "EngineKnobs":
@@ -182,6 +197,9 @@ class EngineKnobs:
             out["kv_dtype"] = self.kv_dtype
         if self.speculation:
             out["speculation"] = self.speculation
+        if self.lora_adapters:
+            out["lora_rank"] = self.lora_rank
+            out["lora_adapters"] = self.lora_adapters
         return out
 
 
@@ -203,7 +221,13 @@ class LoadPhase:
     ``prompt_period`` > 0 makes each prompt PERIODIC (its tokens repeat
     with that period) — the repeated-text traffic shape whose n-gram
     structure the self-speculative drafter exploits
-    (docs/serving.md#speculative-decoding).
+    (docs/serving.md#speculative-decoding). ``adapter_mix`` is a
+    ``{adapter_id: weight}`` draw over the LoRA tenants each request
+    serves under — the special id ``"base"`` means no adapter; every
+    other id must name an adapter the engine's store holds (the runner
+    loads ids ``"0"`` .. ``"lora_adapters-1"``). Empty = all-base
+    traffic with NO extra generator draws, so pre-LoRA scenarios
+    reproduce byte-identical schedules (docs/serving.md#multi-lora).
     """
 
     name: str
@@ -220,6 +244,7 @@ class LoadPhase:
     eos_token: Optional[int] = None
     shared_prefix_len: int = 0
     prompt_period: int = 0
+    adapter_mix: Dict[str, float] = field(default_factory=dict)
 
     def __post_init__(self):
         if self.n_requests < 1:
@@ -267,6 +292,15 @@ class LoadPhase:
             raise ValueError(
                 f"phase {self.name!r}: prompt_period must be >= 0, "
                 f"got {self.prompt_period}")
+        for aid, w in self.adapter_mix.items():
+            if not isinstance(aid, str) or not aid:
+                raise ValueError(
+                    f"phase {self.name!r}: adapter_mix keys must be "
+                    f"non-empty strings, got {aid!r}")
+            if float(w) <= 0:
+                raise ValueError(
+                    f"phase {self.name!r}: adapter_mix weight for "
+                    f"{aid!r} must be > 0, got {w}")
 
     @property
     def max_total_len(self) -> int:
@@ -294,7 +328,9 @@ class LoadPhase:
             top_ks=tuple(int(k) for k in d.pop("top_ks", (0,))),
             eos_token=int(eos) if eos is not None else None,
             shared_prefix_len=int(d.pop("shared_prefix_len", 0)),
-            prompt_period=int(d.pop("prompt_period", 0)))
+            prompt_period=int(d.pop("prompt_period", 0)),
+            adapter_mix={str(k): float(v)
+                         for k, v in d.pop("adapter_mix", {}).items()})
         if d:
             raise ValueError(
                 f"phase {name!r}: unknown keys {sorted(d)}")
@@ -322,6 +358,8 @@ class LoadPhase:
             out["shared_prefix_len"] = self.shared_prefix_len
         if self.prompt_period > 0:
             out["prompt_period"] = self.prompt_period
+        if self.adapter_mix:
+            out["adapter_mix"] = dict(self.adapter_mix)
         return out
 
 
@@ -500,6 +538,21 @@ class Scenario:
                 raise ValueError(
                     f"phase {phase.name!r}: eos_token {phase.eos_token} "
                     f"out of vocab [0, {self.model.vocab_size})")
+            for aid in phase.adapter_mix:
+                if aid == "base":
+                    continue
+                if not self.engine.lora_adapters:
+                    raise ValueError(
+                        f"phase {phase.name!r}: adapter_mix names "
+                        f"adapter {aid!r} but the engine has no "
+                        f"adapter store (set engine.lora_adapters/"
+                        f"lora_rank)")
+                if not (aid.isdigit()
+                        and int(aid) < self.engine.lora_adapters):
+                    raise ValueError(
+                        f"phase {phase.name!r}: adapter_mix id {aid!r} "
+                        f"is not one of the runner-loaded ids '0'..'"
+                        f"{self.engine.lora_adapters - 1}' (or 'base')")
         if self.engine.max_len > self.model.max_position_embeddings:
             raise ValueError(
                 f"engine max_len ({self.engine.max_len}) exceeds the "

@@ -1,0 +1,31 @@
+"""Multi-LoRA: adapter fine-tuning + per-request multi-tenant serving.
+
+Train side: :func:`lora_finetune` — batched multi-job fine-tuning of
+several rank-r adapters against one frozen base model, factor updates on
+the multi_tensor_apply flat-bucket path through FusedAdam. Serve side:
+:class:`AdapterStore` — a device-resident stacked adapter bank the
+serving engine gathers per slot in-jit, so one batched decode program
+serves every tenant (docs/lora.md has the walkthrough).
+"""
+
+from apex_tpu.lora.adapter import (
+    LORA_TARGETS,
+    AdapterStore,
+    UnknownAdapterError,
+    init_adapter,
+    merge_adapter,
+    random_adapter,
+    target_dims,
+)
+from apex_tpu.lora.finetune import lora_finetune
+
+__all__ = [
+    "LORA_TARGETS",
+    "AdapterStore",
+    "UnknownAdapterError",
+    "init_adapter",
+    "merge_adapter",
+    "random_adapter",
+    "target_dims",
+    "lora_finetune",
+]

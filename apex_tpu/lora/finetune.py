@@ -1,0 +1,86 @@
+"""Batched multi-job LoRA fine-tuning against one frozen base model.
+
+LoRAFusion's training shape (PAPERS.md 2510.00206): several adapters
+fine-tune against ONE base model in one step loop — the base forward is
+shared work, only the rank-r factors train. Here ``n_jobs`` adapters are
+stacked on a leading axis and the whole cohort steps as one jitted
+program: the per-job loss is ``vmap`` of the base model applied to
+``merge_adapter(stop_gradient(base), factors_j)``, so gradients flow
+ONLY into the factors, and the update routes the factor leaves through
+the existing fused-optimizer machinery — flattened into one contiguous
+buffer per dtype (``multi_tensor_apply.flatten_by_dtype``, the
+flat-bucket path the Pallas fused optimizers dispatch on) and stepped by
+:class:`~apex_tpu.optimizers.FusedAdam`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.lora.adapter import init_adapter, merge_adapter
+from apex_tpu.multi_tensor_apply import flatten_by_dtype, unflatten_by_dtype
+from apex_tpu.optimizers import FusedAdam
+
+__all__ = ["lora_finetune"]
+
+
+def lora_finetune(model, params, tokens, labels, *, rank: int = 4,
+                  steps: int = 10, lr: float = 1e-2,
+                  optimizer=None, rng: Optional[jax.Array] = None,
+                  factors=None):
+    """Fine-tune ``n_jobs`` adapters in one batched step loop.
+
+    ``tokens``/``labels``: ``[n_jobs, batch, seq]`` int arrays — each
+    job's own data stream. Returns ``(factors, losses)`` where
+    ``factors`` is the STACKED adapter pytree (leaves ``[n_jobs, L,
+    ...]``; slice job ``j`` with ``jax.tree.map(lambda x: x[j], factors)``
+    and hand it to :meth:`AdapterStore.load`) and ``losses`` is the
+    ``[steps, n_jobs]`` per-job loss history.
+
+    ``optimizer`` defaults to ``FusedAdam(lr)``; pass ``factors`` (same
+    stacked shape) to resume. The base ``params`` are frozen — they sit
+    behind ``stop_gradient`` inside the merged forward and are never
+    touched by the optimizer.
+    """
+    n_jobs = tokens.shape[0]
+    if labels.shape != tokens.shape:
+        raise ValueError(
+            f"labels shape {labels.shape} != tokens shape {tokens.shape}")
+    if factors is None:
+        rng = jax.random.PRNGKey(0) if rng is None else rng
+        keys = jax.random.split(rng, n_jobs)
+        factors = jax.vmap(
+            lambda k: init_adapter(model.config, rank, k))(keys)
+    opt = optimizer or FusedAdam(lr=lr)
+
+    frozen = jax.lax.stop_gradient(params)
+
+    def job_loss(f, tok, lab):
+        return model.apply(merge_adapter(frozen, f), tok, lab)
+
+    def cohort_loss(stacked):
+        losses = jax.vmap(job_loss)(stacked, tokens, labels)  # [n_jobs]
+        return jnp.mean(losses), losses
+
+    # the factor leaves ride the flat-bucket path: one contiguous buffer
+    # per dtype, the layout multi_tensor_apply hands the fused kernels
+    buffers, metas, aux = flatten_by_dtype(factors)
+    state = opt.init(buffers)
+
+    @jax.jit
+    def train_step(buffers, state):
+        stacked = unflatten_by_dtype(buffers, metas, aux)
+        grads, losses = jax.grad(cohort_loss, has_aux=True)(stacked)
+        gbufs, _, _ = flatten_by_dtype(grads)
+        new_buffers, state = opt.step(gbufs, buffers, state, lr=lr)
+        return new_buffers, state, losses
+
+    history = []
+    for _ in range(steps):
+        buffers, state, losses = train_step(buffers, state)
+        history.append(losses)
+    factors = unflatten_by_dtype(buffers, metas, aux)
+    return factors, jnp.stack(history) if history else jnp.zeros((0, n_jobs))

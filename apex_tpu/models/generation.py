@@ -176,7 +176,7 @@ def _gather_vocab(logits: jax.Array, axis_name: str) -> jax.Array:
 
 def _cached_forward(model, params, caches, tokens: jax.Array, index,
                     last_only: bool = False, last_index=None,
-                    paged_state=None):
+                    paged_state=None, lora=None):
     """Run ``tokens`` [batch, s] occupying cache slots [index, index+s) ->
     (fp32 full-vocab logits [s, batch, V], new caches). ``last_only``:
     compute the LM head for the FINAL position only (returns [1, b, V]) —
@@ -208,7 +208,7 @@ def _cached_forward(model, params, caches, tokens: jax.Array, index,
     hidden = hidden.astype(c.compute_dtype)
     hidden, new_caches = model.transformer.apply(
         params["transformer"], hidden, kv_caches=caches, cache_index=index,
-        paged_state=paged_state)
+        paged_state=paged_state, lora=lora)
     from apex_tpu.models.gpt import lm_head_loss
     if last_only:
         hidden = hidden[-1:]
@@ -221,7 +221,7 @@ def _cached_forward(model, params, caches, tokens: jax.Array, index,
 
 
 def decode_step(model, params, caches, tokens: jax.Array, index,
-                paged_state=None):
+                paged_state=None, lora=None):
     """One incremental step: ``tokens`` [batch] at position ``index`` ->
     (fp32 full-vocab logits [batch, V], updated caches). ``caches`` is
     either form :func:`init_kv_caches` produces — the stacked ``(k, v)``
@@ -236,7 +236,7 @@ def decode_step(model, params, caches, tokens: jax.Array, index,
     :func:`generate`)."""
     logits, new_caches = _cached_forward(model, params, caches,
                                          tokens[:, None], index,
-                                         paged_state=paged_state)
+                                         paged_state=paged_state, lora=lora)
     return logits[0], new_caches
 
 

@@ -334,6 +334,19 @@ def _ln(params, x, eps, sequence_parallel=False, axis_name=TENSOR_AXIS,
                                    out_dtype=x.dtype)
 
 
+def _lora_delta(x, lora):
+    """Per-slot low-rank delta for one target projection: ``(x @ A) @ B``
+    with PER-BATCH-ELEMENT factors — ``x [s, b, h]``, ``A [b, h, r]``,
+    ``B [b, r, out(_local)]`` -> ``[s, b, out]``. The serving engine
+    gathers each slot's factors from the adapter bank by adapter index
+    (apex_tpu.lora; the null row is all-zeros, so base-traffic slots add
+    an exact 0). Math in fp32 — the factors train in fp32 and rank is
+    tiny, so the two skinny GEMMs round once at the final cast."""
+    xf = x.astype(jnp.float32)
+    d = jnp.einsum("sbh,bhr->sbr", xf, lora["A"].astype(jnp.float32))
+    return jnp.einsum("sbr,bro->sbo", d, lora["B"].astype(jnp.float32))
+
+
 @dataclass
 class ParallelMLP:
     """h -> ffn (column) -> act -> h (row).
@@ -377,9 +390,11 @@ class ParallelMLP:
         return {"dense_h_to_4h": self.dense_h_to_4h.spec(),
                 "dense_4h_to_h": self.dense_4h_to_h.spec()}
 
-    def apply(self, params, hidden):
+    def apply(self, params, hidden, *, lora=None):
         c = self.config
         x = self.dense_h_to_4h.apply(params["dense_h_to_4h"], hidden)
+        if lora is not None:
+            x = x + _lora_delta(hidden, lora).astype(x.dtype)
         x = apply_activation(x, c.activation)
         return self.dense_4h_to_h.apply(params["dense_4h_to_h"], x)
 
@@ -672,7 +687,7 @@ class ParallelAttention:
     def apply(self, params, hidden, *, encoder_output=None,
               attention_mask=None, kv_lengths=None, kv_cache=None,
               cache_index=None, rng=None, deterministic=True,
-              dropout_seed=None, paged_state=None):
+              dropout_seed=None, paged_state=None, lora=None):
         """hidden: [s(, shard), b, h] -> [s(, shard), b, h]; cross-attention
         reads K/V from ``encoder_output`` [s_enc, b, h].
 
@@ -704,6 +719,10 @@ class ParallelAttention:
         if self.attn_type == AttnType.self_attn:
             qkv = self.query_key_value.apply(params["query_key_value"],
                                              hidden)
+            if lora is not None:
+                # per-slot low-rank QKV delta (B pre-sliced to the local
+                # out-dim under TP, so the delta matches the qkv slice)
+                qkv = qkv + _lora_delta(hidden, lora).astype(qkv.dtype)
             s, b = qkv.shape[0], qkv.shape[1]
             qpg = c.num_attention_heads // c.kv_heads
             block = (qpg + 2) * dh
@@ -977,7 +996,8 @@ class ParallelTransformerLayer:
               enc_dec_attn_mask=None, enc_kv_lengths=None,
               attention_mask=None, kv_lengths=None, kv_cache=None,
               cache_index=None, rng=None, deterministic=True,
-              moe_drop_free=None, attention_seed=None, paged_state=None):
+              moe_drop_free=None, attention_seed=None, paged_state=None,
+              lora=None):
         """``encoder_output`` (decoder layers) must be the FULL encoder
         sequence ``[s_enc, b, h]`` — under sequence parallelism gather it
         first (``gather_from_sequence_parallel_region``), as
@@ -1000,7 +1020,8 @@ class ParallelTransformerLayer:
             attention_mask=attention_mask, kv_lengths=kv_lengths,
             kv_cache=kv_cache, cache_index=cache_index,
             rng=rngs[2], deterministic=deterministic,
-            dropout_seed=attention_seed, paged_state=paged_state)
+            dropout_seed=attention_seed, paged_state=paged_state,
+            lora=None if lora is None else lora.get("query_key_value"))
         new_cache = None
         if kv_cache is not None:
             attn_out, new_cache = attn_out
@@ -1051,7 +1072,9 @@ class ParallelTransformerLayer:
                 rng=moe_rng, deterministic=deterministic,
                 drop_free=moe_drop_free)
         else:
-            mlp_out = self.mlp.apply(params["mlp"], x.astype(c.compute_dtype))
+            mlp_out = self.mlp.apply(
+                params["mlp"], x.astype(c.compute_dtype),
+                lora=None if lora is None else lora.get("dense_h_to_4h"))
             aux = None
         mlp_out = _dropout(mlp_out, c.hidden_dropout, rngs[1], deterministic,
                            model_parallel_region=c.sequence_parallel,
@@ -1100,7 +1123,8 @@ class ParallelTransformer:
               enc_dec_attn_mask=None, enc_kv_lengths=None,
               attention_mask=None, kv_lengths=None, kv_caches=None,
               cache_index=None, rng=None, deterministic=True,
-              final_norm=True, moe_drop_free=None, paged_state=None):
+              final_norm=True, moe_drop_free=None, paged_state=None,
+              lora=None):
         """Returns ``hidden`` — or ``(hidden, moe_aux_loss)`` (aux summed
         over layers) when the config enables MoE, or ``(hidden, new_caches)``
         when decoding with ``kv_caches`` — either ``(k, v)`` stacked
@@ -1134,6 +1158,20 @@ class ParallelTransformer:
             golden = jnp.int32(-1640531527)  # 0x9E3779B9, odd
             return attn_seed_base + jnp.int32(idx) * golden
 
+        if lora is not None and not (
+                kv_caches is not None and isinstance(kv_caches, list)):
+            # per-slot adapters exist for the serving step programs, which
+            # all decode over the per-layer LIST cache form; training and
+            # merged-reference paths fold adapters into the weights instead
+            # (apex_tpu.lora.merge_adapter)
+            raise NotImplementedError(
+                "lora (per-slot adapter factors) needs the per-layer LIST "
+                "kv_caches form — merge adapters into the weights for "
+                "cache-free or scan-form forwards")
+        if lora is not None and c.sequence_parallel:
+            raise NotImplementedError(
+                "lora deltas read the layer input pre-gather; sequence "
+                "parallelism is not supported on the adapter path")
         if paged_state is not None and not (
                 kv_caches is not None and isinstance(kv_caches, list)):
             raise NotImplementedError(
@@ -1185,6 +1223,10 @@ class ParallelTransformer:
                                                   layers_p))
                 layer_rng = (None if rng is None
                              else jax.random.fold_in(rng, idx))
+                # adapter-bank leaves are [L, b, ...] (gathered per slot
+                # by the caller); slice this layer's factors
+                layer_lora = (None if lora is None
+                              else jax.tree.map(lambda x: x[idx], lora))
                 h, new_cache = self.layer.apply(
                     layer_params, h, encoder_output=encoder_output,
                     enc_dec_attn_mask=enc_dec_attn_mask,
@@ -1195,7 +1237,7 @@ class ParallelTransformer:
                     deterministic=deterministic,
                     moe_drop_free=moe_drop_free,
                     attention_seed=_attn_seed(idx),
-                    paged_state=paged_state)
+                    paged_state=paged_state, lora=layer_lora)
                 new_caches.append(new_cache)
             if final_norm:
                 h = _ln(params["final_layernorm"], h, c.layernorm_epsilon,

@@ -81,6 +81,7 @@ SERVING_SHED_COUNTERS = {
     "deadline": "requests_shed_deadline",
     "fleet": "requests_shed_fleet",
     "pages_exhausted": "requests_shed_pages",
+    "unknown_adapter": "requests_shed_adapter",
 }
 
 #: fleet incident event -> registry counter — same one-increment-per-
@@ -232,6 +233,44 @@ def _fleet_section(requests: List[dict], events: List[dict],
             "dispatches": dispatch}
 
 
+def _adapter_section(requests: List[dict], events: List[dict],
+                     counters: Dict[str, int]) -> Optional[dict]:
+    """Fold multi-LoRA telemetry into the monitor's adapters section:
+    admissions grouped by ``adapter_id`` from the engine's
+    ``adapter_request`` event stream (each event is one increment of the
+    matching ``adapter<ix>_requests`` counter at the same site, so the
+    two views reconcile key-for-key), terminal requests grouped by the
+    ``adapter_id`` their result rows carry, and sheds from the
+    ``requests_shed_adapter`` counter. ``None`` when the log carries no
+    adapter signal (a base-model run, or a pre-LoRA log)."""
+    admitted: Dict[str, int] = {}
+    by_index: Dict[str, int] = {}
+    for e in events:
+        if e.get("event") != "adapter_request":
+            continue
+        aid = str(e.get("adapter_id", "?"))
+        admitted[aid] = admitted.get(aid, 0) + 1
+        ix = e.get("adapter_ix")
+        if isinstance(ix, int):
+            by_index[str(ix)] = by_index.get(str(ix), 0) + 1
+    finished: Dict[str, int] = {}
+    for r in requests:
+        aid = r.get("adapter_id")
+        if isinstance(aid, str):
+            finished[aid] = finished.get(aid, 0) + 1
+    adapter_counters = {name: n for name, n in counters.items()
+                        if name.startswith("adapter")
+                        and name.endswith("_requests") and n}
+    shed = counters.get("requests_shed_adapter", 0)
+    if not admitted and not finished and not adapter_counters and not shed:
+        return None
+    return {"admitted_by_adapter": admitted,
+            "admitted_by_index": by_index,
+            "finished_by_adapter": finished,
+            "counters": adapter_counters,
+            "shed_unknown": shed}
+
+
 def _checkpoint_section(events: List[dict], counters: Dict[str, int],
                         histograms: Dict[str, dict]) -> Optional[dict]:
     """Fold checkpoint telemetry into the monitor's checkpoints section:
@@ -306,6 +345,7 @@ def build_report(path: str,
         "requests": _request_summary(requests),
         "serving_incidents": _serving_incidents(events),
         "fleet": _fleet_section(requests, events, counters),
+        "adapters": _adapter_section(requests, events, counters),
         "checkpoints": _checkpoint_section(events, counters, histograms),
         "timeline": sorted(events, key=lambda e: e.get("seq", 0)),
         "scenario": ({k: scenario[k] for k in ("name", "seed")
@@ -452,6 +492,22 @@ def render_report(report: dict) -> str:
             lines.append(f"  requests by replica: {split}")
         lines += [f"  {name} = {n}"
                   for name, n in sorted(fleet["counts"].items())]
+    adapters = report.get("adapters")
+    if adapters:
+        lines += ["", "adapters (multi-LoRA):"]
+        if adapters["admitted_by_adapter"]:
+            split = " ".join(f"{k}={v}" for k, v in sorted(
+                adapters["admitted_by_adapter"].items()))
+            lines.append(f"  admitted by adapter: {split}")
+        if adapters["finished_by_adapter"]:
+            split = " ".join(f"{k}={v}" for k, v in sorted(
+                adapters["finished_by_adapter"].items()))
+            lines.append(f"  finished by adapter: {split}")
+        lines += [f"  {name} = {n}"
+                  for name, n in sorted(adapters["counters"].items())]
+        if adapters["shed_unknown"]:
+            lines.append(
+                f"  shed (unknown adapter) = {adapters['shed_unknown']}")
     ckpt = report.get("checkpoints")
     if ckpt:
         lines += ["", "checkpoints:"]

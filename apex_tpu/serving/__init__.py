@@ -18,6 +18,7 @@ and draining restarts, and :class:`ShardedEngine` runs the decode step
 tensor-parallel over the device mesh. See docs/serving.md.
 """
 
+from apex_tpu.lora import UnknownAdapterError
 from apex_tpu.serving.engine import EngineConfig, InferenceEngine
 from apex_tpu.serving.fleet import (
     FleetConfig,
@@ -77,6 +78,7 @@ __all__ = [
     "SchedulerConfig",
     "QueueFullError",
     "DeadlineExpiredError",
+    "UnknownAdapterError",
     "bucket_for",
     "prefill_buckets",
     "SlotPool",

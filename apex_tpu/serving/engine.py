@@ -84,7 +84,12 @@ from apex_tpu.serving.request import (
     Request,
     RequestResult,
 )
-from apex_tpu.serving.prefix import prefix_hash_chain, prefix_salt
+from apex_tpu.lora import UnknownAdapterError
+from apex_tpu.serving.prefix import (
+    adapter_salt,
+    prefix_hash_chain,
+    prefix_salt,
+)
 from apex_tpu.serving.scheduler import (
     DeadlineExpiredError,
     FCFSScheduler,
@@ -109,6 +114,11 @@ _COUNTERS = ("requests_submitted", "requests_eos", "requests_length",
              "requests_error", "prefills", "decode_steps",
              "tokens_generated", "slots_quarantined",
              "requests_shed_pages",
+             # multi-LoRA (docs/serving.md#multi-lora): submits whose
+             # adapter_id the AdapterStore doesn't know, fast-failed at
+             # submit() — reconciled against request_shed events with
+             # reason="unknown_adapter"
+             "requests_shed_adapter",
              # prefix cache (docs/serving.md#prefix-cache): hits + misses
              # == paged prefills when prefix_cache is on, so hit_rate is
              # derivable; pages_shared counts prefill pages NOT recomputed
@@ -230,7 +240,7 @@ class _Active:
     __slots__ = ("request", "slot", "tokens", "last_token", "position",
                  "submit_ts", "prefill_start", "prefill_end",
                  "first_token_ts", "last_token_ts", "cancelled",
-                 "reserved_pages")
+                 "reserved_pages", "adapter_ix")
 
     def __init__(self, request: Request, slot: int, submit_ts: float):
         self.request = request
@@ -239,6 +249,7 @@ class _Active:
         self.last_token = 0
         self.position = 0       # cache rows written for this slot
         self.reserved_pages = 0  # worst-case pages minus shared-prefix hit
+        self.adapter_ix = 0     # bank row (null row when no adapter)
         self.submit_ts = submit_ts
         self.prefill_start = 0.0
         self.prefill_end = 0.0
@@ -272,6 +283,17 @@ def _sample_tokens(logits, temps, topks, seeds, steps):
     return jnp.where(temps > 0.0, sampled, greedy)
 
 
+def _select_adapters(lora, adapter_ix):
+    """Gather per-slot LoRA factors from the stacked adapter bank: leaves
+    ``[L, max_adapters + 1, ...]`` at bank rows ``adapter_ix`` (``[b]``)
+    -> ``[L, b, ...]``, the layout the transformer's per-layer loop
+    slices. ``None`` passes through — an engine without an AdapterStore
+    compiles the identical no-delta program."""
+    if lora is None:
+        return None
+    return jax.tree.map(lambda x: x[:, adapter_ix], lora)
+
+
 class InferenceEngine:
     """Continuous-batching serving engine; see the module docstring.
 
@@ -282,9 +304,16 @@ class InferenceEngine:
 
     def __init__(self, model, params, config: Optional[EngineConfig] = None,
                  *, metrics: Optional[MetricsRegistry] = None,
-                 faults=None, replica_id: Optional[int] = None):
+                 faults=None, replica_id: Optional[int] = None,
+                 adapters=None):
         self.model = model
         self.config = config or EngineConfig()
+        #: optional AdapterStore (apex_tpu.lora) — multi-tenant serving:
+        #: per-request adapter_id selects a bank row, the step programs
+        #: gather per-slot factors in-jit (docs/serving.md#multi-lora).
+        #: The bank is re-read every call, so host-side load/unload
+        #: between ticks applies on the next step without a retrace.
+        self.adapters = adapters
         #: fleet replica label stamped on every RequestResult / JSONL
         #: record this engine emits (None = single-engine deployment)
         self.replica_id = replica_id
@@ -301,6 +330,14 @@ class InferenceEngine:
                 f"max_position_embeddings ({c.max_position_embeddings})")
         self.metrics = metrics if metrics is not None else MetricsRegistry()
         self.metrics.declare_counters(*_COUNTERS)
+        if self.adapters is not None:
+            # per-adapter submit counters, declared up front like the
+            # fleet's replica{i}_dispatches so final snapshots carry every
+            # key; the monitor reconciles them against adapter_request
+            # events key-for-key
+            self.metrics.declare_counters(
+                *(f"adapter{ix}_requests"
+                  for ix in range(self.adapters.max_adapters)))
         self.scheduler = FCFSScheduler(self.config.scheduler)
         self.slots = SlotPool(self.config.max_slots)
         self.buckets = prefill_buckets(self.config.max_len)
@@ -324,7 +361,10 @@ class InferenceEngine:
                 lru_capacity=(self.config.prefix_lru_capacity
                               if self.config.prefix_cache else 0))
             #: salt for the prompt-prefix hash chains — keyed by the
-            #: model fingerprint only (K/V are sampling-invariant)
+            #: model fingerprint (K/V are sampling-invariant), with each
+            #: request's adapter_id folded in at hash time: adapter
+            #: deltas write adapter-specific K/V, so tenants must never
+            #: alias pages across adapters (see prefix.adapter_salt)
             self._prefix_salt = prefix_salt(c)
             self._evictions_seen = 0
             self._quantized = self.config.kv_dtype == "int8"
@@ -364,6 +404,11 @@ class InferenceEngine:
         self._temps_h = np.zeros(n, np.float32)
         self._topks_h = np.full(n, self._vocab, np.int32)
         self._seeds_h = np.zeros(n, np.int32)
+        #: per-slot adapter bank row; idle/base slots point at the
+        #: all-zeros null row, so their delta is an exact zero
+        self._null_adapter = (0 if self.adapters is None
+                              else self.adapters.null_index)
+        self._adapter_ix_h = np.full(n, self._null_adapter, np.int32)
         #: speculation host state: per-slot verify window (row 0 is the
         #: token being fed — the sequential step's _tokens_h — rows 1..
         #: the n-gram draft, padded by repeating the last real feed) and
@@ -401,9 +446,10 @@ class InferenceEngine:
     # -- shard_map over the device mesh) ----------------------------------
 
     def _decode_body(self, params, caches, tokens, positions, temps,
-                     topks, seeds):
+                     topks, seeds, adapter_ix, lora):
         logits, caches = decode_step(self.model, params, caches, tokens,
-                                     positions)
+                                     positions,
+                                     lora=_select_adapters(lora, adapter_ix))
         nxt = _sample_tokens(logits, temps, topks, seeds, positions + 1)
         # per-slot integrity flag: one cheap in-jit reduction so the
         # host can quarantine a poisoned row without fetching logits
@@ -418,7 +464,7 @@ class InferenceEngine:
                 for k, v in caches]
 
     def _prefill_body(self, params, caches, prompt, slot, prompt_len,
-                      temp, topk, seed):
+                      temp, topk, seed, adapter_ix, lora):
         # the EXACT prefill generate() runs (4D per-layer list -> the
         # cache_index==0 causal-flash fast path), at the bucket-padded
         # length; pad rows are causally invisible to real rows and
@@ -427,7 +473,9 @@ class InferenceEngine:
         model = self.model
         small = init_kv_caches(model, 1, prompt.shape[1], stacked=False)
         logits, small = _cached_forward(model, params, small, prompt, 0,
-                                        last_index=prompt_len - 1)
+                                        last_index=prompt_len - 1,
+                                        lora=_select_adapters(lora,
+                                                              adapter_ix))
         flat = flatten_decode_caches(small, model.config.num_layers)
         new = [
             (jax.lax.dynamic_update_slice(bk, fk, (slot, 0, 0)),
@@ -438,20 +486,23 @@ class InferenceEngine:
         return first[0], new
 
     def _paged_decode_body(self, params, caches, page_table, tokens,
-                           positions, temps, topks, seeds):
+                           positions, temps, topks, seeds, adapter_ix,
+                           lora):
         # same decode step over the PAGED pool: one fused append+attend
         # per layer (apex_tpu.ops.decode_attention) instead of the flat
         # row scatter + masked read; with the pool donated the appends
         # are in-place row writes, so per step the KV traffic is one
         # read of the mapped stream plus one row
         logits, caches = decode_step(self.model, params, caches, tokens,
-                                     positions, paged_state=page_table)
+                                     positions, paged_state=page_table,
+                                     lora=_select_adapters(lora, adapter_ix))
         nxt = _sample_tokens(logits, temps, topks, seeds, positions + 1)
         finite = jnp.all(jnp.isfinite(logits), axis=-1)
         return nxt, finite, caches
 
     def _spec_decode_body(self, params, caches, page_table, windows,
-                          positions, temps, topks, seeds):
+                          positions, temps, topks, seeds, adapter_ix,
+                          lora):
         # speculative decode: each slot feeds a k-token verify window
         # (row 0 = the sequential step's token, rows 1.. the draft) in
         # ONE forward — one read of the mapped KV stream buys up to k
@@ -465,7 +516,8 @@ class InferenceEngine:
         n, k = windows.shape
         logits, caches = _cached_forward(
             self.model, params, caches, windows, positions,
-            paged_state=page_table)                       # [k, n, V]
+            paged_state=page_table,
+            lora=_select_adapters(lora, adapter_ix))      # [k, n, V]
         lf = logits.transpose(1, 0, 2).reshape(n * k, -1)
         steps = (positions[:, None] + 1 + jnp.arange(k)[None, :]).reshape(-1)
         nxt = _sample_tokens(lf, jnp.repeat(temps, k), jnp.repeat(topks, k),
@@ -500,7 +552,8 @@ class InferenceEngine:
                 for (k, ks), (v, vs) in caches]
 
     def _paged_prefill_body(self, params, caches, page_row, prompt,
-                            prompt_len, temp, topk, seed):
+                            prompt_len, temp, topk, seed, adapter_ix,
+                            lora):
         # identical prefill compute to the flat body (same 4D small-cache
         # forward, so greedy outputs stay token-exact); only the landing
         # differs — the flattened rows scatter into this slot's freshly
@@ -510,7 +563,9 @@ class InferenceEngine:
         model = self.model
         small = init_kv_caches(model, 1, prompt.shape[1], stacked=False)
         logits, small = _cached_forward(model, params, small, prompt, 0,
-                                        last_index=prompt_len - 1)
+                                        last_index=prompt_len - 1,
+                                        lora=_select_adapters(lora,
+                                                              adapter_ix))
         flat = flatten_decode_caches(small, model.config.num_layers)
         ps = self.config.page_size
         bucket = prompt.shape[1]
@@ -546,7 +601,7 @@ class InferenceEngine:
 
     def _suffix_prefill_body(self, params, caches, page_row, suffix,
                              start, suffix_len, prompt_len, temp, topk,
-                             seed, skip_first):
+                             seed, skip_first, adapter_ix, lora):
         """Prefill ONLY the suffix of a prefix-cache hit.
 
         The slot's page table already maps the shared prefix pages for
@@ -599,7 +654,9 @@ class InferenceEngine:
                 bk, bv = cache
                 filled.append((place(bk, sk), place(bv, sv)))
         logits, filled = _cached_forward(model, params, filled, suffix,
-                                         start, last_index=suffix_len - 1)
+                                         start, last_index=suffix_len - 1,
+                                         lora=_select_adapters(lora,
+                                                               adapter_ix))
         # scatter the suffix K/V into the slot's pages, one row per
         # suffix position (rows can straddle page boundaries, so the
         # whole-page chunk scatter of the miss path does not apply)
@@ -673,6 +730,30 @@ class InferenceEngine:
                         donate_argnums=(0,) if donate else ()),
                 None)
 
+    @property
+    def _bank(self):
+        """Current adapter bank (None without an AdapterStore) — read
+        fresh per step call so hot load/unload lands next tick."""
+        return None if self.adapters is None else self.adapters.bank
+
+    def _adapter_index(self, adapter_id, *, strict: bool) -> int:
+        """Resolve an ``adapter_id`` to its bank row. ``strict`` raises
+        :class:`UnknownAdapterError` (submit validation); non-strict
+        falls back to the null row — the prefill/decode path for a
+        request whose adapter was unloaded after admission, which
+        degrades to base-model output instead of crashing the batch."""
+        if self.adapters is None:
+            if adapter_id is not None and strict:
+                raise UnknownAdapterError(
+                    f"adapter {adapter_id!r}: engine has no AdapterStore")
+            return self._null_adapter
+        try:
+            return self.adapters.index_of(adapter_id)
+        except UnknownAdapterError:
+            if strict:
+                raise
+            return self._null_adapter
+
     # -- introspection ----------------------------------------------------
 
     @property
@@ -735,6 +816,34 @@ class InferenceEngine:
         now = time.monotonic()
         if not resubmission:
             self.metrics.inc("requests_submitted")
+        aid = request.sampling.adapter_id
+        try:
+            # restart continuations (resubmission) were validated at their
+            # ORIGINAL submit; if the adapter vanished since, they degrade
+            # to the null row (base output) instead of failing the restart
+            ix = self._adapter_index(aid, strict=not resubmission)
+        except UnknownAdapterError:
+            # fast-fail BEFORE the queue: an unknown/unloaded adapter_id
+            # can never produce the tenant's output, so it sheds with its
+            # own counter + request_shed reason (the supervisor-shed
+            # convention) and a terminal rejected record
+            self.metrics.inc("requests_shed_adapter")
+            log_event(_LOG, "request_shed",
+                      request_id=request.request_id,
+                      reason="unknown_adapter", adapter_id=aid)
+            self.metrics.event("request_shed",
+                               request_id=request.request_id,
+                               reason="unknown_adapter", adapter_id=aid)
+            self._finish(request, [], FINISH_REJECTED, submit_ts=now,
+                         now=now, detail="unknown_adapter")
+            raise
+        if aid is not None and not resubmission:
+            # per-adapter arrival ledger (monitor reconciles the counter
+            # against these events key-for-key)
+            self.metrics.inc(f"adapter{ix}_requests")
+            self.metrics.event("adapter_request",
+                               request_id=request.request_id,
+                               adapter_id=aid, adapter_ix=ix)
         try:
             self.scheduler.submit(request, now)
         except QueueFullError:
@@ -878,7 +987,12 @@ class InferenceEngine:
         overrun ``max_len`` (only possible for non-power-of-two page
         sizes) so the static bucket set keeps holding."""
         ps = self.config.page_size
-        chain = prefix_hash_chain(request.prompt, ps, self._prefix_salt)
+        # fold the request's adapter identity into the salt: adapter
+        # deltas make K/V adapter-specific, so same-prompt tenants under
+        # different adapters must never share a chain (base traffic,
+        # adapter_id=None, keeps the plain model salt and still shares)
+        salt = adapter_salt(self._prefix_salt, request.sampling.adapter_id)
+        chain = prefix_hash_chain(request.prompt, ps, salt)
         if not self.config.prefix_cache or not chain:
             return chain, [], False
         pages, matched = self.pages.match_prefix(chain)
@@ -967,6 +1081,12 @@ class InferenceEngine:
         rec = _Active(request, slot, submit_ts)
         rec.prefill_start = time.monotonic()
         sp = request.sampling
+        # resolve the adapter row NOW (non-strict: an id unloaded while
+        # queued degrades to the null row — base output — rather than
+        # crashing admission; submit() already validated it existed)
+        rec.adapter_ix = self._adapter_index(sp.adapter_id, strict=False)
+        aix = jnp.asarray([rec.adapter_ix], jnp.int32)
+        bank = self._bank
         topk = jnp.int32(sp.top_k if sp.top_k is not None else self._vocab)
         chain, shared_pages, skip_first = (), [], False
         shared_used = 0
@@ -1028,7 +1148,7 @@ class InferenceEngine:
                     jnp.asarray(suffix), jnp.int32(start),
                     jnp.int32(suffix_len), jnp.int32(request.prompt_len),
                     jnp.float32(sp.temperature), topk,
-                    jnp.int32(sp.seed), jnp.bool_(skip_first))
+                    jnp.int32(sp.seed), jnp.bool_(skip_first), aix, bank)
             elif self.pages is not None:
                 bucket = bucket_for(request.prompt_len, self.config.max_len)
                 padded = np.zeros((1, bucket), np.int32)
@@ -1038,7 +1158,7 @@ class InferenceEngine:
                     jnp.asarray(self._page_table_h[slot]),
                     jnp.asarray(padded), jnp.int32(request.prompt_len),
                     jnp.float32(sp.temperature), topk,
-                    jnp.int32(sp.seed))
+                    jnp.int32(sp.seed), aix, bank)
             else:
                 bucket = bucket_for(request.prompt_len, self.config.max_len)
                 padded = np.zeros((1, bucket), np.int32)
@@ -1047,7 +1167,7 @@ class InferenceEngine:
                     self._params, self._caches, jnp.asarray(padded),
                     jnp.int32(slot), jnp.int32(request.prompt_len),
                     jnp.float32(sp.temperature), topk,
-                    jnp.int32(sp.seed))
+                    jnp.int32(sp.seed), aix, bank)
             first = int(np.asarray(first))
         except Exception:
             # keep the pool invariants even as the failure propagates:
@@ -1145,13 +1265,15 @@ class InferenceEngine:
                 jnp.asarray(self._page_table_h),
                 fed, jnp.asarray(self._positions_h),
                 jnp.asarray(self._temps_h), jnp.asarray(self._topks_h),
-                jnp.asarray(self._seeds_h))
+                jnp.asarray(self._seeds_h),
+                jnp.asarray(self._adapter_ix_h), self._bank)
         else:
             nxt, finite, self._caches = self._decode_fn(
                 self._params, self._caches,
                 jnp.asarray(self._tokens_h), jnp.asarray(self._positions_h),
                 jnp.asarray(self._temps_h), jnp.asarray(self._topks_h),
-                jnp.asarray(self._seeds_h))
+                jnp.asarray(self._seeds_h),
+                jnp.asarray(self._adapter_ix_h), self._bank)
         nxt = np.asarray(nxt)
         finite = np.asarray(finite)
         if self._faults is not None:
@@ -1313,6 +1435,7 @@ class InferenceEngine:
         self._temps_h[i] = sp.temperature
         self._topks_h[i] = sp.top_k if sp.top_k is not None else self._vocab
         self._seeds_h[i] = sp.seed
+        self._adapter_ix_h[i] = rec.adapter_ix
 
     def _clear_slot(self, slot: int) -> None:
         self._tokens_h[slot] = 0
@@ -1320,6 +1443,7 @@ class InferenceEngine:
         self._temps_h[slot] = 0.0
         self._topks_h[slot] = self._vocab
         self._seeds_h[slot] = 0
+        self._adapter_ix_h[slot] = self._null_adapter
         if self._spec:
             self._window_h[slot] = 0
             self._wlen_h[slot] = 1
@@ -1376,7 +1500,8 @@ class InferenceEngine:
             tokens=list(tokens), finish_reason=reason, queue_s=queue_s,
             prefill_s=prefill_s, decode_s=decode_s,
             total_s=now - submit_ts, ttft_s=ttft_s, tpot_s=tpot_s,
-            replica_id=self.replica_id)
+            replica_id=self.replica_id,
+            adapter_id=request.sampling.adapter_id)
         self.completed[request.request_id] = result
         self.metrics.inc(f"requests_{reason}")
         for name, value in (("request_queue_s", result.queue_s),

@@ -67,6 +67,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 from apex_tpu.observability import MetricsRegistry
 from apex_tpu.serving.engine import EngineConfig
 from apex_tpu.serving.prefix import (
+    adapter_salt,
     common_chain_len,
     prefix_hash_chain,
     prefix_salt,
@@ -306,9 +307,14 @@ class ReplicaFleet:
                  fleet: Optional[FleetConfig] = None,
                  metrics: Optional[MetricsRegistry] = None,
                  faults=None, router: Optional[Router] = None,
-                 engine_factory=None):
+                 engine_factory=None, adapters=None):
         self._model = model
         self._params = params
+        #: shared LoRA :class:`~apex_tpu.lora.AdapterStore` — every
+        #: replica's supervisor (and engine incarnation) reads the SAME
+        #: store, so one load()/unload() takes effect fleet-wide and a
+        #: migrated continuation finds its adapter on the new replica
+        self._adapters = adapters
         self.config = config or EngineConfig()
         self.supervisor_config = supervisor or SupervisorConfig()
         self.fleet = fleet or FleetConfig()
@@ -356,7 +362,8 @@ class ReplicaFleet:
             self._model, self._params, self.config,
             supervisor=self.supervisor_config, metrics=self.metrics,
             faults=self._faults.get(replica_id), replica_id=replica_id,
-            service_s=service_s, engine_factory=self._engine_factory)
+            service_s=service_s, engine_factory=self._engine_factory,
+            adapters=self._adapters)
 
     # -- introspection ----------------------------------------------------
 
@@ -402,8 +409,11 @@ class ReplicaFleet:
         look up and intern, or None when affinity is off."""
         if not self._route_chains:
             return None
+        # same adapter fold the engine applies: a tenant's chains only
+        # collide with that tenant's resident pages
+        salt = adapter_salt(self._route_salt, request.sampling.adapter_id)
         return prefix_hash_chain(request.prompt, self.config.page_size,
-                                 self._route_salt) or None
+                                 salt) or None
 
     # -- admission --------------------------------------------------------
 

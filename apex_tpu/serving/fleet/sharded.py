@@ -51,7 +51,7 @@ class ShardedEngine(InferenceEngine):
 
     def __init__(self, model, params, config: Optional[EngineConfig] = None,
                  *, mesh=None, metrics=None, faults=None,
-                 replica_id: Optional[int] = None):
+                 replica_id: Optional[int] = None, adapters=None):
         self.mesh = mesh if mesh is not None else parallel_state.get_mesh()
         c = model.config
         self._tp = self.mesh.shape[c.axis_name]
@@ -72,7 +72,8 @@ class ShardedEngine(InferenceEngine):
                 "sequence_parallel has nothing to shard; build the model "
                 "with sequence_parallel=False for serving")
         super().__init__(model, params, config, metrics=metrics,
-                         faults=faults, replica_id=replica_id)
+                         faults=faults, replica_id=replica_id,
+                         adapters=adapters)
 
     # -- sharding specs ---------------------------------------------------
 
@@ -113,6 +114,23 @@ class ShardedEngine(InferenceEngine):
             pair = (P(None, None, axis), P(None, None, axis))
         return [pair for _ in range(self.model.config.num_layers)]
 
+    def _lora_spec(self):
+        """Spec for the LoRA adapter bank argument. Both LoRA targets
+        (QKV, dense_h_to_4h) are column-parallel, so each ``B`` bank
+        ``[L, n_adapters+1, r, out]`` shards its OUT dim over the tensor
+        axis — each rank's slice is exactly the out block its projection
+        computes, so ``y += (x @ A) @ B`` stays rank-local with zero
+        collective cost (the rank-r inner product replicates). ``A``
+        banks replicate (their dims are hidden x r on every target).
+        With no :class:`~apex_tpu.lora.AdapterStore` the bank argument
+        is ``None`` (an empty pytree) and a bare replicated spec
+        suffices."""
+        if self.adapters is None:
+            return P()
+        axis = self.model.config.axis_name
+        target = {"A": P(), "B": P(None, None, None, axis)}
+        return {t: target for t in self.adapters.bank}
+
     def _build_step_fns(self, donate: bool):
         """The base engine's step bodies, ``shard_map``-wrapped over the
         mesh: params by ``model.spec()``, KV pool on the heads axis,
@@ -124,6 +142,7 @@ class ShardedEngine(InferenceEngine):
         pspec = self._param_spec()
         cspec = self._cache_spec()
         rep = P()
+        lspec = self._lora_spec()
         reset = None
         if self.pages is not None:
             # paged bodies take one extra replicated arg (the page
@@ -135,11 +154,13 @@ class ShardedEngine(InferenceEngine):
                            else self._paged_decode_body)
             decode = shard_map(
                 decode_body, mesh=mesh,
-                in_specs=(pspec, cspec, rep, rep, rep, rep, rep, rep),
+                in_specs=(pspec, cspec, rep, rep, rep, rep, rep, rep,
+                          rep, lspec),
                 out_specs=(rep, rep, cspec))
             prefill = shard_map(
                 self._paged_prefill_body, mesh=mesh,
-                in_specs=(pspec, cspec, rep, rep, rep, rep, rep, rep),
+                in_specs=(pspec, cspec, rep, rep, rep, rep, rep, rep,
+                          rep, lspec),
                 out_specs=(rep, rep, cspec))
             # suffix prefill (prefix-cache hit): the gather/scatter of
             # shared pages is rank-local on each rank's head slice, so
@@ -148,7 +169,7 @@ class ShardedEngine(InferenceEngine):
             suffix = shard_map(
                 self._suffix_prefill_body, mesh=mesh,
                 in_specs=(pspec, cspec, rep, rep, rep, rep, rep, rep,
-                          rep, rep, rep),
+                          rep, rep, rep, rep, lspec),
                 out_specs=(rep, rep, cspec))
             scrub = shard_map(
                 self._paged_scrub_body, mesh=mesh,
@@ -160,11 +181,13 @@ class ShardedEngine(InferenceEngine):
         else:
             decode = shard_map(
                 self._decode_body, mesh=mesh,
-                in_specs=(pspec, cspec, rep, rep, rep, rep, rep),
+                in_specs=(pspec, cspec, rep, rep, rep, rep, rep, rep,
+                          lspec),
                 out_specs=(rep, rep, cspec))
             prefill = shard_map(
                 self._prefill_body, mesh=mesh,
-                in_specs=(pspec, cspec, rep, rep, rep, rep, rep, rep),
+                in_specs=(pspec, cspec, rep, rep, rep, rep, rep, rep,
+                          rep, lspec),
                 out_specs=(rep, cspec))
             suffix = None
             scrub = shard_map(

@@ -28,7 +28,8 @@ from __future__ import annotations
 import hashlib
 from typing import Sequence, Tuple
 
-__all__ = ["prefix_hash_chain", "prefix_salt", "common_chain_len"]
+__all__ = ["prefix_hash_chain", "prefix_salt", "adapter_salt",
+           "common_chain_len"]
 
 
 def prefix_salt(config) -> str:
@@ -43,6 +44,20 @@ def prefix_salt(config) -> str:
             f"{getattr(config, 'kv_heads', 0)}:"
             f"{getattr(config, 'vocab_size', 0)}:"
             f"{getattr(config, 'position_embedding_type', '')}")
+
+
+def adapter_salt(salt: str, adapter_id=None) -> str:
+    """Fold a request's LoRA ``adapter_id`` into the chain salt. K/V are
+    sampling-invariant but NOT adapter-invariant — the per-slot QKV delta
+    writes adapter-specific K/V into the pages — so two tenants with
+    identical prompts under different adapters must never share a chain
+    (a naive model-only salt would alias their pages; the regression test
+    in tests/test_prefix_cache.py demonstrates the bug). ``None`` (base
+    traffic) keeps the plain model salt, so all base requests still
+    share."""
+    if adapter_id is None:
+        return salt
+    return f"{salt}|adapter:{adapter_id}"
 
 
 def prefix_hash_chain(tokens: Sequence[int], page_size: int,

@@ -46,11 +46,18 @@ class SamplingParams:
     ``top_k``-truncated) softmax, keyed by ``seed`` folded with the
     absolute position of each generated token — one request's stream is
     deterministic in (seed, prompt) and independent of what else shares
-    the batch."""
+    the batch.
+
+    ``adapter_id`` selects a LoRA adapter loaded in the engine's
+    :class:`~apex_tpu.lora.AdapterStore` (docs/serving.md#multi-lora);
+    ``None`` is base-model traffic (the bank's zero adapter). An id the
+    engine doesn't know fast-fails at ``submit()`` with
+    :class:`~apex_tpu.lora.UnknownAdapterError`."""
 
     temperature: float = 0.0
     top_k: Optional[int] = None
     seed: int = 0
+    adapter_id: Optional[str] = None
 
     def __post_init__(self):
         if self.temperature < 0.0:
@@ -58,6 +65,11 @@ class SamplingParams:
                 f"temperature must be >= 0, got {self.temperature}")
         if self.top_k is not None and self.top_k < 1:
             raise ValueError(f"top_k must be >= 1, got {self.top_k}")
+        if self.adapter_id is not None and (
+                not isinstance(self.adapter_id, str) or not self.adapter_id):
+            raise ValueError(
+                f"adapter_id must be None or a non-empty string, "
+                f"got {self.adapter_id!r}")
 
 
 @dataclass
@@ -132,6 +144,10 @@ ReplicaFleet`; ``None`` on a single-engine deployment or a fleet-level
     outcome (shed at the fleet front door, retired mid-migration), and
     OMITTED from the JSONL record when ``None`` so pre-fleet report
     readers keep working unchanged.
+
+    ``adapter_id`` echoes the request's LoRA adapter (``None`` for base
+    traffic) so per-tenant latency/throughput can be sliced straight
+    from the request records; omitted from the JSONL when ``None``.
     """
 
     request_id: int
@@ -145,6 +161,7 @@ ReplicaFleet`; ``None`` on a single-engine deployment or a fleet-level
     ttft_s: Optional[float] = None
     tpot_s: Optional[float] = None
     replica_id: Optional[int] = None
+    adapter_id: Optional[str] = None
 
     @property
     def new_tokens(self) -> int:
@@ -174,6 +191,8 @@ ReplicaFleet`; ``None`` on a single-engine deployment or a fleet-level
         # summary's per-field guards
         if self.replica_id is not None:
             rec["replica_id"] = self.replica_id
+        if self.adapter_id is not None:
+            rec["adapter_id"] = self.adapter_id
         if self.ttft_s is not None:
             rec["ttft_s"] = self.ttft_s
         if self.tpot_s is not None:

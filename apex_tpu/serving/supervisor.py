@@ -168,9 +168,14 @@ class EngineSupervisor:
                  metrics: Optional[MetricsRegistry] = None,
                  faults=None, replica_id: Optional[int] = None,
                  service_s: Optional[float] = None,
-                 engine_factory=None):
+                 engine_factory=None, adapters=None):
         self._model = model
         self._params = params
+        #: LoRA :class:`~apex_tpu.lora.AdapterStore`, handed to every
+        #: engine incarnation — the store (and its device bank) is
+        #: SUPERVISOR state, so loaded adapters survive engine rebuilds
+        #: and restart continuations keep their per-tenant deltas
+        self._adapters = adapters
         self.config = config or EngineConfig()
         self.supervisor = supervisor or SupervisorConfig()
         self.metrics = metrics if metrics is not None else MetricsRegistry()
@@ -202,10 +207,14 @@ class EngineSupervisor:
         self.engine = self._build_engine()
 
     def _build_engine(self) -> InferenceEngine:
+        kwargs = dict(metrics=self.metrics, faults=self._faults,
+                      replica_id=self.replica_id)
+        if self._adapters is not None:
+            # only forwarded when set, so custom engine factories that
+            # predate multi-LoRA keep their narrower signature
+            kwargs["adapters"] = self._adapters
         return self._engine_factory(self._model, self._params, self.config,
-                                    metrics=self.metrics,
-                                    faults=self._faults,
-                                    replica_id=self.replica_id)
+                                    **kwargs)
 
     # -- introspection ----------------------------------------------------
 

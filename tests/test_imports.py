@@ -27,6 +27,7 @@ MODULES = [
     "apex_tpu.fp16_utils",
     "apex_tpu.fused_dense",
     "apex_tpu.loadtest",
+    "apex_tpu.lora",
     "apex_tpu.mlp",
     "apex_tpu.monitor",
     "apex_tpu.multi_tensor_apply",

@@ -201,6 +201,51 @@ class TestScenarioSchema:
             p = s.request.prompt
             assert p == (p[:2] * len(p))[:len(p)]
 
+    def test_lora_knobs_and_adapter_mix_round_trip(self):
+        d = _scenario_dict(engine={
+            "max_slots": 4, "max_len": 32, "max_queue": 16,
+            "lora_rank": 4, "lora_adapters": 2})
+        d["phases"][0]["adapter_mix"] = {"0": 3, "1": 1, "base": 2}
+        scn = Scenario.from_dict(d)
+        assert scn.engine.lora_rank == 4
+        assert scn.engine.lora_adapters == 2
+        assert scn.phases[0].adapter_mix == {"0": 3.0, "1": 1.0,
+                                             "base": 2.0}
+        again = Scenario.from_dict(scn.to_dict())
+        assert again.to_dict() == scn.to_dict()
+        # defaults stay absent: a pre-LoRA scenario's dict form is
+        # unchanged by the new knobs
+        plain = Scenario.from_dict(_scenario_dict())
+        assert "lora_rank" not in plain.to_dict()["engine"]
+        assert "lora_adapters" not in plain.to_dict()["engine"]
+        assert "adapter_mix" not in plain.to_dict()["phases"][0]
+
+    def test_bad_lora_knobs_and_adapter_mix_rejected(self):
+        # rank and bank size come together or not at all
+        with pytest.raises(ValueError, match="lora_rank"):
+            Scenario.from_dict(_scenario_dict(engine={
+                "max_slots": 4, "max_len": 32, "lora_rank": 4}))
+        with pytest.raises(ValueError, match="lora_rank"):
+            Scenario.from_dict(_scenario_dict(engine={
+                "max_slots": 4, "max_len": 32, "lora_adapters": 2}))
+        # an adapter_mix needs a store, and its ids must fit the bank
+        d = _scenario_dict()
+        d["phases"][0]["adapter_mix"] = {"0": 1}
+        with pytest.raises(ValueError, match="lora_adapters"):
+            Scenario.from_dict(d)
+        d = _scenario_dict(engine={
+            "max_slots": 4, "max_len": 32, "lora_rank": 4,
+            "lora_adapters": 2})
+        d["phases"][0]["adapter_mix"] = {"2": 1}
+        with pytest.raises(ValueError, match="adapter_mix"):
+            Scenario.from_dict(d)
+        d["phases"][0]["adapter_mix"] = {"tenant-a": 1}
+        with pytest.raises(ValueError, match="adapter_mix"):
+            Scenario.from_dict(d)
+        d["phases"][0]["adapter_mix"] = {"0": 0}
+        with pytest.raises(ValueError, match="weight"):
+            Scenario.from_dict(d)
+
     def test_fault_schedule_round_trip(self):
         fs = FaultSchedule.from_dict({
             "decode_raise_calls": [3], "decode_hang": {"5": 1.5},
@@ -257,6 +302,30 @@ class TestGeneratorDeterminism:
         assert [s.phase for s in sched] == ["a"] * 5 + ["b"] * 7
         assert all(len(s.request.prompt) == 4 for s in sched[:5])
         assert all(len(s.request.prompt) == 8 for s in sched[5:])
+
+    def test_adapter_mix_deterministic_and_isolated(self):
+        """The adapter draw rides LAST in the per-request draw chain:
+        same seed -> same adapter assignment (part of signature()), and
+        an empty mix leaves the pre-LoRA schedule byte-identical."""
+        lora_engine = {"max_slots": 4, "max_len": 32, "max_queue": 16,
+                       "lora_rank": 4, "lora_adapters": 2}
+        with_mix = _scenario_dict(engine=dict(lora_engine))
+        with_mix["phases"][0]["n_requests"] = 30
+        with_mix["phases"][0]["adapter_mix"] = {"0": 2, "1": 1, "base": 1}
+        s1 = TrafficGenerator(Scenario.from_dict(with_mix)).schedule()
+        s2 = TrafficGenerator(Scenario.from_dict(with_mix)).schedule()
+        assert [s.signature() for s in s1] == [s.signature() for s in s2]
+        aids = {s.request.sampling.adapter_id for s in s1}
+        assert aids == {"0", "1", None}   # 30 draws at 2/1/1 hit all
+        # enabling the store WITHOUT a mix changes nothing: the adapter
+        # draw only exists when the phase declares one
+        plain = TrafficGenerator(
+            Scenario.from_dict(_scenario_dict())).schedule()
+        stored = TrafficGenerator(Scenario.from_dict(
+            _scenario_dict(engine=dict(lora_engine)))).schedule()
+        assert [s.signature() for s in plain] == \
+            [s.signature() for s in stored]
+        assert all(s.request.sampling.adapter_id is None for s in stored)
 
     def test_mixes_are_honored(self):
         d = _scenario_dict(phases=[

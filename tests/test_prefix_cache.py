@@ -34,6 +34,7 @@ from apex_tpu.serving import (
 from apex_tpu.serving.fleet import FleetConfig, Router
 from apex_tpu.serving.fleet.router import _Replica
 from apex_tpu.serving.prefix import (
+    adapter_salt,
     common_chain_len,
     prefix_hash_chain,
     prefix_salt,
@@ -112,6 +113,30 @@ class TestPrefixHash:
         s = prefix_salt(cfg)
         assert str(cfg.num_layers) in s.split(":")[0]
         assert prefix_salt(cfg) == s                      # deterministic
+
+    def test_adapter_salt_regression_naive_salt_aliases_tenants(self):
+        """REGRESSION (multi-LoRA): adapter deltas make K/V
+        adapter-specific, so the model-only salt is NOT enough — two
+        tenants with identical prompts would alias each other's interned
+        pages and silently read another adapter's K/V. First demonstrate
+        the trap (naive chains collide), then that ``adapter_salt``
+        separates tenants while base traffic (``adapter_id=None``) keeps
+        the plain salt and still shares."""
+        toks = list(range(12))
+        base = "model-fingerprint"
+        # the bug the fold exists to prevent: same prompt, same naive
+        # salt, different adapters -> IDENTICAL chains (full aliasing)
+        naive_a = prefix_hash_chain(toks, 4, base)
+        naive_b = prefix_hash_chain(toks, 4, base)
+        assert naive_a == naive_b
+        chain_a = prefix_hash_chain(toks, 4, adapter_salt(base, "tenant-a"))
+        chain_b = prefix_hash_chain(toks, 4, adapter_salt(base, "tenant-b"))
+        assert chain_a != chain_b                 # tenants never share
+        assert chain_a != naive_a                 # nor with base traffic
+        assert common_chain_len(chain_a, chain_b) == 0
+        # None is base traffic: plain salt unchanged, base still shares
+        assert adapter_salt(base, None) == base
+        assert prefix_hash_chain(toks, 4, adapter_salt(base)) == naive_a
 
 
 # ---------------------------------------------------------------------------
