@@ -221,13 +221,21 @@ def _suppressed(finding: Finding, lines: Sequence[str]) -> bool:
 # baseline
 # --------------------------------------------------------------------------
 
+#: the justification ``--write-baseline`` stamps on fresh entries — a
+#: human must replace it before the entry suppresses anything
+PLACEHOLDER_JUSTIFICATION = "TODO: justify"
+
+
 class Baseline:
     """Committed ledger of accepted findings.
 
     Entries match on ``(path, code, snippet)``; duplicates are counted, so
     two identical offending lines in one file need two entries.  ``line``
-    and ``justification`` are for humans (the gate requires a
-    justification on every committed entry).
+    is for humans; ``justification`` is **enforced**: an entry whose
+    justification is missing, blank, or still the literal
+    ``--write-baseline`` placeholder (:data:`PLACEHOLDER_JUSTIFICATION`)
+    does not suppress its finding — the finding stays "new" and the gate
+    stays red until someone writes down *why* the hazard is acceptable.
     """
 
     def __init__(self, entries: Optional[List[dict]] = None, path: str = ""):
@@ -237,6 +245,19 @@ class Baseline:
     @staticmethod
     def _key(path: str, code: str, snippet: str) -> Tuple[str, str, str]:
         return (path.replace(os.sep, "/"), code, snippet.strip())
+
+    @staticmethod
+    def entry_justified(entry: dict) -> bool:
+        """Whether an entry carries a real (non-placeholder)
+        justification and may therefore suppress its finding."""
+        j = entry.get("justification")
+        return (isinstance(j, str) and bool(j.strip())
+                and PLACEHOLDER_JUSTIFICATION not in j)
+
+    def unjustified_entries(self) -> List[dict]:
+        """Entries the gate refuses to honor (empty or placeholder
+        justification); their findings surface as new."""
+        return [e for e in self.entries if not self.entry_justified(e)]
 
     @classmethod
     def load(cls, path: str) -> "Baseline":
@@ -255,9 +276,13 @@ class Baseline:
                   ) -> Tuple[List[Finding], List[Finding], List[dict]]:
         """Split findings into (new, baselined); also return stale entries
         (baseline lines whose finding no longer exists — fixed code whose
-        ledger entry should be dropped)."""
+        ledger entry should be dropped). Entries without a real
+        justification (see :meth:`entry_justified`) are excluded from the
+        budget entirely: their findings come back as new."""
         budget: Dict[Tuple[str, str, str], int] = {}
         for e in self.entries:
+            if not self.entry_justified(e):
+                continue
             k = self._key(e.get("path", ""), e.get("code", ""),
                           e.get("snippet", ""))
             budget[k] = budget.get(k, 0) + 1
@@ -272,6 +297,8 @@ class Baseline:
                 new.append(f)
         stale: List[dict] = []
         for e in self.entries:
+            if not self.entry_justified(e):
+                continue        # reported via unjustified_entries()
             k = self._key(e.get("path", ""), e.get("code", ""),
                           e.get("snippet", ""))
             if budget.get(k, 0) > 0:
@@ -564,16 +591,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     baselined: List[Finding] = []
     stale: List[dict] = []
+    unjustified: List[dict] = []
     if baseline_path and not args.no_baseline and os.path.exists(
             baseline_path):
         bl = Baseline.load(baseline_path)
         findings, baselined, stale = bl.partition(findings)
+        unjustified = bl.unjustified_entries()
 
     if args.format == "json":
         print(json.dumps({
             "findings": [vars(f) for f in findings],
             "baselined": len(baselined),
             "stale_baseline_entries": stale,
+            "unjustified_baseline_entries": unjustified,
         }, indent=2))
     else:
         for f in findings:
@@ -585,6 +615,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             print(f"stale baseline entry (code fixed? drop it): "
                   f"{e.get('path')}:{e.get('line')} {e.get('code')} "
                   f"{e.get('snippet', '')!r}", file=sys.stderr)
+        for e in unjustified:
+            print(f"baseline entry lacks a justification (placeholder "
+                  f"or blank — finding NOT suppressed): "
+                  f"{e.get('path')}:{e.get('line')} {e.get('code')}",
+                  file=sys.stderr)
         n = len(findings)
         print(f"{n} finding{'s' if n != 1 else ''} "
               f"({len(baselined)} baselined, {len(stale)} stale baseline "

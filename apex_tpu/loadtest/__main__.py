@@ -42,6 +42,7 @@ from apex_tpu.observability.slo import (
     evaluate_slos,
     measure_slo_metrics,
 )
+from apex_tpu.observability.trace import check_span_conservation
 
 EXIT_OK = 0
 EXIT_SLO_VIOLATION = 1
@@ -154,6 +155,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     # objectives is red regardless of baseline state
     if args.check and slo_report is not None and not slo_report.ok:
         code = EXIT_SLO_VIOLATION
+    # ...except broken telemetry: a traced run whose span timelines do
+    # not reconcile with its request records cannot be trusted to have
+    # measured ANY of the above, so conservation failures outrank even
+    # the SLO verdict. Vacuous on pre-tracing logs (no trace_id rows).
+    if args.check:
+        span_violations = check_span_conservation(records)
+        verdict["span_violations"] = span_violations
+        if span_violations:
+            code = EXIT_ERROR
     verdict["exit"] = code
 
     if args.json:
@@ -201,6 +211,13 @@ def _render(verdict: dict, scenario: Scenario, tolerance: float,
                 print(f"  {line}")
         else:
             print(f"baseline: no regression (tolerance {tolerance:.0%})")
+    if verdict.get("span_violations"):
+        print(f"span conservation: "
+              f"{len(verdict['span_violations'])} violation(s):")
+        for line in verdict["span_violations"][:10]:
+            print(f"  {line}")
+    elif args.check and "span_violations" in verdict:
+        print("span conservation: OK")
     print(f"exit: {code}")
 
 

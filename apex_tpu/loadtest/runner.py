@@ -30,6 +30,7 @@ from typing import Dict, List, Optional
 from apex_tpu.loadtest.generator import ScheduledRequest, TrafficGenerator
 from apex_tpu.loadtest.scenario import ModelSpec, Scenario
 from apex_tpu.observability import (
+    FleetMetrics,
     InMemorySink,
     JsonlSink,
     MetricsRegistry,
@@ -99,6 +100,13 @@ class ScenarioRun:
     submitted: int = 0                    # arrivals actually offered
     metrics_by_name: Dict[str, Optional[float]] = field(
         default_factory=dict)
+    #: per-tenant SLO attribution (adapter_id -> metrics dict); kept
+    #: apart from metrics_by_name — never part of the baseline payload
+    slo_by_adapter: Dict[str, Dict[str, Optional[float]]] = field(
+        default_factory=dict)
+    #: the final FleetMetrics.signals() poll (fleet scenarios only) —
+    #: also stamped into the log as the kind="signals" record
+    signals: Optional[dict] = None
 
     @property
     def ok(self) -> bool:
@@ -249,6 +257,13 @@ def run_scenario(scenario: Scenario, *, model=None, params=None,
                 time.sleep(_IDLE_SLEEP_S)  # waiting on a scheduled drain
     finally:
         run.wall_s = time.monotonic() - t0
+        if hasattr(sup, "replica_metrics"):
+            # final autoscaler poll, stamped into the log before the
+            # close-time snapshots so signals precede the counters they
+            # must reconcile with
+            run.signals = FleetMetrics(sup).signals()
+            registry.emit_record({"kind": "signals", "wall": time.time(),
+                                  "values": run.signals})
         sup.close()             # flushes the final counter snapshot
     run.results = dict(sup.completed)
     run.counters = registry.counters()
@@ -259,6 +274,8 @@ def run_scenario(scenario: Scenario, *, model=None, params=None,
         run.metrics_by_name = dict(run.slo.metrics)
     else:
         run.metrics_by_name = measure_slo_metrics(mem.records)
+    run.slo_by_adapter = measure_slo_metrics(mem.records,
+                                             by_adapter=True)
     return run
 
 

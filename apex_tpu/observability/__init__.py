@@ -24,6 +24,15 @@ subsystem of a pre-training stack; this package is that subsystem here.
   objectives (TTFT/TPOT/latency percentiles, goodput, error budget,
   recovery time) scored from the run log; the monitor renders the
   verdict and ``python -m apex_tpu.loadtest --check`` gates on it.
+- :mod:`~apex_tpu.observability.trace` — request-level span timelines:
+  every serving request carries a ``trace_id``; the engine/supervisor/
+  fleet stamp typed ``kind="span"`` rows whose phase durations sum to
+  the request's measured latency (:func:`check_span_conservation`).
+- :class:`FleetMetrics` / :class:`ReplicaRegistry`
+  (:mod:`~apex_tpu.observability.fleet_metrics`) — per-replica metric
+  views merged into one fleet snapshot plus the polled ``signals()``
+  dict (goodput window, queue depth, p99 TTFT/TPOT, occupancy,
+  per-adapter share) that feeds the autoscaler.
 """
 
 from apex_tpu.observability.registry import (
@@ -51,6 +60,21 @@ from apex_tpu.observability.slo import (
     evaluate_slos,
     measure_slo_metrics,
 )
+from apex_tpu.observability.trace import (
+    MARK_SPANS,
+    PHASE_SPANS,
+    build_timelines,
+    check_span_conservation,
+    emit_request_spans,
+    emit_span,
+    format_timeline,
+    new_trace_id,
+)
+from apex_tpu.observability.fleet_metrics import (
+    FleetMetrics,
+    ReplicaRegistry,
+    merge_histograms,
+)
 
 __all__ = [
     "MetricsRegistry",
@@ -72,4 +96,15 @@ __all__ = [
     "SLOReport",
     "evaluate_slos",
     "measure_slo_metrics",
+    "PHASE_SPANS",
+    "MARK_SPANS",
+    "new_trace_id",
+    "emit_request_spans",
+    "emit_span",
+    "build_timelines",
+    "format_timeline",
+    "check_span_conservation",
+    "FleetMetrics",
+    "ReplicaRegistry",
+    "merge_histograms",
 ]

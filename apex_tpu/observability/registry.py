@@ -71,6 +71,14 @@ class HistogramSnapshot:
     def mean(self) -> float:
         return self.sum / self.count if self.count else 0.0
 
+    @property
+    def recent(self) -> List[float]:
+        """The bounded window of recent observations (a copy) — what
+        percentiles are computed over, and what
+        :func:`~apex_tpu.observability.fleet_metrics.merge_histograms`
+        concatenates when combining per-replica snapshots."""
+        return list(self._recent)
+
     def percentile(self, p: float) -> float:
         return percentile(self._recent, p)
 

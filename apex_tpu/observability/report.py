@@ -47,11 +47,22 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
+import time
 from typing import Dict, List, Optional
 
 from apex_tpu.observability.registry import percentile
-from apex_tpu.observability.slo import SLOSpec, evaluate_slos
+from apex_tpu.observability.slo import (
+    SLOSpec,
+    evaluate_slos,
+    measure_slo_metrics,
+)
+from apex_tpu.observability.trace import (
+    build_timelines,
+    check_span_conservation,
+    format_timeline,
+)
 
 __all__ = ["read_records", "build_report", "render_report", "main",
            "SERVING_INCIDENT_COUNTERS", "SERVING_SHED_COUNTERS",
@@ -271,6 +282,40 @@ def _adapter_section(requests: List[dict], events: List[dict],
             "shed_unknown": shed}
 
 
+def _span_section(records: List[dict]) -> Optional[dict]:
+    """Fold ``kind="span"`` rows into the monitor's tracing section:
+    per-span-name counts (reconciling key-for-key with the ``spans_*``
+    counters — same emission sites), the number of distinct traced
+    requests, and the span-conservation verdict
+    (:func:`~apex_tpu.observability.trace.check_span_conservation`).
+    ``None`` for a pre-tracing log with no span rows — readers must
+    tolerate logs written before trace ids existed."""
+    spans = [r for r in records if r.get("kind") == "span"]
+    if not spans:
+        return None
+    by_name: Dict[str, int] = {}
+    traced = set()
+    for s in spans:
+        name = str(s.get("span", "?"))
+        by_name[name] = by_name.get(name, 0) + 1
+        traced.add(s.get("request_id"))
+    return {"count": len(spans), "by_name": by_name,
+            "traced_requests": len(traced),
+            "violations": check_span_conservation(records)}
+
+
+def _signals_section(records: List[dict]) -> Optional[dict]:
+    """The last ``kind="signals"`` record's values — the fleet
+    autoscaler poll the loadtest runner stamps before close. ``None``
+    for single-engine runs and pre-fleet-telemetry logs."""
+    signals = None
+    for r in records:           # later wins, like the counter snapshots
+        if r.get("kind") == "signals" and isinstance(
+                r.get("values"), dict):
+            signals = r["values"]
+    return signals
+
+
 def _checkpoint_section(events: List[dict], counters: Dict[str, int],
                         histograms: Dict[str, dict]) -> Optional[dict]:
     """Fold checkpoint telemetry into the monitor's checkpoints section:
@@ -346,6 +391,14 @@ def build_report(path: str,
         "serving_incidents": _serving_incidents(events),
         "fleet": _fleet_section(requests, events, counters),
         "adapters": _adapter_section(requests, events, counters),
+        "spans": _span_section(records),
+        "signals": _signals_section(records),
+        # per-tenant SLO attribution, only when the run carried adapter
+        # traffic (a base-only or pre-LoRA log renders no tenant table)
+        "slo_by_adapter": (
+            measure_slo_metrics(records, by_adapter=True)
+            if any(isinstance(r.get("adapter_id"), str) for r in requests)
+            else None),
         "checkpoints": _checkpoint_section(events, counters, histograms),
         "timeline": sorted(events, key=lambda e: e.get("seq", 0)),
         "scenario": ({k: scenario[k] for k in ("name", "seed")
@@ -492,6 +545,54 @@ def render_report(report: dict) -> str:
             lines.append(f"  requests by replica: {split}")
         lines += [f"  {name} = {n}"
                   for name, n in sorted(fleet["counts"].items())]
+    signals = report.get("signals")
+    if signals:
+        def _sig(key):
+            return _fmt(signals.get(key)) \
+                if signals.get(key) is not None else "-"
+
+        lines += ["", "fleet signals (autoscaler):",
+                  f"  replicas: {signals.get('replicas_total', '?')} total "
+                  f"{signals.get('replicas_dispatchable', '?')} "
+                  f"dispatchable  inflight={signals.get('inflight', '?')} "
+                  f"queue_depth={signals.get('queue_depth', '?')}",
+                  f"  goodput: window={_sig('goodput_window')} "
+                  f"({signals.get('window_ok', 0)}/"
+                  f"{signals.get('window_terminal', 0)}) "
+                  f"cumulative={_sig('goodput')} "
+                  f"({signals.get('requests_ok', 0)}/"
+                  f"{signals.get('requests_terminal', 0)})",
+                  f"  latency: ttft_p99={_sig('ttft_p99_s')}s "
+                  f"tpot_p99={_sig('tpot_p99_s')}s",
+                  f"  occupancy: slots={_sig('slot_occupancy')} "
+                  f"kv_pages={_sig('kv_page_occupancy')}"]
+        share = signals.get("adapter_share") or {}
+        if share:
+            split = " ".join(f"{k}={_fmt(v)}"
+                             for k, v in sorted(share.items()))
+            lines.append(f"  adapter share: {split}")
+    by_adapter = report.get("slo_by_adapter")
+    if by_adapter:
+        lines += ["", "per-tenant slo (by adapter_id):",
+                  f"  {'tenant':<10}{'reqs':>6}{'ttft_p99':>10}"
+                  f"{'tpot_p99':>10}{'goodput':>9}"]
+        for aid, m in sorted(by_adapter.items()):
+            lines.append(
+                f"  {aid:<10}{m.get('requests', 0):>6}"
+                f"{_fmt(m.get('ttft_p99_s'), 's'):>10}"
+                f"{_fmt(m.get('tpot_p99_s'), 's'):>10}"
+                f"{_fmt(m.get('goodput')):>9}")
+    spans = report.get("spans")
+    if spans:
+        split = " ".join(f"{k}={v}"
+                         for k, v in sorted(spans["by_name"].items()))
+        verdict = ("OK" if not spans["violations"]
+                   else f"{len(spans['violations'])} VIOLATION(S)")
+        lines += ["", f"request tracing ({spans['count']} spans over "
+                      f"{spans['traced_requests']} requests):",
+                  f"  {split}",
+                  f"  span conservation: {verdict}"]
+        lines += [f"    {v}" for v in spans["violations"][:10]]
     adapters = report.get("adapters")
     if adapters:
         lines += ["", "adapters (multi-LoRA):"]
@@ -558,6 +659,57 @@ def render_report(report: dict) -> str:
     return "\n".join(lines)
 
 
+def _print_trace(path: str, request_id: int) -> int:
+    """``--trace``: print one request's span timeline. Exit 0 when the
+    request has spans in the log, 2 when it does not (unknown id, or a
+    pre-tracing log)."""
+    records = read_records(path)
+    timelines = build_timelines(records)
+    if request_id not in timelines:
+        print(f"apex_tpu.monitor: no spans for request {request_id} "
+              f"in {path}", file=sys.stderr)
+        return 2
+    result = None
+    for r in records:
+        if r.get("kind") == "request" and \
+                r.get("request_id") == request_id:
+            result = r
+    print(format_timeline(request_id, timelines[request_id], result))
+    return 0
+
+
+def _follow(path: str, *, spec: Optional[Dict[str, float]], as_json: bool,
+            poll_s: float, max_polls: Optional[int]) -> int:
+    """``--follow``: tail a growing run log, re-rendering the report
+    whenever the file grows (size change is the signal — JSONL is
+    append-only). ``max_polls`` bounds the loop for tests; the default
+    ``None`` polls until interrupted."""
+    last_size = -1
+    polls = 0
+    try:
+        while max_polls is None or polls < max_polls:
+            polls += 1
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                size = -1       # not written yet: keep polling
+            if size != last_size and size >= 0:
+                last_size = size
+                report = build_report(path, slo_spec=spec)
+                if as_json:
+                    print(json.dumps(report, indent=2, default=str))
+                else:
+                    stamp = time.strftime("%H:%M:%S")
+                    print(f"\n--- follow poll {polls} [{stamp}] ---")
+                    print(render_report(report))
+                sys.stdout.flush()
+            if max_polls is None or polls < max_polls:
+                time.sleep(poll_s)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m apex_tpu.monitor",
@@ -570,6 +722,21 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="score the run against this SLO spec "
                              "({metric: threshold} JSON) instead of the "
                              "one embedded in the log's scenario record")
+    parser.add_argument("--trace", metavar="REQUEST_ID", type=int,
+                        default=None,
+                        help="print one request's span timeline instead "
+                             "of the full report (exit 2 if the log has "
+                             "no spans for it)")
+    parser.add_argument("--follow", action="store_true",
+                        help="tail a growing log: re-render the report "
+                             "each time the file grows, until "
+                             "interrupted (or --max-polls)")
+    parser.add_argument("--poll-s", type=float, default=2.0,
+                        help="--follow poll interval in seconds "
+                             "(default: 2)")
+    parser.add_argument("--max-polls", type=int, default=None,
+                        help="--follow: stop after N polls (default: "
+                             "poll until interrupted)")
     args = parser.parse_args(argv)
     spec = None
     if args.slo is not None:
@@ -580,6 +747,16 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"apex_tpu.monitor: cannot read SLO spec {args.slo}: "
                   f"{exc}", file=sys.stderr)
             return 2
+    if args.trace is not None:
+        try:
+            return _print_trace(args.path, args.trace)
+        except OSError as exc:
+            print(f"apex_tpu.monitor: cannot read {args.path}: {exc}",
+                  file=sys.stderr)
+            return 2
+    if args.follow:
+        return _follow(args.path, spec=spec, as_json=args.json,
+                       poll_s=args.poll_s, max_polls=args.max_polls)
     try:
         report = build_report(args.path, slo_spec=spec)
     except OSError as exc:

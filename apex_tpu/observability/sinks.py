@@ -87,11 +87,22 @@ def _jsonable(value):
 _PROM_BAD = re.compile(r"[^a-zA-Z0-9_:]")
 
 
+def _split_labels(name: str) -> tuple:
+    """Split ``kv_pages_free{replica="1"}`` into the bare metric name
+    and its ``{...}`` label block (empty string when unlabeled) — the
+    registry stores labeled gauges as flat keys in this form."""
+    brace = name.find("{")
+    if brace == -1 or not name.endswith("}"):
+        return name, ""
+    return name[:brace], name[brace:]
+
+
 def _prom_name(name: str, suffix: str = "") -> str:
-    base = _PROM_BAD.sub("_", name)
+    base, labels = _split_labels(name)
+    base = _PROM_BAD.sub("_", base)
     if not re.match(r"[a-zA-Z_:]", base):
         base = "_" + base
-    return f"apex_tpu_{base}{suffix}"
+    return f"apex_tpu_{base}{suffix}{labels}"
 
 
 class PrometheusTextfileSink:
@@ -120,13 +131,20 @@ class PrometheusTextfileSink:
 
     def flush(self) -> None:
         lines: List[str] = []
+        typed: set = set()  # one TYPE line per metric family, not per label set
         for name, value in sorted((self._counters or {}).items()):
             metric = _prom_name(name, "_total")
-            lines.append(f"# TYPE {metric} counter")
+            family = _prom_name(_split_labels(name)[0], "_total")
+            if family not in typed:
+                typed.add(family)
+                lines.append(f"# TYPE {family} counter")
             lines.append(f"{metric} {value}")
         for name, value in sorted((self._gauges or {}).items()):
             metric = _prom_name(name)
-            lines.append(f"# TYPE {metric} gauge")
+            family = _prom_name(_split_labels(name)[0])
+            if family not in typed:
+                typed.add(family)
+                lines.append(f"# TYPE {family} gauge")
             lines.append(f"{metric} {value}")
         for name, summ in sorted((self._histograms or {}).items()):
             metric = _prom_name(name)

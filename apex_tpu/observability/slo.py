@@ -61,13 +61,36 @@ SLO_METRICS: Dict[str, tuple] = {
 }
 
 
-def measure_slo_metrics(records: List[dict]) -> Dict[str, Optional[float]]:
+def measure_slo_metrics(records: List[dict], *, by_adapter: bool = False):
     """Fold a record list (:func:`~apex_tpu.observability.report.\
 read_records` output) into measured values for every
     :data:`SLO_METRICS` key. ``None`` marks a metric the log cannot
     support (no requests, no disruptions, no TTFT-stamped records — e.g.
     a pre-TTFT run log); an objective declared against a ``None`` metric
-    FAILS rather than silently passing."""
+    FAILS rather than silently passing.
+
+    With ``by_adapter=True`` the same fold runs once per tenant instead:
+    the return value is ``{adapter_id: metrics_dict}`` over the
+    ``adapter_id`` stamped on each request record (``"base"`` for
+    un-adapted traffic), each inner dict extended with a ``"requests"``
+    count. Events are withheld from the per-tenant folds — a disruption
+    is fleet-wide, so ``recovery_s`` stays a whole-run metric and reads
+    ``None`` per tenant. Per-tenant dicts are attribution output, NOT
+    baseline payloads: they must never be merged into the flat metrics
+    dict that :class:`SLOSpec`/the regression gate consume.
+    """
+    if by_adapter:
+        groups: Dict[str, List[dict]] = {}
+        for r in records:
+            if r.get("kind") == "request":
+                groups.setdefault(str(r.get("adapter_id", "base")),
+                                  []).append(r)
+        out: Dict[str, Dict[str, Optional[float]]] = {}
+        for adapter_id, rows in sorted(groups.items()):
+            metrics = measure_slo_metrics(rows)
+            metrics["requests"] = len(rows)
+            out[adapter_id] = metrics
+        return out
     requests = [r for r in records if r.get("kind") == "request"]
     ok = [r for r in requests
           if r.get("finish_reason") in OK_FINISH_REASONS]

@@ -70,6 +70,12 @@ from apex_tpu.models.generation import (
     preslice_layer_params,
 )
 from apex_tpu.observability import MetricsRegistry
+from apex_tpu.observability.trace import (
+    SPAN_QUARANTINE,
+    SPAN_SPEC_VERIFY,
+    emit_request_spans,
+    emit_span,
+)
 from apex_tpu.ops.decode_attention import (
     paged_quant_fill,
     paged_quant_scatter,
@@ -240,7 +246,8 @@ class _Active:
     __slots__ = ("request", "slot", "tokens", "last_token", "position",
                  "submit_ts", "prefill_start", "prefill_end",
                  "first_token_ts", "last_token_ts", "cancelled",
-                 "reserved_pages", "adapter_ix")
+                 "reserved_pages", "adapter_ix",
+                 "spec_proposed", "spec_accepted")
 
     def __init__(self, request: Request, slot: int, submit_ts: float):
         self.request = request
@@ -256,6 +263,8 @@ class _Active:
         self.first_token_ts = 0.0   # when token #1 reached the host (TTFT)
         self.last_token_ts = 0.0    # latest token arrival (TPOT numerator)
         self.cancelled = False
+        self.spec_proposed = 0   # draft positions offered over the lifetime
+        self.spec_accepted = 0   # draft positions the target agreed with
 
 
 def _sample_tokens(logits, temps, topks, seeds, steps):
@@ -1349,6 +1358,8 @@ class InferenceEngine:
                 self.metrics.inc("draft_tokens_accepted", accepted)
                 self.metrics.observe("spec_accept_rate",
                                      accepted / proposed)
+                rec.spec_proposed += proposed
+                rec.spec_accepted += accepted
             if quarantined is not None:
                 # poisoned at any window row: quarantine the slot even
                 # if clean tokens landed first — its KV is suspect
@@ -1417,6 +1428,13 @@ class InferenceEngine:
                   request_id=rec.request.request_id, cause=cause)
         self.metrics.event("slot_quarantined", slot=slot,
                            request_id=rec.request.request_id, cause=cause)
+        # mark span (zero-width): annotates the timeline with the scrub —
+        # excluded from the phase-span conservation sum
+        emit_span(self.metrics, SPAN_QUARANTINE,
+                  trace_id=rec.request.trace_id,
+                  request_id=rec.request.request_id,
+                  start_s=now, end_s=now, wall=time.time(),
+                  replica_id=self.replica_id, detail=cause)
         return self._retire(rec, FINISH_ERROR, now, scrub=True)
 
     def _finish_reason(self, rec: _Active, token: int) -> Optional[str]:
@@ -1470,6 +1488,16 @@ class InferenceEngine:
                 # zero scales until their next allocation
                 self.pages.note_scrubbed(freed)
         self._clear_slot(rec.slot)
+        if rec.spec_proposed:
+            # mark span over the decode stretch the verify windows rode:
+            # lifetime speculation totals, for the --trace timeline
+            emit_span(self.metrics, SPAN_SPEC_VERIFY,
+                      trace_id=rec.request.trace_id,
+                      request_id=rec.request.request_id,
+                      start_s=rec.prefill_end, end_s=now,
+                      wall=time.time(), replica_id=self.replica_id,
+                      proposed=rec.spec_proposed,
+                      accepted=rec.spec_accepted)
         return self._finish(
             rec.request, rec.tokens, reason, submit_ts=rec.submit_ts,
             now=now, prefill_start=rec.prefill_start,
@@ -1501,9 +1529,21 @@ class InferenceEngine:
             prefill_s=prefill_s, decode_s=decode_s,
             total_s=now - submit_ts, ttft_s=ttft_s, tpot_s=tpot_s,
             replica_id=self.replica_id,
-            adapter_id=request.sampling.adapter_id)
+            adapter_id=request.sampling.adapter_id,
+            trace_id=request.trace_id)
         self.completed[request.request_id] = result
         self.metrics.inc(f"requests_{reason}")
+        # the span timeline, stamped at the SAME terminal choke point and
+        # from the SAME timestamps as the queue/prefill/decode
+        # decomposition above — so span-sum == total_s by construction,
+        # and restarts stay exactly-once (a dead incarnation emits
+        # neither a record nor spans)
+        emit_request_spans(
+            self.metrics, trace_id=request.trace_id,
+            request_id=request.request_id, submit_ts=submit_ts, now=now,
+            wall=time.time(), prefill_start=prefill_start,
+            prefill_end=prefill_end, replica_id=self.replica_id,
+            detail=detail)
         for name, value in (("request_queue_s", result.queue_s),
                             ("request_prefill_s", result.prefill_s),
                             ("request_decode_s", result.decode_s),

@@ -65,6 +65,13 @@ from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from apex_tpu.observability import MetricsRegistry
+from apex_tpu.observability.fleet_metrics import ReplicaRegistry
+from apex_tpu.observability.trace import (
+    SPAN_DECODE,
+    SPAN_MIGRATION,
+    SPAN_SHED,
+    emit_span,
+)
 from apex_tpu.serving.engine import EngineConfig
 from apex_tpu.serving.prefix import (
     adapter_salt,
@@ -351,6 +358,13 @@ class ReplicaFleet:
         self._order = 0
         self._closed = False
         self._engine_restarts_base = 0   # restarts of already-rebuilt sups
+        #: per-replica registry views (fleet_metrics.ReplicaRegistry):
+        #: every producer call lands on BOTH the replica's local state
+        #: and the shared fleet registry, so the global stream/counters
+        #: are unchanged while FleetMetrics can split by replica. One
+        #: view per replica id, surviving rebuilds — a replica's
+        #: counters are cumulative over its whole slot in the fleet.
+        self.replica_metrics: Dict[int, ReplicaRegistry] = {}
         self.replicas: List[_Replica] = [
             _Replica(i, self._build_supervisor(i))
             for i in range(self.fleet.n_replicas)]
@@ -358,9 +372,13 @@ class ReplicaFleet:
     def _build_supervisor(self, replica_id: int,
                           service_s: Optional[float] = None
                           ) -> EngineSupervisor:
+        reg = self.replica_metrics.get(replica_id)
+        if reg is None:
+            reg = self.replica_metrics[replica_id] = ReplicaRegistry(
+                self.metrics, replica_id)
         return EngineSupervisor(
             self._model, self._params, self.config,
-            supervisor=self.supervisor_config, metrics=self.metrics,
+            supervisor=self.supervisor_config, metrics=reg,
             faults=self._faults.get(replica_id), replica_id=replica_id,
             service_s=service_s, engine_factory=self._engine_factory,
             adapters=self._adapters)
@@ -464,9 +482,16 @@ class ReplicaFleet:
         result = RequestResult(
             request_id=request.request_id, prompt_len=request.prompt_len,
             tokens=[], finish_reason=FINISH_REJECTED,
-            queue_s=now - start, total_s=now - start)
+            queue_s=now - start, total_s=now - start,
+            trace_id=request.trace_id)
         self.completed[request.request_id] = result
-        self.metrics.emit_record(result.record(wall=time.time()))
+        wall = time.time()
+        # front-door shed: one shed phase span, no replica_id (the
+        # request never reached one)
+        emit_span(self.metrics, SPAN_SHED, trace_id=request.trace_id,
+                  request_id=request.request_id, start_s=start,
+                  end_s=now, wall=wall, detail="fleet")
+        self.metrics.emit_record(result.record(wall=wall))
         states = {r.replica_id: (BREAKER_OPEN
                                  if r.supervisor.breaker_state ==
                                  BREAKER_OPEN and r.state == REPLICA_ACTIVE
@@ -614,6 +639,14 @@ class ReplicaFleet:
                                request_id=cont.request_id,
                                from_replica=replica.replica_id,
                                tokens_carried=len(recovered))
+            # mark span (zero-width): the handoff instant — the carried
+            # token count explains any TTFT/decode split across replicas
+            emit_span(self.metrics, SPAN_MIGRATION,
+                      trace_id=cont.trace_id,
+                      request_id=cont.request_id, start_s=now,
+                      end_s=now, wall=time.time(),
+                      from_replica=replica.replica_id,
+                      tokens_carried=len(recovered))
             self._backlog.append(cont)
         self._dispatch_backlog()
 
@@ -759,10 +792,21 @@ class ReplicaFleet:
         result = RequestResult(
             request_id=rid, prompt_len=tr.request.prompt_len,
             tokens=list(tr.prefix), finish_reason=reason,
-            total_s=now - tr.first_submit_ts)
+            total_s=now - tr.first_submit_ts,
+            trace_id=tr.request.trace_id)
         self.completed[rid] = result
         self.metrics.inc(f"requests_{reason}")
-        self.metrics.emit_record(result.record(wall=time.time()))
+        wall = time.time()
+        # no replica will ever finish this request (it died in the
+        # migration backlog), so the fleet owns its timeline: one coarse
+        # phase span over the whole fleet-tracked lifetime
+        emit_span(self.metrics,
+                  SPAN_DECODE if reason in (FINISH_EOS, FINISH_LENGTH)
+                  else SPAN_SHED,
+                  trace_id=tr.request.trace_id, request_id=rid,
+                  start_s=tr.first_submit_ts, end_s=now, wall=wall,
+                  detail="migration_backlog")
+        self.metrics.emit_record(result.record(wall=wall))
         log_event(_LOG, f"request_{reason}", request_id=rid,
                   new_tokens=result.new_tokens)
         self.metrics.event(f"request_{reason}", request_id=rid,

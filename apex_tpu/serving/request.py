@@ -19,6 +19,8 @@ import itertools
 from dataclasses import dataclass, field
 from typing import List, Optional, Sequence
 
+from apex_tpu.observability.trace import new_trace_id
+
 __all__ = ["SamplingParams", "Request", "RequestResult",
            "FINISH_EOS", "FINISH_LENGTH", "FINISH_CANCELLED",
            "FINISH_TIMEOUT", "FINISH_REJECTED", "FINISH_ERROR",
@@ -88,6 +90,12 @@ class Request:
     from ``submit()`` — so a request that spent its whole budget in
     transit fast-fails at admission, and the supervisor's restart
     continuations keep honoring the ORIGINAL deadline.
+
+    ``trace_id`` names the request's span timeline
+    (:mod:`apex_tpu.observability.trace`): minted fresh per request,
+    carried verbatim onto restart/migration continuations (which get a
+    NEW request object but the same trace), and stamped onto every
+    ``kind="span"`` row and the terminal ``kind="request"`` record.
     """
 
     prompt: Sequence[int]
@@ -97,6 +105,7 @@ class Request:
     deadline_s: Optional[float] = None
     request_id: int = field(default_factory=lambda: next(_REQUEST_IDS))
     arrival_ts: Optional[float] = None
+    trace_id: str = field(default_factory=new_trace_id)
 
     def __post_init__(self):
         self.prompt = [int(t) for t in self.prompt]
@@ -148,6 +157,10 @@ ReplicaFleet`; ``None`` on a single-engine deployment or a fleet-level
     ``adapter_id`` echoes the request's LoRA adapter (``None`` for base
     traffic) so per-tenant latency/throughput can be sliced straight
     from the request records; omitted from the JSONL when ``None``.
+
+    ``trace_id`` joins the record to its ``kind="span"`` timeline;
+    omitted when ``None`` (pre-tracing producers), in which case span
+    conservation is vacuous for the record.
     """
 
     request_id: int
@@ -162,6 +175,7 @@ ReplicaFleet`; ``None`` on a single-engine deployment or a fleet-level
     tpot_s: Optional[float] = None
     replica_id: Optional[int] = None
     adapter_id: Optional[str] = None
+    trace_id: Optional[str] = None
 
     @property
     def new_tokens(self) -> int:
@@ -189,6 +203,8 @@ ReplicaFleet`; ``None`` on a single-engine deployment or a fleet-level
         # optional fields are OMITTED (not null) when unmeasured, so the
         # records stay readable by pre-TTFT report readers and the
         # summary's per-field guards
+        if self.trace_id is not None:
+            rec["trace_id"] = self.trace_id
         if self.replica_id is not None:
             rec["replica_id"] = self.replica_id
         if self.adapter_id is not None:

@@ -52,6 +52,11 @@ from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence
 
 from apex_tpu.observability import MetricsRegistry
+from apex_tpu.observability.trace import (
+    SPAN_DECODE,
+    SPAN_SHED,
+    emit_span,
+)
 from apex_tpu.serving.engine import EngineConfig, InferenceEngine
 from apex_tpu.serving.request import (
     FINISH_CANCELLED,
@@ -310,9 +315,16 @@ class EngineSupervisor:
             request_id=request.request_id, prompt_len=request.prompt_len,
             tokens=[], finish_reason=FINISH_REJECTED,
             queue_s=now - start, total_s=now - start,
-            replica_id=self.replica_id)
+            replica_id=self.replica_id, trace_id=request.trace_id)
         self.completed[request.request_id] = result
-        self.metrics.emit_record(result.record(wall=time.time()))
+        wall = time.time()
+        # one shed phase span covering the request's whole (rejected)
+        # lifetime — span-sum == total_s for admission sheds too
+        emit_span(self.metrics, SPAN_SHED, trace_id=request.trace_id,
+                  request_id=request.request_id, start_s=start,
+                  end_s=now, wall=wall, replica_id=self.replica_id,
+                  detail=why)
+        self.metrics.emit_record(result.record(wall=wall))
         log_event(_LOG, "request_shed", request_id=request.request_id,
                   reason=why, **fields)
         self.metrics.event("request_shed", request_id=request.request_id,
@@ -493,7 +505,8 @@ class EngineSupervisor:
             prompt=list(req.prompt) + tr.prefix,
             max_new_tokens=remaining, sampling=req.sampling,
             eos_token=req.eos_token, deadline_s=req.deadline_s,
-            request_id=req.request_id, arrival_ts=start)
+            request_id=req.request_id, arrival_ts=start,
+            trace_id=req.trace_id)
 
     def _drain_backlog(self) -> None:
         while self._backlog and (self.engine.queued_count
@@ -516,10 +529,22 @@ class EngineSupervisor:
         result = RequestResult(
             request_id=rid, prompt_len=tr.request.prompt_len,
             tokens=list(tr.prefix), finish_reason=reason,
-            total_s=now - tr.first_submit_ts, replica_id=self.replica_id)
+            total_s=now - tr.first_submit_ts, replica_id=self.replica_id,
+            trace_id=tr.request.trace_id)
         self.completed[rid] = result
         self.metrics.inc(f"requests_{reason}")
-        self.metrics.emit_record(result.record(wall=time.time()))
+        wall = time.time()
+        # the engine incarnation that held this request died without
+        # finishing it, so the supervisor owns the timeline: one coarse
+        # phase span over the whole supervised lifetime (``decode`` when
+        # generation actually completed, else ``shed``)
+        emit_span(self.metrics,
+                  SPAN_DECODE if reason in (FINISH_EOS, FINISH_LENGTH)
+                  else SPAN_SHED,
+                  trace_id=tr.request.trace_id, request_id=rid,
+                  start_s=tr.first_submit_ts, end_s=now, wall=wall,
+                  replica_id=self.replica_id, detail=detail)
+        self.metrics.emit_record(result.record(wall=wall))
         extra = {"reason": detail} if detail else {}
         log_event(_LOG, f"request_{reason}", request_id=rid,
                   new_tokens=result.new_tokens, **extra)

@@ -362,7 +362,8 @@ class TestBaseline:
     def test_unmatched_finding_is_new(self):
         found = self._findings()
         bl = Baseline([{"path": "other.py", "code": "APX001",
-                        "snippet": found[0].snippet}])
+                        "snippet": found[0].snippet,
+                        "justification": "known"}])
         new, matched, stale = bl.partition(found)
         assert len(new) == 1 and matched == [] and len(stale) == 1
 
@@ -370,7 +371,8 @@ class TestBaseline:
         found = self._findings()
         bl = Baseline([{"path": "pkg/mod.py", "code": "APX001",
                         "line": 9999,  # wrong line: snippet still matches
-                        "snippet": found[0].snippet}])
+                        "snippet": found[0].snippet,
+                        "justification": "known"}])
         new, _, _ = bl.partition(found)
         assert new == []
 
@@ -379,6 +381,15 @@ class TestBaseline:
         bl = Baseline.from_findings(found)
         p = tmp_path / "bl.json"
         bl.save(str(p))
+        loaded = Baseline.load(str(p))
+        # fresh from --write-baseline: placeholder justification, so the
+        # entry does NOT yet suppress (the gate stays red until edited)
+        new, _, _ = loaded.partition(found)
+        assert len(new) == 1
+        assert loaded.unjustified_entries() == loaded.entries
+        for e in loaded.entries:    # ...the human step
+            e["justification"] = "deliberate in this fixture"
+        loaded.save(str(p))
         loaded = Baseline.load(str(p))
         new, matched, stale = loaded.partition(found)
         assert new == [] and len(matched) == 1 and stale == []
@@ -418,11 +429,28 @@ class TestConfigAndCLI:
         assert rc == 1
         assert "APX001" in out and "skipme" not in out
 
+    @staticmethod
+    def _justify(root):
+        """The human step after ``--write-baseline``: replace the
+        placeholder justifications with a real reason."""
+        p = root / "bl.json"
+        data = json.loads(p.read_text())
+        for e in data["entries"]:
+            e["justification"] = "deliberate in this fixture"
+        p.write_text(json.dumps(data))
+
     def test_cli_write_baseline_then_clean(self, tmp_path, capsys):
         root = self._project(tmp_path)
         rc = cli_main([str(root / "pkg"), "--write-baseline"])
         assert rc == 0
         assert json.loads((root / "bl.json").read_text())["entries"]
+        # placeholder justifications do not suppress: still red, with
+        # the unjustified entry called out on stderr
+        rc = cli_main([str(root / "pkg")])
+        captured = capsys.readouterr()
+        assert rc == 1
+        assert "justification" in captured.err
+        self._justify(root)
         rc = cli_main([str(root / "pkg")])
         out = capsys.readouterr().out
         assert rc == 0
@@ -431,6 +459,7 @@ class TestConfigAndCLI:
     def test_cli_stale_entry_reported(self, tmp_path, capsys):
         root = self._project(tmp_path)
         cli_main([str(root / "pkg"), "--write-baseline"])
+        self._justify(root)
         (root / "pkg" / "mod.py").write_text("x = 1\n")
         rc = cli_main([str(root / "pkg")])
         err = capsys.readouterr().err

@@ -18,7 +18,13 @@ import subprocess
 import sys
 from pathlib import Path
 
-from apex_tpu.analysis import Baseline, analyze_paths, load_config
+from apex_tpu.analysis import (
+    Baseline,
+    Finding,
+    analyze_paths,
+    load_config,
+)
+from apex_tpu.analysis.engine import PLACEHOLDER_JUSTIFICATION
 
 REPO_ROOT = Path(__file__).resolve().parents[1]
 PYPROJECT = REPO_ROOT / "pyproject.toml"
@@ -33,6 +39,7 @@ CHEAP_MODEL_TEST_MODULES = {
     "test_gqa.py",
     "test_imports.py",
     "test_moe.py",
+    "test_trace_fleet.py",
 }
 
 
@@ -69,6 +76,40 @@ class TestHazardGate:
         assert not unjustified, (
             "baseline entries need a real one-line justification:\n"
             + "\n".join(str(e) for e in unjustified))
+
+    def test_placeholder_justification_does_not_suppress(self):
+        """A baseline entry still carrying the ``--write-baseline``
+        placeholder (or a blank justification) must NOT suppress its
+        finding — the gate stays red until a human writes the reason."""
+        finding = Finding(code="APX001", message="m",
+                          path="pkg/mod.py", line=3, col=0,
+                          snippet="jax.random.normal(key)")
+        entry = {"path": "pkg/mod.py", "code": "APX001", "line": 3,
+                 "snippet": "jax.random.normal(key)"}
+        for bad in (PLACEHOLDER_JUSTIFICATION,
+                    f"{PLACEHOLDER_JUSTIFICATION} later", "", "   ", None):
+            bl = Baseline([{**entry, "justification": bad}])
+            new, matched, stale = bl.partition([finding])
+            assert new == [finding] and not matched and not stale, (
+                f"justification {bad!r} suppressed the finding")
+            assert bl.unjustified_entries() == bl.entries
+        # the same entry with a real justification does suppress it
+        bl = Baseline([{**entry,
+                        "justification": "deliberate: test fixture"}])
+        new, matched, stale = bl.partition([finding])
+        assert not new and matched == [finding] and not stale
+        assert bl.unjustified_entries() == []
+
+    def test_write_baseline_output_is_rejected_until_edited(self):
+        """``Baseline.from_findings`` (what ``--write-baseline`` saves)
+        stamps the placeholder, so a freshly written baseline cannot
+        silently green the gate."""
+        finding = Finding(code="APX002", message="m", path="a.py",
+                          line=1, col=0, snippet="x")
+        bl = Baseline.from_findings([finding])
+        assert bl.unjustified_entries() == bl.entries
+        new, _, _ = bl.partition([finding])
+        assert new == [finding]
 
     def test_module_entrypoint_runs_clean(self):
         """``python -m apex_tpu.analysis`` exits 0 on the committed tree

@@ -34,7 +34,9 @@ MODULES = [
     "apex_tpu.native",
     "apex_tpu.normalization",
     "apex_tpu.observability",
+    "apex_tpu.observability.fleet_metrics",
     "apex_tpu.observability.slo",
+    "apex_tpu.observability.trace",
     "apex_tpu.ops",
     "apex_tpu.ops.decode_attention",
     "apex_tpu.optimizers",

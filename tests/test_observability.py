@@ -166,6 +166,43 @@ class TestSinks:
         # no torn files: the render is atomic (temp + rename)
         assert not os.path.exists(path + ".tmp")
 
+    def test_prometheus_gauges_round_trip(self, tmp_path):
+        """Plain gauges survive the sink round-trip with exact values
+        and one TYPE declaration each."""
+        path = str(tmp_path / "metrics.prom")
+        reg = MetricsRegistry([PrometheusTextfileSink(path)])
+        reg.set_gauge("mfu", 0.4)
+        reg.set_gauge("kv_pages_free", 12)
+        reg.set_gauge("loss_scale", 256.0)
+        reg.flush()
+        lines = open(path, encoding="utf-8").read().splitlines()
+        assert "apex_tpu_mfu 0.4" in lines
+        assert "apex_tpu_kv_pages_free 12.0" in lines
+        assert "apex_tpu_loss_scale 256.0" in lines
+        assert lines.count("# TYPE apex_tpu_mfu gauge") == 1
+
+    def test_prometheus_labeled_gauges_round_trip(self, tmp_path):
+        """Fleet-labeled gauges (``name{replica="i"}`` flat keys, what
+        FleetMetrics.write_prometheus emits) render as one metric family
+        per base name — a single TYPE line followed by every label set —
+        and the label block survives name sanitization untouched."""
+        sink = PrometheusTextfileSink(str(tmp_path / "metrics.prom"))
+        sink.write({"kind": "gauges", "wall": 0.0, "values": {
+            "kv_pages_free": 5.0,
+            'kv_pages_free{replica="0"}': 2.0,
+            'kv_pages_free{replica="1"}': 3.0,
+        }})
+        sink.flush()
+        lines = open(sink.path, encoding="utf-8").read().splitlines()
+        assert "apex_tpu_kv_pages_free 5.0" in lines
+        assert 'apex_tpu_kv_pages_free{replica="0"} 2.0' in lines
+        assert 'apex_tpu_kv_pages_free{replica="1"} 3.0' in lines
+        # one TYPE line per family, not per label set
+        assert lines.count("# TYPE apex_tpu_kv_pages_free gauge") == 1
+        # labeled series sit under their family's TYPE line
+        t = lines.index("# TYPE apex_tpu_kv_pages_free gauge")
+        assert lines[t + 1].startswith("apex_tpu_kv_pages_free")
+
 
 class TestFlops:
     def test_transformer_train_flops_hand_computed(self):
@@ -551,3 +588,48 @@ class TestReportBackCompat:
         text = render_report(report)
         assert "dispatches: 2" in text
         assert "replica0=1 replica1=1" in text
+
+    PRE_PR14 = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "data", "pre_pr14_run.jsonl")
+
+    def test_pre_pr14_log_without_spans_still_renders(self):
+        """A committed pre-tracing-era log (PR-13 vintage: adapter
+        ledger present, NO ``trace_id`` on requests, NO span rows, no
+        ``spans_*`` counters, torn last line) builds, renders without a
+        tracing section, and still yields per-tenant attribution from
+        the ``adapter_id`` request fields alone."""
+        report = build_report(self.PRE_PR14)
+        assert report["requests"]["count"] == 4
+        # no span rows anywhere: the tracing section degrades to absent
+        assert report["spans"] is None
+        assert report["signals"] is None
+        # per-tenant attribution needs only adapter_id on request rows
+        by_adapter = report["slo_by_adapter"]
+        assert set(by_adapter) == {"0", "1", "base"}
+        assert by_adapter["0"]["requests"] == 1
+        assert by_adapter["1"]["requests"] == 1
+        assert by_adapter["base"]["requests"] == 2
+        text = render_report(report)
+        assert "per-tenant slo" in text
+        assert "request tracing" not in text
+        assert "fleet signals" not in text
+
+    def test_pre_pr14_log_span_check_is_vacuous(self):
+        """``check_span_conservation`` only examines requests that carry
+        a ``trace_id`` — a trace-less log passes vacuously, so the
+        loadtest ``--check`` gate cannot fail old logs."""
+        from apex_tpu.observability.report import read_records
+        from apex_tpu.observability.trace import check_span_conservation
+
+        records = read_records(self.PRE_PR14)
+        assert check_span_conservation(records) == []
+
+    def test_pre_pr14_trace_lookup_reports_not_found(self, capsys):
+        """``--trace`` on a trace-less log exits 2 with a clear message
+        instead of raising."""
+        from apex_tpu.observability.report import main as monitor_main
+
+        assert monitor_main([self.PRE_PR14, "--trace", "0"]) == 2
+        out = capsys.readouterr()
+        assert "no spans" in (out.out + out.err).lower() or \
+            "not found" in (out.out + out.err).lower()

@@ -1,0 +1,598 @@
+"""Tracing + fleet-telemetry acceptance suite (ISSUE 14).
+
+Unit layer (jax-free modules under test): span emission and the
+conservation checker's red paths, :class:`ReplicaRegistry` dual-write
+semantics, :func:`merge_histograms`, and :class:`FleetMetrics.signals`
+driven exactly against a hand-built fake fleet.
+
+Acceptance layer (tier-1, shared 2-layer model — same dims as the
+committed scenarios, so one build serves both runs):
+
+- the committed ``multi_tenant`` scenario: every terminal request's
+  span timeline is complete and gap-free, per-request span durations
+  sum to the measured latency, the per-tenant SLO table reconciles
+  key-for-key with the adapter ledger, the monitor (human and
+  ``--json``) renders both, the loadtest ``--check`` gate stays green
+  on the real log and goes ``EXIT_ERROR`` on an injected violation —
+  with tracing adding zero decode retraces.
+- the committed ``fleet_smoke`` scenario: ``FleetMetrics.signals()``
+  reconciles exactly with the merged replica counters even across a
+  mid-run draining restart + migration, and the signals record lands
+  in the log for the monitor's fleet-signals section.
+"""
+
+import json
+import os
+
+import jax
+import pytest
+
+from apex_tpu.loadtest import Scenario, run_scenario
+from apex_tpu.loadtest.__main__ import (
+    EXIT_ERROR,
+    EXIT_OK,
+    main as loadtest_main,
+)
+from apex_tpu.models import GPTModel, TransformerConfig
+from apex_tpu.observability import (
+    FleetMetrics,
+    InMemorySink,
+    MARK_SPANS,
+    MetricsRegistry,
+    PHASE_SPANS,
+    ReplicaRegistry,
+    build_report,
+    build_timelines,
+    check_span_conservation,
+    emit_request_spans,
+    emit_span,
+    format_timeline,
+    merge_histograms,
+    new_trace_id,
+    render_report,
+)
+from apex_tpu.observability.report import (
+    main as monitor_main,
+    read_records,
+)
+from apex_tpu.observability.trace import SPAN_COUNTER_PREFIX
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCENARIO_DIR = os.path.join(REPO, "benchmarks", "scenarios")
+MT_SCENARIO = os.path.join(SCENARIO_DIR, "multi_tenant.json")
+FLEET_SCENARIO = os.path.join(SCENARIO_DIR, "fleet_smoke.json")
+
+
+# ---------------------------------------------------------------------------
+# unit: span emission + the conservation checker
+
+
+class TestSpanEmission:
+    def test_emit_span_stamps_row_and_counter(self):
+        mem = InMemorySink()
+        reg = MetricsRegistry([mem])
+        tid = new_trace_id()
+        rec = emit_span(reg, "decode", trace_id=tid, request_id=7,
+                        start_s=1.0, end_s=1.5, wall=100.0,
+                        replica_id=1, detail="x", proposed=4)
+        assert rec["kind"] == "span" and rec["span"] == "decode"
+        assert rec["duration_s"] == pytest.approx(0.5)
+        assert rec["replica_id"] == 1 and rec["proposed"] == 4
+        assert mem.of_kind("span") == [rec]
+        assert reg.counters()[SPAN_COUNTER_PREFIX + "decode"] == 1
+
+    def test_trace_ids_are_unique_hex(self):
+        ids = {new_trace_id() for _ in range(64)}
+        assert len(ids) == 64
+        assert all(len(t) == 16 and int(t, 16) >= 0 for t in ids)
+
+    def test_emit_request_spans_full_trio_conserves(self):
+        """The prefill-reaching path emits queued -> prefill -> decode,
+        contiguous by construction, and the stream passes the checker
+        once the terminal record + counters land next to it."""
+        mem = InMemorySink()
+        reg = MetricsRegistry([mem])
+        tid = new_trace_id()
+        spans = emit_request_spans(reg, trace_id=tid, request_id=0,
+                                   submit_ts=10.0, now=10.7, wall=1.0,
+                                   prefill_start=10.1, prefill_end=10.4)
+        assert [s["span"] for s in spans] == ["queued", "prefill",
+                                              "decode"]
+        records = mem.records + [
+            {"kind": "request", "request_id": 0, "trace_id": tid,
+             "finish_reason": "eos", "total_s": 0.7, "wall": 1.0},
+            {"kind": "counters", "wall": 1.0,
+             "values": dict(reg.counters())},
+        ]
+        assert check_span_conservation(records) == []
+
+    def test_emit_request_spans_shed_paths(self):
+        reg = MetricsRegistry([InMemorySink()])
+        shed = emit_request_spans(reg, trace_id=new_trace_id(),
+                                  request_id=1, submit_ts=0.0, now=0.2,
+                                  wall=1.0, detail="queue_full")
+        assert [s["span"] for s in shed] == ["shed"]
+        assert shed[0]["detail"] == "queue_full"
+        waited = emit_request_spans(reg, trace_id=new_trace_id(),
+                                    request_id=2, submit_ts=0.0,
+                                    now=0.2, wall=1.0)
+        assert [s["span"] for s in waited] == ["queued"]
+
+    def test_format_timeline_renders_marks_and_sum(self):
+        tid = new_trace_id()
+        spans = [
+            {"kind": "span", "span": "queued", "trace_id": tid,
+             "request_id": 3, "start_s": 0.0, "end_s": 0.1,
+             "duration_s": 0.1, "wall": 1.0},
+            {"kind": "span", "span": "migration", "trace_id": tid,
+             "request_id": 3, "start_s": 0.05, "end_s": 0.05,
+             "duration_s": 0.0, "wall": 1.0, "from_replica": 0},
+            {"kind": "span", "span": "decode", "trace_id": tid,
+             "request_id": 3, "start_s": 0.1, "end_s": 0.4,
+             "duration_s": 0.3, "wall": 1.0},
+        ]
+        text = format_timeline(3, spans, {"finish_reason": "eos",
+                                          "total_s": 0.4})
+        assert f"trace_id={tid}" in text and "finish=eos" in text
+        assert "(mark)" in text and "from_replica=0" in text
+        assert "span sum: 0.4000s over 2 phase span(s)" in text
+        assert format_timeline(9, []) == "request 9: no spans recorded"
+
+
+class TestCheckSpanConservation:
+    @staticmethod
+    def _stream(*, gap=0.0, pad=0.0, drop_spans=False, wrong_tid=False,
+                counter_skew=0):
+        tid = "aa" * 8
+        spans = [] if drop_spans else [
+            {"kind": "span", "span": "queued", "trace_id": tid,
+             "request_id": 0, "start_s": 0.0, "end_s": 0.1,
+             "duration_s": 0.1, "wall": 1.0},
+            {"kind": "span", "span": "decode",
+             "trace_id": "bb" * 8 if wrong_tid else tid,
+             "request_id": 0, "start_s": 0.1 + gap,
+             "end_s": 0.5 + gap + pad, "duration_s": 0.4 + pad,
+             "wall": 1.0},
+        ]
+        return spans + [
+            {"kind": "request", "request_id": 0, "trace_id": tid,
+             "finish_reason": "eos", "total_s": 0.5, "wall": 1.0},
+            {"kind": "counters", "wall": 1.0, "values": {
+                "spans_queued": (0 if drop_spans else 1) + counter_skew,
+                "spans_decode": 0 if drop_spans else 1}},
+        ]
+
+    def test_conserved_stream_passes(self):
+        assert check_span_conservation(self._stream()) == []
+
+    def test_traceless_log_is_vacuous(self):
+        records = [{"kind": "request", "request_id": 0,
+                    "finish_reason": "eos", "total_s": 0.5, "wall": 1.0}]
+        assert check_span_conservation(records) == []
+
+    def test_missing_spans_flagged(self):
+        v = check_span_conservation(self._stream(drop_spans=True))
+        assert any("no phase spans" in line for line in v)
+
+    def test_gap_between_phases_flagged(self):
+        v = check_span_conservation(self._stream(gap=0.05))
+        assert any("gap between" in line for line in v)
+
+    def test_span_sum_mismatch_flagged(self):
+        v = check_span_conservation(self._stream(pad=0.2))
+        assert any("phase span sum" in line for line in v)
+
+    def test_foreign_trace_id_flagged(self):
+        v = check_span_conservation(self._stream(wrong_tid=True))
+        assert any("trace_id" in line for line in v)
+
+    def test_counter_row_mismatch_flagged(self):
+        v = check_span_conservation(self._stream(counter_skew=2))
+        assert any("span counter spans_queued=3" in line for line in v)
+
+
+# ---------------------------------------------------------------------------
+# unit: the fleet telemetry plane
+
+
+class TestReplicaRegistry:
+    def test_producer_calls_dual_write(self):
+        parent = MetricsRegistry([InMemorySink()])
+        r0 = ReplicaRegistry(parent, 0)
+        r1 = ReplicaRegistry(parent, 1)
+        assert r0.inc("requests_eos", 2) == 2   # returns the GLOBAL count
+        assert r1.inc("requests_eos") == 3
+        assert r0.counters()["requests_eos"] == 2
+        assert r1.counters()["requests_eos"] == 1
+        assert parent.counters()["requests_eos"] == 3
+        r0.set_gauge("kv_pages_free", 5.0)
+        assert r0.gauges()["kv_pages_free"] == 5.0
+        assert parent.gauges()["kv_pages_free"] == 5.0
+        r1.observe("request_ttft_s", 0.25)
+        assert r1.histogram("request_ttft_s").count == 1
+        assert parent.histogram("request_ttft_s").count == 1
+        assert r0.histogram("request_ttft_s") is None
+        r0.declare_counters("requests_error")
+        assert r0.counters()["requests_error"] == 0
+        assert parent.counters()["requests_error"] == 0
+
+    def test_stream_is_parent_only(self):
+        """Events/records go through the parent's single seq-ordered
+        stream — the fleet log stays byte-identical to the pre-split
+        era, with no per-replica sinks to interleave."""
+        mem = InMemorySink()
+        parent = MetricsRegistry([mem])
+        rep = ReplicaRegistry(parent, 1)
+        ev = rep.event("replica_probe", replica_id=1)
+        rep.emit_record({"kind": "span", "span": "queued"})
+        assert mem.of_kind("event") == [ev]
+        assert len(mem.of_kind("span")) == 1
+        assert rep._sinks == () or list(rep._sinks) == []
+        extra = InMemorySink()
+        rep.add_sink(extra)             # lands on the parent
+        rep.event("second")
+        assert len(extra.of_kind("event")) == 1
+
+    def test_flush_and_close_delegate(self):
+        mem = InMemorySink()
+        parent = MetricsRegistry([mem])
+        rep = ReplicaRegistry(parent, 0)
+        rep.inc("steps")
+        rep.flush()
+        snaps = mem.of_kind("counters")
+        assert snaps and snaps[-1]["values"]["steps"] == 1
+        rep.close()
+        assert mem.closed
+
+
+class TestMergeHistograms:
+    def test_exact_aggregates_and_window_union(self):
+        a = MetricsRegistry()
+        b = MetricsRegistry()
+        for v in (0.1, 0.2, 0.3):
+            a.observe("ttft", v)
+        for v in (1.0, 2.0):
+            b.observe("ttft", v)
+        merged = merge_histograms(
+            [a.histogram("ttft"), b.histogram("ttft")], "ttft")
+        assert merged.count == 5
+        assert merged.sum == pytest.approx(3.6)
+        assert merged.min == pytest.approx(0.1)
+        assert merged.max == pytest.approx(2.0)
+        # the merged percentile window saw BOTH replicas' observations
+        assert merged.percentile(99) == pytest.approx(2.0)
+        assert merged.percentile(0) == pytest.approx(0.1)
+
+
+class _FakeSupervisor:
+    def __init__(self, queued, active):
+        self.queued_count = queued
+        self.active_count = active
+
+
+class _FakeReplica:
+    def __init__(self, queued, active):
+        self.supervisor = _FakeSupervisor(queued, active)
+
+
+class _FakeConfig:
+    def __init__(self, max_slots):
+        self.max_slots = max_slots
+
+
+class _FakeFleet:
+    """The duck-typed surface FleetMetrics polls, with deterministic
+    numbers so every signal is asserted exactly."""
+
+    def __init__(self):
+        self.metrics = MetricsRegistry([InMemorySink()])
+        self.replica_metrics = {
+            0: ReplicaRegistry(self.metrics, 0),
+            1: ReplicaRegistry(self.metrics, 1),
+        }
+        self.replicas = [_FakeReplica(2, 1), _FakeReplica(1, 2)]
+        self.config = _FakeConfig(max_slots=2)
+        self._backlog = [object()]
+        self.inflight_count = 3
+
+    def dispatch_set(self):
+        return [0, 1]
+
+
+class TestFleetMetricsSignals:
+    @pytest.fixture()
+    def fleet(self):
+        f = _FakeFleet()
+        r0, r1 = f.replica_metrics[0], f.replica_metrics[1]
+        r0.inc("requests_eos", 3)
+        r0.inc("requests_error", 1)
+        r0.inc("adapter0_requests", 3)
+        r1.inc("requests_length", 2)
+        r1.inc("requests_timeout", 1)
+        r1.inc("adapter1_requests", 1)
+        f.metrics.inc("requests_submitted", 7)   # fleet-level key
+        r0.set_gauge("kv_pages_in_use", 6.0)
+        r0.set_gauge("kv_pages_free", 2.0)
+        r1.set_gauge("kv_pages_in_use", 2.0)
+        r1.set_gauge("kv_pages_free", 6.0)
+        for v in (0.1, 0.2):
+            r0.observe("request_ttft_s", v)
+        r1.observe("request_ttft_s", 0.9)
+        return f
+
+    def test_signals_exact(self, fleet):
+        fm = FleetMetrics(fleet)
+        s = fm.signals()
+        assert s["replicas_total"] == 2
+        assert s["replicas_dispatchable"] == 2
+        assert s["inflight"] == 3
+        # queued 2+1 across supervisors + 1 fleet backlog entry
+        assert s["queue_depth"] == 4
+        assert s["requests_submitted"] == 7
+        assert s["requests_ok"] == 5            # 3 eos + 2 length
+        assert s["requests_terminal"] == 7
+        assert s["goodput"] == pytest.approx(5 / 7)
+        assert s["slot_occupancy"] == pytest.approx(3 / 4)
+        assert s["kv_page_occupancy"] == pytest.approx(8 / 16)
+        # merged-window p99: sees replica 1's slow observation
+        assert s["ttft_p99_s"] == pytest.approx(0.9)
+        assert s["tpot_p99_s"] is None          # no data -> no number
+        assert s["adapter_share"] == {
+            "adapter0": pytest.approx(3 / 4),
+            "adapter1": pytest.approx(1 / 4)}
+
+    def test_goodput_window_is_since_last_poll(self, fleet):
+        fm = FleetMetrics(fleet)
+        first = fm.signals()
+        assert first["window_terminal"] == 7
+        assert first["goodput_window"] == pytest.approx(5 / 7)
+        # nothing terminal between polls: window empty, no verdict
+        idle = fm.signals()
+        assert idle["window_terminal"] == 0
+        assert idle["goodput_window"] is None
+        # one new failure: the window sees ONLY it, lifetime barely moves
+        fleet.replica_metrics[0].inc("requests_error")
+        third = fm.signals()
+        assert third["window_terminal"] == 1
+        assert third["goodput_window"] == 0.0
+        assert third["goodput"] == pytest.approx(5 / 8)
+
+    def test_merged_counters_reconcile_with_parent(self, fleet):
+        fm = FleetMetrics(fleet)
+        merged = fm.merged_counters()
+        parent = fleet.metrics.counters()
+        # every replica-incremented counter sums to the parent's value
+        for name, value in merged.items():
+            assert parent[name] == value, name
+        # fleet-level keys are the difference, never in the merge
+        assert "requests_submitted" not in merged
+        snap = fm.snapshot()
+        assert snap["counters"] == parent
+        assert snap["replica_counters"]["0"]["requests_eos"] == 3
+        assert snap["gauges"]['kv_pages_in_use{replica="1"}'] == 2.0
+
+    def test_write_prometheus_labeled_export(self, fleet, tmp_path):
+        path = str(tmp_path / "fleet.prom")
+        FleetMetrics(fleet).write_prometheus(path)
+        text = open(path, encoding="utf-8").read()
+        assert "apex_tpu_requests_eos_total 3" in text
+        assert 'apex_tpu_kv_pages_in_use{replica="0"} 6.0' in text
+        assert 'apex_tpu_kv_pages_in_use{replica="1"} 2.0' in text
+        assert text.count("# TYPE apex_tpu_kv_pages_in_use gauge") == 1
+        assert "apex_tpu_request_ttft_s_count 3" in text
+
+
+# ---------------------------------------------------------------------------
+# acceptance: the committed scenarios, tier-1
+
+
+@pytest.fixture(scope="module")
+def small():
+    """Same dims as the committed scenarios' model spec (the
+    test_loadtest convention) — one build serves both runs."""
+    model = GPTModel(TransformerConfig(
+        num_layers=2, hidden_size=32, num_attention_heads=4, vocab_size=64,
+        max_position_embeddings=64, hidden_dropout=0.0,
+        attention_dropout=0.0))
+    params = model.init(jax.random.PRNGKey(0))
+    return model, params
+
+
+@pytest.fixture(scope="module")
+def mt_run(small, tmp_path_factory):
+    model, params = small
+    scn = Scenario.load(MT_SCENARIO)
+    log = str(tmp_path_factory.mktemp("trace") / "multi_tenant.jsonl")
+    run = run_scenario(scn, model=model, params=params, log_path=log)
+    assert not run.aborted and run.submitted == scn.total_requests
+    return {"run": run, "log": log, "records": read_records(log)}
+
+
+@pytest.fixture(scope="module")
+def fleet_run(small, tmp_path_factory):
+    model, params = small
+    scn = Scenario.load(FLEET_SCENARIO)
+    log = str(tmp_path_factory.mktemp("trace") / "fleet_smoke.jsonl")
+    run = run_scenario(scn, model=model, params=params, log_path=log)
+    assert not run.aborted and run.submitted == scn.total_requests
+    return {"run": run, "log": log, "records": read_records(log)}
+
+
+class TestMultiTenantTraceAcceptance:
+    def test_every_terminal_request_has_complete_timeline(self, mt_run):
+        """Acceptance: span conservation over the real run — every
+        terminal request's timeline exists, is gap-free, and its phase
+        durations sum to the measured latency (the checker asserts all
+        three, plus key-for-key counter reconciliation)."""
+        records = mt_run["records"]
+        assert check_span_conservation(records) == []
+        requests = [r for r in records if r.get("kind") == "request"]
+        assert requests and all(r.get("trace_id") for r in requests)
+        timelines = build_timelines(records)
+        for r in requests:
+            spans = timelines[r["request_id"]]
+            phases = [s for s in spans if s["span"] in PHASE_SPANS]
+            assert phases, r
+            # spot-check the invariant the checker enforces
+            span_sum = sum(s["duration_s"] for s in phases)
+            assert span_sum == pytest.approx(r["total_s"], rel=0.02,
+                                             abs=0.002)
+
+    def test_span_counters_match_rows(self, mt_run):
+        records = mt_run["records"]
+        counters = mt_run["run"].counters
+        rows = [r for r in records if r.get("kind") == "span"]
+        by_name = {}
+        for s in rows:
+            by_name[s["span"]] = by_name.get(s["span"], 0) + 1
+        for name, n in by_name.items():
+            assert counters[SPAN_COUNTER_PREFIX + name] == n, name
+        # one timeline per terminal request, each starting queued
+        assert by_name["queued"] == len(mt_run["run"].results)
+
+    def test_per_tenant_table_reconciles_with_adapter_ledger(
+            self, mt_run):
+        """Acceptance: the per-tenant SLO attribution reconciles
+        key-for-key with the adapter admission ledger — every
+        ``adapterN_requests`` counter has a tenant row whose request
+        count matches, and base traffic is attributed too."""
+        run = mt_run["run"]
+        counters = run.counters
+        by_adapter = run.slo_by_adapter
+        ledger = {name[len("adapter"):-len("_requests")]: n
+                  for name, n in counters.items()
+                  if name.startswith("adapter")
+                  and name.endswith("_requests") and n}
+        assert ledger, "multi_tenant ran without adapter traffic"
+        for adapter_id, n in ledger.items():
+            assert by_adapter[adapter_id]["requests"] == n
+        base = [r for r in mt_run["records"]
+                if r.get("kind") == "request"
+                and not isinstance(r.get("adapter_id"), str)]
+        if base:
+            assert by_adapter["base"]["requests"] == len(base)
+        assert set(by_adapter) == set(ledger) | ({"base"} if base
+                                                 else set())
+        total = sum(m["requests"] for m in by_adapter.values())
+        assert total == len(run.results)
+
+    def test_tracing_adds_no_retraces(self, mt_run):
+        """The engine runs with ``retrace_budget=0`` (any decode retrace
+        aborts the run), so a completed, conserved run IS the zero-new-
+        jit-programs proof; the counter stays flat regardless."""
+        run = mt_run["run"]
+        assert not run.aborted
+        assert run.counters.get("retraces", 0) == 0
+        assert run.counters.get("requests_error", 0) == 0
+
+    def test_monitor_renders_tracing_and_tenant_sections(
+            self, mt_run, capsys):
+        report = build_report(mt_run["log"])
+        spans = report["spans"]
+        assert spans is not None and spans["violations"] == []
+        assert spans["traced_requests"] == len(mt_run["run"].results)
+        assert set(report["slo_by_adapter"]) == \
+            set(mt_run["run"].slo_by_adapter)
+        text = render_report(report)
+        assert "request tracing" in text
+        assert "span conservation: OK" in text
+        assert "per-tenant slo" in text
+        # --json carries both sections, reconciled with the in-process run
+        assert monitor_main([mt_run["log"], "--json"]) == 0
+        cli = json.loads(capsys.readouterr().out)
+        assert cli["spans"]["by_name"] == spans["by_name"]
+        for tenant, metrics in cli["slo_by_adapter"].items():
+            assert metrics["requests"] == \
+                mt_run["run"].slo_by_adapter[tenant]["requests"]
+
+    def test_monitor_trace_prints_one_timeline(self, mt_run, capsys):
+        rid = min(mt_run["run"].results)
+        assert monitor_main([mt_run["log"], "--trace", str(rid)]) == 0
+        out = capsys.readouterr().out
+        assert f"request {rid}" in out and "trace_id=" in out
+        assert "span sum:" in out
+        assert monitor_main([mt_run["log"], "--trace", "99999"]) == 2
+
+    def test_loadtest_check_gate_green_and_red(self, mt_run, tmp_path,
+                                               capsys):
+        """``--check`` passes on the real log; a log with a torn
+        invariant (an extra phase span forged into one timeline) exits
+        ``EXIT_ERROR`` — span violations outrank the SLO verdict."""
+        base = str(tmp_path / "base.json")
+        assert loadtest_main([MT_SCENARIO, "--from-log", mt_run["log"],
+                              "--baseline", base,
+                              "--update-baseline"]) == EXIT_OK
+        assert loadtest_main([MT_SCENARIO, "--from-log", mt_run["log"],
+                              "--check", "--baseline", base]) == EXIT_OK
+        assert "span conservation: OK" in capsys.readouterr().out
+
+        records = mt_run["records"]
+        victim = next(r for r in records if r.get("kind") == "request")
+        forged = str(tmp_path / "forged.jsonl")
+        with open(mt_run["log"], encoding="utf-8") as src, \
+                open(forged, "w", encoding="utf-8") as dst:
+            dst.write(src.read())
+            dst.write(json.dumps({
+                "kind": "span", "span": "decode",
+                "trace_id": victim["trace_id"],
+                "request_id": victim["request_id"],
+                "start_s": 0.0, "end_s": 99.0, "duration_s": 99.0,
+                "wall": 0.0}) + "\n")
+        assert loadtest_main([MT_SCENARIO, "--from-log", forged,
+                              "--check", "--baseline", base]) \
+            == EXIT_ERROR
+        assert "span conservation" in capsys.readouterr().out
+
+
+class TestFleetSignalsAcceptance:
+    def test_signals_reconcile_with_merged_counters(self, fleet_run):
+        """Acceptance: the final ``signals()`` poll is derived from —
+        and reconciles exactly with — the merged replica counters, even
+        after a draining restart migrated in-flight work."""
+        run = fleet_run["run"]
+        s = run.signals
+        assert s is not None
+        counters = run.counters
+        ok = sum(counters.get(f"requests_{r}", 0)
+                 for r in ("eos", "length"))
+        terminal = sum(counters.get(f"requests_{r}", 0)
+                       for r in ("eos", "length", "cancelled",
+                                 "timeout", "rejected", "error"))
+        assert s["requests_submitted"] == counters["requests_submitted"]
+        assert s["requests_ok"] == ok
+        assert s["requests_terminal"] == terminal
+        assert s["goodput"] == pytest.approx(ok / terminal)
+        assert s["replicas_total"] == 2
+        # end of run: nothing queued or in flight
+        assert s["queue_depth"] == 0 and s["inflight"] == 0
+        assert s["ttft_p99_s"] is not None
+        # the same dict was stamped into the log for the monitor
+        stamped = [r for r in fleet_run["records"]
+                   if r.get("kind") == "signals"]
+        assert stamped and stamped[-1]["values"] == \
+            json.loads(json.dumps(s))
+
+    def test_spans_conserve_across_migration(self, fleet_run):
+        """A migrated request still gets exactly one timeline (emitted
+        by its final engine incarnation) that reconciles with the
+        LOGGED record — conservation holds across drain/migrate/
+        rebuild, with migration rendered as a mark, not a phase."""
+        records = fleet_run["records"]
+        assert check_span_conservation(records) == []
+        marks = [r for r in records if r.get("kind") == "span"
+                 and r.get("span") in MARK_SPANS]
+        for m in marks:
+            assert m["span"] == "migration"
+        requests = [r for r in records if r.get("kind") == "request"]
+        assert all(r.get("trace_id") for r in requests)
+
+    def test_monitor_renders_fleet_signals(self, fleet_run, capsys):
+        report = build_report(fleet_run["log"])
+        assert report["signals"] == json.loads(
+            json.dumps(fleet_run["run"].signals))
+        text = render_report(report)
+        assert "fleet signals" in text
+        assert "request tracing" in text
+        assert monitor_main([fleet_run["log"], "--json"]) == 0
+        cli = json.loads(capsys.readouterr().out)
+        assert cli["signals"]["replicas_total"] == 2
