@@ -149,6 +149,7 @@ def _build_serving(scenario: Scenario, model, params,
         prefix_lru_capacity=knobs.prefix_lru_capacity,
         kv_dtype=knobs.kv_dtype,
         speculation=knobs.speculation,
+        prefill_token_budget=knobs.prefill_token_budget,
         scheduler=SchedulerConfig(
             max_queue=knobs.max_queue,
             max_prefills_per_tick=knobs.max_prefills_per_tick))

@@ -127,6 +127,9 @@ class EngineKnobs:
     speculation: int = 0
     lora_rank: int = 0
     lora_adapters: int = 0
+    #: chunked-prefill token budget per tick (docs/serving.md#chunked-
+    #: prefill); None = monolithic prefill (the pre-PR-15 behavior)
+    prefill_token_budget: Optional[int] = None
 
     def __post_init__(self):
         if self.kv_layout not in ("flat", "paged"):
@@ -164,6 +167,17 @@ class EngineKnobs:
                 f"lora_rank ({self.lora_rank}) and lora_adapters "
                 f"({self.lora_adapters}) must be set together (both 0 "
                 f"= no adapter store)")
+        if self.prefill_token_budget is not None:
+            if self.prefill_token_budget < 1:
+                raise ValueError(
+                    f"prefill_token_budget must be >= 1, got "
+                    f"{self.prefill_token_budget}")
+            if self.kv_layout == "paged" \
+                    and self.prefill_token_budget < self.page_size:
+                raise ValueError(
+                    f"prefill_token_budget ({self.prefill_token_budget}) "
+                    f"must be >= page_size ({self.page_size}) under the "
+                    f"paged layout — chunk boundaries are page-aligned")
 
     @classmethod
     def from_dict(cls, data: Dict[str, Any]) -> "EngineKnobs":
@@ -178,6 +192,9 @@ class EngineKnobs:
             kw["n_pages"] = int(n) if n is not None else None
         if "prefix_cache" in d:
             kw["prefix_cache"] = bool(d.pop("prefix_cache"))
+        if "prefill_token_budget" in d:
+            b = d.pop("prefill_token_budget")
+            kw["prefill_token_budget"] = int(b) if b is not None else None
         kw.update({k: int(v) for k, v in d.items()})
         return cls(**kw)
 
@@ -200,6 +217,8 @@ class EngineKnobs:
         if self.lora_adapters:
             out["lora_rank"] = self.lora_rank
             out["lora_adapters"] = self.lora_adapters
+        if self.prefill_token_budget is not None:
+            out["prefill_token_budget"] = self.prefill_token_budget
         return out
 
 

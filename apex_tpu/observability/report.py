@@ -194,6 +194,11 @@ def _request_summary(requests: List[dict]) -> Optional[dict]:
         "ttft_s": _field("ttft_s"),
         "tpot_s": _field("tpot_s"),
         "tokens_per_s": _field("tokens_per_s"),
+        # chunked-prefill audit: sum of per-request prefill_chunks,
+        # reconciling with the prefill_chunks counter (rows written by
+        # pre-chunking engines simply contribute 0)
+        "prefill_chunks": sum(int(r.get("prefill_chunks", 0))
+                              for r in requests),
     }
 
 
@@ -513,6 +518,24 @@ def render_report(report: dict) -> str:
                 line += (f" per-step mean={_fmt(acc.get('mean'))} "
                          f"n={acc['count']}")
             lines.append(line)
+    chunk_counters = report.get("counters") or {}
+    chunks = chunk_counters.get("prefill_chunks", 0)
+    if chunks:
+        # chunked prefill (both layouts — rendered outside the paged-KV
+        # block): the chunk-program counter reconciles with the sum of
+        # per-request prefill_chunks record fields, and the
+        # prefill_tokens_per_tick histogram shows how full the
+        # per-tick token budget actually ran
+        line = f"  chunked prefill: chunks={chunks}"
+        if req is not None:
+            line += f" per-request sum={req.get('prefill_chunks', 0)}"
+        tpt = (report.get("histograms") or {}).get("prefill_tokens_per_tick")
+        if isinstance(tpt, dict) and tpt.get("count"):
+            line += (f"  tokens/tick mean={_fmt(tpt.get('mean'))} "
+                     f"max={_fmt(tpt.get('max'))} n={tpt['count']}")
+        if not req and "kv_pages_in_use" not in gauges:
+            lines += ["", "serving kv cache:"]
+        lines.append(line)
     slo = report.get("slo")
     if slo:
         verdict = "PASS" if slo["ok"] else "FAIL"

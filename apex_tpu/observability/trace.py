@@ -95,6 +95,7 @@ def emit_request_spans(registry, *, trace_id: str, request_id: int,
                        prefill_start: float = 0.0,
                        prefill_end: float = 0.0,
                        replica_id: Optional[int] = None,
+                       prefill_segments: Sequence[float] = (),
                        detail: Optional[str] = None) -> List[dict]:
     """Emit the request's phase-span timeline at its terminal choke
     point, from the same timestamps that produced the terminal record's
@@ -105,21 +106,36 @@ def emit_request_spans(registry, *, trace_id: str, request_id: int,
     - a request shed before prefill gets a single span: ``shed`` when a
       shed ``detail`` is given (queue_full/deadline_expired/...), else
       ``queued`` (cancelled or expired while waiting).
+
+    ``prefill_segments`` are the INTERIOR chunk-boundary timestamps of a
+    chunked prefill (docs/serving.md#chunked-prefill): the prefill phase
+    is then emitted as one span per chunk — contiguous by construction,
+    covering exactly ``[prefill_start, prefill_end]``, so the
+    conservation invariants (gap-free, sum == ``total_s``) hold
+    unchanged while the timeline shows every chunk the tick budget
+    carved. Empty for a monolithic prefill (one span, the pre-chunking
+    timeline bit-for-bit).
     """
     if prefill_start:
-        return [
+        spans = [
             emit_span(registry, SPAN_QUEUED, trace_id=trace_id,
                       request_id=request_id, start_s=submit_ts,
                       end_s=prefill_start, wall=wall,
                       replica_id=replica_id),
-            emit_span(registry, SPAN_PREFILL, trace_id=trace_id,
-                      request_id=request_id, start_s=prefill_start,
-                      end_s=prefill_end, wall=wall,
-                      replica_id=replica_id),
+        ]
+        bounds = [prefill_start, *prefill_segments, prefill_end]
+        for seg, (seg_start, seg_end) in enumerate(
+                zip(bounds, bounds[1:])):
+            spans.append(emit_span(
+                registry, SPAN_PREFILL, trace_id=trace_id,
+                request_id=request_id, start_s=seg_start, end_s=seg_end,
+                wall=wall, replica_id=replica_id,
+                **({"chunk": seg} if len(bounds) > 2 else {})))
+        spans.append(
             emit_span(registry, SPAN_DECODE, trace_id=trace_id,
                       request_id=request_id, start_s=prefill_end,
-                      end_s=now, wall=wall, replica_id=replica_id),
-        ]
+                      end_s=now, wall=wall, replica_id=replica_id))
+        return spans
     name = SPAN_SHED if detail is not None else SPAN_QUEUED
     return [emit_span(registry, name, trace_id=trace_id,
                       request_id=request_id, start_s=submit_ts,
@@ -165,7 +181,7 @@ def format_timeline(request_id: int, spans: Sequence[dict],
             extra += f"  detail={s['detail']}"
         if s.get("replica_id") is not None:
             extra += f"  replica={s['replica_id']}"
-        for key in ("proposed", "accepted", "from_replica",
+        for key in ("chunk", "proposed", "accepted", "from_replica",
                     "tokens_carried"):
             if key in s:
                 extra += f"  {key}={s[key]}"

@@ -134,7 +134,12 @@ _COUNTERS = ("requests_submitted", "requests_eos", "requests_length",
              # proposed counts drafted positions beyond the forced first
              # feed; accepted counts the ones the target agreed with, so
              # accepted/proposed is the fleet-wide acceptance rate
-             "draft_tokens_proposed", "draft_tokens_accepted")
+             "draft_tokens_proposed", "draft_tokens_accepted",
+             # chunked prefill (docs/serving.md#chunked-prefill): chunk
+             # programs run under prefill_token_budget — reconciled
+             # against the per-request prefill_chunks record field and
+             # the prefill_tokens_per_tick histogram's observation sum
+             "prefill_chunks")
 
 
 @dataclass
@@ -182,6 +187,17 @@ class EngineConfig:
     step). Both knobs keep greedy streams token-exact against the
     defaults; speculation keeps SAMPLED streams exact too (the
     acceptance rule reproduces the sequential per-position sampling).
+
+    Chunked prefill (docs/serving.md#chunked-prefill):
+    ``prefill_token_budget=n`` bounds the prefill TOKENS one tick may
+    run — a long prompt prefills as a sequence of bucketed chunk
+    programs carried across ticks, interleaved with the batched decode
+    step, so co-tenant TPOT never stalls for more than one chunk's
+    compute. Internal chunk boundaries are page-aligned under the paged
+    layout (so int8 scales and prefix interning stay bitwise what the
+    monolithic fill produces) and outputs are token-exact, greedy and
+    sampled. ``None`` (default) keeps the one-shot prefill path
+    unchanged.
     """
 
     max_slots: int = 8
@@ -196,6 +212,7 @@ class EngineConfig:
     prefix_lru_capacity: int = 32
     kv_dtype: str = "bf16"
     speculation: int = 0
+    prefill_token_budget: Optional[int] = None
 
     def __post_init__(self):
         if self.max_slots < 1:
@@ -233,6 +250,19 @@ class EngineConfig:
             raise ValueError(
                 "speculation needs kv_layout='paged' — the verify "
                 "window rides the fused paged kernel")
+        if self.prefill_token_budget is not None:
+            if self.prefill_token_budget < 1:
+                raise ValueError(
+                    f"prefill_token_budget must be >= 1 (or None to "
+                    f"disable chunking), got {self.prefill_token_budget}")
+            if (self.kv_layout == "paged"
+                    and self.prefill_token_budget < self.page_size):
+                raise ValueError(
+                    f"prefill_token_budget ({self.prefill_token_budget}) "
+                    f"must be >= page_size ({self.page_size}) under the "
+                    f"paged layout — internal chunk boundaries are "
+                    f"page-aligned, so a smaller budget could never make "
+                    f"progress on a multi-page prompt")
 
     @property
     def pages_per_slot(self) -> int:
@@ -247,7 +277,10 @@ class _Active:
                  "submit_ts", "prefill_start", "prefill_end",
                  "first_token_ts", "last_token_ts", "cancelled",
                  "reserved_pages", "adapter_ix",
-                 "spec_proposed", "spec_accepted")
+                 "spec_proposed", "spec_accepted",
+                 "prefill_pos", "prefill_chunks", "chunk_marks",
+                 "page_row", "chain", "shared_used", "skip_first",
+                 "finite_ok")
 
     def __init__(self, request: Request, slot: int, submit_ts: float):
         self.request = request
@@ -265,6 +298,17 @@ class _Active:
         self.cancelled = False
         self.spec_proposed = 0   # draft positions offered over the lifetime
         self.spec_accepted = 0   # draft positions the target agreed with
+        # chunked-prefill progress, carried across ticks as plain host
+        # data (page ids + an absolute token offset — never jit-trace
+        # state, the seam a dedicated prefill replica would ship)
+        self.prefill_pos = 0     # prompt tokens whose K/V are written
+        self.prefill_chunks = 0  # chunk programs run so far
+        self.chunk_marks: List[float] = []  # interior chunk-end stamps
+        self.page_row = None     # the slot's REAL page row while chunking
+        self.chain = ()          # prefix hash chain (interned at the end)
+        self.shared_used = 0     # prefix-hit pages mapped at admission
+        self.skip_first = False  # fully page-aligned hit (COW seam)
+        self.finite_ok = True    # AND of every chunk's isfinite flag
 
 
 def _sample_tokens(logits, temps, topks, seeds, steps):
@@ -354,6 +398,12 @@ class InferenceEngine:
         #: request ids in admission (prefill) order — the FCFS audit trail
         self.admission_log: List[int] = []
         self._active: Dict[int, _Active] = {}      # slot -> state
+        #: slots mid-chunked-prefill, in admission order (insertion-
+        #: ordered dict) — excluded from _active so the batched decode
+        #: step never sees them; the slot's real page row lives on the
+        #: rec until the final chunk lands (see _begin_chunked_prefill)
+        self._prefilling: Dict[int, _Active] = {}
+        self._chunk_tokens_tick = 0   # prefill tokens run this tick
         self._vocab = c.vocab_size
 
         # serving precision: generate()'s own one-time pre-cast +
@@ -431,7 +481,7 @@ class InferenceEngine:
         if donate is None:
             donate = jax.default_backend() != "cpu"
 
-        decode_fn, prefill_fn, suffix_fn, scrub_fn, reset_fn = \
+        decode_fn, prefill_fn, suffix_fn, chunk_fn, scrub_fn, reset_fn = \
             self._build_step_fns(donate)
         self._decode_fn = RetraceWatchdog(
             decode_fn,
@@ -444,10 +494,17 @@ class InferenceEngine:
             prefill_fn, budget=None, expected_compiles=len(self.buckets),
             name="serving_prefill", metrics=self.metrics)
         # suffix prefill (prefix-cache hits) buckets exactly like full
-        # prefill, so its compile count has the same bound
+        # prefill, so its compile count has the same bound; under
+        # chunked prefill it doubles as the paged CHUNK program (the
+        # chunk offset is a traced scalar, so chunking adds no shapes)
         self._suffix_fn = None if suffix_fn is None else RetraceWatchdog(
             suffix_fn, budget=None, expected_compiles=len(self.buckets),
             name="serving_suffix_prefill", metrics=self.metrics)
+        # flat-layout chunk program (paged chunks ride _suffix_fn) —
+        # bucketed like prefill, so the same compile bound holds
+        self._chunk_fn = None if chunk_fn is None else RetraceWatchdog(
+            chunk_fn, budget=None, expected_compiles=len(self.buckets),
+            name="serving_chunk_prefill", metrics=self.metrics)
         self._scrub_fn = scrub_fn
         self._reset_scales_fn = reset_fn
 
@@ -705,19 +762,77 @@ class InferenceEngine:
                                seed[None], prompt_len[None])
         return first[0], jnp.all(jnp.isfinite(logits)), new
 
+    def _flat_chunk_body(self, params, caches, slot, chunk, start,
+                         chunk_len, prompt_len, temp, topk, seed,
+                         adapter_ix, lora):
+        """Prefill ONE bucketed chunk of a prompt into a flat slot row.
+
+        The flat analogue of the suffix body: gather the slot's dense
+        row (tokens ``[0, start)`` are live, later rows garbage the
+        offset-causal mask never attends) into a small 4D cache, run
+        the chunk forward at ``cache_index=start`` — rope and sampling
+        keyed to the ABSOLUTE position, so the final chunk's sample is
+        bitwise the monolithic prefill's first token — and scatter the
+        chunk's K/V rows back (pad rows drop)."""
+        model = self.model
+        max_len = self.config.max_len
+        bucket = chunk.shape[1]
+        # static length max_len + bucket keeps the chunk update
+        # in-bounds for any traced start
+        small = init_kv_caches(model, 1, max_len + bucket, stacked=False)
+        filled = []
+        for (bk, bv), (sk, sv) in zip(caches, small):
+            h, d = sk.shape[1], sk.shape[3]
+            f = bk.shape[-1]
+
+            def place(big, sm):
+                g = jax.lax.dynamic_slice(big, (slot, 0, 0),
+                                          (1, max_len, f))[0]
+                g = g.reshape(max_len, h, d).transpose(1, 0, 2)[None]
+                return sm.at[:, :, :max_len, :].set(g.astype(sm.dtype))
+
+            filled.append((place(bk, sk), place(bv, sv)))
+        logits, filled = _cached_forward(model, params, filled, chunk,
+                                         start, last_index=chunk_len - 1,
+                                         lora=_select_adapters(lora,
+                                                               adapter_ix))
+        idx = jnp.arange(bucket)
+        # pad rows (idx >= chunk_len) target row max_len — out of bounds
+        # for the dense row, so the drop-mode scatter discards them
+        dest = jnp.where(idx < chunk_len, start + idx, max_len)
+        new = []
+        for (bk, bv), (fk, fv) in zip(caches, filled):
+            h, d = fk.shape[1], fk.shape[3]
+
+            def rows(f4):
+                r = jax.lax.dynamic_slice_in_dim(f4, start, bucket, axis=2)
+                return r[0].transpose(1, 0, 2).reshape(bucket, h * d)
+
+            new.append(
+                (bk.at[slot, dest].set(rows(fk).astype(bk.dtype),
+                                       mode="drop"),
+                 bv.at[slot, dest].set(rows(fv).astype(bv.dtype),
+                                       mode="drop")))
+        first = _sample_tokens(logits[0], temp[None], topk[None],
+                               seed[None], prompt_len[None])
+        return first[0], jnp.all(jnp.isfinite(logits)), new
+
     def _build_step_fns(self, donate: bool):
         """Compile the device programs:
-        ``(decode, prefill, suffix_prefill, scrub, reset_scales)`` —
-        ``suffix_prefill`` is None under the flat layout (no pages, no
-        prefix cache) and ``reset_scales`` is None unless the pool is
-        quantized. The base engine jits the bodies directly
-        (single-chip); :class:`~apex_tpu.serving.fleet.ShardedEngine`
-        overrides this to wrap each body in ``shard_map`` over the
-        tensor axis first. The bodies are picked by ``kv_layout`` — both
-        layouts keep the caches as argument 1 so donation and the
-        watchdogs are shared. With ``speculation`` on, the decode
-        program is the windowed verify body (same arity: the [n] token
-        vector becomes the [n, k] window matrix)."""
+        ``(decode, prefill, suffix_prefill, chunk_prefill, scrub,
+        reset_scales)`` — ``suffix_prefill`` is None under the flat
+        layout (no pages, no prefix cache), ``chunk_prefill`` is None
+        under the paged layout (paged chunks reuse the suffix program —
+        the chunk offset is a traced scalar), and ``reset_scales`` is
+        None unless the pool is quantized. The base engine jits the
+        bodies directly (single-chip);
+        :class:`~apex_tpu.serving.fleet.ShardedEngine` overrides this to
+        wrap each body in ``shard_map`` over the tensor axis first. The
+        bodies are picked by ``kv_layout`` — both layouts keep the
+        caches as argument 1 so donation and the watchdogs are shared.
+        With ``speculation`` on, the decode program is the windowed
+        verify body (same arity: the [n] token vector becomes the
+        [n, k] window matrix)."""
         donate_args = (1,) if donate else ()
         if self.pages is not None:
             decode_body = (self._spec_decode_body if self._spec
@@ -727,6 +842,7 @@ class InferenceEngine:
                             donate_argnums=donate_args),
                     jax.jit(self._suffix_prefill_body,
                             donate_argnums=donate_args),
+                    None,
                     jax.jit(self._paged_scrub_body,
                             donate_argnums=(0,) if donate else ()),
                     jax.jit(self._reset_scales_body,
@@ -735,6 +851,7 @@ class InferenceEngine:
         return (jax.jit(self._decode_body, donate_argnums=donate_args),
                 jax.jit(self._prefill_body, donate_argnums=donate_args),
                 None,
+                jax.jit(self._flat_chunk_body, donate_argnums=donate_args),
                 jax.jit(self._scrub_body,
                         donate_argnums=(0,) if donate else ()),
                 None)
@@ -776,6 +893,16 @@ class InferenceEngine:
         return self._prefill_fn.compiles
 
     @property
+    def chunk_compiles(self) -> int:
+        """Distinct chunk-program shapes compiled under chunked prefill
+        — bounded by ``len(buckets)`` (on the paged layout the chunk
+        program IS the suffix program, so this counts its shapes)."""
+        if self.pages is not None:
+            return 0 if self._suffix_fn is None else \
+                self._suffix_fn.compiles
+        return 0 if self._chunk_fn is None else self._chunk_fn.compiles
+
+    @property
     def decode_compiles(self) -> int:
         """Decode-step compilations (warmup included) — the supervisor
         exempts compile ticks from its hung-tick wall-clock budget."""
@@ -789,12 +916,25 @@ class InferenceEngine:
     def queued_count(self) -> int:
         return self.scheduler.depth
 
+    @property
+    def queued_tokens(self) -> int:
+        """Prompt tokens waiting in the queue — the token-aware load
+        signal the supervisor's shed/cost estimates fold in (a backlog
+        of long prompts is more work than its depth suggests)."""
+        return self.scheduler.queued_tokens
+
     def inflight(self) -> List:
         """Snapshot of active (admitted, non-terminal) requests as
         ``(request, generated_tokens, submit_ts)`` tuples in slot order —
-        what the supervisor re-prefills after an engine restart."""
-        return [(rec.request, list(rec.tokens), rec.submit_ts)
+        what the supervisor re-prefills after an engine restart.
+        Mid-chunked-prefill requests are included with NO tokens: a
+        restart re-prefills them from the prompt through the same admit
+        path (their chunk progress died with the engine's pages)."""
+        recs = [(rec.request, list(rec.tokens), rec.submit_ts)
                 for _, rec in sorted(self._active.items())]
+        recs += [(rec.request, [], rec.submit_ts)
+                 for rec in self._prefilling.values()]
+        return recs
 
     # -- request lifecycle ------------------------------------------------
 
@@ -876,7 +1016,7 @@ class InferenceEngine:
             self._finish(request, [], FINISH_CANCELLED, submit_ts=submit_ts,
                          now=time.monotonic())
             return True
-        for rec in self._active.values():
+        for rec in (*self._active.values(), *self._prefilling.values()):
             if rec.request.request_id == request_id:
                 rec.cancelled = True
                 return True
@@ -893,7 +1033,17 @@ class InferenceEngine:
         now = time.monotonic()
         self._expire(now, finished)
         self._evict_cancelled(finished)
-        self._admit(finished)
+        self._chunk_tokens_tick = 0
+        if self.config.prefill_token_budget is None:
+            self._admit(finished)
+        else:
+            self._chunked_admit(finished)
+        if self._chunk_tokens_tick:
+            # one observation per tick with prefill activity — the
+            # histogram's sum is the total chunked prefill tokens, its
+            # max must never exceed prefill_token_budget
+            self.metrics.observe("prefill_tokens_per_tick",
+                                 self._chunk_tokens_tick)
         self._decode_tick(finished)
         self.metrics.observe("slot_occupancy", self.slots.occupancy)
         if self.pages is not None:
@@ -919,11 +1069,13 @@ class InferenceEngine:
         pending = list(requests)
         ids = [r.request_id for r in pending]
         ticks = 0
-        while pending or self.scheduler.depth or self._active:
+        while pending or self.scheduler.depth or self._active \
+                or self._prefilling:
             while pending and \
                     self.scheduler.depth < self.config.scheduler.max_queue:
                 self.submit(pending.pop(0))
-            before = (len(pending), self.scheduler.depth, len(self._active))
+            before = (len(pending), self.scheduler.depth,
+                      len(self._active), len(self._prefilling))
             self.tick()
             ticks += 1
             if on_tick is not None:
@@ -931,8 +1083,9 @@ class InferenceEngine:
             if max_ticks is not None and ticks >= max_ticks:
                 break
             if (before == (len(pending), self.scheduler.depth,
-                           len(self._active))
-                    and not self._active and self.scheduler.depth):
+                           len(self._active), len(self._prefilling))
+                    and not self._active and not self._prefilling
+                    and self.scheduler.depth):
                 raise RuntimeError(
                     "serve() made no progress: queued requests exist but "
                     "none are admissible (admission_hook deferring "
@@ -948,6 +1101,7 @@ class InferenceEngine:
             return
         self._closed = True
         self._active.clear()
+        self._prefilling.clear()
         self.slots.reset()
         if self.pages is not None:
             # the page free list resets WITH the slot pool — a rebuild
@@ -975,12 +1129,23 @@ class InferenceEngine:
             d = rec.request.deadline_s
             if d is not None and now - rec.submit_ts > d:
                 finished.append(self._retire(rec, FINISH_TIMEOUT, now))
+        for slot in list(self._prefilling):
+            rec = self._prefilling[slot]
+            d = rec.request.deadline_s
+            if d is not None and now - rec.submit_ts > d:
+                finished.append(self._abandon_prefill(
+                    rec, FINISH_TIMEOUT, now))
 
     def _evict_cancelled(self, finished: List[RequestResult]) -> None:
         for slot in sorted(self._active):
             rec = self._active[slot]
             if rec.cancelled:
                 finished.append(self._retire(
+                    rec, FINISH_CANCELLED, time.monotonic()))
+        for slot in list(self._prefilling):
+            rec = self._prefilling[slot]
+            if rec.cancelled:
+                finished.append(self._abandon_prefill(
                     rec, FINISH_CANCELLED, time.monotonic()))
 
     def _plan_prefix(self, request: Request):
@@ -1019,47 +1184,52 @@ class InferenceEngine:
         return chain, pages[:matched], \
             matched * ps == request.prompt_len
 
+    def _make_page_predicate(self):
+        """Pages-aware admission predicate (None under the flat layout):
+        a request enters only when its WORST-CASE page need (total_len,
+        minus the shared-prefix pages a cache hit maps refcounted) fits
+        alongside every other admitted request's outstanding reservation
+        — so decode-time on-demand extends can never exhaust the pool.
+        ``reclaimable`` pages (held only by the intern index) count as
+        capacity since allocation evicts entries under pressure, but
+        this request's own shared pages are subtracted from that pot
+        first: mapping PINS them, so they stop being evictable. A head
+        that can never fit (need > n_pages) is shed as
+        ``pages_exhausted``; one that merely must wait defers (FCFS
+        head-blocking). The ``planned`` tallies accumulate across the
+        pops of ONE call — chunked admission builds a fresh predicate
+        per single-head pop because it maps pages between pops."""
+        if self.pages is None:
+            return None
+        planned = 0          # private pages promised this tick
+        planned_shared = 0   # reclaimable pages pinned this tick
+
+        def predicate(request):
+            nonlocal planned, planned_shared
+            need = self.pages.pages_for(request.total_len)
+            if need > self.pages.n_pages:
+                return "shed"
+            _, shared_pages, _ = self._plan_prefix(request)
+            shared = len(shared_pages)
+            pool = self.pages
+            avail = (pool.free_count
+                     + max(0, pool.reclaimable_count
+                           - planned_shared - shared)
+                     - (self._reserved_pages - pool.owned_count)
+                     - planned)
+            if need - shared <= avail:
+                planned += need - shared
+                planned_shared += shared
+                return "admit"
+            return "defer"
+
+        return predicate
+
     def _admit(self, finished: List[RequestResult]) -> None:
         shed: List = []
-        predicate = None
-        if self.pages is not None:
-            # pages-aware admission: a request enters only when its
-            # WORST-CASE page need (total_len, minus the shared-prefix
-            # pages a cache hit maps refcounted) fits alongside every
-            # other admitted request's outstanding reservation — so
-            # decode-time on-demand extends can never exhaust the pool.
-            # ``reclaimable`` pages (held only by the intern index) count
-            # as capacity since allocation evicts entries under
-            # pressure, but this request's own shared pages are
-            # subtracted from that pot first: mapping PINS them, so they
-            # stop being evictable. A head that can never fit
-            # (need > n_pages) is shed as ``pages_exhausted``; one that
-            # merely must wait defers (FCFS head-blocking).
-            planned = 0          # private pages promised this tick
-            planned_shared = 0   # reclaimable pages pinned this tick
-
-            def predicate(request):
-                nonlocal planned, planned_shared
-                need = self.pages.pages_for(request.total_len)
-                if need > self.pages.n_pages:
-                    return "shed"
-                _, shared_pages, _ = self._plan_prefix(request)
-                shared = len(shared_pages)
-                pool = self.pages
-                avail = (pool.free_count
-                         + max(0, pool.reclaimable_count
-                               - planned_shared - shared)
-                         - (self._reserved_pages - pool.owned_count)
-                         - planned)
-                if need - shared <= avail:
-                    planned += need - shared
-                    planned_shared += shared
-                    return "admit"
-                return "defer"
-
         batch = self.scheduler.pop_admissible(
             self.slots.free_count, decoding=bool(self._active),
-            predicate=predicate, shed=shed)
+            predicate=self._make_page_predicate(), shed=shed)
         now = time.monotonic()
         for request, submit_ts in shed:
             finished.append(self._shed_pages(request, submit_ts, now))
@@ -1067,6 +1237,52 @@ class InferenceEngine:
             slot = self.slots.allocate()
             assert slot is not None  # pop_admissible respects free_count
             self._prefill_into(request, slot, submit_ts, finished)
+
+    def _chunked_admit(self, finished: List[RequestResult]) -> None:
+        """Token-budgeted mixed tick (docs/serving.md#chunked-prefill):
+        continue in-flight chunked prefills in admission order, then
+        admit new heads while budget remains, each running its first
+        chunk(s) in the same tick. ``max_prefills_per_tick`` still caps
+        NEW admissions per tick while requests are decoding; the token
+        budget bounds the total prefill compute of the whole tick, so a
+        long prompt can never stall co-tenant decode for more than one
+        chunk's worth."""
+        budget = self.config.prefill_token_budget
+        spent = 0
+        for slot in list(self._prefilling):
+            if spent >= budget:
+                break
+            ran = self._run_chunk(self._prefilling[slot], budget - spent,
+                                  finished)
+            if ran == 0:
+                break           # remaining budget below one page
+            spent += ran
+        admitted = 0
+        limit = self.slots.free_count
+        if self._active:
+            limit = min(limit,
+                        self.config.scheduler.max_prefills_per_tick)
+        while spent < budget and admitted < limit and self.scheduler.depth:
+            shed: List = []
+            batch = self.scheduler.pop_admissible(
+                1, decoding=False, predicate=self._make_page_predicate(),
+                shed=shed)
+            now = time.monotonic()
+            for request, submit_ts in shed:
+                finished.append(self._shed_pages(request, submit_ts, now))
+            if not batch:
+                break           # head deferred (pages) or queue drained
+            request, submit_ts = batch[0]
+            slot = self.slots.allocate()
+            assert slot is not None
+            rec = self._begin_chunked_prefill(request, slot, submit_ts)
+            if rec is None:
+                break           # intern-eviction race: requeued at front
+            admitted += 1
+            ran = self._run_chunk(rec, budget - spent, finished)
+            if ran == 0:
+                break           # admitted; first chunk waits for budget
+            spent += ran
 
     def _shed_pages(self, request: Request, submit_ts: float,
                     now: float) -> RequestResult:
@@ -1217,6 +1433,203 @@ class InferenceEngine:
         done = self._finish_reason(rec, first)
         if done is not None:
             finished.append(self._retire(rec, done, time.monotonic()))
+
+    def _begin_chunked_prefill(self, request: Request, slot: int,
+                               submit_ts: float) -> Optional[_Active]:
+        """Admission half of a chunked prefill: allocate the slot,
+        commit the page reservation and map the prompt's pages (shared
+        prefix refcounted, exactly like the monolithic path) — but run
+        NO compute yet. The slot's real page row lives on the rec while
+        chunks land; the GLOBAL table row stays all-sentinel, so the
+        batched decode step treats the slot exactly like an idle one
+        (gathers mask, appends drop) and mid-prefill slots are excluded
+        from decode with no program or shape change. Returns None when
+        an intern-eviction race requeued the request (FCFS front)."""
+        rec = _Active(request, slot, submit_ts)
+        rec.prefill_start = time.monotonic()
+        rec.adapter_ix = self._adapter_index(request.sampling.adapter_id,
+                                             strict=False)
+        if self.pages is not None:
+            chain, shared_pages, skip_first = self._plan_prefix(request)
+            shared_used = len(shared_pages)
+            need = self.pages.pages_for(request.total_len) - shared_used
+            mapped = self.pages.map_slot(slot, request.prompt_len,
+                                         shared=shared_pages or None)
+            if mapped is None:
+                self.slots.release(slot)
+                if self.config.prefix_cache:
+                    self.scheduler.requeue_front(request, submit_ts)
+                    return None
+                raise RuntimeError(
+                    f"page pool exhausted at prefill despite admission "
+                    f"reservation (slot {slot}, "
+                    f"free={self.pages.free_count}) — reservation "
+                    f"accounting is broken")
+            rec.reserved_pages = need
+            self._reserved_pages += need
+            row = np.full(self.config.pages_per_slot, self.pages.n_pages,
+                          np.int32)
+            row[:len(mapped)] = mapped
+            rec.page_row = row
+            rec.chain = chain
+            rec.shared_used = shared_used
+            rec.skip_first = skip_first
+            # shared prefix rows are already resident: chunking starts
+            # at the first uncovered token (page-aligned), or — fully
+            # covered — at the last-token recompute (the COW seam)
+            rec.prefill_pos = (request.prompt_len - 1 if skip_first
+                               else shared_used * self.config.page_size)
+            self._reset_fresh_scales(mapped[shared_used:])
+        else:
+            # park the position at the last row: the flat decode step
+            # appends unconditionally at _positions_h[slot], and row
+            # max_len-1 is never live (a request's final sampled token
+            # is never fed back), so co-tenant decode garbage cannot
+            # clobber already-prefilled chunk rows
+            self._positions_h[slot] = self.config.max_len - 1
+        self._prefilling[slot] = rec
+        self.admission_log.append(request.request_id)
+        return rec
+
+    def _run_chunk(self, rec: _Active, budget_left: int,
+                   finished: List[RequestResult]) -> int:
+        """Run ONE maximal prefill chunk for ``rec`` within
+        ``budget_left`` tokens; returns the tokens consumed (0 = no
+        progress possible this tick). Paged chunks reuse the suffix
+        program (the slot's pages ARE the carried state); flat chunks
+        run the dedicated chunk body. The final chunk's sample — keyed
+        at step ``prompt_len`` from the prompt's last-token logits —
+        is the request's first token, bitwise what the monolithic
+        prefill emits; intermediate chunks' samples are discarded."""
+        request = rec.request
+        remaining = request.prompt_len - rec.prefill_pos
+        chunk_len = min(remaining, budget_left)
+        if chunk_len < remaining and self.pages is not None:
+            # internal chunk boundaries stay page-aligned: every fresh
+            # page is then written whole in ONE scatter onto a zeroed
+            # scale, so int8 page contents (and the interned prefix
+            # pages) are bitwise what the monolithic fill produces
+            ps = self.config.page_size
+            chunk_len = ((rec.prefill_pos + chunk_len) // ps) * ps \
+                - rec.prefill_pos
+        if chunk_len <= 0:
+            return 0
+        sp = request.sampling
+        start = rec.prefill_pos
+        bucket = bucket_for(chunk_len, self.config.max_len)
+        chunk = np.zeros((1, bucket), np.int32)
+        chunk[0, :chunk_len] = request.prompt[start:start + chunk_len]
+        aix = jnp.asarray([rec.adapter_ix], jnp.int32)
+        topk = jnp.int32(sp.top_k if sp.top_k is not None else self._vocab)
+        try:
+            if self._faults is not None:
+                self._faults.before_prefill()
+            if self.pages is not None:
+                first, finite, self._caches = self._suffix_fn(
+                    self._params, self._caches, jnp.asarray(rec.page_row),
+                    jnp.asarray(chunk), jnp.int32(start),
+                    jnp.int32(chunk_len), jnp.int32(request.prompt_len),
+                    jnp.float32(sp.temperature), topk, jnp.int32(sp.seed),
+                    jnp.bool_(rec.skip_first and rec.prefill_chunks == 0),
+                    aix, self._bank)
+            else:
+                first, finite, self._caches = self._chunk_fn(
+                    self._params, self._caches, jnp.int32(rec.slot),
+                    jnp.asarray(chunk), jnp.int32(start),
+                    jnp.int32(chunk_len), jnp.int32(request.prompt_len),
+                    jnp.float32(sp.temperature), topk, jnp.int32(sp.seed),
+                    aix, self._bank)
+            rec.finite_ok = rec.finite_ok and bool(np.asarray(finite))
+            first = int(np.asarray(first))
+        except Exception:
+            # same failure contract as the monolithic prefill: the slot
+            # never held committed state — release everything as the
+            # exception propagates; the supervisor's restart re-prefills
+            # the request from its prompt through the same admit path
+            del self._prefilling[rec.slot]
+            self.slots.release(rec.slot)
+            if self.pages is not None:
+                self.pages.release_slot(rec.slot)
+                self._reserved_pages -= rec.reserved_pages
+                self._page_table_h[rec.slot, :] = self.pages.n_pages
+            self._clear_slot(rec.slot)
+            raise
+        rec.prefill_pos += chunk_len
+        rec.prefill_chunks += 1
+        self.metrics.inc("prefill_chunks")
+        self._chunk_tokens_tick += chunk_len
+        if rec.prefill_pos < request.prompt_len:
+            rec.chunk_marks.append(time.monotonic())
+        else:
+            self._complete_chunked_prefill(rec, first, finished)
+        return chunk_len
+
+    def _complete_chunked_prefill(self, rec: _Active, first: int,
+                                  finished: List[RequestResult]) -> None:
+        """Final chunk landed: publish the page row to the global table
+        (the batched decode step sees — and appends to — the slot from
+        the next step on), intern the prefix, and promote the rec to
+        the active set with its first token."""
+        request = rec.request
+        slot = rec.slot
+        del self._prefilling[slot]
+        if self.pages is not None:
+            self._page_table_h[slot] = rec.page_row
+            if self.config.prefix_cache:
+                # hit/miss accounting lands at COMPLETION so hits +
+                # misses stays == prefills even when a mid-prefill
+                # request times out or is cancelled
+                if rec.shared_used:
+                    self.metrics.inc("prefix_hits")
+                    self.metrics.inc("prefix_pages_shared",
+                                     rec.shared_used)
+                else:
+                    self.metrics.inc("prefix_misses")
+                if rec.chain and rec.finite_ok:
+                    self.pages.intern_prefix(
+                        rec.chain,
+                        [int(p) for p in rec.page_row[:len(rec.chain)]])
+        rec.prefill_end = time.monotonic()
+        rec.tokens.append(first)
+        rec.last_token = first
+        # token #1 is emitted by THIS tick's final chunk — TTFT stamps
+        # here, not at prefill admission
+        rec.first_token_ts = rec.last_token_ts = rec.prefill_end
+        rec.position = request.prompt_len
+        self._active[slot] = rec
+        self.metrics.inc("prefills")
+        self.metrics.inc("tokens_generated")
+        self._sync_slot(rec)
+        done = self._finish_reason(rec, first)
+        if done is not None:
+            finished.append(self._retire(rec, done, time.monotonic()))
+
+    def _abandon_prefill(self, rec: _Active, reason: str,
+                         now: float) -> RequestResult:
+        """Retire a request whose chunked prefill never completed
+        (deadline/cancel): release the slot and its pages. Partially
+        written rows need no scrub unless a chunk went non-finite —
+        finite garbage is causally invisible to any future occupant,
+        exactly like bucket-padding rows."""
+        del self._prefilling[rec.slot]
+        self.slots.release(rec.slot)
+        if self.pages is not None:
+            freed = self.pages.release_slot(rec.slot)
+            self._reserved_pages -= rec.reserved_pages
+            self._page_table_h[rec.slot, :] = self.pages.n_pages
+            if not rec.finite_ok and freed:
+                row = np.full(self.config.pages_per_slot,
+                              self.pages.n_pages, np.int32)
+                row[:len(freed)] = freed
+                self._caches = self._scrub_fn(self._caches,
+                                              jnp.asarray(row))
+                self.pages.note_scrubbed(freed)
+        self._clear_slot(rec.slot)
+        return self._finish(
+            rec.request, [], reason, submit_ts=rec.submit_ts, now=now,
+            prefill_start=rec.prefill_start, prefill_end=now,
+            prefill_segments=tuple(rec.chunk_marks),
+            prefill_chunks=rec.prefill_chunks or None)
 
     def _reset_fresh_scales(self, pages) -> None:
         """Zero the scale sidecar for freshly allocated ``pages``
@@ -1503,12 +1916,16 @@ class InferenceEngine:
             now=now, prefill_start=rec.prefill_start,
             prefill_end=rec.prefill_end,
             first_token_ts=rec.first_token_ts,
-            last_token_ts=rec.last_token_ts)
+            last_token_ts=rec.last_token_ts,
+            prefill_segments=tuple(rec.chunk_marks),
+            prefill_chunks=rec.prefill_chunks or None)
 
     def _finish(self, request: Request, tokens: List[int], reason: str, *,
                 submit_ts: float, now: float, prefill_start: float = 0.0,
                 prefill_end: float = 0.0, first_token_ts: float = 0.0,
                 last_token_ts: float = 0.0,
+                prefill_segments: Sequence[float] = (),
+                prefill_chunks: Optional[int] = None,
                 detail: Optional[str] = None) -> RequestResult:
         if prefill_start:
             queue_s = prefill_start - submit_ts
@@ -1530,7 +1947,8 @@ class InferenceEngine:
             total_s=now - submit_ts, ttft_s=ttft_s, tpot_s=tpot_s,
             replica_id=self.replica_id,
             adapter_id=request.sampling.adapter_id,
-            trace_id=request.trace_id)
+            trace_id=request.trace_id,
+            prefill_chunks=prefill_chunks)
         self.completed[request.request_id] = result
         self.metrics.inc(f"requests_{reason}")
         # the span timeline, stamped at the SAME terminal choke point and
@@ -1543,7 +1961,7 @@ class InferenceEngine:
             request_id=request.request_id, submit_ts=submit_ts, now=now,
             wall=time.time(), prefill_start=prefill_start,
             prefill_end=prefill_end, replica_id=self.replica_id,
-            detail=detail)
+            prefill_segments=prefill_segments, detail=detail)
         for name, value in (("request_queue_s", result.queue_s),
                             ("request_prefill_s", result.prefill_s),
                             ("request_decode_s", result.decode_s),

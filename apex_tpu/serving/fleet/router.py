@@ -14,8 +14,12 @@ way TorchTitan composes parallelism primitives into one entry point:
   replica minimizing ``queue_depth × EWMA(service_s)`` — the SAME
   service-time estimate the supervisor's deadline shedding maintains
   (:attr:`~apex_tpu.serving.EngineSupervisor.service_estimate_s`), so
-  routing and shedding agree about how loaded a replica is. Ties break
-  by depth then replica id, keeping runs deterministic.
+  routing and shedding agree about how loaded a replica is — plus the
+  supervisor's token-aware surcharge
+  (:attr:`~apex_tpu.serving.EngineSupervisor.queued_token_excess_s`)
+  so a backlog of unusually LONG prompts prices above the same depth
+  of short ones. Ties break by depth then replica id, keeping runs
+  deterministic.
 - **Prefix-affinity dispatch**: the router hashes each prompt's
   page-aligned prefix with the SAME chain the engine's prefix cache
   interns (:func:`~apex_tpu.serving.prefix.prefix_hash_chain`) and
@@ -240,8 +244,16 @@ class Router:
     def cost(cls, replica: _Replica) -> Tuple[float, int, int]:
         depth = cls.depth(replica)
         service = replica.supervisor.service_estimate_s
-        return (depth * service if service is not None else 0.0,
-                depth, replica.replica_id)
+        # depth x EWMA(service) underprices a backlog of LONG prompts —
+        # fold in the supervisor's token-aware surcharge (0.0 until the
+        # per-token prefill rate has been measured, so a fresh replica
+        # still costs exactly 0 and routing stays deterministic)
+        base = depth * service if service is not None else 0.0
+        # getattr: the router prices any supervisor-shaped object (test
+        # stubs included); no surcharge is indistinguishable from a
+        # not-yet-measured one
+        base += getattr(replica.supervisor, "queued_token_excess_s", 0.0)
+        return (base, depth, replica.replica_id)
 
     def affinity(self, replica_id: int,
                  chain: Optional[Sequence[int]]) -> float:

@@ -171,6 +171,10 @@ class ShardedEngine(InferenceEngine):
                 in_specs=(pspec, cspec, rep, rep, rep, rep, rep, rep,
                           rep, rep, rep, rep, lspec),
                 out_specs=(rep, rep, cspec))
+            # chunked prefill (docs/serving.md#chunked-prefill) rides
+            # the suffix program on the paged layout — the chunk offset
+            # is a traced scalar, so no extra sharded wiring exists
+            chunk = None
             scrub = shard_map(
                 self._paged_scrub_body, mesh=mesh,
                 in_specs=(cspec, rep), out_specs=cspec)
@@ -190,6 +194,14 @@ class ShardedEngine(InferenceEngine):
                           rep, lspec),
                 out_specs=(rep, cspec))
             suffix = None
+            # the flat chunk program scatters a bucketed K/V slice into
+            # each rank's own head block of the slot row — rank-local,
+            # same spec shape as flat prefill plus the start offset
+            chunk = shard_map(
+                self._flat_chunk_body, mesh=mesh,
+                in_specs=(pspec, cspec, rep, rep, rep, rep, rep, rep,
+                          rep, rep, rep, lspec),
+                out_specs=(rep, rep, cspec))
             scrub = shard_map(
                 self._scrub_body, mesh=mesh,
                 in_specs=(cspec, rep), out_specs=cspec)
@@ -198,6 +210,8 @@ class ShardedEngine(InferenceEngine):
                 jax.jit(prefill, donate_argnums=donate_args),
                 None if suffix is None else
                 jax.jit(suffix, donate_argnums=donate_args),
+                None if chunk is None else
+                jax.jit(chunk, donate_argnums=donate_args),
                 jax.jit(scrub, donate_argnums=(0,) if donate else ()),
                 None if reset is None else
                 jax.jit(reset, donate_argnums=(0,) if donate else ()))

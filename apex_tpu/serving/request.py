@@ -161,6 +161,12 @@ ReplicaFleet`; ``None`` on a single-engine deployment or a fleet-level
     ``trace_id`` joins the record to its ``kind="span"`` timeline;
     omitted when ``None`` (pre-tracing producers), in which case span
     conservation is vacuous for the record.
+
+    ``prefill_chunks`` counts the chunk programs a token-budgeted
+    (chunked) prefill ran for this request
+    (docs/serving.md#chunked-prefill) — ``None`` on the monolithic
+    path, and omitted from the JSONL record so pre-chunking readers
+    keep working unchanged.
     """
 
     request_id: int
@@ -176,6 +182,7 @@ ReplicaFleet`; ``None`` on a single-engine deployment or a fleet-level
     replica_id: Optional[int] = None
     adapter_id: Optional[str] = None
     trace_id: Optional[str] = None
+    prefill_chunks: Optional[int] = None
 
     @property
     def new_tokens(self) -> int:
@@ -213,6 +220,8 @@ ReplicaFleet`; ``None`` on a single-engine deployment or a fleet-level
             rec["ttft_s"] = self.ttft_s
         if self.tpot_s is not None:
             rec["tpot_s"] = self.tpot_s
+        if self.prefill_chunks is not None:
+            rec["prefill_chunks"] = self.prefill_chunks
         tps = self.tokens_per_s
         if tps is not None:
             rec["tokens_per_s"] = tps

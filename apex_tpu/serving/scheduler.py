@@ -117,6 +117,15 @@ class FCFSScheduler:
     def depth(self) -> int:
         return len(self._queue)
 
+    @property
+    def queued_tokens(self) -> int:
+        """Total PROMPT tokens waiting in line — the token-denominated
+        companion to ``depth``. A backlog of long prompts costs far more
+        prefill work than the same depth of short ones; the supervisor's
+        deadline-shed projection and the fleet Router's cost estimate
+        both fold this in (docs/serving.md#chunked-prefill)."""
+        return sum(q.request.prompt_len for q in self._queue)
+
     def submit(self, request: Request, now: float) -> None:
         # deadline fast-fail: a request whose budget elapsed before it
         # reached the queue (stale arrival_ts) can only ever time out —

@@ -204,6 +204,13 @@ class EngineSupervisor:
         # supervisor with the old one's estimate (``service_s=``) so the
         # first post-restart submits are not admitted blind
         self._service_s: Optional[float] = service_s
+        # token-aware companion EWMAs (same alpha): per-token prefill
+        # cost and typical prompt length, so the shed projection and the
+        # fleet Router can price a backlog of LONG prompts above the
+        # same depth of short ones (docs/serving.md#chunked-prefill).
+        # None until the first completion measures them.
+        self._prefill_s_per_token: Optional[float] = None
+        self._avg_prompt_tokens: Optional[float] = None
         #: custom engine constructor, ``(model, params, config, *,
         #: metrics, faults, replica_id) -> InferenceEngine`` — how a
         #: fleet runs :class:`~apex_tpu.serving.fleet.ShardedEngine`
@@ -251,6 +258,36 @@ class EngineSupervisor:
         replica so it never restarts blind."""
         return self._service_s
 
+    @property
+    def queued_prompt_tokens(self) -> int:
+        """Total prompt tokens waiting in line (engine queue + restart
+        backlog) — the token-denominated companion to
+        :attr:`queued_count`."""
+        return (self.engine.queued_tokens
+                + sum(r.prompt_len for r in self._backlog))
+
+    @property
+    def queued_token_excess_s(self) -> float:
+        """Extra prefill seconds the queued PROMPT TOKENS represent
+        beyond what ``depth x EWMA(service_s)`` already prices in.
+
+        ``depth x service_s`` assumes every queued request costs the
+        observed average; a backlog of unusually long prompts breaks
+        that (the first open failure mode ISSUE 15's router satellite
+        names). This is the bounded, additive correction: the queued
+        tokens in EXCESS of ``depth x EWMA(prompt_tokens)``, at the
+        observed per-token prefill rate. Non-negative by construction
+        (a backlog of SHORT prompts never discounts the estimate below
+        the depth-based one), and 0.0 until both token EWMAs have been
+        measured — so uniform traffic, fresh supervisors, and every
+        pre-existing test see exactly the old behavior."""
+        if self._prefill_s_per_token is None \
+                or self._avg_prompt_tokens is None:
+            return 0.0
+        waiting = self.engine.queued_count + len(self._backlog)
+        excess = self.queued_prompt_tokens - waiting * self._avg_prompt_tokens
+        return max(0.0, excess) * self._prefill_s_per_token
+
     # -- admission --------------------------------------------------------
 
     def submit(self, request: Request, *, resubmission: bool = False) -> int:
@@ -279,7 +316,10 @@ class EngineSupervisor:
             # projected wait before this request even starts: everything
             # already in line, at the observed per-request service rate
             waiting = self.engine.queued_count + len(self._backlog)
-            projected = waiting * self._service_s
+            # depth x average service, plus the token-aware surcharge
+            # for a line of unusually long prompts (0.0 until measured)
+            projected = (waiting * self._service_s
+                         + self.queued_token_excess_s)
             start = request.arrival_ts if request.arrival_ts is not None \
                 else now
             remaining = request.deadline_s - (now - start)
@@ -610,6 +650,21 @@ class EngineSupervisor:
                 self._service_s = (
                     service if self._service_s is None
                     else a * service + (1.0 - a) * self._service_s)
+                # token-aware companions: per-token prefill cost and
+                # typical prompt length, feeding queued_token_excess_s.
+                # Under chunked prefill, prefill_s includes interleaved
+                # co-tenant decode wall time — a conservative (over-)
+                # estimate, which is the right bias for shedding.
+                if res.prefill_s > 0 and res.prompt_len > 0:
+                    rate = res.prefill_s / res.prompt_len
+                    self._prefill_s_per_token = (
+                        rate if self._prefill_s_per_token is None
+                        else a * rate + (1.0 - a) * self._prefill_s_per_token)
+                    self._avg_prompt_tokens = (
+                        float(res.prompt_len)
+                        if self._avg_prompt_tokens is None
+                        else a * res.prompt_len
+                        + (1.0 - a) * self._avg_prompt_tokens)
 
     # -- migration (the fleet's draining-restart path) --------------------
 

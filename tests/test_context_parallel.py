@@ -81,6 +81,7 @@ def _reference(q, k, v, causal):
 
 
 class TestRingAttention:
+    @pytest.mark.slow  # cp=4 parity vs reference: slow-tier family (ROADMAP)
     @pytest.mark.parametrize("causal", [False, True])
     def test_matches_reference(self, causal):
         q, k, v = _qkv()

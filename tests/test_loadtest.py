@@ -670,3 +670,25 @@ class TestFullScenarios:
         inc = report["serving_incidents"]
         assert inc["counts"]["engine_restart"] == \
             report["counters"]["engine_restarts"]
+
+    def test_bimodal_burst_scenario(self, small, tmp_path):
+        model, params = small
+        scn = Scenario.load(
+            os.path.join(SCENARIO_DIR, "bimodal_burst.json"))
+        log = str(tmp_path / "bimodal.jsonl")
+        run = run_scenario(scn, model=model, params=params, log_path=log)
+        assert not run.aborted
+        assert run.counters["requests_error"] == 0
+        assert run.ok, run.slo.as_dict()
+        # the burst's long prompts actually chunked (48 and 56 tokens at
+        # budget 16 => 3-4 page-aligned chunks), short traffic did not
+        done = list(run.results.values())
+        chunks = [r.prefill_chunks or 1 for r in done
+                  if r.finish_reason in ("eos", "length")]
+        assert max(chunks) >= 3
+        assert min(chunks) == 1
+        # chunk audit reconciles: the counter equals the per-request sum
+        report = build_report(log)
+        _assert_reconciles(report)
+        assert report["counters"]["prefill_chunks"] == \
+            sum(r.prefill_chunks or 0 for r in done)

@@ -339,6 +339,7 @@ class TestSubmitValidation:
 
 
 class TestMultiTenantChurn:
+    @pytest.mark.slow  # compile-bound churn integration (ROADMAP tiers)
     def test_churn_terminal_once_no_leaks_co_tenant_exact(self, small):
         """Seeded random multi-tenant arrivals x cancellations x a
         mid-run unload on one paged engine: every request reaches

@@ -103,6 +103,7 @@ class TestGPT:
         rc_losses, _ = _train(tp=1, sp=False, recompute=True)
         np.testing.assert_allclose(ref_losses, rc_losses, atol=1e-6)
 
+    @pytest.mark.slow  # full-vocab parity forward x2: compile-bound (ROADMAP tiers)
     def test_chunked_lm_head_loss_matches_plain(self):
         """loss_seq_chunks (the long-context vocab-head memory guard) is a
         pure schedule change — loss and grads must match unchunked."""
@@ -143,6 +144,7 @@ class TestGPT:
                          rng=jax.random.PRNGKey(2), deterministic=False)
         assert float(l1) != float(l2)
 
+    @pytest.mark.slow  # packed-path dropout statistics: compile-bound (ROADMAP tiers)
     def test_attention_dropout_on_packed_path(self):
         # attention dropout rides the packed kernels (in-kernel hash
         # mask); must be seed-reproducible, seed-sensitive, trainable,

@@ -633,3 +633,38 @@ class TestReportBackCompat:
         out = capsys.readouterr()
         assert "no spans" in (out.out + out.err).lower() or \
             "not found" in (out.out + out.err).lower()
+
+    PRE_PR15 = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "data", "pre_pr15_run.jsonl")
+
+    def test_pre_pr15_log_without_chunk_fields_still_renders(self):
+        """A committed pre-chunked-prefill log (PR-14 vintage: tracing
+        present, single-segment prefill spans, NO ``prefill_chunks``
+        request fields, no ``prefill_tokens_per_tick`` histogram, torn
+        last line) builds and renders with no chunked-prefill section —
+        the new audit line only appears when the counter is non-zero."""
+        report = build_report(self.PRE_PR15)
+        assert report["requests"]["count"] == 4
+        # rows without the field fold to a zero sum, not a KeyError
+        assert report["requests"]["prefill_chunks"] == 0
+        text = render_report(report)
+        assert "chunked prefill" not in text
+
+    def test_pre_pr15_log_span_check_still_conserves(self):
+        """Single-segment prefill spans from a pre-chunking engine pass
+        the SAME conservation checker the multi-segment timelines do —
+        the gate cannot fail old logs."""
+        from apex_tpu.observability.report import read_records
+        from apex_tpu.observability.trace import check_span_conservation
+
+        records = read_records(self.PRE_PR15)
+        assert check_span_conservation(records) == []
+
+    def test_pre_pr15_trace_renders_single_segment(self, capsys):
+        """``--trace`` on a pre-chunking timeline renders the familiar
+        queued/prefill/decode trio with no chunk annotations."""
+        from apex_tpu.observability.report import main as monitor_main
+
+        assert monitor_main([self.PRE_PR15, "--trace", "0"]) == 0
+        out = capsys.readouterr().out
+        assert "prefill" in out and "chunk=" not in out

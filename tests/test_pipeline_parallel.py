@@ -746,6 +746,7 @@ class TestInterleavedMemory:
             f"({small}B) to M=32 ({big}B)")
 
 
+@pytest.mark.slow  # pipelined-model parity: slow-tier family (ROADMAP)
 class TestPipelinedEncoderDecoder:
     """Two-section (encoder|decoder) pipeline vs the unpipelined
     EncoderDecoderModel — the ``ModelType.encoder_and_decoder`` parity the

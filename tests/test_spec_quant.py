@@ -328,6 +328,7 @@ class TestInt8Engine:
                 expect = ref_eng.serve([clean()])[0].tokens
             assert eng.serve([clean()])[0].tokens == expect
 
+    @pytest.mark.slow  # int8 x prefix-cache cross: slow-tier composition
     def test_prefix_sharing_carries_scales(self, small):
         """Two prompts sharing an interned prefix on the int8 engine:
         the second request's suffix-only prefill reads the shared pages
@@ -389,6 +390,7 @@ class TestSpeculativeEngine:
         # the plain engine declares the draft counters too (zero-valued)
         assert ref_c["draft_tokens_proposed"] == 0
 
+    @pytest.mark.slow  # speculation x int8 cross: slow-tier composition
     def test_spec_with_int8_token_exact(self, small):
         """Both tentpole knobs at once: int8 pool + speculation, still
         token-exact against the plain bf16 engine."""

@@ -383,7 +383,8 @@ class TestFleetMetricsSignals:
 
 
 # ---------------------------------------------------------------------------
-# acceptance: the committed scenarios, tier-1
+# acceptance: the committed scenarios, slow tier (each reruns a full
+# scenario; the span/signals unit tests above stay tier-1)
 
 
 @pytest.fixture(scope="module")
@@ -418,6 +419,7 @@ def fleet_run(small, tmp_path_factory):
     return {"run": run, "log": log, "records": read_records(log)}
 
 
+@pytest.mark.slow
 class TestMultiTenantTraceAcceptance:
     def test_every_terminal_request_has_complete_timeline(self, mt_run):
         """Acceptance: span conservation over the real run — every
@@ -544,6 +546,7 @@ class TestMultiTenantTraceAcceptance:
         assert "span conservation" in capsys.readouterr().out
 
 
+@pytest.mark.slow
 class TestFleetSignalsAcceptance:
     def test_signals_reconcile_with_merged_counters(self, fleet_run):
         """Acceptance: the final ``signals()`` poll is derived from —

@@ -28,6 +28,7 @@ from apex_tpu.optimizers import FusedSGD
 
 
 class TestResNet:
+    @pytest.mark.slow  # full RN50 build+forward is compile-bound (ROADMAP tiers)
     def test_resnet50_shapes(self):
         model = resnet50(num_classes=10)
         params, state = model.init(jax.random.PRNGKey(0))
