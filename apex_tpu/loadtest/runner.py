@@ -23,9 +23,10 @@ SLOReport`, and the same records land in ``log_path`` when given.
 
 from __future__ import annotations
 
+import tempfile
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 from apex_tpu.loadtest.generator import ScheduledRequest, TrafficGenerator
 from apex_tpu.loadtest.scenario import ModelSpec, Scenario
@@ -158,18 +159,68 @@ def _build_serving(scenario: Scenario, model, params,
     if not scenario.faults.empty:
         faults = ServingFaultInjector(**scenario.faults.injector_kwargs())
     if scenario.fleet is not None:
-        from apex_tpu.serving.fleet import FleetConfig, ReplicaFleet
+        from apex_tpu.serving.fleet import (
+            AutoscaleConfig,
+            FleetConfig,
+            ReplicaFleet,
+        )
 
         fl = scenario.fleet
+        autoscale = AutoscaleConfig(**scenario.autoscale.config_kwargs()) \
+            if scenario.autoscale is not None else None
         return ReplicaFleet(
             model, params, engine_cfg, supervisor=sup_cfg,
             fleet=FleetConfig(n_replicas=fl.n_replicas,
                               migrate_on_drain=fl.migrate_on_drain,
                               probe_on_rebuild=fl.probe_on_rebuild),
-            metrics=metrics, faults=faults, adapters=adapters)
+            metrics=metrics, faults=faults, adapters=adapters,
+            autoscale=autoscale)
     return EngineSupervisor(model, params, engine_cfg,
                             supervisor=sup_cfg, metrics=metrics,
                             faults=faults, adapters=adapters)
+
+
+def _prepare_deploy(scenario: Scenario, model, params,
+                    scratch: str) -> Dict[str, Any]:
+    """Materialize the scenario's ``deploy`` artifact and return the
+    kwargs for :meth:`~apex_tpu.serving.fleet.ReplicaFleet.deploy`.
+
+    ``kind="checkpoint"`` saves the scenario's own seeded parameters at
+    step 1 through a :class:`~apex_tpu.checkpoint.\
+ShardedCheckpointManager` (a happy-path deploy is weight-identical, so
+    it must be token-exact); ``poison=true`` then value-corrupts the
+    committed step with :func:`~apex_tpu.testing_faults.\
+corrupt_checkpoint_weights` — fsck stays green, the live canary score
+    is the only detector. ``kind="adapter"`` builds a seeded LoRA
+    canary tenant (NaN factors when poisoned)."""
+    from apex_tpu.serving.fleet import CanaryConfig
+
+    spec = scenario.deploy
+    canary = CanaryConfig(**{
+        k: (int(v) if k == "min_requests" else float(v))
+        for k, v in spec.canary.items()})
+    if spec.kind == "adapter":
+        import jax
+
+        from apex_tpu.lora import random_adapter
+
+        factors = random_adapter(
+            model.config, scenario.engine.lora_rank,
+            # offset keeps the canary tenant's weights distinct from
+            # the runner-preloaded ids "0".."n-1" (same seed stream)
+            jax.random.PRNGKey(scenario.seed + 7919))
+        if spec.poison:
+            factors = jax.tree_util.tree_map(
+                lambda a: a * float("nan"), factors)
+        return {"adapter": (spec.adapter_id, factors), "canary": canary}
+    from apex_tpu.checkpoint import ShardedCheckpointManager
+
+    ShardedCheckpointManager(scratch, max_to_keep=1).save(1, params)
+    if spec.poison:
+        from apex_tpu.testing_faults import corrupt_checkpoint_weights
+
+        corrupt_checkpoint_weights(scratch, 1)
+    return {"checkpoint_dir": scratch, "step": 1, "canary": canary}
 
 
 def run_scenario(scenario: Scenario, *, model=None, params=None,
@@ -211,10 +262,36 @@ def run_scenario(scenario: Scenario, *, model=None, params=None,
     drains = sorted(scenario.fleet.drain_restarts) \
         if scenario.fleet is not None else []
     d = 0
+    # the continuous-deployment schedule: one rollout at a fixed offset
+    # (artifact materialized up front — a poisoned checkpoint must be
+    # committed and fsck-green BEFORE the first drain)
+    deploy_fired = scenario.deploy is None
+    deploy_kwargs: Optional[Dict[str, Any]] = None
+    scratch = None
+    if scenario.deploy is not None:
+        scratch = tempfile.TemporaryDirectory(prefix="apex-deploy-")
+        deploy_kwargs = _prepare_deploy(scenario, model, params,
+                                        scratch.name)
+
+    def _deploy_active() -> bool:
+        dep = getattr(sup, "deployment", None)
+        return dep is not None and not dep.done
+
+    def _autoscale_settling() -> bool:
+        # after traffic drains, keep polling until the autoscaler has
+        # retired back to min_replicas — an idle fleet always meets the
+        # scale-down bands, so this converges (max_wall_s still guards)
+        scaler = getattr(sup, "autoscaler", None)
+        return (scaler is not None
+                and len(sup.replicas) > scaler.config.min_replicas)
+
+    autoscaling = getattr(sup, "autoscaler", None) is not None
     t0 = time.monotonic()
     i = 0
     try:
-        while i < len(schedule) or sup.inflight_count or d < len(drains):
+        while (i < len(schedule) or sup.inflight_count or d < len(drains)
+               or not deploy_fired or _deploy_active()
+               or _autoscale_settling()):
             now = time.monotonic() - t0
             if now > scenario.max_wall_s:
                 run.aborted = True
@@ -235,6 +312,19 @@ def run_scenario(scenario: Scenario, *, model=None, params=None,
                     registry.event("drain_restart_skipped",
                                    replica_id=replica, at_s=at_s,
                                    reason=str(exc))
+            if not deploy_fired and scenario.deploy.at_s <= now:
+                deploy_fired = True
+                try:
+                    sup.deploy(**deploy_kwargs)
+                except Exception as exc:
+                    # pre-flight rejection (fsck failure) or a topology
+                    # race: the fleet already stamped deploy_rejected
+                    # when it could; the skip itself is logged too
+                    log_event(_LOG, "deploy_skipped",
+                              at_s=scenario.deploy.at_s, reason=str(exc))
+                    registry.event("deploy_skipped",
+                                   at_s=scenario.deploy.at_s,
+                                   reason=str(exc))
             while i < len(schedule) and schedule[i].at_s <= now:
                 req = schedule[i].request
                 # open-loop contract: the deadline clock starts at the
@@ -247,15 +337,26 @@ def run_scenario(scenario: Scenario, *, model=None, params=None,
                 except (EngineUnavailableError, QueueFullError,
                         DeadlineExpiredError, UnknownAdapterError):
                     pass        # recorded terminally by the supervisor
-            if sup.inflight_count:
+            if sup.inflight_count or _deploy_active():
                 sup.tick()
                 run.ticks += 1
             elif i < len(schedule):
                 gap = (t0 + schedule[i].at_s) - time.monotonic()
                 if gap > 0:
                     time.sleep(min(gap, _IDLE_SLEEP_S))
-            elif d < len(drains):
-                time.sleep(_IDLE_SLEEP_S)  # waiting on a scheduled drain
+                if autoscaling:
+                    # idle ticks keep the autoscaler's poll clock alive
+                    # through traffic gaps (scale-down happens here)
+                    sup.tick()
+                    run.ticks += 1
+            elif d < len(drains) or not deploy_fired \
+                    or _autoscale_settling():
+                # waiting on a scheduled drain/deploy, or for the
+                # autoscaler to retire back to min_replicas
+                time.sleep(_IDLE_SLEEP_S)
+                if autoscaling:
+                    sup.tick()
+                    run.ticks += 1
     finally:
         run.wall_s = time.monotonic() - t0
         if hasattr(sup, "replica_metrics"):
@@ -266,6 +367,8 @@ def run_scenario(scenario: Scenario, *, model=None, params=None,
             registry.emit_record({"kind": "signals", "wall": time.time(),
                                   "values": run.signals})
         sup.close()             # flushes the final counter snapshot
+        if scratch is not None:
+            scratch.cleanup()   # the deployed weights live in the fleet
     run.results = dict(sup.completed)
     run.counters = registry.counters()
     run.engine_restarts = sup.restarts

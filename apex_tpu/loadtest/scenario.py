@@ -38,7 +38,7 @@ from typing import Any, Dict, Optional, Tuple
 from apex_tpu.observability.slo import SLO_METRICS
 
 __all__ = ["ModelSpec", "EngineKnobs", "LoadPhase", "FaultSchedule",
-           "FleetSpec", "Scenario"]
+           "FleetSpec", "AutoscaleSpec", "DeploySpec", "Scenario"]
 
 #: keys accepted in a scenario's ``"supervisor"`` section — mirrors the
 #: :class:`~apex_tpu.serving.SupervisorConfig` fields so a typo fails at
@@ -495,6 +495,173 @@ class FleetSpec:
 
 
 @dataclass(frozen=True)
+class AutoscaleSpec:
+    """Optional ``"autoscale"`` scenario block: run the fleet under an
+    :class:`~apex_tpu.serving.fleet.Autoscaler` that grows/shrinks the
+    replica count between ``min_replicas``/``max_replicas`` off the
+    live :meth:`~apex_tpu.observability.FleetMetrics.signals` poll
+    (docs/serving.md#autoscaling). Fields mirror
+    :class:`~apex_tpu.serving.fleet.AutoscaleConfig` (kept jax-free
+    here; the runner builds the config) so a typo fails at scenario
+    load, not deep in a run. Requires a ``"fleet"`` block whose
+    ``n_replicas`` lies inside the band."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    poll_interval_s: float = 0.25
+    cooldown_s: float = 2.0
+    hysteresis_polls: int = 2
+    scale_up_queue_per_replica: float = 4.0
+    scale_up_queued_tokens_per_replica: float = 0.0
+    scale_up_goodput: float = 0.0
+    scale_up_ttft_p99_s: float = 0.0
+    scale_down_queue_per_replica: float = 0.5
+    scale_down_slot_occupancy: float = 0.25
+
+    def __post_init__(self):
+        # mirror AutoscaleConfig's validation so a bad scenario fails
+        # at parse time, not at fleet construction mid-run
+        if self.min_replicas < 1:
+            raise ValueError(
+                f"autoscale min_replicas must be >= 1, got "
+                f"{self.min_replicas}")
+        if self.max_replicas < self.min_replicas:
+            raise ValueError(
+                f"autoscale max_replicas ({self.max_replicas}) must be "
+                f">= min_replicas ({self.min_replicas})")
+        if self.poll_interval_s <= 0:
+            raise ValueError(
+                f"autoscale poll_interval_s must be > 0, got "
+                f"{self.poll_interval_s}")
+        if self.cooldown_s < 0:
+            raise ValueError(
+                f"autoscale cooldown_s must be >= 0, got "
+                f"{self.cooldown_s}")
+        if self.hysteresis_polls < 1:
+            raise ValueError(
+                f"autoscale hysteresis_polls must be >= 1, got "
+                f"{self.hysteresis_polls}")
+
+    def config_kwargs(self) -> Dict[str, Any]:
+        """Constructor kwargs for ``AutoscaleConfig``."""
+        return {
+            "min_replicas": self.min_replicas,
+            "max_replicas": self.max_replicas,
+            "poll_interval_s": self.poll_interval_s,
+            "cooldown_s": self.cooldown_s,
+            "hysteresis_polls": self.hysteresis_polls,
+            "scale_up_queue_per_replica": self.scale_up_queue_per_replica,
+            "scale_up_queued_tokens_per_replica":
+                self.scale_up_queued_tokens_per_replica,
+            "scale_up_goodput": self.scale_up_goodput,
+            "scale_up_ttft_p99_s": self.scale_up_ttft_p99_s,
+            "scale_down_queue_per_replica":
+                self.scale_down_queue_per_replica,
+            "scale_down_slot_occupancy": self.scale_down_slot_occupancy,
+        }
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "AutoscaleSpec":
+        d = dict(data)
+        kw: Dict[str, Any] = {}
+        for key in ("min_replicas", "max_replicas", "hysteresis_polls"):
+            if key in d:
+                kw[key] = int(d.pop(key))
+        for key in ("poll_interval_s", "cooldown_s",
+                    "scale_up_queue_per_replica",
+                    "scale_up_queued_tokens_per_replica",
+                    "scale_up_goodput", "scale_up_ttft_p99_s",
+                    "scale_down_queue_per_replica",
+                    "scale_down_slot_occupancy"):
+            if key in d:
+                kw[key] = float(d.pop(key))
+        if d:
+            raise ValueError(f"unknown autoscale keys {sorted(d)}")
+        return cls(**kw)
+
+    def to_dict(self) -> Dict[str, Any]:
+        defaults = AutoscaleSpec()
+        out: Dict[str, Any] = {"min_replicas": self.min_replicas,
+                               "max_replicas": self.max_replicas}
+        out.update({k: v for k, v in self.config_kwargs().items()
+                    if v != getattr(defaults, k)})
+        return out
+
+
+#: keys accepted in a deploy block's ``"canary"`` section — mirrors
+#: :class:`~apex_tpu.serving.fleet.CanaryConfig`
+_CANARY_KEYS = frozenset({
+    "window_s", "min_requests", "max_window_s", "max_error_rate",
+    "latency_ratio"})
+
+
+@dataclass(frozen=True)
+class DeploySpec:
+    """Optional ``"deploy"`` scenario block: at ``at_s`` seconds into
+    the run, fire a :meth:`~apex_tpu.serving.fleet.ReplicaFleet.deploy`
+    — a rolling, canary-scored weight rollout
+    (docs/serving.md#continuous-deployment).
+
+    ``kind="checkpoint"`` saves the scenario's own (seeded) parameters
+    through a :class:`~apex_tpu.checkpoint.ShardedCheckpointManager`
+    into a scratch directory and deploys that step — a happy-path
+    deploy is therefore weight-identical and must be token-exact.
+    ``kind="adapter"`` hot-loads a seeded LoRA adapter ``adapter_id``
+    as a canary tenant (needs ``engine.lora_adapters`` > 0).
+    ``poison=true`` corrupts the artifact's values post-commit with
+    non-finite weights (``corrupt_checkpoint_weights`` — manifest and
+    checksums stay green) so the deploy must be caught by the live
+    canary score and rolled back, not by fsck. ``canary`` is a
+    validated passthrough for
+    :class:`~apex_tpu.serving.fleet.CanaryConfig` kwargs."""
+
+    at_s: float
+    kind: str = "checkpoint"
+    poison: bool = False
+    adapter_id: str = "canary"
+    canary: Dict[str, Any] = field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.at_s < 0:
+            raise ValueError(
+                f"deploy at_s must be >= 0, got {self.at_s}")
+        if self.kind not in ("checkpoint", "adapter"):
+            raise ValueError(
+                f"deploy kind must be 'checkpoint' or 'adapter', got "
+                f"{self.kind!r}")
+        if self.kind == "adapter" and not self.adapter_id:
+            raise ValueError("deploy adapter_id must be non-empty")
+        unknown = set(self.canary) - _CANARY_KEYS
+        if unknown:
+            raise ValueError(
+                f"unknown deploy canary keys {sorted(unknown)}; known: "
+                f"{sorted(_CANARY_KEYS)}")
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "DeploySpec":
+        d = dict(data)
+        spec = cls(
+            at_s=float(d.pop("at_s")),
+            kind=str(d.pop("kind", "checkpoint")),
+            poison=bool(d.pop("poison", False)),
+            adapter_id=str(d.pop("adapter_id", "canary")),
+            canary=dict(d.pop("canary", {})))
+        if d:
+            raise ValueError(f"unknown deploy keys {sorted(d)}")
+        return spec
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"at_s": self.at_s, "kind": self.kind}
+        if self.poison:
+            out["poison"] = True
+        if self.kind == "adapter":
+            out["adapter_id"] = self.adapter_id
+        if self.canary:
+            out["canary"] = dict(self.canary)
+        return out
+
+
+@dataclass(frozen=True)
 class Scenario:
     """One complete load-test description; see the module docstring.
 
@@ -516,6 +683,8 @@ class Scenario:
     supervisor: Dict[str, Any] = field(default_factory=dict)
     faults: FaultSchedule = field(default_factory=FaultSchedule)
     fleet: Optional[FleetSpec] = None
+    autoscale: Optional[AutoscaleSpec] = None
+    deploy: Optional[DeploySpec] = None
     slo: Dict[str, float] = field(default_factory=dict)
     tolerance: float = 0.25
     max_wall_s: float = 300.0
@@ -557,8 +726,14 @@ class Scenario:
                 raise ValueError(
                     f"phase {phase.name!r}: eos_token {phase.eos_token} "
                     f"out of vocab [0, {self.model.vocab_size})")
+            deploy_aid = (self.deploy.adapter_id
+                          if self.deploy is not None
+                          and self.deploy.kind == "adapter" else None)
             for aid in phase.adapter_mix:
-                if aid == "base":
+                # the deploy block's canary tenant may be addressed too
+                # (requests before the deploy fires shed as unknown —
+                # the tenant comes online mid-run, by design)
+                if aid == "base" or aid == deploy_aid:
                     continue
                 if not self.engine.lora_adapters:
                     raise ValueError(
@@ -572,6 +747,30 @@ class Scenario:
                         f"phase {phase.name!r}: adapter_mix id {aid!r} "
                         f"is not one of the runner-loaded ids '0'..'"
                         f"{self.engine.lora_adapters - 1}' (or 'base')")
+        if self.autoscale is not None:
+            if self.fleet is None:
+                raise ValueError(
+                    "an 'autoscale' block needs a 'fleet' block")
+            if not (self.autoscale.min_replicas <= self.fleet.n_replicas
+                    <= self.autoscale.max_replicas):
+                raise ValueError(
+                    f"fleet n_replicas ({self.fleet.n_replicas}) must "
+                    f"lie in the autoscale band "
+                    f"[{self.autoscale.min_replicas}, "
+                    f"{self.autoscale.max_replicas}]")
+        if self.deploy is not None:
+            if self.fleet is None:
+                raise ValueError("a 'deploy' block needs a 'fleet' block")
+            if self.deploy.kind == "adapter":
+                if not self.engine.lora_adapters:
+                    raise ValueError(
+                        "deploy kind='adapter' needs an adapter store "
+                        "(set engine.lora_adapters/lora_rank)")
+                if self.deploy.adapter_id.isdigit() and int(
+                        self.deploy.adapter_id) < self.engine.lora_adapters:
+                    raise ValueError(
+                        f"deploy adapter_id {self.deploy.adapter_id!r} "
+                        f"collides with a runner-preloaded tenant id")
         if self.engine.max_len > self.model.max_position_embeddings:
             raise ValueError(
                 f"engine max_len ({self.engine.max_len}) exceeds the "
@@ -585,8 +784,8 @@ class Scenario:
     @classmethod
     def from_dict(cls, data: Dict[str, Any]) -> "Scenario":
         known = {"name", "seed", "description", "model", "engine",
-                 "supervisor", "phases", "faults", "fleet", "slo",
-                 "tolerance", "max_wall_s"}
+                 "supervisor", "phases", "faults", "fleet", "autoscale",
+                 "deploy", "slo", "tolerance", "max_wall_s"}
         unknown = set(data) - known
         if unknown:
             raise ValueError(
@@ -604,6 +803,10 @@ class Scenario:
             faults=FaultSchedule.from_dict(data.get("faults", {})),
             fleet=(FleetSpec.from_dict(data["fleet"])
                    if data.get("fleet") is not None else None),
+            autoscale=(AutoscaleSpec.from_dict(data["autoscale"])
+                       if data.get("autoscale") is not None else None),
+            deploy=(DeploySpec.from_dict(data["deploy"])
+                    if data.get("deploy") is not None else None),
             slo={str(k): float(v)
                  for k, v in data.get("slo", {}).items()},
             tolerance=float(data.get("tolerance", 0.25)),
@@ -624,6 +827,10 @@ class Scenario:
             out["faults"] = self.faults.to_dict()
         if self.fleet is not None:
             out["fleet"] = self.fleet.to_dict()
+        if self.autoscale is not None:
+            out["autoscale"] = self.autoscale.to_dict()
+        if self.deploy is not None:
+            out["deploy"] = self.deploy.to_dict()
         if self.slo:
             out["slo"] = dict(self.slo)
         return out

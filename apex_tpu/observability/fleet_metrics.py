@@ -135,27 +135,42 @@ class FleetMetrics:
         self.fleet = fleet
         self._window_ok = 0         # terminal counts at the last poll
         self._window_terminal = 0
+        self._window_ts = time.monotonic()   # when the window opened
 
     # -- merged views ------------------------------------------------------
 
     def replica_counters(self) -> Dict[int, Dict[str, int]]:
+        """Per-LIVE-replica counter split: retired replica ids never
+        appear here (or in :meth:`labeled_gauges`) — a scale-down removes
+        the id from every per-replica view, it does not leave a ghost."""
         return {rid: reg.counters()
                 for rid, reg in sorted(self.fleet.replica_metrics.items())}
 
+    def _all_registries(self) -> Iterable[MetricsRegistry]:
+        """Live AND retired replica views — what the merged (fleet-total)
+        folds read, so scaling a replica away never un-counts the work it
+        did: merged counters keep equaling the parent's for every
+        replica-incremented key."""
+        regs = list(self.fleet.replica_metrics.values())
+        regs.extend(getattr(self.fleet, "retired_replica_metrics",
+                            {}).values())
+        return regs
+
     def merged_counters(self) -> Dict[str, int]:
-        """Sum of the replica-local counters. For every counter a
-        replica increments this equals the parent's value; parent-only
-        keys (``fleet_dispatches``, ``requests_shed_fleet``, ...) are
-        absent here — the difference IS the fleet-level contribution."""
+        """Sum of the replica-local counters (retired replicas
+        included). For every counter a replica increments this equals
+        the parent's value; parent-only keys (``fleet_dispatches``,
+        ``requests_shed_fleet``, ...) are absent here — the difference
+        IS the fleet-level contribution."""
         merged: Dict[str, int] = {}
-        for counters in self.replica_counters().values():
-            for name, value in counters.items():
+        for reg in self._all_registries():
+            for name, value in reg.counters().items():
                 merged[name] = merged.get(name, 0) + value
         return merged
 
     def merged_histograms(self) -> Dict[str, HistogramSnapshot]:
         per_replica: Dict[str, List[HistogramSnapshot]] = {}
-        for reg in self.fleet.replica_metrics.values():
+        for reg in self._all_registries():
             for name, snap in reg.histograms().items():
                 per_replica.setdefault(name, []).append(snap)
         return {name: merge_histograms(snaps, name)
@@ -203,6 +218,9 @@ class FleetMetrics:
         window_ok = ok - self._window_ok
         window_terminal = terminal - self._window_terminal
         self._window_ok, self._window_terminal = ok, terminal
+        now = time.monotonic()
+        window_s = now - self._window_ts
+        self._window_ts = now
 
         def _p99(name: str) -> Optional[float]:
             snap = hists.get(name)
@@ -215,6 +233,12 @@ class FleetMetrics:
         # replica mid-restart still reports its waiting work
         queue_depth = sum(r.supervisor.queued_count for r in replicas)
         queue_depth += len(getattr(fleet, "_backlog", ()))
+        # token-weighted backlog: the same prompt-token sum the
+        # supervisor's admission surcharge prices, so the autoscaler can
+        # tell a queue of long prompts from the same depth of short ones
+        queued_tokens = sum(
+            getattr(r.supervisor, "queued_prompt_tokens", 0)
+            for r in replicas)
         active_slots = sum(r.supervisor.active_count for r in replicas)
         total_slots = len(replicas) * fleet.config.max_slots
         pages_in_use = pages_total = 0.0
@@ -234,14 +258,19 @@ class FleetMetrics:
             "replicas_dispatchable": len(fleet.dispatch_set()),
             "inflight": fleet.inflight_count,
             "queue_depth": queue_depth,
+            "queued_tokens": queued_tokens,
             "requests_submitted": counters.get("requests_submitted", 0),
             "requests_ok": ok,
             "requests_terminal": terminal,
             "goodput": ok / terminal if terminal else None,
+            # an idle window is 0.0, never None/NaN: "nothing completed"
+            # must rate-normalize cleanly in the autoscaler (which guards
+            # on window_terminal before treating 0.0 as degradation)
             "goodput_window": (window_ok / window_terminal
-                               if window_terminal else None),
+                               if window_terminal else 0.0),
             "window_ok": window_ok,
             "window_terminal": window_terminal,
+            "window_s": window_s,
             "ttft_p99_s": _p99("request_ttft_s"),
             "tpot_p99_s": _p99("request_tpot_s"),
             "slot_occupancy": (active_slots / total_slots

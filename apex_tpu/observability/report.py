@@ -66,7 +66,8 @@ from apex_tpu.observability.trace import (
 
 __all__ = ["read_records", "build_report", "render_report", "main",
            "SERVING_INCIDENT_COUNTERS", "SERVING_SHED_COUNTERS",
-           "FLEET_INCIDENT_COUNTERS", "CHECKPOINT_INCIDENT_COUNTERS"]
+           "FLEET_INCIDENT_COUNTERS", "CHECKPOINT_INCIDENT_COUNTERS",
+           "DEPLOY_ACTION_COUNTERS", "AUTOSCALE_ACTION_COUNTERS"]
 
 #: number of windows in the throughput/MFU trajectory
 _TRAJECTORY_WINDOWS = 5
@@ -102,6 +103,33 @@ FLEET_INCIDENT_COUNTERS = {
     "replica_drain": "replica_drains",
     "replica_rebuild": "replica_rebuilds",
     "request_migrated": "requests_migrated",
+    # autoscaling + continuous deployment (PR 16)
+    "replica_scale_up": "replica_scale_ups",
+    "replica_scale_down": "replica_scale_downs",
+    "deploy_start": "deploys_started",
+    "deploy_complete": "deploys_completed",
+    "deploy_rollback": "deploys_rolled_back",
+    "deploy_rejected": "deploys_rejected",
+    "canary_promoted": "canary_promotions",
+}
+
+#: ``kind="deploy"`` record action -> registry counter — each typed
+#: deploy record is emitted at the same site as its counter increment
+#: and event, so the monitor's deployments section reconciles
+#: key-for-key with both the counter snapshot and the event timeline
+DEPLOY_ACTION_COUNTERS = {
+    "start": "deploys_started",
+    "canary_pass": "canary_promotions",
+    "rollback": "deploys_rolled_back",
+    "complete": "deploys_completed",
+    "rejected": "deploys_rejected",
+}
+
+#: ``kind="autoscale"`` record action -> registry counter (same
+#: co-emission contract as :data:`DEPLOY_ACTION_COUNTERS`)
+AUTOSCALE_ACTION_COUNTERS = {
+    "scale_up": "replica_scale_ups",
+    "scale_down": "replica_scale_downs",
 }
 
 #: checkpoint incident event -> registry counter, the
@@ -321,6 +349,62 @@ def _signals_section(records: List[dict]) -> Optional[dict]:
     return signals
 
 
+def _autoscale_section(records: List[dict],
+                       counters: Dict[str, int]) -> Optional[dict]:
+    """Fold ``kind="autoscale"`` decision records into the monitor's
+    autoscale section: per-action counts (reconciling key-for-key with
+    :data:`AUTOSCALE_ACTION_COUNTERS` — same emission sites), the final
+    replica count after the last decision, and the full decision
+    timeline. ``None`` for a fixed-size or pre-autoscaler log."""
+    rows = [r for r in records if r.get("kind") == "autoscale"]
+    if not rows:
+        return None
+    by_action: Dict[str, int] = {}
+    for r in rows:
+        action = str(r.get("action", "?"))
+        by_action[action] = by_action.get(action, 0) + 1
+    return {
+        "count": len(rows),
+        "by_action": by_action,
+        "counters": {c: counters.get(c, 0)
+                     for c in sorted(set(AUTOSCALE_ACTION_COUNTERS.values()))},
+        "final_replicas": rows[-1].get("n_replicas"),
+        "decisions": [{k: r.get(k) for k in
+                       ("action", "replica_id", "reason", "n_replicas",
+                        "wall") if k in r} for r in rows],
+    }
+
+
+def _deploy_section(records: List[dict],
+                    counters: Dict[str, int]) -> Optional[dict]:
+    """Fold ``kind="deploy"`` records into the monitor's deployments
+    section: per-action counts (reconciling key-for-key with
+    :data:`DEPLOY_ACTION_COUNTERS`), the action timeline, and the last
+    canary score observed (the one that promoted or rolled back).
+    ``None`` for a log with no deployment activity."""
+    rows = [r for r in records if r.get("kind") == "deploy"]
+    if not rows:
+        return None
+    by_action: Dict[str, int] = {}
+    for r in rows:
+        action = str(r.get("action", "?"))
+        by_action[action] = by_action.get(action, 0) + 1
+    last_score = None
+    for r in rows:              # later wins — the decisive window
+        if isinstance(r.get("score"), dict):
+            last_score = r["score"]
+    return {
+        "count": len(rows),
+        "by_action": by_action,
+        "counters": {c: counters.get(c, 0)
+                     for c in sorted(set(DEPLOY_ACTION_COUNTERS.values()))},
+        "timeline": [{k: r.get(k) for k in
+                      ("action", "target", "replica_id", "reason", "wall")
+                      if k in r} for r in rows],
+        "last_score": last_score,
+    }
+
+
 def _checkpoint_section(events: List[dict], counters: Dict[str, int],
                         histograms: Dict[str, dict]) -> Optional[dict]:
     """Fold checkpoint telemetry into the monitor's checkpoints section:
@@ -398,6 +482,8 @@ def build_report(path: str,
         "adapters": _adapter_section(requests, events, counters),
         "spans": _span_section(records),
         "signals": _signals_section(records),
+        "autoscale": _autoscale_section(records, counters),
+        "deploys": _deploy_section(records, counters),
         # per-tenant SLO attribution, only when the run carried adapter
         # traffic (a base-only or pre-LoRA log renders no tenant table)
         "slo_by_adapter": (
@@ -578,10 +664,15 @@ def render_report(report: dict) -> str:
                   f"  replicas: {signals.get('replicas_total', '?')} total "
                   f"{signals.get('replicas_dispatchable', '?')} "
                   f"dispatchable  inflight={signals.get('inflight', '?')} "
-                  f"queue_depth={signals.get('queue_depth', '?')}",
+                  f"queue_depth={signals.get('queue_depth', '?')}"
+                  + (f" queued_tokens={signals['queued_tokens']}"
+                     if signals.get("queued_tokens") is not None else ""),
                   f"  goodput: window={_sig('goodput_window')} "
                   f"({signals.get('window_ok', 0)}/"
-                  f"{signals.get('window_terminal', 0)}) "
+                  f"{signals.get('window_terminal', 0)}"
+                  + (f" over {_fmt(signals['window_s'], 's')}"
+                     if signals.get("window_s") is not None else "")
+                  + ") "
                   f"cumulative={_sig('goodput')} "
                   f"({signals.get('requests_ok', 0)}/"
                   f"{signals.get('requests_terminal', 0)})",
@@ -594,6 +685,54 @@ def render_report(report: dict) -> str:
             split = " ".join(f"{k}={_fmt(v)}"
                              for k, v in sorted(share.items()))
             lines.append(f"  adapter share: {split}")
+    autoscale = report.get("autoscale")
+    if autoscale:
+        split = " ".join(f"{k}={v}"
+                         for k, v in sorted(autoscale["by_action"].items()))
+        final = autoscale.get("final_replicas")
+        lines += ["", f"autoscale decisions ({autoscale['count']}):",
+                  f"  {split}"
+                  + (f"  final_replicas={final}" if final is not None
+                     else "")]
+        for d in autoscale["decisions"][:10]:
+            wall = d.get("wall")
+            stamp = f"[wall={wall:.3f}] " if isinstance(
+                wall, (int, float)) else ""
+            lines.append(
+                f"  {stamp}{d.get('action', '?')} "
+                f"replica={d.get('replica_id', '?')} "
+                f"reason={d.get('reason', '?')} "
+                f"-> n={d.get('n_replicas', '?')}")
+        if len(autoscale["decisions"]) > 10:
+            lines.append(
+                f"  ... {len(autoscale['decisions']) - 10} more")
+    deploys = report.get("deploys")
+    if deploys:
+        split = " ".join(f"{k}={v}"
+                         for k, v in sorted(deploys["by_action"].items()))
+        lines += ["", f"deployments ({deploys['count']} records):",
+                  f"  {split}"]
+        for d in deploys["timeline"][:12]:
+            wall = d.get("wall")
+            stamp = f"[wall={wall:.3f}] " if isinstance(
+                wall, (int, float)) else ""
+            extra = " ".join(
+                f"{k}={d[k]}" for k in ("replica_id", "reason")
+                if d.get(k) is not None)
+            lines.append(f"  {stamp}{d.get('action', '?')} "
+                         f"{d.get('target', '?')}"
+                         + (f" {extra}" if extra else ""))
+        score = deploys.get("last_score")
+        if isinstance(score, dict):
+            lines.append(
+                f"  last canary score: "
+                f"{'PASS' if score.get('pass') else 'FAIL'} "
+                f"requests={score.get('requests', '?')} "
+                f"errors={score.get('errors', '?')} "
+                f"error_rate={_fmt(score.get('error_rate'))} "
+                f"ttft_p99={_fmt(score.get('canary_ttft_p99_s'), 's')} "
+                f"vs incumbent "
+                f"{_fmt(score.get('incumbent_ttft_p99_s'), 's')}")
     by_adapter = report.get("slo_by_adapter")
     if by_adapter:
         lines += ["", "per-tenant slo (by adapter_id):",

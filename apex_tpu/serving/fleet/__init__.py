@@ -11,8 +11,29 @@ never drops capacity below N−1. :class:`ShardedEngine` is the
 scale-up counterpart: the same engine with its decode/prefill programs
 tensor-parallel over the device mesh and the flat KV slot pool sharded
 on the heads axis. See docs/serving.md#fleet.
+
+On top of the fleet sit the two halves of the train->serve loop
+(PR 16): :class:`Autoscaler` grows and shrinks the fleet between
+``min_replicas``/``max_replicas`` under live SLO pressure
+(docs/serving.md#autoscaling), and :class:`Deployment` rolls freshly
+trained checkpoints or LoRA adapters through canary-scored draining
+restarts with automatic rollback
+(docs/serving.md#continuous-deployment).
 """
 
+from apex_tpu.serving.fleet.autoscale import AutoscaleConfig, Autoscaler
+from apex_tpu.serving.fleet.deploy import (
+    DEPLOY_CANARY,
+    DEPLOY_COMPLETE,
+    DEPLOY_DRAINING,
+    DEPLOY_REJECTED,
+    DEPLOY_ROLLED_BACK,
+    DEPLOY_ROLLING,
+    DEPLOY_ROLLING_BACK,
+    DEPLOY_UNLOADING,
+    CanaryConfig,
+    Deployment,
+)
 from apex_tpu.serving.fleet.router import (
     REPLICA_ACTIVE,
     REPLICA_DRAINING,
@@ -31,8 +52,20 @@ __all__ = [
     "FleetConfig",
     "FleetUnavailableError",
     "ShardedEngine",
+    "AutoscaleConfig",
+    "Autoscaler",
+    "CanaryConfig",
+    "Deployment",
     "REPLICA_ACTIVE",
     "REPLICA_DRAINING",
     "REPLICA_PROBING",
     "REPLICA_FAILED",
+    "DEPLOY_ROLLING",
+    "DEPLOY_DRAINING",
+    "DEPLOY_CANARY",
+    "DEPLOY_ROLLING_BACK",
+    "DEPLOY_UNLOADING",
+    "DEPLOY_COMPLETE",
+    "DEPLOY_ROLLED_BACK",
+    "DEPLOY_REJECTED",
 ]

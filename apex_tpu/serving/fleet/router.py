@@ -66,7 +66,7 @@ from __future__ import annotations
 import time
 from collections import OrderedDict
 from dataclasses import dataclass
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from apex_tpu.observability import MetricsRegistry
 from apex_tpu.observability.fleet_metrics import ReplicaRegistry
@@ -116,7 +116,13 @@ REPLICA_FAILED = "failed"        # rebuild probes exhausted; out for good
 #: an incident type never fired — the monitor's fleet section reconciles
 #: these against the event stream key-for-key
 _FLEET_COUNTERS = ("fleet_dispatches", "replica_drains", "replica_rebuilds",
-                   "requests_migrated", "requests_shed_fleet")
+                   "requests_migrated", "requests_shed_fleet",
+                   # autoscaling + continuous deployment (PR 16): each
+                   # counter pairs with a same-named kind="event" record
+                   "replica_scale_ups", "replica_scale_downs",
+                   "deploys_started", "deploys_completed",
+                   "deploys_rolled_back", "deploys_rejected",
+                   "canary_promotions")
 
 
 class FleetUnavailableError(EngineUnavailableError):
@@ -164,10 +170,15 @@ class FleetConfig:
 
 
 class _Replica:
-    """One fleet slot: a supervisor plus its lifecycle state."""
+    """One fleet slot: a supervisor plus its lifecycle state.
+
+    ``retire_on_drain`` marks a scale-down: when the drain empties, the
+    replica is REMOVED from the fleet (:meth:`ReplicaFleet._finish_retire`)
+    instead of rebuilt — the terminal leg of ``retire_replica``.
+    """
 
     __slots__ = ("replica_id", "supervisor", "state", "dispatches",
-                 "probe_id", "probe_attempts")
+                 "probe_id", "probe_attempts", "retire_on_drain")
 
     def __init__(self, replica_id: int, supervisor: EngineSupervisor):
         self.replica_id = replica_id
@@ -176,6 +187,7 @@ class _Replica:
         self.dispatches = 0
         self.probe_id: Optional[int] = None
         self.probe_attempts = 0
+        self.retire_on_drain = False
 
 
 class _FleetTracked:
@@ -326,7 +338,7 @@ class ReplicaFleet:
                  fleet: Optional[FleetConfig] = None,
                  metrics: Optional[MetricsRegistry] = None,
                  faults=None, router: Optional[Router] = None,
-                 engine_factory=None, adapters=None):
+                 engine_factory=None, adapters=None, autoscale=None):
         self._model = model
         self._params = params
         #: shared LoRA :class:`~apex_tpu.lora.AdapterStore` — every
@@ -377,9 +389,44 @@ class ReplicaFleet:
         #: view per replica id, surviving rebuilds — a replica's
         #: counters are cumulative over its whole slot in the fleet.
         self.replica_metrics: Dict[int, ReplicaRegistry] = {}
+        #: registry views of RETIRED replicas — removed from every live
+        #: per-replica view but still folded into FleetMetrics' merged
+        #: counters/histograms, so scaling a replica away never
+        #: un-counts the work it did
+        self.retired_replica_metrics: Dict[int, ReplicaRegistry] = {}
+        #: per-replica weight overrides (canary deploys): a replica id
+        #: present here rebuilds with THESE params instead of
+        #: ``self._params``; a rollback pops the entry and rebuilds
+        self._replica_params: Dict[int, Any] = {}
+        #: monotonic id source for scale-ups — retired ids are never
+        #: reused, so records/counters stay unambiguous across churn
+        self._next_replica_id = self.fleet.n_replicas
+        self._deployment = None
         self.replicas: List[_Replica] = [
             _Replica(i, self._build_supervisor(i))
             for i in range(self.fleet.n_replicas)]
+        if autoscale is not None:
+            from apex_tpu.serving.fleet.autoscale import (
+                Autoscaler,
+                AutoscaleConfig,
+            )
+            if isinstance(autoscale, Autoscaler):
+                self.autoscaler: Optional[Autoscaler] = autoscale
+            elif isinstance(autoscale, AutoscaleConfig):
+                self.autoscaler = Autoscaler(autoscale)
+            else:
+                raise TypeError(
+                    f"autoscale must be an AutoscaleConfig or Autoscaler, "
+                    f"got {type(autoscale).__name__}")
+            cfg = self.autoscaler.config
+            if not (cfg.min_replicas <= self.fleet.n_replicas
+                    <= cfg.max_replicas):
+                raise ValueError(
+                    f"n_replicas={self.fleet.n_replicas} outside the "
+                    f"autoscaler's [{cfg.min_replicas}, "
+                    f"{cfg.max_replicas}] bounds")
+        else:
+            self.autoscaler = None
 
     def _build_supervisor(self, replica_id: int,
                           service_s: Optional[float] = None
@@ -389,7 +436,9 @@ class ReplicaFleet:
             reg = self.replica_metrics[replica_id] = ReplicaRegistry(
                 self.metrics, replica_id)
         return EngineSupervisor(
-            self._model, self._params, self.config,
+            self._model,
+            self._replica_params.get(replica_id, self._params),
+            self.config,
             supervisor=self.supervisor_config, metrics=reg,
             faults=self._faults.get(replica_id), replica_id=replica_id,
             service_s=service_s, engine_factory=self._engine_factory,
@@ -397,9 +446,32 @@ class ReplicaFleet:
 
     # -- introspection ----------------------------------------------------
 
+    def _replica(self, replica_id: int) -> Optional[_Replica]:
+        """Id-keyed lookup — replica ids are NOT list indices once
+        scale-up/down churn starts (ids are monotonic, never reused)."""
+        for replica in self.replicas:
+            if replica.replica_id == replica_id:
+                return replica
+        return None
+
     @property
     def n_replicas(self) -> int:
         return len(self.replicas)
+
+    @property
+    def topology_busy(self) -> Optional[int]:
+        """Replica id currently draining or probing, else None — one
+        topology change (drain, scale, deploy step) at a time."""
+        for r in self.replicas:
+            if r.state in (REPLICA_DRAINING, REPLICA_PROBING):
+                return r.replica_id
+        return None
+
+    @property
+    def deployment(self):
+        """The current (or most recent) :class:`~apex_tpu.serving.fleet.\
+deploy.Deployment`, or None if :meth:`deploy` was never called."""
+        return self._deployment
 
     @property
     def replica_states(self) -> Dict[int, str]:
@@ -459,6 +531,16 @@ class ReplicaFleet:
         candidates = self.dispatch_set()
         if not candidates:
             self._shed_fleet(request, now)
+        # an active adapter-canary deployment pins its tenant's traffic
+        # to the canary replica (when dispatchable) so the canary window
+        # actually observes the adapter under live load
+        dep = self._deployment
+        if dep is not None and not dep.done:
+            pin = dep.pin_replica(request)
+            if pin is not None:
+                pinned = [r for r in candidates if r.replica_id == pin]
+                if pinned:
+                    candidates = pinned
         chain = self._chain_for(request)
         replica = self.router.pick(candidates, chain=chain)
         tr = _FleetTracked(request, now, self._order)
@@ -533,7 +615,9 @@ class ReplicaFleet:
                 return True
         if tr.replica_id is None:
             return False
-        replica = self.replicas[tr.replica_id]
+        replica = self._replica(tr.replica_id)
+        if replica is None:
+            return False
         found = replica.supervisor.cancel(request_id)
         if found:
             self._harvest_replica(replica, now)
@@ -550,12 +634,17 @@ class ReplicaFleet:
             raise RuntimeError("fleet is closed")
         before = set(self.completed)
         self._dispatch_backlog()
-        for replica in self.replicas:
+        for replica in list(self.replicas):
             if replica.state == REPLICA_FAILED:
                 continue
             replica.supervisor.tick()
             self._harvest_replica(replica, time.monotonic())
         self._advance_drains()
+        now = time.monotonic()
+        if self._deployment is not None and not self._deployment.done:
+            self._deployment.step(self, now)
+        if self.autoscaler is not None:
+            self.autoscaler.maybe_scale(self, now)
         return [self.completed[rid] for rid in sorted(
             set(self.completed) - before)]
 
@@ -603,18 +692,18 @@ class ReplicaFleet:
         raises ``RuntimeError`` instead of silently stacking drains)."""
         if self._closed:
             raise RuntimeError("fleet is closed")
-        if not 0 <= replica_id < len(self.replicas):
-            raise ValueError(f"no replica {replica_id} "
-                             f"(fleet has 0..{len(self.replicas) - 1})")
-        replica = self.replicas[replica_id]
+        replica = self._replica(replica_id)
+        if replica is None:
+            raise ValueError(
+                f"no replica {replica_id} (fleet has "
+                f"{sorted(r.replica_id for r in self.replicas)})")
         if replica.state != REPLICA_ACTIVE:
             raise RuntimeError(
                 f"replica {replica_id} is {replica.state}, not active")
-        busy = [r.replica_id for r in self.replicas
-                if r.state in (REPLICA_DRAINING, REPLICA_PROBING)]
-        if busy:
+        busy = self.topology_busy
+        if busy is not None:
             raise RuntimeError(
-                f"replica {busy[0]} is already draining/probing — one "
+                f"replica {busy} is already draining/probing — one "
                 f"restart at a time keeps fleet capacity at N-1")
         replica.state = REPLICA_DRAINING
         self.metrics.inc("replica_drains")
@@ -696,14 +785,150 @@ class ReplicaFleet:
         self._backlog = kept
 
     def _advance_drains(self) -> None:
-        """Move the drain/probe lifecycle forward: rebuild a drained-out
-        replica, then score its health probe."""
-        for replica in self.replicas:
+        """Move the drain/probe lifecycle forward: rebuild (or, for a
+        scale-down, retire) a drained-out replica, then score its health
+        probe. Iterates a copy — retirement mutates ``self.replicas``."""
+        for replica in list(self.replicas):
             if (replica.state == REPLICA_DRAINING
                     and replica.supervisor.inflight_count == 0):
+                if replica.retire_on_drain:
+                    self._finish_retire(replica)
+                    continue
                 self._rebuild(replica)
             if replica.state == REPLICA_PROBING:
                 self._check_probe(replica)
+
+    # -- autoscaling: add / retire replicas -------------------------------
+
+    def add_replica(self) -> int:
+        """Scale up by one replica (the autoscaler's up-leg, also usable
+        directly). The new replica gets a fresh, never-reused id and
+        joins through the SAME health-probe gate as a rebuild: it enters
+        the dispatch set only after a real one-token probe request
+        succeeds (``probe_on_rebuild`` permitting). One topology change
+        at a time — raises ``RuntimeError`` while another replica is
+        draining or probing. Returns the new replica id."""
+        if self._closed:
+            raise RuntimeError("fleet is closed")
+        busy = self.topology_busy
+        if busy is not None:
+            raise RuntimeError(
+                f"replica {busy} is draining/probing — one topology "
+                f"change at a time")
+        rid = self._next_replica_id
+        self._next_replica_id += 1
+        self.metrics.declare_counters(f"replica{rid}_dispatches")
+        replica = _Replica(rid, self._build_supervisor(rid))
+        self.replicas.append(replica)
+        self.metrics.inc("replica_scale_ups")
+        log_event(_LOG, "replica_scale_up", replica_id=rid,
+                  n_replicas=len(self.replicas))
+        self.metrics.event("replica_scale_up", replica_id=rid,
+                           n_replicas=len(self.replicas))
+        if self.fleet.probe_on_rebuild:
+            replica.state = REPLICA_PROBING
+            self._launch_probe(replica)
+        else:
+            replica.state = REPLICA_ACTIVE
+        return rid
+
+    def retire_replica(self, replica_id: int) -> None:
+        """Scale down by retiring one replica (the autoscaler's
+        down-leg): drain it through the migrate-or-finish machinery —
+        no request dropped — then REMOVE it from the fleet entirely
+        (its id never comes back; its counters fold into the retired
+        ledger so fleet totals still reconcile). One topology change at
+        a time; the last active replica cannot be retired."""
+        if self._closed:
+            raise RuntimeError("fleet is closed")
+        replica = self._replica(replica_id)
+        if replica is None:
+            raise ValueError(
+                f"no replica {replica_id} (fleet has "
+                f"{sorted(r.replica_id for r in self.replicas)})")
+        if replica.state != REPLICA_ACTIVE:
+            raise RuntimeError(
+                f"replica {replica_id} is {replica.state}, not active")
+        busy = self.topology_busy
+        if busy is not None:
+            raise RuntimeError(
+                f"replica {busy} is draining/probing — one topology "
+                f"change at a time")
+        others = [r for r in self.replicas
+                  if r.state == REPLICA_ACTIVE and r is not replica]
+        if not others:
+            raise RuntimeError(
+                f"replica {replica_id} is the last active replica — "
+                f"retiring it would empty the dispatch set")
+        replica.state = REPLICA_DRAINING
+        replica.retire_on_drain = True
+        self.metrics.inc("replica_scale_downs")
+        inflight = replica.supervisor.inflight_count
+        log_event(_LOG, "replica_scale_down", replica_id=replica_id,
+                  inflight=inflight, n_replicas=len(self.replicas))
+        self.metrics.event("replica_scale_down", replica_id=replica_id,
+                           inflight=inflight,
+                           n_replicas=len(self.replicas))
+        if self.fleet.migrate_on_drain:
+            self._migrate_from(replica)
+        self._advance_drains()
+
+    def _finish_retire(self, replica: _Replica) -> None:
+        """Terminal leg of a scale-down: the drain has emptied — close
+        the supervisor, remove the id from the fleet, the router's
+        residency/cost tables, and every live per-replica metrics view
+        (the registry moves to ``retired_replica_metrics`` so merged
+        fleet totals keep reconciling with the parent)."""
+        rid = replica.replica_id
+        self._harvest_replica(replica, time.monotonic())
+        self._engine_restarts_base += replica.supervisor.restarts
+        replica.supervisor.close()
+        self.replicas.remove(replica)
+        self.router.invalidate(rid)
+        reg = self.replica_metrics.pop(rid, None)
+        if reg is not None:
+            self.retired_replica_metrics[rid] = reg
+        log_event(_LOG, "replica_retired", replica_id=rid,
+                  n_replicas=len(self.replicas))
+        self.metrics.event("replica_retired", replica_id=rid,
+                           n_replicas=len(self.replicas))
+
+    # -- continuous deployment --------------------------------------------
+
+    def deploy(self, checkpoint_dir: Optional[str] = None, *,
+               step: Optional[int] = None, adapter=None, canary=None):
+        """Start a rolling canary deployment
+        (docs/serving.md#continuous-deployment). Exactly one of
+        ``checkpoint_dir`` (roll every replica onto the committed
+        sharded checkpoint at ``step``, default latest, via draining
+        restarts) or ``adapter`` (``(adapter_id, factors)`` — hot-load
+        a LoRA adapter through the shared ``AdapterStore`` and canary
+        it on one replica, gated on its per-tenant SLO score).
+
+        The checkpoint is fsck-verified BEFORE the first drain — a
+        corrupt step raises
+        :class:`~apex_tpu.checkpoint.CheckpointCorruptionError` here
+        (recorded as ``deploy_rejected``) and no replica is touched.
+        Progress then happens across :meth:`tick` calls; watch
+        :attr:`deployment`. Raises ``RuntimeError`` if a deployment is
+        already in progress."""
+        from apex_tpu.serving.fleet.deploy import Deployment
+        if self._closed:
+            raise RuntimeError("fleet is closed")
+        if self._deployment is not None and not self._deployment.done:
+            raise RuntimeError(
+                f"deployment {self._deployment.describe()} is already "
+                f"in progress — one rollout at a time")
+        dep = Deployment(checkpoint_dir=checkpoint_dir, step=step,
+                         adapter=adapter, canary=canary)
+        try:
+            dep.start(self)
+        except Exception:
+            if dep.done:        # recorded as deploy_rejected: keep it
+                self._deployment = dep   # visible (and non-blocking)
+            raise
+        self._deployment = dep
+        return dep
 
     def _rebuild(self, replica: _Replica) -> None:
         """Tear down the drained supervisor and build a fresh one (new

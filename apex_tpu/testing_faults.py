@@ -22,6 +22,13 @@ scripted :class:`FaultInjector`:
   :func:`tear_manifest` (garble the manifest after commit): damage the
   per-shard sha256 / manifest-sha256 verification must catch, driving
   checksum-verified fallback instead of a silently-wrong restore;
+- **value-level poisoning** — :func:`corrupt_checkpoint_weights`
+  overwrites a committed step's floating-point shards with non-finite
+  values AND re-checksums the manifest + commit marker, so every
+  integrity check passes on the poisoned bytes. This is what a
+  checkpoint *trained into* a bad state (or poisoned upstream of
+  checksumming) looks like: only live traffic can catch it — the fault
+  kind behind the ``canary_rollback`` deployment scenario;
 - **slow writes** — ``save_delays`` stretches a scheduled save attempt by
   sleeping in the save hook, pinning an async background write in flight
   while the test preempts/drains/abandons around it.
@@ -52,7 +59,8 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["FaultInjector", "StepFaults", "poison_batch",
-           "corrupt_checkpoint", "corrupt_shard", "tear_manifest",
+           "corrupt_checkpoint", "corrupt_shard",
+           "corrupt_checkpoint_weights", "tear_manifest",
            "InjectedEngineFault", "ServingFaultInjector"]
 
 
@@ -119,6 +127,54 @@ def corrupt_shard(directory: str, step: int, *, leaf: int = 0,
         raise ValueError(f"kind must be 'bitflip', 'truncate' or "
                          f"'missing', got {kind!r}")
     return path
+
+
+def corrupt_checkpoint_weights(directory: str, step: int, *,
+                               value: float = float("nan")) -> int:
+    """Poison the VALUES of a committed sharded-format step while
+    keeping every integrity check green: each floating-point shard file
+    is rewritten as ``value`` (non-finite by default) in the original
+    shape/dtype/format, then the manifest's per-shard ``bytes``/
+    ``sha256`` entries and the commit marker's manifest sha are
+    re-stamped to match the poisoned bytes.
+
+    Distinct from :func:`corrupt_shard`: that damages bytes the
+    checksums CATCH (restore falls back); this is damage the checksums
+    CANNOT catch — manifest + COMMIT intact, per-shard hashes pass,
+    weights are garbage. ``verify_step(deep=True)`` reports healthy and
+    elastic restore succeeds; only serving the weights to live traffic
+    (the deploy canary's SLO score) detects it. Returns the number of
+    shard files poisoned (0 ⇒ no floating leaves — a test bug, assert
+    on it). Integer leaves are left untouched (step counters etc. stay
+    valid)."""
+    from io import BytesIO
+
+    from apex_tpu.checkpoint.manifest import (
+        load_manifest,
+        sha256_bytes,
+        write_commit,
+        write_manifest,
+    )
+    step_dir = os.path.join(os.path.abspath(os.fspath(directory)), str(step))
+    manifest = load_manifest(step_dir)
+    count = 0
+    for _, leaf in sorted(manifest["leaves"].items()):
+        if not np.issubdtype(np.dtype(leaf["dtype"]), np.floating):
+            continue
+        for shard in leaf["shards"]:
+            path = os.path.join(step_dir, shard["file"])
+            poisoned = np.full_like(np.load(path), value)
+            buf = BytesIO()
+            np.save(buf, poisoned, allow_pickle=False)
+            data = buf.getvalue()
+            with open(path, "wb") as f:
+                f.write(data)
+            shard["bytes"] = len(data)
+            shard["sha256"] = sha256_bytes(data)
+            count += 1
+    sha = write_manifest(step_dir, manifest)
+    write_commit(step_dir, sha, int(manifest.get("step", step)))
+    return count
 
 
 def tear_manifest(directory: str, step: int) -> str:

@@ -1,0 +1,468 @@
+"""SLO-driven autoscaling tests: policy, damping, and churn hygiene.
+
+Three layers:
+
+- **Pure policy** — :meth:`Autoscaler.desired_direction` maps one
+  signals dict to up/down/hold with no fleet, engine, or jax in sight;
+  the idle-window contract (``goodput_window == 0.0`` with
+  ``window_terminal == 0`` never scales up) is pinned here.
+- **Damping** — hysteresis streaks, the cooldown window, band clamps,
+  and the hold-while-topology-busy rule, driven through scripted
+  signal sequences against a stub fleet (at most one decision per
+  cooldown window, by construction).
+- **Churn hygiene** — a real fleet swept through scale-up/scale-down
+  cycles leaks NOTHING: retired replica ids vanish from the router's
+  residency table, the per-replica counter/gauge views, and the
+  dispatch set, while merged fleet totals still reconcile with the
+  parent registry (the retired ledger keeps the work counted).
+"""
+
+import jax
+import pytest
+
+from apex_tpu.models import GPTModel, TransformerConfig
+from apex_tpu.observability import MetricsRegistry
+from apex_tpu.observability.fleet_metrics import FleetMetrics
+from apex_tpu.serving import EngineConfig, Request, SchedulerConfig
+from apex_tpu.serving.fleet import (
+    REPLICA_ACTIVE,
+    AutoscaleConfig,
+    Autoscaler,
+    FleetConfig,
+    ReplicaFleet,
+)
+from apex_tpu.serving.fleet.router import _Replica
+
+
+@pytest.fixture(scope="module")
+def small():
+    # 1 layer, same rationale as the fleet suite: scale-ups build fresh
+    # engines and the policy/bookkeeping under test is depth-agnostic
+    model = GPTModel(TransformerConfig(
+        num_layers=1, hidden_size=32, num_attention_heads=4, vocab_size=64,
+        max_position_embeddings=64, hidden_dropout=0.0,
+        attention_dropout=0.0))
+    params = model.init(jax.random.PRNGKey(0))
+    return model, params
+
+
+def _signals(**overrides):
+    base = {
+        "replicas_total": 1, "replicas_dispatchable": 1,
+        "inflight": 0, "queue_depth": 0, "queued_tokens": 0,
+        "goodput_window": 0.0, "window_ok": 0, "window_terminal": 0,
+        "window_s": 0.25, "ttft_p99_s": None, "tpot_p99_s": None,
+        "slot_occupancy": 0.0,
+    }
+    base.update(overrides)
+    return base
+
+
+# ---------------------------------------------------------------------------
+# config validation
+
+
+class TestAutoscaleConfig:
+    def test_band_must_be_ordered(self):
+        with pytest.raises(ValueError, match="max_replicas"):
+            AutoscaleConfig(min_replicas=3, max_replicas=2)
+
+    def test_min_replicas_positive(self):
+        with pytest.raises(ValueError, match="min_replicas"):
+            AutoscaleConfig(min_replicas=0)
+
+    def test_queue_bands_must_not_overlap(self):
+        # scale-down-at >= scale-up-at would flap forever
+        with pytest.raises(ValueError, match="flap"):
+            AutoscaleConfig(scale_up_queue_per_replica=2.0,
+                            scale_down_queue_per_replica=2.0)
+
+    def test_goodput_threshold_is_a_fraction(self):
+        with pytest.raises(ValueError, match="scale_up_goodput"):
+            AutoscaleConfig(scale_up_goodput=1.5)
+
+
+# ---------------------------------------------------------------------------
+# the pure policy
+
+
+class TestDesiredDirection:
+    def test_queue_pressure_scales_up(self):
+        scaler = Autoscaler(AutoscaleConfig(scale_up_queue_per_replica=4.0))
+        direction, reason = scaler.desired_direction(
+            _signals(queue_depth=9, replicas_dispatchable=2,
+                     slot_occupancy=1.0))
+        assert (direction, reason) == ("up", "queue_depth")
+
+    def test_queue_is_normalized_per_dispatchable_replica(self):
+        scaler = Autoscaler(AutoscaleConfig(scale_up_queue_per_replica=4.0))
+        # 6 queued over 2 dispatchable = 3 per replica: under the bar
+        direction, _ = scaler.desired_direction(
+            _signals(queue_depth=6, replicas_dispatchable=2,
+                     slot_occupancy=1.0))
+        assert direction is None
+
+    def test_token_weighted_backlog_scales_up(self):
+        # long-prompt backlog trips the token trigger before raw depth
+        scaler = Autoscaler(AutoscaleConfig(
+            scale_up_queue_per_replica=100.0,
+            scale_up_queued_tokens_per_replica=64.0))
+        direction, reason = scaler.desired_direction(
+            _signals(queue_depth=3, queued_tokens=200, slot_occupancy=1.0))
+        assert (direction, reason) == ("up", "queued_tokens")
+
+    def test_degraded_goodput_scales_up_only_with_traffic(self):
+        scaler = Autoscaler(AutoscaleConfig(scale_up_goodput=0.9))
+        bad = _signals(goodput_window=0.5, window_terminal=4,
+                       slot_occupancy=1.0)
+        assert scaler.desired_direction(bad) == ("up", "goodput")
+
+    def test_idle_window_zero_goodput_never_scales_up(self):
+        # the FleetMetrics contract: an idle window reports 0.0 (never
+        # None/NaN) with window_terminal == 0 — that is "no evidence",
+        # not "every request failed"
+        scaler = Autoscaler(AutoscaleConfig(scale_up_goodput=0.9,
+                                            scale_down_slot_occupancy=0.0))
+        idle = _signals(goodput_window=0.0, window_terminal=0,
+                        queue_depth=1, slot_occupancy=0.5)
+        direction, _ = scaler.desired_direction(idle)
+        assert direction is None
+
+    def test_ttft_breach_scales_up(self):
+        scaler = Autoscaler(AutoscaleConfig(scale_up_ttft_p99_s=1.0))
+        direction, reason = scaler.desired_direction(
+            _signals(ttft_p99_s=2.5, slot_occupancy=1.0))
+        assert (direction, reason) == ("up", "ttft_p99")
+
+    def test_scale_down_needs_quiet_on_every_axis(self):
+        scaler = Autoscaler(AutoscaleConfig(
+            scale_down_queue_per_replica=0.5,
+            scale_down_slot_occupancy=0.25))
+        assert scaler.desired_direction(_signals()) == ("down", "idle")
+        # quiet queue but busy slots: hold
+        busy_slots = _signals(slot_occupancy=0.8)
+        assert scaler.desired_direction(busy_slots)[0] is None
+        # unmeasurable occupancy counts as quiet
+        no_slots = _signals(slot_occupancy=None)
+        assert scaler.desired_direction(no_slots) == ("down", "idle")
+
+    def test_mid_band_load_holds(self):
+        scaler = Autoscaler(AutoscaleConfig(
+            scale_up_queue_per_replica=4.0,
+            scale_down_queue_per_replica=0.5))
+        direction, _ = scaler.desired_direction(
+            _signals(queue_depth=2, slot_occupancy=0.6))
+        assert direction is None
+
+
+# ---------------------------------------------------------------------------
+# damping: hysteresis, cooldown, bounds, topology holds
+
+
+class _ScriptedMetrics:
+    """Stands in for the autoscaler's private FleetMetrics view: each
+    poll pops the next scripted signals dict (the last one repeats)."""
+
+    def __init__(self, fleet, script):
+        self.fleet = fleet
+        self._script = list(script)
+
+    def signals(self):
+        if len(self._script) > 1:
+            return self._script.pop(0)
+        return self._script[0]
+
+
+class _PolicyFleet:
+    """The minimal fleet surface maybe_scale touches."""
+
+    def __init__(self, n=1):
+        self.metrics = MetricsRegistry()
+        self.replicas = [self._active(i, 0) for i in range(n)]
+        self.topology_busy = None
+        self.deployment = None
+        self.added = 0
+        self.retired = []
+
+    @staticmethod
+    def _active(rid, depth):
+        r = _Replica.__new__(_Replica)
+        r.replica_id, r.state = rid, REPLICA_ACTIVE
+        r.supervisor = type("S", (), {
+            "queued_count": depth, "active_count": 0,
+            "service_estimate_s": 0.01})()
+        return r
+
+    @property
+    def n_replicas(self):
+        return len(self.replicas)
+
+    def add_replica(self):
+        rid = max((r.replica_id for r in self.replicas), default=-1) + 1
+        self.replicas.append(self._active(rid, 0))
+        self.added += 1
+        return rid
+
+    def retire_replica(self, rid):
+        self.replicas = [r for r in self.replicas if r.replica_id != rid]
+        self.retired.append(rid)
+
+
+def _scripted(fleet, config, script):
+    scaler = Autoscaler(config)
+    scaler._fm = _ScriptedMetrics(fleet, script)
+    return scaler
+
+
+class TestMaybeScale:
+    CFG = AutoscaleConfig(min_replicas=1, max_replicas=3,
+                          poll_interval_s=0.1, cooldown_s=1.0,
+                          hysteresis_polls=2,
+                          scale_up_queue_per_replica=2.0)
+
+    def test_hysteresis_requires_consecutive_polls(self):
+        fleet = _PolicyFleet(n=1)
+        hot = _signals(queue_depth=9, slot_occupancy=1.0)
+        scaler = _scripted(fleet, self.CFG, [hot])
+        assert scaler.maybe_scale(fleet, now=0.0) is None     # streak 1
+        assert scaler.maybe_scale(fleet, now=0.2) == "up"     # streak 2
+        assert fleet.added == 1
+
+    def test_direction_flip_resets_the_streak(self):
+        fleet = _PolicyFleet(n=2)
+        hot = _signals(queue_depth=9, slot_occupancy=1.0)
+        idle = _signals()
+        scaler = _scripted(fleet, self.CFG, [hot, idle, hot, hot])
+        assert scaler.maybe_scale(fleet, now=0.0) is None     # up x1
+        assert scaler.maybe_scale(fleet, now=0.2) is None     # down x1
+        assert scaler.maybe_scale(fleet, now=0.4) is None     # up x1 again
+        assert scaler.maybe_scale(fleet, now=0.6) == "up"
+
+    def test_poll_interval_gates_reads(self):
+        fleet = _PolicyFleet(n=1)
+        hot = _signals(queue_depth=9, slot_occupancy=1.0)
+        scaler = _scripted(fleet, self.CFG, [hot])
+        assert scaler.maybe_scale(fleet, now=0.0) is None
+        # inside the poll interval: not even a signals read, no streak
+        assert scaler.maybe_scale(fleet, now=0.05) is None
+        assert scaler._streak == 1
+
+    def test_cooldown_allows_one_decision_per_window(self):
+        fleet = _PolicyFleet(n=1)
+        hot = _signals(queue_depth=50, slot_occupancy=1.0)
+        scaler = _scripted(fleet, self.CFG, [hot])
+        times = [0.0, 0.15, 0.3, 0.45, 0.6, 0.75, 0.9, 1.05, 1.2, 1.35]
+        applied = [t for t in times if scaler.maybe_scale(fleet, now=t)]
+        # decisions at least cooldown_s (1.0) apart: 2 in 1.35s, max
+        assert len(applied) == 2
+        assert applied[1] - applied[0] >= self.CFG.cooldown_s
+
+    def test_bounds_clamp_before_streak_accounting(self):
+        fleet = _PolicyFleet(n=3)           # already at max_replicas
+        hot = _signals(queue_depth=50, slot_occupancy=1.0)
+        scaler = _scripted(fleet, self.CFG, [hot])
+        for k in range(5):
+            assert scaler.maybe_scale(fleet, now=0.2 * k) is None
+        assert fleet.added == 0
+        assert scaler._streak == 0          # forbidden direction != held
+
+    def test_min_replicas_blocks_scale_down(self):
+        fleet = _PolicyFleet(n=1)
+        scaler = _scripted(fleet, self.CFG, [_signals()])
+        for k in range(5):
+            assert scaler.maybe_scale(fleet, now=0.2 * k) is None
+        assert fleet.retired == []
+
+    def test_holds_while_topology_busy_without_resetting_streak(self):
+        fleet = _PolicyFleet(n=1)
+        hot = _signals(queue_depth=9, slot_occupancy=1.0)
+        scaler = _scripted(fleet, self.CFG, [hot])
+        scaler.maybe_scale(fleet, now=0.0)
+        fleet.topology_busy = 0             # a drain/probe in flight
+        assert scaler.maybe_scale(fleet, now=0.2) is None
+        assert scaler._streak >= 2          # evidence kept, not reset
+        fleet.topology_busy = None
+        assert scaler.maybe_scale(fleet, now=0.4) == "up"
+
+    def test_holds_while_deployment_rolls(self):
+        fleet = _PolicyFleet(n=1)
+        fleet.deployment = type("D", (), {"done": False})()
+        hot = _signals(queue_depth=9, slot_occupancy=1.0)
+        scaler = _scripted(fleet, self.CFG, [hot])
+        scaler.maybe_scale(fleet, now=0.0)
+        assert scaler.maybe_scale(fleet, now=0.2) is None
+        fleet.deployment.done = True
+        assert scaler.maybe_scale(fleet, now=0.4) == "up"
+
+    def test_retire_target_is_least_loaded_then_youngest(self):
+        fleet = _PolicyFleet(n=3)
+        fleet.replicas[0].supervisor.queued_count = 3
+        fleet.replicas[1].supervisor.queued_count = 0
+        fleet.replicas[2].supervisor.queued_count = 0
+        # replicas 1 and 2 tie on depth: the YOUNGEST id unwinds first
+        assert Autoscaler._retire_target(fleet) == 2
+
+    def test_retire_target_never_empties_the_fleet(self):
+        fleet = _PolicyFleet(n=1)
+        assert Autoscaler._retire_target(fleet) is None
+
+    def test_applied_decisions_are_recorded_in_order(self):
+        fleet = _PolicyFleet(n=1)
+        hot = _signals(queue_depth=9, slot_occupancy=1.0)
+        scaler = _scripted(fleet, self.CFG, [hot])
+        scaler.maybe_scale(fleet, now=0.0)
+        scaler.maybe_scale(fleet, now=0.2)
+        assert scaler.decisions == [(0.2, "up", 1, "queue_depth")]
+
+
+# ---------------------------------------------------------------------------
+# churn hygiene against a real fleet
+
+
+class TestChurnHygiene:
+    def _fleet(self, model, params, n=2):
+        return ReplicaFleet(
+            model, params,
+            EngineConfig(max_slots=2, max_len=32,
+                         scheduler=SchedulerConfig(max_queue=16)),
+            fleet=FleetConfig(n_replicas=n, probe_on_rebuild=False))
+
+    def test_scale_up_down_sweep_leaks_nothing(self, small):
+        model, params = small
+        fleet = self._fleet(model, params, n=2)
+        fm = FleetMetrics(fleet)
+        try:
+            retired_ids = []
+            for _ in range(3):
+                rid = fleet.add_replica()
+                # seed residency so invalidate() has something to clear
+                fleet.router.note_dispatch(rid, (1, 2, 3))
+                assert rid in fleet.router._resident
+                fleet.retire_replica(rid)
+                retired_ids.append(rid)
+            live = {r.replica_id for r in fleet.replicas}
+            assert live == {0, 1}
+            for rid in retired_ids:
+                # ids are never reused and never linger anywhere live
+                assert rid not in live
+                assert rid not in fleet.router._resident
+                assert rid not in fleet.replica_metrics
+                assert rid in fleet.retired_replica_metrics
+                assert rid not in fm.replica_counters()
+                assert not any(f'replica="{rid}"' in k
+                               for k in fm.labeled_gauges())
+            assert fleet._next_replica_id == 2 + len(retired_ids)
+            signals = fm.signals()
+            assert signals["replicas_total"] == 2
+            assert signals["replicas_dispatchable"] == 2
+        finally:
+            fleet.close()
+
+    def test_retired_work_stays_counted(self, small):
+        """Scale a replica up, serve THROUGH it, scale it down: merged
+        counters still reconcile with the parent for every
+        replica-incremented key — the retired ledger keeps the work."""
+        model, params = small
+        fleet = self._fleet(model, params, n=1)
+        fm = FleetMetrics(fleet)
+        try:
+            rid = fleet.add_replica()
+            for req_id, prompt in enumerate([[1, 2, 3], [4, 5, 6]]):
+                fleet.submit(Request(request_id=req_id, prompt=prompt,
+                                     max_new_tokens=2))
+            while fleet.inflight_count:
+                fleet.tick()
+            served_by_new = fleet.metrics.counters().get(
+                f"replica{rid}_dispatches", 0)
+            fleet.retire_replica(rid)
+            while any(r.replica_id == rid for r in fleet.replicas):
+                fleet.tick()
+            merged = fm.merged_counters()
+            parent = fleet.metrics.counters()
+            for key in ("requests_submitted", "prefills", "decode_steps"):
+                if key in merged:
+                    assert merged[key] == parent.get(key, 0), key
+            assert rid in fleet.retired_replica_metrics
+            if served_by_new:
+                assert fleet.retired_replica_metrics[rid].counters().get(
+                    "requests_submitted", 0) > 0
+        finally:
+            fleet.close()
+
+    def test_one_topology_change_at_a_time(self, small):
+        model, params = small
+        fleet = ReplicaFleet(
+            model, params,
+            EngineConfig(max_slots=2, max_len=32,
+                         scheduler=SchedulerConfig(max_queue=16)),
+            fleet=FleetConfig(n_replicas=2, probe_on_rebuild=True))
+        try:
+            rid = fleet.add_replica()       # probing: topology busy
+            assert fleet.topology_busy == rid
+            with pytest.raises(RuntimeError, match="one topology"):
+                fleet.add_replica()
+            with pytest.raises(RuntimeError, match="one topology"):
+                fleet.retire_replica(0)
+            while fleet.topology_busy is not None:
+                fleet.tick()
+            fleet.retire_replica(rid)
+        finally:
+            fleet.close()
+
+    def test_last_active_replica_cannot_retire(self, small):
+        model, params = small
+        fleet = self._fleet(model, params, n=1)
+        try:
+            with pytest.raises(RuntimeError, match="last active"):
+                fleet.retire_replica(0)
+        finally:
+            fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end (compile-heavy: slow lane; the committed traffic_ramp
+# scenario gates the same loop in CI via the loadtest harness)
+
+
+@pytest.mark.slow
+class TestAutoscaleEndToEnd:
+    def test_burst_scales_up_then_idle_scales_down(self, small):
+        model, params = small
+        fleet = ReplicaFleet(
+            model, params,
+            EngineConfig(max_slots=2, max_len=32,
+                         scheduler=SchedulerConfig(max_queue=32)),
+            fleet=FleetConfig(n_replicas=1),
+            autoscale=AutoscaleConfig(
+                min_replicas=1, max_replicas=2, poll_interval_s=0.01,
+                cooldown_s=0.05, hysteresis_polls=2,
+                scale_up_queue_per_replica=2.0))
+        try:
+            for i in range(12):
+                fleet.submit(Request(request_id=i, prompt=[1 + i % 8, 2],
+                                     max_new_tokens=3))
+            while fleet.inflight_count:
+                fleet.tick()
+            assert len(fleet.completed) == 12
+            actions = [a for _, a, _, _ in fleet.autoscaler.decisions]
+            assert "up" in actions
+            # idle polls after the burst retire the extra replica
+            import time as _time
+            deadline = _time.monotonic() + 30.0
+            while (len(fleet.replicas) > 1
+                   and _time.monotonic() < deadline):
+                fleet.tick()
+                _time.sleep(0.005)
+            assert len(fleet.replicas) == 1
+            assert "down" in [a for _, a, _, _
+                              in fleet.autoscaler.decisions]
+            # every decision reconciles: counters == events == records
+            counters = fleet.metrics.counters()
+            ups = sum(1 for _, a, _, _ in fleet.autoscaler.decisions
+                      if a == "up")
+            downs = sum(1 for _, a, _, _ in fleet.autoscaler.decisions
+                        if a == "down")
+            assert counters.get("replica_scale_ups", 0) == ups
+            assert counters.get("replica_scale_downs", 0) == downs
+        finally:
+            fleet.close()

@@ -89,7 +89,12 @@ class TestConfigValidation:
 class TestTokenExactness:
     """Chunked == monolithic, token for token, on both layouts."""
 
-    @pytest.mark.parametrize("layout", ["flat", "paged"])
+    @pytest.mark.parametrize("layout", [
+        # flat is the bisection opt-out layout; its exactness variant is
+        # slow-tier (ROADMAP), the default paged layout stays tier-1
+        pytest.param("flat", marks=pytest.mark.slow),
+        "paged",
+    ])
     def test_greedy_and_sampled_exact(self, small, layout):
         model, params = small
         prompts = _prompts((23, 5, 11, 17), seed=41)
@@ -295,6 +300,8 @@ class TestMixedTicks:
         assert res.ttft_s == pytest.approx(
             res.queue_s + res.prefill_s, abs=0.05)
 
+    @pytest.mark.slow  # ordering sweep over full engine builds: slow tier (ROADMAP)
+
     def test_fcfs_admission_order_preserved(self, small):
         """Token-budget admission stays strictly FCFS: the admission
         log lists requests in submit order even when budget starvation
@@ -422,6 +429,8 @@ class TestLifecycle:
             eng.slots.check()
         finally:
             eng.close()
+
+    @pytest.mark.slow  # restart x chunking feature-cross: slow tier (ROADMAP)
 
     def test_supervisor_restart_mid_prefill_token_exact(self, small):
         """A crash between chunks re-prefills the request from its
@@ -560,6 +569,8 @@ class TestTokenAwareLoad:
             assert sup.queued_token_excess_s == 0.0
         finally:
             sup.close()
+
+    @pytest.mark.slow  # compile-bound load-measurement sweep: slow tier (ROADMAP)
 
     def test_harvest_measures_token_rate(self, small):
         model, params = small

@@ -242,6 +242,8 @@ class TestRingMemory:
     everything attention (all_gather K/V then full attention) at a long
     sequence on the virtual mesh."""
 
+    @pytest.mark.slow  # memory-benchmark comparison: slow tier (ROADMAP)
+
     def test_ring_temp_memory_beats_allgather(self):
         # measured on the XLA fallback path (interpret-mode emulation
         # buffers would dominate): the contrast here is the DESIGN —

@@ -1,0 +1,408 @@
+"""Continuous-deployment tests: canary scoring, rollback, accounting.
+
+The contract under test (docs/serving.md#continuous-deployment):
+
+- **Pre-flight fsck** — a checkpoint that fails deep verification
+  (``corrupt_shard``) is REJECTED before the first drain: no replica
+  ever touches it, the fleet stays untouched, ``deploys_rejected``
+  reconciles, and the next deploy attempt is not blocked.
+- **Value poisoning slips past fsck** — ``corrupt_checkpoint_weights``
+  re-checksums after poisoning, so manifest + COMMIT + per-shard
+  digests all stay green while every float leaf goes non-finite. Deep
+  fsck passes; the one-token health probe passes too (argmax of an
+  all-NaN row is a valid token id) — only live canary traffic catches
+  it. That gap is exactly what the canary window exists for.
+- **Happy path** — deploying the fleet's own saved weights rolls every
+  replica through one-drain-at-a-time canary windows and promotes
+  each; the fleet stays greedy-token-exact afterwards.
+- **Rollback accounting** — a poisoned deploy is detected by the
+  canary's live error rate and rolled back: every client request
+  exactly one terminal record, migrated requests keep their ORIGINAL
+  trace_id, span conservation holds over the deploy-window log, and
+  the deploy_* events / counters / records reconcile key-for-key.
+"""
+
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.checkpoint import (
+    CheckpointCorruptionError,
+    ShardedCheckpointManager,
+)
+from apex_tpu.models import GPTModel, TransformerConfig
+from apex_tpu.models.generation import generate
+from apex_tpu.observability import (
+    InMemorySink,
+    MetricsRegistry,
+    check_span_conservation,
+)
+from apex_tpu.serving import (
+    EngineConfig,
+    Request,
+    SamplingParams,
+    SchedulerConfig,
+)
+from apex_tpu.serving.fleet import (
+    DEPLOY_CANARY,
+    DEPLOY_COMPLETE,
+    DEPLOY_DRAINING,
+    DEPLOY_REJECTED,
+    DEPLOY_ROLLED_BACK,
+    DEPLOY_ROLLING,
+    CanaryConfig,
+    Deployment,
+    FleetConfig,
+    ReplicaFleet,
+)
+from apex_tpu.testing_faults import (
+    corrupt_checkpoint_weights,
+    corrupt_shard,
+)
+
+#: deployment states during which tests keep feeding live traffic —
+#: the canary window needs scored terminals to close
+_FEEDING = (DEPLOY_ROLLING, DEPLOY_DRAINING, DEPLOY_CANARY)
+
+
+@pytest.fixture(scope="module")
+def small():
+    # 1 layer for the same reason as the fleet suite: every replica
+    # rebuild is a fresh compile, and deploy semantics don't need depth
+    model = GPTModel(TransformerConfig(
+        num_layers=1, hidden_size=32, num_attention_heads=4, vocab_size=64,
+        max_position_embeddings=64, hidden_dropout=0.0,
+        attention_dropout=0.0))
+    params = model.init(jax.random.PRNGKey(0))
+    return model, params
+
+
+def _save_step(root, params, step=1):
+    directory = str(root)
+    ShardedCheckpointManager(directory, max_to_keep=1).save(step, params)
+    return directory
+
+
+def _drain(fleet, cap=20000):
+    ticks = 0
+    while fleet.inflight_count:
+        fleet.tick()
+        ticks += 1
+        assert ticks < cap, "fleet failed to settle"
+
+
+def _nonfinite_float_leaves(tree):
+    return [leaf for leaf in jax.tree_util.tree_leaves(tree)
+            if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating)
+            and not bool(jnp.isfinite(leaf).all())]
+
+
+# ---------------------------------------------------------------------------
+# the fault primitive
+
+
+class TestCorruptCheckpointWeights:
+    def test_poisons_values_but_fsck_stays_green(self, small, tmp_path):
+        _, params = small
+        directory = _save_step(tmp_path / "ckpt", params)
+        n = corrupt_checkpoint_weights(directory, 1)
+        assert n > 0
+        mgr = ShardedCheckpointManager(directory, max_to_keep=1)
+        # the whole point: manifest, COMMIT, sizes AND shard checksums
+        # all verify — the corruption is invisible to fsck
+        mgr.verify_step(1, deep=True)
+        restored = mgr.restore_step(1, params)
+        assert _nonfinite_float_leaves(restored)
+
+    def test_custom_poison_value(self, small, tmp_path):
+        _, params = small
+        directory = _save_step(tmp_path / "ckpt", params)
+        corrupt_checkpoint_weights(directory, 1, value=float("inf"))
+        restored = ShardedCheckpointManager(
+            directory, max_to_keep=1).restore_step(1, params)
+        assert any(bool(jnp.isposinf(leaf).any())
+                   for leaf in jax.tree_util.tree_leaves(restored))
+
+    def test_distinct_from_corrupt_shard(self, small, tmp_path):
+        """``corrupt_shard`` damages bytes and IS caught by deep fsck;
+        ``corrupt_checkpoint_weights`` damages values and is not — the
+        two faults sit on opposite sides of the verification gap."""
+        _, params = small
+        directory = _save_step(tmp_path / "ckpt", params)
+        corrupt_shard(directory, 1, kind="bitflip")
+        with pytest.raises(CheckpointCorruptionError):
+            ShardedCheckpointManager(
+                directory, max_to_keep=1).verify_step(1, deep=True)
+
+
+# ---------------------------------------------------------------------------
+# deployment construction + pre-flight
+
+
+def _fleet(small, *, metrics=None, adapters=None, n=2,
+           probe_on_rebuild=True):
+    model, params = small
+    return ReplicaFleet(
+        model, params,
+        EngineConfig(max_slots=2, max_len=32,
+                     scheduler=SchedulerConfig(max_queue=32)),
+        fleet=FleetConfig(n_replicas=n,
+                          probe_on_rebuild=probe_on_rebuild),
+        metrics=metrics, adapters=adapters)
+
+
+class TestDeployPreflight:
+    def test_exactly_one_target_required(self):
+        with pytest.raises(ValueError, match="exactly one"):
+            Deployment()
+        with pytest.raises(ValueError, match="exactly one"):
+            Deployment("/tmp/ckpt", adapter=("a", {}))
+
+    def test_byte_corrupt_checkpoint_rejected_before_any_drain(
+            self, small, tmp_path):
+        _, params = small
+        directory = _save_step(tmp_path / "ckpt", params)
+        corrupt_shard(directory, 1, kind="bitflip")
+        mem = InMemorySink()
+        registry = MetricsRegistry([mem])
+        fleet = _fleet(small, metrics=registry)
+        try:
+            with pytest.raises(CheckpointCorruptionError):
+                fleet.deploy(directory, step=1)
+            # terminal REJECTED deployment; fleet topology untouched
+            assert fleet.deployment is not None
+            assert fleet.deployment.state == DEPLOY_REJECTED
+            assert fleet.deployment.done
+            assert fleet.topology_busy is None
+            counters = fleet.metrics.counters()
+            assert counters["deploys_rejected"] == 1
+            assert counters["deploys_started"] == 0
+            assert counters["replica_drains"] == 0
+            events = [r for r in mem.records if r.get("kind") == "event"]
+            assert any(e.get("event") == "deploy_rejected"
+                       for e in events)
+            rows = [r for r in mem.records if r.get("kind") == "deploy"]
+            assert [r["action"] for r in rows] == ["rejected"]
+            # a rejected deployment does not block the next attempt
+            good = _save_step(tmp_path / "good", params)
+            dep = fleet.deploy(good, step=1)
+            assert not dep.done
+        finally:
+            fleet.close()
+
+    def test_empty_directory_rejected(self, small, tmp_path):
+        directory = str(tmp_path / "empty")
+        os.makedirs(directory)
+        fleet = _fleet(small)
+        try:
+            with pytest.raises(CheckpointCorruptionError,
+                               match="no committed step"):
+                fleet.deploy(directory)
+            assert fleet.deployment.state == DEPLOY_REJECTED
+            assert fleet.metrics.counters()["deploys_rejected"] == 1
+        finally:
+            fleet.close()
+
+    def test_one_deployment_at_a_time(self, small, tmp_path):
+        _, params = small
+        directory = _save_step(tmp_path / "ckpt", params)
+        fleet = _fleet(small)
+        try:
+            fleet.deploy(directory, step=1)
+            with pytest.raises(RuntimeError, match="already"):
+                fleet.deploy(directory, step=1)
+        finally:
+            fleet.close()
+
+    def test_adapter_deploy_needs_a_store(self, small):
+        fleet = _fleet(small)
+        try:
+            with pytest.raises(ValueError, match="AdapterStore"):
+                fleet.deploy(adapter=("t", {}))
+        finally:
+            fleet.close()
+
+    def test_canary_config_validation(self):
+        with pytest.raises(ValueError, match="window_s"):
+            CanaryConfig(window_s=0.0)
+        with pytest.raises(ValueError, match="max_window_s"):
+            CanaryConfig(window_s=1.0, max_window_s=0.5)
+        with pytest.raises(ValueError, match="max_error_rate"):
+            CanaryConfig(max_error_rate=1.5)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end rollouts (compile-heavy: slow lane; the committed
+# canary_rollback scenario gates the poisoned path under --check)
+
+
+def _feed(fleet, dep, submitted, *, max_inflight=3, tokens=3,
+          adapter_id=None, cap=60000):
+    """Tick the deployment to a terminal state, feeding live traffic
+    while the rollout can still use it. Returns submitted client ids."""
+    rng = np.random.RandomState(1234)
+    ticks = 0
+    while not dep.done:
+        fleet.tick()
+        ticks += 1
+        assert ticks < cap, f"deployment stuck in state {dep.state}"
+        if (dep.state in _FEEDING
+                and fleet.inflight_count < max_inflight):
+            rid = fleet.submit(Request(
+                prompt=rng.randint(1, 64, size=4).tolist(),
+                max_new_tokens=tokens,
+                sampling=SamplingParams(adapter_id=adapter_id)))
+            submitted.append(rid)
+    _drain(fleet)
+    return submitted
+
+
+def _conservation_stream(registry, mem):
+    return mem.records + [{"kind": "counters", "wall": time.time(),
+                           "values": dict(registry.counters())}]
+
+
+@pytest.mark.slow
+class TestDeployEndToEnd:
+    CANARY = CanaryConfig(window_s=0.05, min_requests=2, max_window_s=15.0)
+
+    def test_happy_deploy_promotes_every_replica(self, small, tmp_path):
+        model, params = small
+        directory = _save_step(tmp_path / "ckpt", params)
+        mem = InMemorySink()
+        registry = MetricsRegistry([mem])
+        fleet = _fleet(small, metrics=registry)
+        try:
+            ids = []
+            for _ in range(4):
+                ids.append(fleet.submit(Request(
+                    prompt=[1, 2, 3, 4], max_new_tokens=2)))
+            dep = fleet.deploy(directory, step=1, canary=self.CANARY)
+            _feed(fleet, dep, ids)
+            assert dep.state == DEPLOY_COMPLETE
+            # both replicas canaried and promoted, in rollout order
+            assert dep.promoted == [0, 1]
+            assert [s["pass"] for s in dep.scores] == [True, True]
+            counters = fleet.metrics.counters()
+            assert counters["deploys_started"] == 1
+            assert counters["deploys_completed"] == 1
+            assert counters["canary_promotions"] == 2
+            assert counters["deploys_rolled_back"] == 0
+            # exactly-once terminal accounting for every client request
+            assert set(ids) <= set(fleet.completed)
+            records = [r for r in mem.records
+                       if r.get("kind") == "request"
+                       and r["request_id"] in set(ids)]
+            assert len(records) == len(ids)
+            assert check_span_conservation(
+                _conservation_stream(registry, mem)) == []
+            # the new weights ARE the old weights: greedy stays exact
+            pid = fleet.submit(Request(prompt=[5, 6, 7, 8],
+                                       max_new_tokens=4))
+            _drain(fleet)
+            want = generate(model, params,
+                            jnp.asarray([[5, 6, 7, 8]], jnp.int32),
+                            4, max_len=32)
+            assert fleet.completed[pid].tokens == \
+                np.asarray(want[0, 4:]).tolist()
+        finally:
+            fleet.close()
+
+    def test_poisoned_deploy_rolls_back_with_exact_accounting(
+            self, small, tmp_path):
+        model, params = small
+        directory = _save_step(tmp_path / "ckpt", params)
+        corrupt_checkpoint_weights(directory, 1)
+        mem = InMemorySink()
+        registry = MetricsRegistry([mem])
+        fleet = _fleet(small, metrics=registry)
+        try:
+            ids = []
+            for _ in range(4):
+                ids.append(fleet.submit(Request(
+                    prompt=[1, 2, 3, 4], max_new_tokens=3)))
+            # fsck passes (checksums re-computed over poisoned bytes):
+            # the deploy STARTS — live canary traffic is the detector
+            dep = fleet.deploy(directory, step=1, canary=self.CANARY)
+            assert dep.state == DEPLOY_ROLLING
+            _feed(fleet, dep, ids)
+            assert dep.state == DEPLOY_ROLLED_BACK
+            assert dep.rollback_reason == "error_rate"
+            assert dep.promoted == []
+            assert dep.scores and dep.scores[-1]["pass"] is False
+            assert dep.scores[-1]["errors"] > 0
+            counters = fleet.metrics.counters()
+            assert counters["deploys_started"] == 1
+            assert counters["deploys_rolled_back"] == 1
+            assert counters["deploys_completed"] == 0
+            assert counters["canary_promotions"] == 0
+            # every client submission exactly one terminal record —
+            # nothing dropped or duplicated across canary + rollback
+            idset = set(ids)
+            assert idset <= set(fleet.completed)
+            records = [r for r in mem.records
+                       if r.get("kind") == "request"
+                       and r["request_id"] in idset]
+            assert len(records) == len(ids)
+            assert check_span_conservation(
+                _conservation_stream(registry, mem)) == []
+            # migrated-off-canary requests keep their ORIGINAL trace_id:
+            # every span of a client request carries the trace_id its
+            # terminal record carries
+            span_tids = {}
+            for s in mem.records:
+                if s.get("kind") == "span" and s.get("request_id") in idset:
+                    span_tids.setdefault(s["request_id"],
+                                         set()).add(s["trace_id"])
+            for r in records:
+                assert span_tids[r["request_id"]] == {r["trace_id"]}
+            # the incumbent weights serve the post-rollback fleet
+            # greedy-token-exact — the poison left no residue
+            pid = fleet.submit(Request(prompt=[5, 6, 7, 8],
+                                       max_new_tokens=4))
+            _drain(fleet)
+            want = generate(model, params,
+                            jnp.asarray([[5, 6, 7, 8]], jnp.int32),
+                            4, max_len=32)
+            assert fleet.completed[pid].tokens == \
+                np.asarray(want[0, 4:]).tolist()
+        finally:
+            fleet.close()
+
+    def test_adapter_canary_promote_then_poisoned_rollback(self, small):
+        from apex_tpu.lora import AdapterStore, random_adapter
+
+        model, params = small
+        registry = MetricsRegistry()
+        store = AdapterStore(model.config, 4, max_adapters=4)
+        fleet = _fleet(small, metrics=registry, adapters=store)
+        try:
+            good = random_adapter(model.config, 4, jax.random.PRNGKey(3))
+            dep = fleet.deploy(adapter=("tenant-x", good),
+                               canary=self.CANARY)
+            assert "tenant-x" in store    # hot-loaded for the canary
+            _feed(fleet, dep, [], tokens=2, adapter_id="tenant-x")
+            assert dep.state == DEPLOY_COMPLETE
+            assert "tenant-x" in store    # promoted: stays loaded
+            # poisoned adapter: NaN factors error every decode
+            bad = jax.tree_util.tree_map(
+                lambda a: a * float("nan"),
+                random_adapter(model.config, 4, jax.random.PRNGKey(4)))
+            dep2 = fleet.deploy(adapter=("tenant-bad", bad),
+                                canary=self.CANARY)
+            _feed(fleet, dep2, [], tokens=2, adapter_id="tenant-bad")
+            assert dep2.state == DEPLOY_ROLLED_BACK
+            assert dep2.rollback_reason == "error_rate"
+            assert "tenant-bad" not in store  # rolled back: unloaded
+            assert "tenant-x" in store        # incumbent tenant intact
+            counters = fleet.metrics.counters()
+            assert counters["deploys_started"] == 2
+            assert counters["deploys_completed"] == 1
+            assert counters["canary_promotions"] == 1
+            assert counters["deploys_rolled_back"] == 1
+        finally:
+            fleet.close()

@@ -130,6 +130,8 @@ class TestEncoderDecoderModel:
         cfg = _cfg(vocab_size=64, max_position_embeddings=32, **kw)
         return EncoderDecoderModel(cfg)
 
+    @pytest.mark.slow  # compile-bound mode sweep: slow tier (ROADMAP)
+
     def test_loss_and_logits_modes(self):
         model = self._model()
         params = model.init(jax.random.PRNGKey(0))

@@ -256,6 +256,93 @@ class TestScenarioSchema:
         assert kw["decode_hang"] == {5: 1.5}
 
 
+class TestAutoscaleDeploySchema:
+    """The PR 16 scenario blocks: strict parse-time validation, so a
+    typo'd autoscale/deploy scenario fails at load, not mid-run."""
+
+    def test_autoscale_round_trip(self):
+        d = _scenario_dict(
+            fleet={"n_replicas": 2},
+            autoscale={"min_replicas": 1, "max_replicas": 3,
+                       "poll_interval_s": 0.1, "cooldown_s": 1.0,
+                       "scale_up_queue_per_replica": 3.0})
+        scn = Scenario.from_dict(d)
+        assert scn.autoscale.max_replicas == 3
+        assert Scenario.from_dict(scn.to_dict()).to_dict() == scn.to_dict()
+        # the runner builds AutoscaleConfig from exactly these kwargs
+        kw = scn.autoscale.config_kwargs()
+        assert len(kw) == 11 and kw["scale_up_queue_per_replica"] == 3.0
+
+    def test_autoscale_unknown_key_rejected(self):
+        with pytest.raises(ValueError, match="unknown autoscale keys"):
+            Scenario.from_dict(_scenario_dict(
+                fleet={"n_replicas": 1},
+                autoscale={"max_replicas": 2, "vibes": 1}))
+
+    def test_autoscale_needs_fleet_block(self):
+        with pytest.raises(ValueError, match="needs a 'fleet' block"):
+            Scenario.from_dict(_scenario_dict(
+                autoscale={"max_replicas": 2}))
+
+    def test_autoscale_band_must_cover_n_replicas(self):
+        with pytest.raises(ValueError, match="autoscale band"):
+            Scenario.from_dict(_scenario_dict(
+                fleet={"n_replicas": 4},
+                autoscale={"min_replicas": 1, "max_replicas": 2}))
+
+    def test_autoscale_bad_band_rejected_at_parse(self):
+        with pytest.raises(ValueError, match="max_replicas"):
+            Scenario.from_dict(_scenario_dict(
+                fleet={"n_replicas": 2},
+                autoscale={"min_replicas": 3, "max_replicas": 2}))
+
+    def test_deploy_round_trip(self):
+        d = _scenario_dict(
+            fleet={"n_replicas": 2},
+            deploy={"at_s": 2.0, "kind": "checkpoint", "poison": True,
+                    "canary": {"window_s": 0.5, "min_requests": 3}})
+        scn = Scenario.from_dict(d)
+        assert scn.deploy.poison is True
+        assert Scenario.from_dict(scn.to_dict()).to_dict() == scn.to_dict()
+
+    def test_deploy_unknown_keys_rejected(self):
+        with pytest.raises(ValueError, match="unknown deploy keys"):
+            Scenario.from_dict(_scenario_dict(
+                fleet={"n_replicas": 2}, deploy={"at_s": 1.0, "when": 2}))
+        with pytest.raises(ValueError,
+                           match="unknown deploy canary keys"):
+            Scenario.from_dict(_scenario_dict(
+                fleet={"n_replicas": 2},
+                deploy={"at_s": 1.0, "canary": {"vibe_check": 1}}))
+
+    def test_deploy_needs_fleet_block(self):
+        with pytest.raises(ValueError, match="needs a 'fleet' block"):
+            Scenario.from_dict(_scenario_dict(deploy={"at_s": 1.0}))
+
+    def test_deploy_bad_kind_rejected(self):
+        with pytest.raises(ValueError, match="deploy kind"):
+            Scenario.from_dict(_scenario_dict(
+                fleet={"n_replicas": 2},
+                deploy={"at_s": 1.0, "kind": "yolo"}))
+
+    def test_adapter_deploy_needs_lora_and_fresh_id(self):
+        with pytest.raises(ValueError, match="adapter store"):
+            Scenario.from_dict(_scenario_dict(
+                fleet={"n_replicas": 2},
+                deploy={"at_s": 1.0, "kind": "adapter"}))
+        # digit ids below lora_adapters are the runner's preloaded
+        # tenants — the canary must be a NEW tenant
+        d = _scenario_dict(fleet={"n_replicas": 2},
+                           deploy={"at_s": 1.0, "kind": "adapter",
+                                   "adapter_id": "0"})
+        d["engine"].update({"lora_adapters": 2, "lora_rank": 2})
+        with pytest.raises(ValueError, match="collides"):
+            Scenario.from_dict(d)
+        d["deploy"]["adapter_id"] = "canary"
+        scn = Scenario.from_dict(d)
+        assert scn.deploy.adapter_id == "canary"
+
+
 # ---------------------------------------------------------------------------
 # generator determinism (satellite: asserted across two runs)
 
@@ -600,6 +687,8 @@ class TestSmokeScenario:
                               "--update-baseline"]) == EXIT_OK
         assert loadtest_main([scn_path, "--from-log", log, "--check",
                               "--baseline", base]) == EXIT_OK
+
+    @pytest.mark.slow  # full scenario rerun: slow tier (ROADMAP)
 
     def test_crash_recovery_reports_finite_recovery(self, small, tmp_path):
         """Acceptance: a ServingFaultInjector-scheduled engine crash

@@ -221,6 +221,8 @@ class TestFinetune:
             store.load(f"job{j}", jax.tree.map(lambda x: x[j], factors))
         assert store.ids() == ["job0", "job1"]
 
+    @pytest.mark.slow  # training-convergence claim: slow tier (ROADMAP)
+
     def test_trained_adapter_beats_base_when_merged(self, small):
         model, params = small
         rng = np.random.RandomState(13)

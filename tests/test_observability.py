@@ -668,3 +668,46 @@ class TestReportBackCompat:
         assert monitor_main([self.PRE_PR15, "--trace", "0"]) == 0
         out = capsys.readouterr().out
         assert "prefill" in out and "chunk=" not in out
+
+    PRE_PR16 = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "data", "pre_pr16_run.jsonl")
+
+    def test_pre_pr16_log_without_autoscale_deploy_still_renders(self):
+        """A committed pre-autoscaling log (PR-15 vintage: fleet +
+        signals present, NO ``queued_tokens``/``window_s`` signal keys,
+        ``goodput_window`` still null-on-idle, no ``kind="autoscale"``
+        / ``kind="deploy"`` rows, no ``replica_scale_*``/``deploys_*``
+        counters, torn last line) builds and renders with no autoscale
+        or deployment section."""
+        report = build_report(self.PRE_PR16)
+        assert report["requests"]["count"] == 4
+        assert report["autoscale"] is None
+        assert report["deploys"] is None
+        # the old signals snapshot still renders: the new keys are
+        # guarded, not assumed
+        signals = report["signals"]
+        assert signals is not None
+        assert "queued_tokens" not in signals
+        assert signals["goodput_window"] is None
+        text = render_report(report)
+        assert "fleet signals" in text
+        assert "autoscale decisions" not in text
+        assert "deployments (" not in text
+        assert "queued_tokens=" not in text
+
+    def test_pre_pr16_fleet_section_still_reconciles(self):
+        """The fleet incident reconciliation (drain/rebuild events vs
+        their counters) is unchanged by the PR 16 counter additions —
+        absent deploy/scale counters read as zero, not as a mismatch."""
+        report = build_report(self.PRE_PR16)
+        fleet = report["fleet"]
+        assert fleet["counts"]["replica_drain"] == 1
+        assert fleet["counts"]["replica_rebuild"] == 1
+        assert fleet["requests_by_replica"] == {"0": 2, "1": 1}
+
+    def test_pre_pr16_log_span_check_still_conserves(self):
+        from apex_tpu.observability.report import read_records
+        from apex_tpu.observability.trace import check_span_conservation
+
+        records = read_records(self.PRE_PR16)
+        assert check_span_conservation(records) == []

@@ -320,6 +320,8 @@ class TestPackedQKV:
         np.testing.assert_allclose(np.asarray(gp), np.asarray(gr),
                                    rtol=2e-3, atol=2e-3)
 
+    @pytest.mark.slow  # varlen/window parity sweep: slow tier (ROADMAP)
+
     def test_varlen_and_window(self):
         s, b, g, qpg, d = 256, 3, 2, 1, 64
         qkv = _rand((s, b, g * (qpg + 2) * d), seed=21)
@@ -451,6 +453,8 @@ class TestFusedMultiblockBackward:
         v = _rand((2, 2, 256, 64), seed=42)
         self._grads(q, k, v, causal=False,
                     kvl=jnp.asarray([200, 37], jnp.int32))
+
+    @pytest.mark.slow  # cross-shape parity sweep: slow tier (ROADMAP)
 
     def test_cross_shapes(self):
         # sq != sk, including the nk == 1 single-j fused case and the

@@ -469,6 +469,7 @@ class TestPipelinedMoE:
 
 
 class TestPipelinedDropout:
+    @pytest.mark.slow  # compile-bound dropout-rng check: slow tier (ROADMAP)
     def test_rng_enables_dropout(self):
         parallel_state.destroy_model_parallel()
         mesh = parallel_state.initialize_model_parallel(

@@ -317,6 +317,8 @@ class TestPrefixEngine:
             if req.sampling.temperature == 0.0:
                 assert r.tokens == _expected_greedy(model, params, req, 32)
 
+    @pytest.mark.slow  # COW edge-seam sweep: slow tier (ROADMAP)
+
     def test_partial_page_boundary_cow(self, small):
         """Two prompts sharing full pages but diverging INSIDE the
         trailing partial page: the second maps the shared run and
@@ -340,6 +342,8 @@ class TestPrefixEngine:
             assert c["prefix_misses"] == 1 and c["prefix_hits"] == 2
             assert eng.decode_retraces == 0
             eng.pages.check()
+
+    @pytest.mark.slow  # quarantine x prefix feature-cross: slow tier (ROADMAP)
 
     def test_quarantine_sharing_slot_leaves_co_tenants_exact(self, small):
         """Poisoned decode on one of two slots sharing interned prefix
@@ -374,6 +378,8 @@ class TestPrefixEngine:
             c = eng.metrics.counters()
             assert c["prefix_hits"] >= 2                  # victim + late
             assert eng.decode_retraces == 0
+
+    @pytest.mark.slow  # eviction stress sweep: slow tier (ROADMAP)
 
     def test_lru_eviction_under_pressure_then_reintern(self, small):
         """A pool sized so distinct prefixes cannot all stay interned:

@@ -90,6 +90,9 @@ def test_cached_decode_matches_full_forward():
             rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow  # decode parity sweep: slow tier (ROADMAP)
+
+
 def test_rope_with_gqa_decode():
     model = GPTModel(_cfg(num_attention_heads=8, num_query_groups=2))
     params = model.init(jax.random.PRNGKey(0))
@@ -301,6 +304,9 @@ def test_gelu_init_stream_is_plain_two_way_split():
     ref = mlp.dense_h_to_4h.init(k1)
     np.testing.assert_array_equal(np.asarray(p["dense_h_to_4h"]["weight"]),
                                   np.asarray(ref["weight"]))
+
+
+@pytest.mark.slow  # composition parity sweep: slow tier (ROADMAP)
 
 
 def test_moe_with_gated_activation():

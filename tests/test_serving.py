@@ -214,6 +214,8 @@ class TestEngine:
         assert res.tokens[-1] == eos
         assert eng.slots.free_count == eng.config.max_slots
 
+    @pytest.mark.slow  # sampling-independence property sweep: slow tier (ROADMAP)
+
     def test_sampled_stream_independent_of_cotenants(self, small):
         """A sampled request's tokens depend only on (seed, prompt,
         positions) — never on what shares the batch: alone vs co-batched
@@ -334,6 +336,8 @@ class TestEngine:
                               EngineConfig(max_slots=1, max_len=8))
         with pytest.raises(ValueError, match="max_len"):
             eng.submit(Request(prompt=[1, 2, 3, 4], max_new_tokens=5))
+
+    @pytest.mark.slow  # report-level reconciliation integration: slow tier (ROADMAP)
 
     def test_request_records_reconcile_with_monitor_report(
             self, small, tmp_path):

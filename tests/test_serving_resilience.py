@@ -172,7 +172,11 @@ class TestDeadlineFastFail:
 
 
 class TestQuarantine:
-    @pytest.mark.parametrize("kind", ["nonfinite", "oov"])
+    @pytest.mark.parametrize("kind", [
+        "nonfinite",
+        # same quarantine machinery from a different poison; slow tier
+        pytest.param("oov", marks=pytest.mark.slow),
+    ])
     def test_poisoned_slot_quarantined_cotenant_exact(self, small, kind):
         """Poison slot 0's decode output: its request retires with
         ``error`` (partial tokens intact), the co-tenant in slot 1 stays
@@ -414,6 +418,7 @@ class TestDeadlineShedding:
 
 
 class TestMonitorReconciliation:
+    @pytest.mark.slow  # report-level reconciliation integration: slow tier (ROADMAP)
     def test_incidents_reconcile_with_counters(self, small, tmp_path):
         """Acceptance: drive restarts, quarantine, breaker transitions,
         and sheds in one run — the monitor report's serving-incidents

@@ -287,6 +287,8 @@ class TestInt8Engine:
             assert a.finish_reason == b.finish_reason
             assert a.tokens == b.tokens, (a.tokens, b.tokens)
 
+    @pytest.mark.slow  # quarantine x int8 feature-cross: slow tier (ROADMAP)
+
     def test_quarantine_scrubs_scales_and_check_asserts_it(self, small):
         """Poisoned decode on the int8 engine: the scrub zeroes the
         victim's pages AND their scale sidecar rows;
@@ -401,6 +403,8 @@ class TestSpeculativeEngine:
         for a, b in zip(ref, out):
             assert a.tokens == b.tokens, (a.tokens, b.tokens)
         assert c["draft_tokens_accepted"] > 0
+
+    @pytest.mark.slow  # statistical-distribution sweep: slow tier (ROADMAP)
 
     def test_sampled_frequencies_match_target_distribution(self, small):
         """Distribution preservation, measured: many seeds sample the

@@ -23,6 +23,7 @@ committed scenarios, so one build serves both runs):
 
 import json
 import os
+import time
 
 import jax
 import pytest
@@ -346,16 +347,33 @@ class TestFleetMetricsSignals:
         first = fm.signals()
         assert first["window_terminal"] == 7
         assert first["goodput_window"] == pytest.approx(5 / 7)
-        # nothing terminal between polls: window empty, no verdict
+        # nothing terminal between polls: an IDLE window reports 0.0
+        # (never None/NaN) so autoscaler math rate-normalizes cleanly;
+        # window_terminal == 0 is the "no traffic" discriminator
         idle = fm.signals()
         assert idle["window_terminal"] == 0
-        assert idle["goodput_window"] is None
+        assert idle["goodput_window"] == 0.0
         # one new failure: the window sees ONLY it, lifetime barely moves
         fleet.replica_metrics[0].inc("requests_error")
         third = fm.signals()
         assert third["window_terminal"] == 1
         assert third["goodput_window"] == 0.0
         assert third["goodput"] == pytest.approx(5 / 8)
+
+    def test_window_s_stamped_across_idle_gap(self, fleet):
+        """Every poll stamps the wall width of ITS window — including an
+        idle gap with zero completions — so decisions rate-normalize."""
+        fm = FleetMetrics(fleet)
+        first = fm.signals()
+        assert first["window_s"] > 0.0
+        time.sleep(0.05)
+        idle = fm.signals()
+        assert idle["window_terminal"] == 0
+        assert idle["goodput_window"] == 0.0
+        assert idle["window_s"] == pytest.approx(0.05, abs=0.04)
+        # the window RESETS each poll: a quick follow-up is narrow again
+        third = fm.signals()
+        assert third["window_s"] < idle["window_s"]
 
     def test_merged_counters_reconcile_with_parent(self, fleet):
         fm = FleetMetrics(fleet)

@@ -42,6 +42,8 @@ class TestResNet:
         new = new_state["stem"]["bn"]["mean"]
         assert not np.allclose(old, new)
 
+    @pytest.mark.slow  # compile-bound eval sweep: slow tier (ROADMAP)
+
     def test_resnet18_eval_deterministic(self):
         model = resnet18(num_classes=4)
         params, state = model.init(jax.random.PRNGKey(0))
