@@ -1,8 +1,18 @@
-"""``python -m apex_tpu.analysis [paths ...]`` — run the hazard linter."""
+"""``python -m apex_tpu.analysis [paths ...]`` — run the hazard linter;
+``python -m apex_tpu.analysis mc [...]`` — run the fleet model checker
+(imported lazily: the linter stays stdlib-only, the checker needs the
+serving stack)."""
 
 import sys
 
-from apex_tpu.analysis.engine import main
+
+def _dispatch(argv):
+    if argv and argv[0] == "mc":
+        from apex_tpu.analysis.mc.cli import main as mc_main
+        return mc_main(argv[1:])
+    from apex_tpu.analysis.engine import main
+    return main(argv)
+
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(_dispatch(sys.argv[1:]))

@@ -156,13 +156,26 @@ class ModuleContext:
 
 class Rule:
     """Base hazard detector.  Subclasses set ``code``/``name``/
-    ``description`` and implement :meth:`check`."""
+    ``description`` and implement :meth:`check`.
+
+    A rule with ``project = True`` is a **cross-file** detector: it
+    implements :meth:`check_project` over every collected module in one
+    pass (contract drift between an emitter in one file and its
+    consumer in another can't be seen one file at a time).  Such rules
+    still work under :func:`analyze_source` — they are handed a
+    one-module project — but only surface their real findings when the
+    whole tree is collected by :func:`analyze_paths`."""
 
     code: str = "APX000"
     name: str = ""
     description: str = ""
+    project: bool = False
 
     def check(self, module: ModuleContext) -> List[Finding]:
+        raise NotImplementedError
+
+    def check_project(self, modules: Sequence[ModuleContext]
+                      ) -> List[Finding]:
         raise NotImplementedError
 
 
@@ -306,6 +319,32 @@ class Baseline:
                 stale.append(e)
         return new, matched, stale
 
+    def prune(self, findings: Sequence[Finding]
+              ) -> Tuple[List[dict], List[dict]]:
+        """Split entries into (kept, dropped): an entry is dropped when
+        NO current finding matches its ``(path, code, snippet)`` key —
+        the code was fixed or deleted and the ledger line is dead
+        weight.  Justification status is irrelevant here: a dead entry
+        is dead either way.  Duplicate entries are budgeted against
+        duplicate findings one-for-one.  Mutates ``self.entries`` to
+        the kept list and returns both halves."""
+        supply: Dict[Tuple[str, str, str], int] = {}
+        for f in findings:
+            k = self._key(f.path, f.code, f.snippet)
+            supply[k] = supply.get(k, 0) + 1
+        kept: List[dict] = []
+        dropped: List[dict] = []
+        for e in self.entries:
+            k = self._key(e.get("path", ""), e.get("code", ""),
+                          e.get("snippet", ""))
+            if supply.get(k, 0) > 0:
+                supply[k] -= 1
+                kept.append(e)
+            else:
+                dropped.append(e)
+        self.entries = kept
+        return kept, dropped
+
     @classmethod
     def from_findings(cls, findings: Sequence[Finding],
                       justification: str = "TODO: justify") -> "Baseline":
@@ -376,10 +415,33 @@ def _split_toml_list(inner: str) -> List[str]:
 
 
 def _read_toml_table(path: str, table: str) -> Dict[str, object]:
-    """Parse one flat ``[table]`` from a TOML file — just the subset this
-    engine's config needs (strings, bools, ints, string arrays, including
-    multi-line arrays).  Python 3.10 ships no tomllib and the image policy
-    forbids new deps."""
+    """Parse one flat ``[table]`` from a TOML file.
+
+    On Python 3.11+ this defers to the stdlib ``tomllib`` (a real TOML
+    parser: escape sequences, inline comments, every string flavor).
+    Python 3.10 ships no tomllib and the image policy forbids new deps,
+    so the fallback is the hand-rolled reader below — just the subset
+    this engine's config needs (strings, bools, ints, string arrays,
+    including multi-line arrays).  Known fallback gap: backslash escape
+    sequences inside basic strings are returned verbatim rather than
+    decoded (tracked by a test; keep config values escape-free)."""
+    try:
+        import tomllib
+    except ImportError:
+        tomllib = None
+    if tomllib is not None:
+        try:
+            with open(path, "rb") as fh:
+                data: object = tomllib.load(fh)
+        except OSError:
+            return {}
+        except tomllib.TOMLDecodeError:
+            return {}
+        for part in table.split("."):
+            if not isinstance(data, dict):
+                return {}
+            data = data.get(part, {})
+        return dict(data) if isinstance(data, dict) else {}
     try:
         with open(path, "r", encoding="utf-8") as fh:
             lines = fh.read().splitlines()
@@ -480,7 +542,10 @@ def analyze_source(source: str, path: str = "<string>",
                         e.lineno or 1, e.offset or 0)]
     findings: List[Finding] = []
     for rule in (rules if rules is not None else _get_rules()):
-        findings.extend(rule.check(module))
+        if rule.project:
+            findings.extend(rule.check_project([module]))
+        else:
+            findings.extend(rule.check(module))
     if respect_noqa:
         findings = [f for f in findings
                     if not _suppressed(f, module.lines)]
@@ -526,9 +591,30 @@ def analyze_paths(paths: Sequence[str],
     cfg = config or load_config(paths[0] if paths else ".")
     if rules is None:
         rules = _get_rules(cfg.select, cfg.disable)
+    per_module = [r for r in rules if not r.project]
+    project = [r for r in rules if r.project]
     findings: List[Finding] = []
+    modules: List[ModuleContext] = []
     for f in _iter_py_files(paths, cfg.exclude):
-        findings.extend(analyze_file(f, rules, rel_to=cfg.root))
+        with open(f, "r", encoding="utf-8") as fh:
+            source = fh.read()
+        shown = os.path.relpath(f, cfg.root).replace(os.sep, "/")
+        try:
+            module = ModuleContext(shown, source)
+        except SyntaxError as e:
+            findings.append(Finding(
+                "APX000", f"syntax error: {e.msg}", shown,
+                e.lineno or 1, e.offset or 0))
+            continue
+        modules.append(module)
+        for rule in per_module:
+            findings.extend(rule.check(module))
+    for rule in project:
+        findings.extend(rule.check_project(modules))
+    lines_by_path = {m.path: m.lines for m in modules}
+    findings = [f for f in findings
+                if f.path not in lines_by_path
+                or not _suppressed(f, lines_by_path[f.path])]
     findings.sort(key=lambda x: (x.path, x.line, x.col, x.code))
     return findings
 
@@ -550,6 +636,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser.add_argument("--write-baseline", action="store_true",
                         help="write all current findings to the baseline "
                              "file and exit 0")
+    parser.add_argument("--prune-baseline", action="store_true",
+                        help="drop baseline entries whose finding no "
+                             "longer exists (fixed/deleted code), "
+                             "rewrite the file, then lint as usual")
     parser.add_argument("--select", default=None,
                         help="comma-separated rule codes to run")
     parser.add_argument("--disable", default=None,
@@ -588,6 +678,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         Baseline.from_findings(findings).save(baseline_path)
         print(f"wrote {len(findings)} entries to {baseline_path}")
         return 0
+
+    if args.prune_baseline:
+        if baseline_path is None or not os.path.exists(baseline_path):
+            print("no baseline file to prune "
+                  f"({baseline_path or 'no path configured'})",
+                  file=sys.stderr)
+            return 2
+        bl = Baseline.load(baseline_path)
+        kept, dropped = bl.prune(findings)
+        if dropped:
+            bl.save()
+        print(f"pruned {len(dropped)} stale baseline "
+              f"entr{'ies' if len(dropped) != 1 else 'y'} "
+              f"({len(kept)} kept) in {baseline_path}")
 
     baselined: List[Finding] = []
     stale: List[dict] = []

@@ -1,0 +1,37 @@
+"""Fleet control-plane model checker (docs/analysis.md#model-checker).
+
+A loom/Shuttle-style bounded schedule explorer that drives the REAL
+``serving/fleet`` control plane — :class:`~apex_tpu.serving.fleet.Router`
+dispatch, drain/migration, autoscaling, canary deployment — under a
+:class:`~apex_tpu.serving.clock.VirtualClock`, systematically running
+seeded interleavings of tick / request-arrival / scale / deploy / fault
+events and checking machine-readable invariants after every step.
+
+Only the data plane is simulated: :class:`~.sim.SimEngine` stands in for
+the jitted :class:`~apex_tpu.serving.engine.InferenceEngine` behind the
+``engine_factory`` seam, honoring the engine's full supervisor-facing
+interface and telemetry contract, so every protocol decision under test
+(admission, routing, drain, migration stitching, probe gating, canary
+scoring, counter/record emission) is made by production code.
+
+Entry points: ``python -m apex_tpu.analysis mc`` (see :mod:`~.cli`),
+:func:`~.explorer.explore` / :func:`~.explorer.replay` from Python.
+A violation reports a delta-debug-minimized schedule that replays
+deterministically from its seed:
+``python -m apex_tpu.analysis mc --replay <seed> --indices i,j,...``.
+"""
+
+from apex_tpu.analysis.mc.events import Event, generate_schedule
+from apex_tpu.analysis.mc.harness import MCConfig, RunResult, run_schedule
+from apex_tpu.analysis.mc.invariants import Violation
+from apex_tpu.analysis.mc.explorer import (
+    ExploreResult,
+    explore,
+    exhaustive,
+    minimize,
+    replay,
+)
+
+__all__ = ["Event", "generate_schedule", "MCConfig", "RunResult",
+           "run_schedule", "Violation", "ExploreResult", "explore",
+           "exhaustive", "minimize", "replay"]

@@ -1,0 +1,113 @@
+"""``python -m apex_tpu.analysis mc`` — the model-checker CLI.
+
+Exit status 0 means every explored schedule upheld the invariant
+catalog; 1 means a violation was found (the minimized, seed-replayable
+schedule is printed), 2 means bad usage. ``--json`` emits the same
+information machine-readably for CI gates.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from apex_tpu.analysis.mc.events import format_schedule
+from apex_tpu.analysis.mc.explorer import exhaustive, explore, replay
+from apex_tpu.analysis.mc.harness import MCConfig, MUTATIONS
+
+__all__ = ["main"]
+
+
+def _parse_indices(text: str) -> List[int]:
+    try:
+        return [int(p) for p in text.split(",") if p.strip()]
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"--indices wants comma-separated ints, got {text!r}")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m apex_tpu.analysis mc",
+        description="Bounded model checker for the serving fleet "
+                    "control plane (docs/analysis.md#model-checker).")
+    p.add_argument("--schedules", type=int, default=50,
+                   help="seeded schedules to explore (default 50)")
+    p.add_argument("--depth", type=int, default=12,
+                   help="events per schedule (default 12)")
+    p.add_argument("--replicas", type=int, default=2,
+                   help="initial fleet size (default 2)")
+    p.add_argument("--seed", type=int, default=0,
+                   help="first schedule seed (default 0)")
+    p.add_argument("--no-faults", action="store_true",
+                   help="drop fault/poisoned-deploy events from the "
+                        "schedule vocabulary")
+    p.add_argument("--mutate", choices=sorted(MUTATIONS), default=None,
+                   help="inject a named protocol bug (the mutation "
+                        "gate: the checker must catch it)")
+    p.add_argument("--exhaustive", action="store_true",
+                   help="enumerate EVERY schedule over a reduced "
+                        "alphabet at --depth (keep depth small)")
+    p.add_argument("--replay", type=int, default=None, metavar="SEED",
+                   help="re-run one schedule by seed instead of "
+                        "exploring")
+    p.add_argument("--indices", type=_parse_indices, default=None,
+                   help="with --replay: restrict to these "
+                        "comma-separated event indices (the minimized "
+                        "subset a violation report printed)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable output")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    cfg = MCConfig(replicas=args.replicas, depth=args.depth,
+                   schedules=args.schedules, seed=args.seed,
+                   faults=not args.no_faults, mutation=args.mutate)
+
+    if args.replay is not None:
+        res = replay(cfg, args.replay, args.indices)
+        if args.as_json:
+            print(json.dumps({
+                "seed": args.replay,
+                "indices": args.indices,
+                "applied": res.applied,
+                "violations": [vars(v) for v in res.violations],
+                "requests": res.requests,
+            }, indent=2))
+        else:
+            print(f"replay seed={args.replay}: "
+                  + format_schedule(res.schedule))
+            for line in res.applied:
+                print(f"  {line}")
+            for v in res.violations:
+                print(f"  {v.render()}")
+            if res.ok:
+                print(f"ok: {res.requests} requests, "
+                      f"no invariant violations")
+        return 0 if res.ok else 1
+
+    if args.exhaustive:
+        er = exhaustive(cfg, depth=args.depth)
+    else:
+        er = explore(cfg)
+    if args.as_json:
+        out = {"explored": er.explored, "ok": er.ok}
+        if not er.ok:
+            out.update({
+                "seed": er.seed,
+                "indices": er.indices,
+                "schedule": [ev.render() for ev in er.schedule],
+                "violations": [vars(v) for v in er.failure.violations],
+            })
+        print(json.dumps(out, indent=2))
+    else:
+        print(er.render())
+    return 0 if er.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

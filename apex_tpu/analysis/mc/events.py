@@ -1,0 +1,86 @@
+"""The schedule vocabulary the explorer enumerates.
+
+A schedule is a flat list of :class:`Event` values applied in order by
+:class:`~apex_tpu.analysis.mc.harness.FleetHarness`. Events carry small
+integer arguments (``a``/``b``) that the harness resolves against live
+fleet state (replica index modulo the active set, prompt shape, ...) so
+EVERY event is applicable in every state — an event whose precondition
+does not hold (drain while another drain is running, scale past the
+bounds) degrades to a recorded no-op instead of invalidating the
+schedule. That keeps the schedule space dense: delta-debugging can drop
+any subset of events and the remainder is still a legal run.
+
+Schedules are generated from a seed via :func:`generate_schedule`
+(``random.Random(seed)`` — no global RNG state), so a violation report
+is reproducible from ``(seed, config)`` alone.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+__all__ = ["Event", "EVENT_KINDS", "generate_schedule", "format_schedule"]
+
+#: every kind the harness understands, with the generator's draw weight.
+#: tick dominates — protocol progress happens there — with a spread of
+#: control-plane perturbations layered on top.
+_WEIGHTED_KINDS = (
+    ("tick", 10),
+    ("arrive", 6),
+    ("arrive_deadline", 2),
+    ("advance", 2),
+    ("cancel", 1),
+    ("drain", 2),
+    ("scale_up", 1),
+    ("scale_down", 1),
+    ("deploy_good", 1),
+    ("deploy_poisoned", 1),
+    ("fault", 2),
+)
+
+EVENT_KINDS = tuple(kind for kind, _ in _WEIGHTED_KINDS)
+
+
+@dataclass(frozen=True)
+class Event:
+    """One schedule step: a kind plus two small resolver arguments."""
+
+    kind: str
+    a: int = 0
+    b: int = 0
+
+    def render(self) -> str:
+        if self.kind in ("tick", "scale_up"):
+            return self.kind
+        return f"{self.kind}({self.a},{self.b})"
+
+
+def generate_schedule(seed: int, depth: int, *,
+                      faults: bool = True,
+                      kinds: Optional[Sequence[str]] = None) -> List[Event]:
+    """The seeded schedule: ``depth`` weighted draws from the event
+    vocabulary. ``faults=False`` drops the fault/poisoned-deploy kinds
+    (the bug-free baseline run); ``kinds`` restricts the alphabet (the
+    exhaustive mode drives this)."""
+    rng = random.Random(seed)
+    table = [(k, w) for k, w in _WEIGHTED_KINDS
+             if (kinds is None or k in kinds)
+             and (faults or k not in ("fault", "deploy_poisoned"))]
+    population = [k for k, _ in table]
+    weights = [w for _, w in table]
+    return [Event(rng.choices(population, weights)[0],
+                  a=rng.randrange(8), b=rng.randrange(8))
+            for _ in range(depth)]
+
+
+def format_schedule(events: Sequence[Event],
+                    indices: Optional[Sequence[int]] = None) -> str:
+    keep = set(indices) if indices is not None else None
+    parts = []
+    for i, ev in enumerate(events):
+        if keep is not None and i not in keep:
+            continue
+        parts.append(f"[{i}] {ev.render()}")
+    return " ".join(parts)

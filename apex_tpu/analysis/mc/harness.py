@@ -1,0 +1,381 @@
+"""The deterministic fleet-under-test harness.
+
+:class:`FleetHarness` stands up one REAL :class:`ReplicaFleet` — sim
+engines behind the ``engine_factory`` seam, a
+:class:`~apex_tpu.serving.clock.VirtualClock` behind the clock seam, an
+:class:`~apex_tpu.observability.sinks.InMemorySink` capturing the full
+telemetry stream — and applies one schedule event at a time, running
+the :class:`~apex_tpu.analysis.mc.invariants.InvariantChecker` after
+every step. Nothing here is wall-clock-, thread-, or RNG-dependent:
+the same ``(config, schedule)`` pair replays the same run bit-for-bit,
+which is what makes delta-debug minimization and ``--replay`` honest.
+
+Events degrade to recorded no-ops when their precondition does not hold
+(see :mod:`~apex_tpu.analysis.mc.events`); control-plane perturbations
+(drain / scale / deploy) mirror production policy by holding while a
+deployment is in flight, exactly as the autoscaler does.
+
+``MUTATIONS`` holds named, deliberately-injected protocol bugs used by
+the mutation gate (tests prove the checker actually catches them):
+``double_terminal_drain`` makes a draining supervisor emit a second
+terminal record for the first continuation it hands over — the classic
+exactly-once violation a drain/migration race would produce.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import shutil
+import tempfile
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from apex_tpu.analysis.mc.events import Event
+from apex_tpu.analysis.mc.invariants import InvariantChecker, Violation
+from apex_tpu.analysis.mc.sim import SimEngine, SimModel
+from apex_tpu.observability import MetricsRegistry
+from apex_tpu.observability.sinks import InMemorySink
+from apex_tpu.serving import clock
+from apex_tpu.serving.clock import VirtualClock, use_clock
+from apex_tpu.serving.engine import EngineConfig
+from apex_tpu.serving.scheduler import SchedulerConfig
+from apex_tpu.serving.fleet.autoscale import AutoscaleConfig
+from apex_tpu.serving.fleet.deploy import CanaryConfig
+from apex_tpu.serving.fleet.router import (
+    REPLICA_ACTIVE,
+    FleetConfig,
+    ReplicaFleet,
+)
+from apex_tpu.serving.request import FINISH_LENGTH, Request, RequestResult
+from apex_tpu.serving.supervisor import EngineSupervisor
+from apex_tpu.testing_faults import (
+    ServingFaultInjector,
+    corrupt_checkpoint_weights,
+)
+
+__all__ = ["MCConfig", "RunResult", "FleetHarness", "run_schedule",
+           "MUTATIONS"]
+
+
+@dataclass(frozen=True)
+class MCConfig:
+    """Exploration bounds. Deliberately tight engine limits (2 slots,
+    queue of 4, 4-token pages) so bounded schedules actually reach the
+    queue-full / deadline / migration / page-churn corners."""
+
+    replicas: int = 2
+    depth: int = 12
+    schedules: int = 50
+    seed: int = 0
+    faults: bool = True
+    mutation: Optional[str] = None
+    max_replicas: int = 4
+    max_queue: int = 4
+    max_slots: int = 2
+    page_size: int = 4
+    tick_dt: float = 0.05
+    liveness_ticks: int = 200
+    settle_ticks: int = 400
+
+
+@dataclass
+class RunResult:
+    """One schedule's outcome: what was applied (including degraded
+    no-ops, for the trace) and every violation found."""
+
+    seed: int
+    schedule: List[Event]
+    applied: List[str] = field(default_factory=list)
+    violations: List[Violation] = field(default_factory=list)
+    requests: int = 0
+    counters: Dict[str, int] = field(default_factory=dict)
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+
+def _mutate_double_terminal(stack: contextlib.ExitStack) -> None:
+    """The injected exactly-once bug: after a real
+    ``detach_for_migration``, the draining supervisor ALSO records the
+    first handed-over continuation as terminal (``length``) — while the
+    continuation goes on to finish again on a peer. Control flow is
+    untouched (tracking maps, return value), so the run proceeds
+    normally and only the telemetry contract is broken: one request id,
+    two terminal records, counters that no longer sum."""
+    orig = EngineSupervisor.detach_for_migration
+
+    def buggy(sup):
+        conts = orig(sup)
+        if conts:
+            cont, recovered = conts[0]
+            res = RequestResult(
+                request_id=cont.request_id, prompt_len=cont.prompt_len,
+                tokens=list(recovered), finish_reason=FINISH_LENGTH,
+                queue_s=0.0, total_s=0.0, replica_id=sup.replica_id)
+            sup.metrics.inc(f"requests_{FINISH_LENGTH}")
+            sup.metrics.emit_record(res.record(wall=clock.wall()))
+        return conts
+
+    EngineSupervisor.detach_for_migration = buggy
+    stack.callback(
+        lambda: setattr(EngineSupervisor, "detach_for_migration", orig))
+
+
+MUTATIONS = {
+    "double_terminal_drain": _mutate_double_terminal,
+}
+
+
+class FleetHarness:
+    """One fleet under one virtual clock, driven event by event.
+    Build inside ``with use_clock(VirtualClock()):`` — see
+    :func:`run_schedule`, which owns that plumbing."""
+
+    def __init__(self, cfg: MCConfig):
+        self.cfg = cfg
+        self.sink = InMemorySink()
+        self.registry = MetricsRegistry(sinks=[self.sink])
+        self.model = SimModel()
+        self.params = {"w": [[0.5, 0.5], [0.5, 0.5]]}
+        self.engines: List[SimEngine] = []
+        self.injectors: Dict[int, ServingFaultInjector] = {
+            i: ServingFaultInjector() for i in range(cfg.replicas)}
+        self.expected: Dict[int, Tuple[List[int], int]] = {}
+        self.ticks = 0
+        # explicit ids keep runs deterministic; the high base keeps them
+        # clear of the process-global auto-id counter health probes draw
+        # from (which can never plausibly reach it)
+        self._next_rid = 10_000_001
+        self._ckpt_dir: Optional[str] = None
+        self._ckpt_step = 0
+
+        def factory(model, params, config, *, metrics=None, faults=None,
+                    replica_id=None, adapters=None):
+            eng = SimEngine(model, params, config, metrics=metrics,
+                            faults=faults, replica_id=replica_id,
+                            adapters=adapters)
+            self.engines.append(eng)
+            return eng
+
+        engine_config = EngineConfig(
+            max_slots=cfg.max_slots, max_len=64,
+            page_size=cfg.page_size,
+            scheduler=SchedulerConfig(max_queue=cfg.max_queue,
+                                      max_prefills_per_tick=1))
+        self.fleet = ReplicaFleet(
+            self.model, self.params, engine_config,
+            fleet=FleetConfig(n_replicas=cfg.replicas),
+            metrics=self.registry,
+            faults=self.injectors,
+            engine_factory=factory,
+            autoscale=AutoscaleConfig(
+                min_replicas=1, max_replicas=cfg.max_replicas,
+                poll_interval_s=0.1, cooldown_s=0.3,
+                hysteresis_polls=2, scale_up_queue_per_replica=2.0))
+        self.checker = InvariantChecker(self)
+
+    # -- event application -------------------------------------------------
+
+    def apply(self, ev: Event) -> str:
+        """Apply one event; returns a human-readable trace line (what
+        actually happened, including the degraded no-op cases)."""
+        handler = getattr(self, f"_ev_{ev.kind}")
+        return handler(ev)
+
+    def _tick_once(self) -> None:
+        clock.get_clock().advance(self.cfg.tick_dt)
+        self.fleet.tick()
+        self.ticks += 1
+
+    def _ev_tick(self, ev: Event) -> str:
+        self._tick_once()
+        return "tick"
+
+    def _submit(self, ev: Event, deadline_s: Optional[float]) -> str:
+        prompt = [1 + ev.b % 7] + [2] * (ev.a % 4)
+        max_new = 1 + (ev.a + ev.b) % 5
+        rid = self._next_rid
+        self._next_rid += 1
+        req = Request(prompt=prompt, max_new_tokens=max_new,
+                      request_id=rid, arrival_ts=clock.now(),
+                      deadline_s=deadline_s)
+        self.expected[rid] = (list(req.prompt), max_new)
+        try:
+            self.fleet.submit(req)
+        except Exception as exc:   # shed/rejected: recorded terminally
+            return (f"arrive r{rid} -> rejected at the door "
+                    f"({type(exc).__name__})")
+        return f"arrive r{rid} prompt={len(prompt)} max_new={max_new}"
+
+    def _ev_arrive(self, ev: Event) -> str:
+        return self._submit(ev, None)
+
+    def _ev_arrive_deadline(self, ev: Event) -> str:
+        # tight enough that a queue wait or a mid-flight drain can blow
+        # it: 2-6 tick intervals of budget
+        budget = self.cfg.tick_dt * (2 + (ev.a + ev.b) % 5)
+        return self._submit(ev, budget) + f" deadline={budget:.3f}"
+
+    def _ev_advance(self, ev: Event) -> str:
+        dt = self.cfg.tick_dt * (1 + ev.a % 4)
+        clock.get_clock().advance(dt)
+        return f"advance {dt:.3f}s"
+
+    def _ev_cancel(self, ev: Event) -> str:
+        live = [rid for rid in sorted(self.expected)
+                if rid not in self.fleet.completed]
+        if not live:
+            return "cancel: no-op (nothing outstanding)"
+        rid = live[ev.a % len(live)]
+        found = self.fleet.cancel(rid)
+        return f"cancel r{rid} -> {'cancelled' if found else 'miss'}"
+
+    def _topology_clear(self) -> bool:
+        dep = self.fleet.deployment
+        return (self.fleet.topology_busy is None
+                and (dep is None or dep.done))
+
+    def _ev_drain(self, ev: Event) -> str:
+        if not self._topology_clear():
+            return "drain: no-op (topology busy or deployment active)"
+        active = [r for r in self.fleet.replicas
+                  if r.state == REPLICA_ACTIVE]
+        if not active:
+            return "drain: no-op (no active replica)"
+        rid = active[ev.a % len(active)].replica_id
+        self.fleet.drain_restart(rid)
+        return f"drain replica {rid}"
+
+    def _ev_scale_up(self, ev: Event) -> str:
+        if not self._topology_clear():
+            return "scale_up: no-op (topology busy or deployment active)"
+        if len(self.fleet.replicas) >= self.cfg.max_replicas:
+            return "scale_up: no-op (at max_replicas)"
+        rid = self.fleet.add_replica()
+        return f"scale_up -> replica {rid}"
+
+    def _ev_scale_down(self, ev: Event) -> str:
+        if not self._topology_clear():
+            return "scale_down: no-op (topology busy or deployment active)"
+        active = [r for r in self.fleet.replicas
+                  if r.state == REPLICA_ACTIVE]
+        if len(active) < 2:
+            return "scale_down: no-op (last active replica)"
+        rid = active[ev.a % len(active)].replica_id
+        self.fleet.retire_replica(rid)
+        return f"scale_down replica {rid}"
+
+    def _deploy(self, ev: Event, poisoned: bool) -> str:
+        kind = "deploy_poisoned" if poisoned else "deploy_good"
+        if not self._topology_clear():
+            return f"{kind}: no-op (topology busy or deployment active)"
+        from apex_tpu.checkpoint import ShardedCheckpointManager
+        if self._ckpt_dir is None:
+            self._ckpt_dir = tempfile.mkdtemp(prefix="apex-mc-ckpt-")
+        self._ckpt_step += 1
+        step = self._ckpt_step
+        mgr = ShardedCheckpointManager(self._ckpt_dir)
+        import numpy as np
+        value = 0.5 + step * 0.001
+        mgr.save(step, {"w": np.full((2, 2), value, dtype=np.float32)},
+                 force=True)
+        if poisoned:
+            corrupt_checkpoint_weights(self._ckpt_dir, step)
+        try:
+            self.fleet.deploy(
+                checkpoint_dir=self._ckpt_dir, step=step,
+                canary=CanaryConfig(window_s=self.cfg.tick_dt * 4,
+                                    min_requests=1,
+                                    max_window_s=self.cfg.tick_dt * 20))
+        except Exception as exc:   # rejected deploys record themselves
+            return f"{kind} step={step} -> rejected ({type(exc).__name__})"
+        return f"{kind} step={step} started"
+
+    def _ev_deploy_good(self, ev: Event) -> str:
+        return self._deploy(ev, poisoned=False)
+
+    def _ev_deploy_poisoned(self, ev: Event) -> str:
+        return self._deploy(ev, poisoned=True)
+
+    def _ev_fault(self, ev: Event) -> str:
+        if not self.injectors:
+            return "fault: no-op (no injectors)"
+        keys = sorted(self.injectors)
+        inj = self.injectors[keys[ev.a % len(keys)]]
+        target = inj.decode_calls + ev.b % 3
+        inj.decode_raise_calls = frozenset(
+            set(inj.decode_raise_calls) | {target})
+        return (f"fault: arm replica {keys[ev.a % len(keys)]} "
+                f"decode call {target}")
+
+    # -- run shape ----------------------------------------------------------
+
+    def settle(self) -> bool:
+        """Tick until the fleet is quiescent (nothing tracked, no
+        backlog, topology free, deployment done) or the settle budget
+        runs out. Returns True when quiescence was reached."""
+        for _ in range(self.cfg.settle_ticks):
+            if (not self.fleet._tracked and not self.fleet._backlog
+                    and self.fleet.topology_busy is None
+                    and (self.fleet.deployment is None
+                         or self.fleet.deployment.done)):
+                return True
+            self._tick_once()
+        return False
+
+    def cleanup(self) -> None:
+        with contextlib.suppress(Exception):
+            self.fleet.close()
+        if self._ckpt_dir is not None:
+            shutil.rmtree(self._ckpt_dir, ignore_errors=True)
+            self._ckpt_dir = None
+
+
+def run_schedule(cfg: MCConfig, schedule: Sequence[Event], *,
+                 seed: int = -1) -> RunResult:
+    """Run one schedule end to end: apply every event (checking the
+    full invariant catalog after each), settle to quiescence, run the
+    final reconciliation, tear down. Deterministic in
+    ``(cfg, schedule)``; ``seed`` only labels the result."""
+    result = RunResult(seed=seed, schedule=list(schedule))
+    with contextlib.ExitStack() as stack:
+        if cfg.mutation is not None:
+            try:
+                MUTATIONS[cfg.mutation](stack)
+            except KeyError:
+                raise ValueError(
+                    f"unknown mutation {cfg.mutation!r} "
+                    f"(have: {sorted(MUTATIONS)})") from None
+        # injected faults / drains / rollbacks are the POINT here — the
+        # serving stack's incident WARNINGs would drown the report
+        prev_disable = logging.root.manager.disable
+        logging.disable(logging.WARNING)
+        stack.callback(logging.disable, prev_disable)
+        stack.enter_context(use_clock(VirtualClock()))
+        harness = FleetHarness(cfg)
+        stack.callback(harness.cleanup)
+        for i, ev in enumerate(schedule):
+            try:
+                result.applied.append(harness.apply(ev))
+            except Exception as exc:
+                result.violations.append(Violation(
+                    "unhandled_exception",
+                    f"{ev.render()} raised "
+                    f"{type(exc).__name__}: {exc}", i))
+                break
+            result.violations.extend(harness.checker.check(i))
+        else:
+            if not harness.settle():
+                result.violations.append(Violation(
+                    "quiescence",
+                    f"fleet not quiescent after {cfg.settle_ticks} "
+                    f"settle ticks (tracked="
+                    f"{sorted(harness.fleet._tracked)}, backlog="
+                    f"{len(harness.fleet._backlog)}, busy="
+                    f"{harness.fleet.topology_busy})"))
+            result.violations.extend(harness.checker.final())
+        result.requests = len(harness.expected)
+        result.counters = dict(harness.registry.counters())
+    return result

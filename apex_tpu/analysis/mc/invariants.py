@@ -1,0 +1,300 @@
+"""The machine-checked invariant catalog (docs/analysis.md#mc-invariants).
+
+Checked by :class:`InvariantChecker` after EVERY schedule event, against
+the live fleet plus the full telemetry stream (an
+:class:`~apex_tpu.observability.sinks.InMemorySink` attached to the
+fleet's shared registry — every counter increment, incident event,
+typed record, and terminal request record flows through it):
+
+- ``exactly_once`` — every request id has at most one terminal
+  ``kind="request"`` record, ever; at quiescence, ``requests_submitted``
+  equals the sum of the ``requests_<reason>`` terminal counters.
+- ``token_conservation`` — every harness-submitted request's final
+  token stream is a prefix of its canonical
+  :func:`~apex_tpu.analysis.mc.sim.sim_stream` (token-exact across any
+  drain / restart / migration stitching), and a ``length`` finish
+  carries exactly its full budget.
+- ``page_balance`` — each sim engine's page pool balances: pages in use
+  equal the recomputed sum over its live requests, allocs minus frees
+  equal usage, and a closed engine holds zero pages.
+- ``replica_id_reuse`` — a replica id that left the fleet never
+  reappears, and new ids are strictly increasing.
+- ``deploy_monotonic`` — within one deployment generation (a
+  ``kind="deploy"`` ``action="start"`` record), no ``canary_pass`` or
+  ``complete`` may follow a ``rollback``/``rejected``, and the terminal
+  actions are mutually exclusive.
+- ``drain_liveness`` — a replica entering ``draining``/``probing``
+  leaves that state within a bounded horizon of tick events.
+- ``counter_reconcile`` — every fleet lifecycle counter
+  (``replica_drains``, ``replica_scale_*``, ``deploys_*``,
+  ``canary_promotions``, ...) equals, key for key, the count of its
+  same-named incident events; the ``deploys_*`` family additionally
+  equals the count of typed ``kind="deploy"`` records claiming each
+  action, and applied autoscale decisions never exceed the scale
+  counters they summarize.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from apex_tpu.analysis.mc.sim import SimEngine, sim_stream
+from apex_tpu.serving.fleet.router import (
+    REPLICA_DRAINING,
+    REPLICA_PROBING,
+)
+from apex_tpu.serving.request import FINISH_REASONS
+
+__all__ = ["Violation", "InvariantChecker"]
+
+#: fleet lifecycle counter -> the same-named incident event it must
+#: reconcile with, key for key (the serving telemetry contract)
+COUNTER_EVENTS = {
+    "replica_drains": "replica_drain",
+    "replica_rebuilds": "replica_rebuild",
+    "requests_migrated": "request_migrated",
+    "replica_scale_ups": "replica_scale_up",
+    "replica_scale_downs": "replica_scale_down",
+    "deploys_started": "deploy_start",
+    "deploys_completed": "deploy_complete",
+    "deploys_rolled_back": "deploy_rollback",
+    "deploys_rejected": "deploy_rejected",
+    "canary_promotions": "canary_promoted",
+}
+
+#: deploys_* counter -> the typed kind="deploy" record action it counts
+COUNTER_DEPLOY_ACTIONS = {
+    "deploys_started": "start",
+    "deploys_completed": "complete",
+    "deploys_rolled_back": "rollback",
+    "deploys_rejected": "rejected",
+    "canary_promotions": "canary_pass",
+}
+
+
+@dataclass(frozen=True)
+class Violation:
+    """One invariant breach at one schedule step (``step`` is the event
+    index, or -1 for the post-schedule settle/final checks)."""
+
+    invariant: str
+    detail: str
+    step: int = -1
+
+    def render(self) -> str:
+        where = "final" if self.step < 0 else f"event {self.step}"
+        return f"{self.invariant} @ {where}: {self.detail}"
+
+
+class InvariantChecker:
+    """Stateful checker over one harness run; see the module docstring.
+    ``check(step)`` returns the NEW violations found at that step (each
+    breach is reported once, not re-reported every following step)."""
+
+    def __init__(self, harness):
+        self.h = harness
+        self.violations: List[Violation] = []
+        self._reported = set()
+        self._seen_replica_ids = set(
+            r.replica_id for r in harness.fleet.replicas)
+        self._live_replica_ids = set(self._seen_replica_ids)
+        self._busy_since: Dict[int, int] = {}   # rid -> tick count at entry
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _report(self, invariant: str, detail: str, step: int,
+                dedup_key=None) -> None:
+        key = (invariant, dedup_key if dedup_key is not None else detail)
+        if key in self._reported:
+            return
+        self._reported.add(key)
+        self.violations.append(Violation(invariant, detail, step))
+
+    def _records(self, kind: str) -> List[dict]:
+        return [r for r in self.h.sink.records if r.get("kind") == kind]
+
+    def _events(self, name: str) -> List[dict]:
+        return [r for r in self.h.sink.records
+                if r.get("kind") == "event" and r.get("event") == name]
+
+    # -- the catalog -------------------------------------------------------
+
+    def check(self, step: int) -> List[Violation]:
+        before = len(self.violations)
+        self._check_exactly_once(step)
+        self._check_token_conservation(step)
+        self._check_page_balance(step)
+        self._check_replica_ids(step)
+        self._check_deploy_monotonic(step)
+        self._check_drain_liveness(step)
+        self._check_counter_reconcile(step)
+        return self.violations[before:]
+
+    def final(self) -> List[Violation]:
+        before = len(self.violations)
+        self.check(-1)
+        counters = self.h.registry.counters()
+        submitted = counters.get("requests_submitted", 0)
+        terminal = sum(counters.get(f"requests_{r}", 0)
+                       for r in FINISH_REASONS)
+        if submitted != terminal:
+            self._report(
+                "exactly_once",
+                f"requests_submitted={submitted} but terminal counters "
+                f"sum to {terminal}", -1, dedup_key="counter-sum")
+        return self.violations[before:]
+
+    def _check_exactly_once(self, step: int) -> None:
+        counts: Dict[int, int] = {}
+        for rec in self._records("request"):
+            rid = rec.get("request_id")
+            counts[rid] = counts.get(rid, 0) + 1
+        for rid, n in sorted(counts.items()):
+            if n > 1:
+                self._report(
+                    "exactly_once",
+                    f"request {rid} has {n} terminal kind=\"request\" "
+                    f"records", step, dedup_key=rid)
+
+    def _check_token_conservation(self, step: int) -> None:
+        for rid, (prompt, max_new) in sorted(self.h.expected.items()):
+            res = self.h.fleet.completed.get(rid)
+            if res is None:
+                continue
+            canon = sim_stream(prompt, max_new)
+            toks = list(res.tokens)
+            if toks != canon[:len(toks)]:
+                self._report(
+                    "token_conservation",
+                    f"request {rid} stream diverges from its canonical "
+                    f"prefix (got {toks[:6]}..., want {canon[:6]}...)",
+                    step, dedup_key=rid)
+            elif res.finish_reason == "length" and len(toks) != max_new:
+                self._report(
+                    "token_conservation",
+                    f"request {rid} finished 'length' with {len(toks)} "
+                    f"of {max_new} budgeted tokens", step, dedup_key=rid)
+
+    def _check_page_balance(self, step: int) -> None:
+        for i, eng in enumerate(self.h.engines):
+            if not isinstance(eng, SimEngine):
+                continue
+            pool = eng.pool
+            if eng._closed:
+                if pool.used != 0:
+                    self._report(
+                        "page_balance",
+                        f"engine {i} (replica {eng.replica_id}) closed "
+                        f"with {pool.used} pages still held", step,
+                        dedup_key=("closed", i))
+                continue
+            want = sum(pool.pages_for(rec.request)
+                       for rec in eng._active.values())
+            if pool.used != want \
+                    or pool.total_allocs - pool.total_frees != pool.used:
+                self._report(
+                    "page_balance",
+                    f"engine {i} (replica {eng.replica_id}) holds "
+                    f"{pool.used} pages; live requests account for "
+                    f"{want} (allocs={pool.total_allocs}, "
+                    f"frees={pool.total_frees})", step, dedup_key=i)
+
+    def _check_replica_ids(self, step: int) -> None:
+        current = set(r.replica_id for r in self.h.fleet.replicas)
+        returned = (current - self._live_replica_ids) \
+            & self._seen_replica_ids
+        for rid in sorted(returned):
+            self._report(
+                "replica_id_reuse",
+                f"replica id {rid} re-entered the fleet after leaving",
+                step, dedup_key=rid)
+        fresh = current - self._seen_replica_ids
+        if fresh and self._seen_replica_ids:
+            floor = max(self._seen_replica_ids)
+            for rid in sorted(fresh):
+                if rid <= floor:
+                    self._report(
+                        "replica_id_reuse",
+                        f"new replica id {rid} is not monotonic "
+                        f"(ids up to {floor} already used)", step,
+                        dedup_key=("monotonic", rid))
+        self._seen_replica_ids |= current
+        self._live_replica_ids = current
+
+    def _check_deploy_monotonic(self, step: int) -> None:
+        generation = -1
+        closed_by: Optional[str] = None
+        for i, rec in enumerate(self._records("deploy")):
+            action = rec.get("action")
+            if action == "start":
+                generation += 1
+                closed_by = None
+                continue
+            if action == "rejected" and closed_by is None \
+                    and generation < 0:
+                # a rejected deploy never started rolling: its own
+                # one-record generation
+                generation += 1
+                closed_by = "rejected"
+                continue
+            if closed_by is not None:
+                self._report(
+                    "deploy_monotonic",
+                    f"deploy record #{i} action={action!r} after the "
+                    f"generation was closed by {closed_by!r}", step,
+                    dedup_key=(generation, i))
+                continue
+            if action in ("rollback", "rejected", "complete"):
+                closed_by = action
+
+    def _check_drain_liveness(self, step: int) -> None:
+        ticks = self.h.ticks
+        busy_now = {r.replica_id for r in self.h.fleet.replicas
+                    if r.state in (REPLICA_DRAINING, REPLICA_PROBING)}
+        for rid in list(self._busy_since):
+            if rid not in busy_now:
+                del self._busy_since[rid]
+        for rid in busy_now:
+            since = self._busy_since.setdefault(rid, ticks)
+            if ticks - since > self.h.cfg.liveness_ticks:
+                self._report(
+                    "drain_liveness",
+                    f"replica {rid} stuck draining/probing for "
+                    f"{ticks - since} ticks "
+                    f"(horizon {self.h.cfg.liveness_ticks})", step,
+                    dedup_key=rid)
+
+    def _check_counter_reconcile(self, step: int) -> None:
+        counters = self.h.registry.counters()
+        for counter, event in COUNTER_EVENTS.items():
+            have = counters.get(counter, 0)
+            want = len(self._events(event))
+            if have != want:
+                self._report(
+                    "counter_reconcile",
+                    f"counter {counter}={have} but {want} "
+                    f"'{event}' events", step, dedup_key=counter)
+        deploy_records = self._records("deploy")
+        for counter, action in COUNTER_DEPLOY_ACTIONS.items():
+            have = counters.get(counter, 0)
+            want = sum(1 for r in deploy_records
+                       if r.get("action") == action)
+            if have != want:
+                self._report(
+                    "counter_reconcile",
+                    f"counter {counter}={have} but {want} typed "
+                    f"kind=\"deploy\" action={action!r} records", step,
+                    dedup_key=("deploy", counter))
+        autoscale = self._records("autoscale")
+        for action, counter in (("scale_up", "replica_scale_ups"),
+                                ("scale_down", "replica_scale_downs")):
+            applied = sum(1 for r in autoscale
+                          if r.get("action") == action)
+            if applied > counters.get(counter, 0):
+                self._report(
+                    "counter_reconcile",
+                    f"{applied} kind=\"autoscale\" {action} records "
+                    f"exceed counter {counter}="
+                    f"{counters.get(counter, 0)}", step,
+                    dedup_key=("autoscale", counter))

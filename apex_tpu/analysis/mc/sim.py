@@ -1,0 +1,363 @@
+"""Deterministic in-memory engine for the fleet model checker.
+
+:class:`SimEngine` plugs into :class:`~apex_tpu.serving.EngineSupervisor`
+through the ``engine_factory`` seam and honors the full engine contract
+the supervisor and fleet program against — ``submit`` / ``cancel`` /
+``tick`` / ``close``, ``completed``, ``active_count`` /
+``queued_count`` / ``queued_tokens``, ``prefill_compiles`` /
+``decode_compiles``, ``scheduler.snapshot()``, ``inflight()`` — plus the
+serving telemetry contract (``requests_submitted`` once per arrival,
+exactly one terminal ``kind="request"`` record and one
+``requests_<reason>`` counter per request), so the REAL supervisor /
+router / autoscaler / deploy code runs unmodified on top of it.
+
+Token streams are a pure function of (first prompt token, absolute
+position): :func:`sim_token`. Because a migration/restart continuation's
+prompt is the original prompt plus the recovered prefix, a continuation
+resumes at exactly the next absolute position — so the checker can
+assert token-exact conservation across any number of drains, restarts,
+and migrations without knowing the schedule.
+
+KV pages are modeled host-side by :class:`SimPagePool` (one per engine):
+``ceil(total_len / page_size)`` pages reserved at admission, released at
+the request's terminal state and on ``close()``. The pool's balance —
+pages in use equals the sum over live requests, and zero after close —
+is the checker's page-refcount invariant.
+
+Faults come from :class:`~apex_tpu.testing_faults.ServingFaultInjector`:
+``before_decode`` is called at the same host-side point as the real
+engine's, so scripted ``decode_raise_calls`` drive the supervisor's
+genuine restart-with-recovery path (the injector's call counter
+advances across rebuilds, exactly as in the real fleet).
+
+Poisoned weights (``testing_faults.corrupt_checkpoint_weights`` — every
+integrity check green, values NaN) are modeled the way the real stack
+experiences them: the one-token health probe still succeeds (argmax of
+NaN logits is a valid token), while live traffic finishes with
+``finish_reason="error"`` — so the deploy canary's SLO score is
+genuinely the first detector, as in production.
+
+Everything here is stdlib-only — no jax, no numpy — so exploring
+thousands of schedules costs milliseconds each.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from apex_tpu.serving import clock
+from apex_tpu.serving.request import (
+    FINISH_CANCELLED,
+    FINISH_EOS,
+    FINISH_LENGTH,
+    FINISH_REJECTED,
+    FINISH_TIMEOUT,
+    FINISH_ERROR,
+    Request,
+    RequestResult,
+)
+from apex_tpu.serving.scheduler import DeadlineExpiredError, QueueFullError
+
+__all__ = ["SimModelConfig", "SimModel", "SimPagePool", "SimEngine",
+           "sim_token", "sim_stream", "is_probe"]
+
+#: the engine-side terminal counters, declared up front like the real
+#: engine's so final snapshots carry every key
+_SIM_COUNTERS = ("requests_submitted", "requests_eos", "requests_length",
+                 "requests_cancelled", "requests_timeout",
+                 "requests_rejected", "requests_error")
+
+
+def sim_token(first_prompt_token: int, position: int) -> int:
+    """The deterministic token at absolute ``position`` of the stream
+    seeded by ``first_prompt_token`` — pure, so expected streams can be
+    recomputed independently of any schedule."""
+    return (first_prompt_token * 7919 + position * 31 + 13) % 50021
+
+
+def sim_stream(prompt: List[int], n: int) -> List[int]:
+    """The canonical first ``n`` generated tokens for ``prompt``."""
+    base = len(prompt)
+    return [sim_token(prompt[0], base + i) for i in range(n)]
+
+
+def is_probe(request: Request) -> bool:
+    """The fleet's rebuild health probe (``prompt=[0]``, one token) —
+    the one request shape that succeeds even on poisoned weights
+    (argmax of NaN logits is a valid token; see module docstring)."""
+    return list(request.prompt) == [0] and request.max_new_tokens == 1
+
+
+def _params_healthy(params) -> bool:
+    """True unless some float leaf of the (nested dict/list) params
+    pytree is non-finite — NaN weights mark a poisoned checkpoint."""
+    if isinstance(params, dict):
+        return all(_params_healthy(v) for v in params.values())
+    if isinstance(params, (list, tuple)):
+        return all(_params_healthy(v) for v in params)
+    # numpy arrays (checkpoint restores) without importing numpy here:
+    # anything exposing flat iteration via tolist()
+    tolist = getattr(params, "tolist", None)
+    if tolist is not None:
+        return _params_healthy(tolist())
+    if isinstance(params, float):
+        return math.isfinite(params)
+    return True
+
+
+@dataclass(frozen=True)
+class SimModelConfig:
+    """Just enough architecture surface for
+    :func:`~apex_tpu.serving.prefix.prefix_salt` to fingerprint."""
+
+    num_layers: int = 2
+    hidden_size: int = 8
+    num_attention_heads: int = 2
+    kv_heads: int = 2
+    vocab_size: int = 50021
+    position_embedding_type: str = "sim"
+
+
+@dataclass
+class SimModel:
+    """The model stub a :class:`~apex_tpu.serving.fleet.ReplicaFleet`
+    constructor accepts (it only reads ``.config``)."""
+
+    config: SimModelConfig = field(default_factory=SimModelConfig)
+
+
+class SimPagePool:
+    """Host-side model of the paged-KV pool's refcount ledger."""
+
+    def __init__(self, page_size: int):
+        self.page_size = max(1, int(page_size))
+        self.used = 0
+        self.total_allocs = 0
+        self.total_frees = 0
+
+    def pages_for(self, request: Request) -> int:
+        return max(1, math.ceil(request.total_len / self.page_size))
+
+    def alloc(self, n: int) -> int:
+        self.used += n
+        self.total_allocs += n
+        return n
+
+    def free(self, n: int) -> None:
+        self.used -= n
+        self.total_frees += n
+
+
+class _SimActive:
+    """One admitted (slot-resident) request."""
+
+    __slots__ = ("request", "tokens", "submit_ts", "pages", "cancelled")
+
+    def __init__(self, request: Request, submit_ts: float, pages: int):
+        self.request = request
+        self.tokens: List[int] = []
+        self.submit_ts = submit_ts
+        self.pages = pages
+        self.cancelled = False
+
+
+class _SimScheduler:
+    """The queue view the supervisor snapshots during a restart."""
+
+    def __init__(self, engine: "SimEngine"):
+        self._engine = engine
+
+    def snapshot(self) -> List[Tuple[Request, float]]:
+        return [(req, ts) for req, ts in self._engine._queue]
+
+
+class SimEngine:
+    """See the module docstring. Constructor signature matches the
+    ``engine_factory`` seam the supervisor rebuilds through."""
+
+    def __init__(self, model, params, config, *,
+                 metrics, faults=None, replica_id: Optional[int] = None,
+                 adapters=None):
+        self.model = model
+        self.params = params
+        self.config = config
+        self.metrics = metrics
+        self.metrics.declare_counters(*_SIM_COUNTERS)
+        self._faults = faults
+        self.replica_id = replica_id
+        self._adapters = adapters
+        self.healthy = _params_healthy(params)
+        self.pool = SimPagePool(getattr(config, "page_size", 64))
+        self.completed: Dict[int, RequestResult] = {}
+        self._queue: List[Tuple[Request, float]] = []
+        self._active: Dict[int, _SimActive] = {}
+        self.scheduler = _SimScheduler(self)
+        self.prefill_compiles = 0
+        self.decode_compiles = 0
+        self._closed = False
+
+    # -- introspection ----------------------------------------------------
+
+    @property
+    def active_count(self) -> int:
+        return len(self._active)
+
+    @property
+    def queued_count(self) -> int:
+        return len(self._queue)
+
+    @property
+    def queued_tokens(self) -> int:
+        return sum(req.prompt_len for req, _ in self._queue)
+
+    def inflight(self) -> List:
+        return [(rec.request, list(rec.tokens), rec.submit_ts)
+                for _, rec in sorted(self._active.items())]
+
+    # -- request lifecycle ------------------------------------------------
+
+    def submit(self, request: Request, *, resubmission: bool = False) -> int:
+        if self._closed:
+            raise RuntimeError("engine is closed")
+        if request.request_id in self.completed:
+            raise ValueError(
+                f"request id {request.request_id} already completed")
+        if request.total_len > self.config.max_len:
+            raise ValueError(
+                f"prompt ({request.prompt_len}) + max_new_tokens "
+                f"({request.max_new_tokens}) exceeds max_len "
+                f"({self.config.max_len})")
+        now = clock.now()
+        if not resubmission:
+            self.metrics.inc("requests_submitted")
+        if len(self._queue) >= self.config.scheduler.max_queue:
+            self._finish(request, [], FINISH_REJECTED, now, now)
+            raise QueueFullError(
+                f"queue full ({self.config.scheduler.max_queue})")
+        start = request.arrival_ts if request.arrival_ts is not None else now
+        if request.deadline_s is not None \
+                and now - start > request.deadline_s:
+            self._finish(request, [], FINISH_REJECTED, now, now)
+            raise DeadlineExpiredError(
+                f"request {request.request_id} deadline already elapsed")
+        self._queue.append((request, now))
+        return request.request_id
+
+    def cancel(self, request_id: int) -> bool:
+        for i, (req, ts) in enumerate(self._queue):
+            if req.request_id == request_id:
+                del self._queue[i]
+                self._finish(req, [], FINISH_CANCELLED, ts, clock.now())
+                return True
+        rec = self._active.get(request_id)
+        if rec is not None:
+            rec.cancelled = True
+            return True
+        return False
+
+    def tick(self) -> List[RequestResult]:
+        """One scheduler iteration, same phase order as the real engine:
+        expire deadlines, evict cancellations, admit FCFS (decode-
+        starvation capped), then one batched decode step."""
+        if self._closed:
+            raise RuntimeError("engine is closed")
+        before = set(self.completed)
+        now = clock.now()
+        self._expire(now)
+        self._evict_cancelled(now)
+        self._admit(now)
+        if self._active:
+            if self._faults is not None:
+                # same host-side hook point as the real engine: a
+                # scripted fault here IS a tick failure the supervisor
+                # must survive
+                self._faults.before_decode()
+            self.decode_compiles = max(self.decode_compiles, 1)
+            self._decode(now)
+        return [self.completed[rid] for rid in sorted(
+            set(self.completed) - before)]
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for rec in self._active.values():
+            self.pool.free(rec.pages)
+        self._active.clear()
+        self._queue.clear()
+
+    # -- the phases -------------------------------------------------------
+
+    def _expire(self, now: float) -> None:
+        for req, ts in list(self._queue):
+            if self._deadline_over(req, ts, now):
+                self._queue.remove((req, ts))
+                self._finish(req, [], FINISH_TIMEOUT, ts, now)
+        for rid, rec in list(self._active.items()):
+            if self._deadline_over(rec.request, rec.submit_ts, now):
+                self._retire_active(rid, FINISH_TIMEOUT, now)
+
+    @staticmethod
+    def _deadline_over(req: Request, submit_ts: float, now: float) -> bool:
+        if req.deadline_s is None:
+            return False
+        start = req.arrival_ts if req.arrival_ts is not None else submit_ts
+        return now - start > req.deadline_s
+
+    def _evict_cancelled(self, now: float) -> None:
+        for rid, rec in list(self._active.items()):
+            if rec.cancelled:
+                self._retire_active(rid, FINISH_CANCELLED, now)
+
+    def _admit(self, now: float) -> None:
+        admitted = 0
+        cap = self.config.scheduler.max_prefills_per_tick
+        while (self._queue and len(self._active) < self.config.max_slots
+               and admitted < cap):
+            req, ts = self._queue.pop(0)
+            pages = self.pool.pages_for(req)
+            self.pool.alloc(pages)
+            self._active[req.request_id] = _SimActive(req, ts, pages)
+            self.prefill_compiles = max(self.prefill_compiles, 1)
+            admitted += 1
+
+    def _decode(self, now: float) -> None:
+        for rid in sorted(self._active):
+            rec = self._active[rid]
+            req = rec.request
+            if not self.healthy and not is_probe(req):
+                # NaN weights: the token stream is garbage the integrity
+                # check quarantines — terminal error, partial tokens kept
+                self._retire_active(rid, FINISH_ERROR, now)
+                continue
+            position = req.prompt_len + len(rec.tokens)
+            token = sim_token(req.prompt[0], position)
+            rec.tokens.append(token)
+            if req.eos_token is not None and token == req.eos_token:
+                self._retire_active(rid, FINISH_EOS, now)
+            elif len(rec.tokens) >= req.max_new_tokens:
+                self._retire_active(rid, FINISH_LENGTH, now)
+
+    # -- terminal emission (the serving telemetry contract) ----------------
+
+    def _retire_active(self, rid: int, reason: str, now: float) -> None:
+        rec = self._active.pop(rid)
+        self.pool.free(rec.pages)
+        self._finish(rec.request, rec.tokens, reason, rec.submit_ts, now)
+
+    def _finish(self, request: Request, tokens: List[int], reason: str,
+                submit_ts: float, now: float) -> None:
+        result = RequestResult(
+            request_id=request.request_id, prompt_len=request.prompt_len,
+            tokens=list(tokens), finish_reason=reason,
+            queue_s=0.0, prefill_s=0.0, decode_s=0.0,
+            total_s=now - submit_ts,
+            ttft_s=(now - submit_ts) if tokens else None,
+            replica_id=self.replica_id,
+            adapter_id=request.sampling.adapter_id,
+            trace_id=request.trace_id)
+        self.completed[request.request_id] = result
+        self.metrics.inc(f"requests_{reason}")
+        self.metrics.emit_record(result.record(wall=clock.wall()))

@@ -19,6 +19,14 @@ from apex_tpu.analysis.rules.apx005_collectives import APX005Collectives
 from apex_tpu.analysis.rules.apx006_dtype import APX006DtypeDiscipline
 from apex_tpu.analysis.rules.apx007_pallas_scan import APX007PallasScan
 from apex_tpu.analysis.rules.apx008_mutable_state import APX008MutableState
+from apex_tpu.analysis.rules.apx009_record_contract import (
+    APX009RecordContract,
+)
+from apex_tpu.analysis.rules.apx010_scenario_schema import (
+    APX010ScenarioSchema,
+)
+from apex_tpu.analysis.rules.apx011_wall_clock import APX011WallClock
+from apex_tpu.analysis.rules.apx012_counter_bypass import APX012CounterBypass
 
 _RULE_CLASSES = [
     APX001PrngReuse,
@@ -29,6 +37,10 @@ _RULE_CLASSES = [
     APX006DtypeDiscipline,
     APX007PallasScan,
     APX008MutableState,
+    APX009RecordContract,
+    APX010ScenarioSchema,
+    APX011WallClock,
+    APX012CounterBypass,
 ]
 
 __all__ = ["all_rules"] + [c.__name__ for c in _RULE_CLASSES]

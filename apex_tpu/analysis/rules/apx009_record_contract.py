@@ -1,0 +1,150 @@
+"""APX009 — typed-record contract drift (cross-file).
+
+Every structured record the serving stack emits (``emit_record`` with a
+``"kind": "<name>"`` payload) is a three-party contract: the emit site
+increments a counter alongside it (so cheap counter dashboards and the
+full record stream cannot silently diverge), and
+``observability/report.py`` knows the kind (so ``build_report``
+reconciles it instead of dropping it on the floor).  A record emitted
+without its counter — or a kind ``build_report`` has never heard of —
+is how a new subsystem ships telemetry nobody can audit.
+
+Detection (project-wide pass): for each ``emit_record(...)`` call
+outside the observability/analysis planes whose payload is a dict
+literal carrying a constant ``"kind"`` (directly, or via a local
+variable assigned a dict literal in the same function):
+
+- the emitting module must also call ``.inc(...)`` somewhere (the
+  co-sited counter half of the contract — module scope, because
+  well-factored emitters split the record and the counter across
+  sibling helpers like ``deploy.py``'s ``_record``/``_incident``);
+- the kind string must appear in ``observability/report.py`` when that
+  file is part of the analyzed project (the reconcile half).
+
+Calls whose payload is not a dict literal (``result.record(...)`` —
+the typed ``RequestResult`` path) are reconciled by construction and
+skipped.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Sequence
+
+from apex_tpu.analysis.engine import Finding, ModuleContext, Rule, RuleVisitor
+from apex_tpu.analysis.rules._common import walk_functions
+
+#: modules that ARE the metrics/analysis plane — the contract's
+#: consumers, not its emitters
+_EXEMPT_PARTS = ("observability", "analysis", "tests")
+
+
+def _in_tree(path: str, part: str) -> bool:
+    return f"/{part}/" in "/" + path.replace("\\", "/")
+
+
+def _exempt(path: str) -> bool:
+    norm = path.replace("\\", "/")
+    if norm.rsplit("/", 1)[-1].startswith("test_"):
+        return True
+    return any(_in_tree(norm, part) for part in _EXEMPT_PARTS)
+
+
+def _dict_kind(node: ast.AST) -> Optional[str]:
+    """The constant ``"kind"`` value of a dict literal, if any."""
+    if not isinstance(node, ast.Dict):
+        return None
+    for key, value in zip(node.keys, node.values):
+        if (isinstance(key, ast.Constant) and key.value == "kind"
+                and isinstance(value, ast.Constant)
+                and isinstance(value.value, str)):
+            return value.value
+    return None
+
+
+def _enclosing(func_spans, node: ast.AST):
+    """Innermost function whose span contains ``node`` (None = module)."""
+    line = getattr(node, "lineno", 0)
+    best = None
+    for func, start, end in func_spans:
+        if start <= line <= end and (
+                best is None or start >= best[1]):
+            best = (func, start, end)
+    return best[0] if best else None
+
+
+def _resolve_kind(call: ast.Call, scope: Optional[ast.AST],
+                  module_tree: ast.AST) -> Optional[str]:
+    """The record kind flowing into ``emit_record`` — from the argument
+    dict literal, or from the nearest preceding assignment when the
+    argument is a bare name."""
+    if not call.args:
+        return None
+    arg = call.args[0]
+    kind = _dict_kind(arg)
+    if kind is not None:
+        return kind
+    if not isinstance(arg, ast.Name):
+        return None
+    body = scope if scope is not None else module_tree
+    kind = None
+    for node in ast.walk(body):
+        if (isinstance(node, ast.Assign)
+                and node.lineno < call.lineno
+                and any(isinstance(t, ast.Name) and t.id == arg.id
+                        for t in node.targets)):
+            kind = _dict_kind(node.value)
+    return kind
+
+
+class APX009RecordContract(Rule):
+    code = "APX009"
+    name = "record-contract"
+    description = ("emit_record(kind=...) sites need a co-sited counter "
+                   "inc and a build_report reconcile arm for the kind")
+    project = True
+
+    def check_project(self, modules: Sequence[ModuleContext]
+                      ) -> List[Finding]:
+        report_kinds: Optional[set] = None
+        for m in modules:
+            if m.path.replace("\\", "/").endswith(
+                    "observability/report.py"):
+                report_kinds = {n.value for n in ast.walk(m.tree)
+                                if isinstance(n, ast.Constant)
+                                and isinstance(n.value, str)}
+        findings: List[Finding] = []
+        for m in modules:
+            if _exempt(m.path):
+                continue
+            v = RuleVisitor(self, m)
+            spans = [(f, f.lineno, getattr(f, "end_lineno", f.lineno))
+                     for f in walk_functions(m.tree)]
+            module_has_inc = any(
+                isinstance(c, ast.Call)
+                and isinstance(c.func, ast.Attribute)
+                and c.func.attr == "inc"
+                for c in ast.walk(m.tree))
+            for node in ast.walk(m.tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "emit_record"):
+                    continue
+                scope = _enclosing(spans, node)
+                kind = _resolve_kind(node, scope, m.tree)
+                if kind is None:
+                    continue
+                if not module_has_inc:
+                    v.report(node, (
+                        f'kind="{kind}" record emitted with no co-sited '
+                        f"counter — `.inc(...)` the matching counter in "
+                        f"the emitting module so dashboards and the "
+                        f"record stream cannot diverge"))
+                if report_kinds is not None and kind not in report_kinds:
+                    v.report(node, (
+                        f'record kind "{kind}" is unknown to '
+                        f"observability/report.py — add a build_report "
+                        f"reconcile arm or the records are emitted into "
+                        f"a void"))
+            findings.extend(v.findings)
+        return findings

@@ -1,0 +1,118 @@
+"""APX010 — scenario schema drift (cross-file).
+
+The load-test scenario schema lives in three places that must agree:
+the :class:`~apex_tpu.loadtest.scenario.Scenario` dataclass fields, the
+strict ``known`` key set its ``from_dict`` validates against, and the
+``scenario.<attr>`` reads the runner performs.  Drift in any direction
+is a silent contract break: a field missing from ``known`` can never be
+loaded from JSON; a ``known`` key with no field is validated but
+dropped; a runner read of a name the dataclass does not carry is an
+``AttributeError`` waiting for the first scenario that exercises it.
+
+Detection (project-wide pass, fires only when
+``loadtest/scenario.py`` is part of the analyzed set):
+
+- ``known`` keys vs ``Scenario`` field names, both directions;
+- every ``scenario.<attr>`` access in ``loadtest/runner.py`` must name
+  a ``Scenario`` field, property, or method.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Sequence, Set, Tuple
+
+from apex_tpu.analysis.engine import Finding, ModuleContext, Rule, RuleVisitor
+
+_SCENARIO_PATH = "loadtest/scenario.py"
+_RUNNER_PATH = "loadtest/runner.py"
+
+
+def _find_module(modules: Sequence[ModuleContext],
+                 suffix: str) -> Optional[ModuleContext]:
+    for m in modules:
+        if m.path.replace("\\", "/").endswith(suffix):
+            return m
+    return None
+
+
+def _scenario_surface(cls: ast.ClassDef
+                      ) -> Tuple[dict, Set[str], Optional[ast.Assign],
+                                 Set[str]]:
+    """(field name -> AnnAssign, method/property names, the ``known``
+    assignment inside ``from_dict``, its key set)."""
+    fields: dict = {}
+    callables: Set[str] = set()
+    known_node: Optional[ast.Assign] = None
+    known: Set[str] = set()
+    for stmt in cls.body:
+        if (isinstance(stmt, ast.AnnAssign)
+                and isinstance(stmt.target, ast.Name)
+                and not stmt.target.id.startswith("_")):
+            fields[stmt.target.id] = stmt
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            callables.add(stmt.name)
+            if stmt.name != "from_dict":
+                continue
+            for node in ast.walk(stmt):
+                if (isinstance(node, ast.Assign)
+                        and any(isinstance(t, ast.Name)
+                                and t.id == "known"
+                                for t in node.targets)
+                        and isinstance(node.value, ast.Set)):
+                    known_node = node
+                    known = {e.value for e in node.value.elts
+                             if isinstance(e, ast.Constant)
+                             and isinstance(e.value, str)}
+    return fields, callables, known_node, known
+
+
+class APX010ScenarioSchema(Rule):
+    code = "APX010"
+    name = "scenario-schema-drift"
+    description = ("Scenario fields, from_dict's strict key set, and the "
+                   "runner's attribute reads must agree")
+    project = True
+
+    def check_project(self, modules: Sequence[ModuleContext]
+                      ) -> List[Finding]:
+        scen = _find_module(modules, _SCENARIO_PATH)
+        if scen is None:
+            return []
+        cls = next((n for n in ast.walk(scen.tree)
+                    if isinstance(n, ast.ClassDef)
+                    and n.name == "Scenario"), None)
+        if cls is None:
+            return []
+        fields, callables, known_node, known = _scenario_surface(cls)
+        findings: List[Finding] = []
+
+        v = RuleVisitor(self, scen)
+        if known_node is not None:
+            for key in sorted(known - set(fields)):
+                v.report(known_node, (
+                    f"from_dict accepts key {key!r} but Scenario has no "
+                    f"such field — the key validates, then vanishes"))
+            for name in sorted(set(fields) - known):
+                v.report(fields[name], (
+                    f"Scenario field {name!r} is missing from "
+                    f"from_dict's strict key set — no JSON scenario can "
+                    f"ever set it"))
+        findings.extend(v.findings)
+
+        runner = _find_module(modules, _RUNNER_PATH)
+        if runner is not None:
+            surface = set(fields) | callables
+            rv = RuleVisitor(self, runner)
+            for node in ast.walk(runner.tree):
+                if (isinstance(node, ast.Attribute)
+                        and isinstance(node.value, ast.Name)
+                        and node.value.id == "scenario"
+                        and node.attr not in surface
+                        and not node.attr.startswith("__")):
+                    rv.report(node, (
+                        f"runner reads scenario.{node.attr} but Scenario "
+                        f"defines no such field/property — "
+                        f"AttributeError on first use"))
+            findings.extend(rv.findings)
+        return findings

@@ -1,0 +1,68 @@
+"""APX011 — wall-clock hygiene in the serving/loadtest planes.
+
+Everything under ``serving/`` and ``loadtest/`` must tell time through
+:mod:`apex_tpu.serving.clock` (``clock.now()``/``clock.wall()``/
+``clock.sleep()``).  A direct ``time.time()``/``time.monotonic()``/
+``perf_counter()``/``time.sleep()`` read punches through the virtual
+clock: the model checker's deterministic schedules stop being
+deterministic, and replay traces stop replaying.  The clock module
+itself is the single sanctioned consumer of :mod:`time` in those trees.
+
+Detection: any resolved call to the :mod:`time` entry points below, in
+a module whose path lies under ``serving/`` or ``loadtest/`` — except
+``serving/clock.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from apex_tpu.analysis.engine import Finding, ModuleContext, Rule, RuleVisitor
+
+_WALL_CLOCK_CALLS = {
+    "time.time", "time.time_ns",
+    "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns",
+    "time.sleep",
+}
+
+#: replacement suggestions, keyed by the time.* entry point
+_SUBSTITUTE = {
+    "time.time": "clock.wall()", "time.time_ns": "clock.wall()",
+    "time.monotonic": "clock.now()", "time.monotonic_ns": "clock.now()",
+    "time.perf_counter": "clock.now()",
+    "time.perf_counter_ns": "clock.now()",
+    "time.sleep": "clock.sleep()",
+}
+
+
+def _scoped(path: str) -> bool:
+    norm = "/" + path.replace("\\", "/")
+    if norm.endswith("/serving/clock.py"):
+        return False
+    return "/serving/" in norm or "/loadtest/" in norm
+
+
+class APX011WallClock(Rule):
+    code = "APX011"
+    name = "wall-clock-hygiene"
+    description = ("direct time.time/monotonic/perf_counter/sleep in "
+                   "serving/ or loadtest/ bypasses the virtual clock "
+                   "seam — use apex_tpu.serving.clock")
+
+    def check(self, module: ModuleContext) -> List[Finding]:
+        if not _scoped(module.path):
+            return []
+        v = RuleVisitor(self, module)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = v.resolve(node.func)
+            if fname in _WALL_CLOCK_CALLS:
+                v.report(node, (
+                    f"`{fname}()` bypasses the clock seam — use "
+                    f"`{_SUBSTITUTE[fname]}` so VirtualClock schedules "
+                    f"(model checker, scenario replay) stay "
+                    f"deterministic"))
+        return v.findings

@@ -1,0 +1,75 @@
+"""APX012 — fleet incident counters mutated without their typed event.
+
+The fleet's incident/action counters (``replica_drains``,
+``deploys_rolled_back``, ...) are one half of a pair: every increment
+is supposed to ride the typed-record emit helper that also writes the
+matching ``.event(...)`` record, so counters and event streams
+reconcile key-for-key (the model checker's ``counter_reconcile``
+invariant enforces exactly this at runtime).  A bare ``.inc(...)`` of
+one of these counters with no event in the same function is a bypass:
+the counter drifts ahead of the record stream and every downstream
+audit (build_report, the mc invariant, dashboards) disagrees about how
+many incidents happened.
+
+Detection: in ``serving/`` modules, a call ``*.inc("<counter>")`` with
+the constant naming one of the paired fleet counters, inside a function
+that never calls ``.event(...)``.  High-frequency counters that are
+deliberately unpaired (``fleet_dispatches``, per-replica dispatch
+counts) are not in the set.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from apex_tpu.analysis.engine import Finding, ModuleContext, Rule, RuleVisitor
+from apex_tpu.analysis.rules._common import walk_functions
+
+#: counters contractually paired with a same-name event record — keep in
+#: sync with apex_tpu.analysis.mc.invariants.COUNTER_EVENTS
+_PAIRED_COUNTERS = frozenset({
+    "replica_drains", "replica_rebuilds", "requests_migrated",
+    "replica_scale_ups", "replica_scale_downs",
+    "deploys_started", "deploys_completed", "deploys_rolled_back",
+    "deploys_rejected", "canary_promotions",
+})
+
+
+def _scoped(path: str) -> bool:
+    return "/serving/" in "/" + path.replace("\\", "/")
+
+
+class APX012CounterBypass(Rule):
+    code = "APX012"
+    name = "counter-bypass"
+    description = ("paired fleet counter inc'd outside a typed-record "
+                   "emit helper (no co-sited .event call)")
+
+    def check(self, module: ModuleContext) -> List[Finding]:
+        if not _scoped(module.path):
+            return []
+        v = RuleVisitor(self, module)
+        for func in walk_functions(module.tree):
+            incs = []
+            has_event = False
+            for node in ast.walk(func):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)):
+                    continue
+                if node.func.attr == "event":
+                    has_event = True
+                elif (node.func.attr == "inc" and node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and node.args[0].value in _PAIRED_COUNTERS):
+                    incs.append(node)
+            if has_event:
+                continue
+            for node in incs:
+                counter = node.args[0].value
+                v.report(node, (
+                    f"`{counter}` inc'd with no `.event(...)` in "
+                    f"'{func.name}' — route the increment through the "
+                    f"typed-record helper so counters and event streams "
+                    f"reconcile"))
+        return v.findings

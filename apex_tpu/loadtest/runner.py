@@ -24,7 +24,6 @@ SLOReport`, and the same records land in ``log_path`` when given.
 from __future__ import annotations
 
 import tempfile
-import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
@@ -53,6 +52,7 @@ from apex_tpu.serving import (
     SupervisorConfig,
     UnknownAdapterError,
 )
+from apex_tpu.serving import clock
 from apex_tpu.utils.logging import get_logger, log_event
 
 __all__ = ["ScenarioRun", "build_model", "run_scenario"]
@@ -108,11 +108,18 @@ class ScenarioRun:
     #: the final FleetMetrics.signals() poll (fleet scenarios only) —
     #: also stamped into the log as the kind="signals" record
     signals: Optional[dict] = None
+    #: recompilations beyond the engines' expected warmup compiles, from
+    #: the RetraceWatchdogs every engine wraps its step programs in —
+    #: must be 0; a storm fails the run even when every SLO passes
+    retraces: int = 0
 
     @property
     def ok(self) -> bool:
-        """SLO verdict (vacuously true when no SLOs are declared)."""
-        return self.slo.ok if self.slo is not None else True
+        """SLO verdict (vacuously true when no SLOs are declared) —
+        AND'd with the retrace watchdogs: a recompilation storm is a
+        perf bug even when the SLOs it hasn't yet sunk still pass."""
+        slo_ok = self.slo.ok if self.slo is not None else True
+        return slo_ok and self.retraces == 0
 
 
 def _build_serving(scenario: Scenario, model, params,
@@ -251,7 +258,7 @@ def run_scenario(scenario: Scenario, *, model=None, params=None,
     registry.emit_record({
         "kind": "scenario", "name": scenario.name, "seed": scenario.seed,
         "total_requests": scenario.total_requests,
-        "slo": dict(scenario.slo), "wall": time.time()})
+        "slo": dict(scenario.slo), "wall": clock.wall()})
 
     schedule = TrafficGenerator(scenario).schedule()
     sup = _build_serving(scenario, model, params, registry)
@@ -286,13 +293,13 @@ def run_scenario(scenario: Scenario, *, model=None, params=None,
                 and len(sup.replicas) > scaler.config.min_replicas)
 
     autoscaling = getattr(sup, "autoscaler", None) is not None
-    t0 = time.monotonic()
+    t0 = clock.now()
     i = 0
     try:
         while (i < len(schedule) or sup.inflight_count or d < len(drains)
                or not deploy_fired or _deploy_active()
                or _autoscale_settling()):
-            now = time.monotonic() - t0
+            now = clock.now() - t0
             if now > scenario.max_wall_s:
                 run.aborted = True
                 _abort(sup, scenario, registry, now)
@@ -341,9 +348,9 @@ def run_scenario(scenario: Scenario, *, model=None, params=None,
                 sup.tick()
                 run.ticks += 1
             elif i < len(schedule):
-                gap = (t0 + schedule[i].at_s) - time.monotonic()
+                gap = (t0 + schedule[i].at_s) - clock.now()
                 if gap > 0:
-                    time.sleep(min(gap, _IDLE_SLEEP_S))
+                    clock.sleep(min(gap, _IDLE_SLEEP_S))
                 if autoscaling:
                     # idle ticks keep the autoscaler's poll clock alive
                     # through traffic gaps (scale-down happens here)
@@ -353,18 +360,18 @@ def run_scenario(scenario: Scenario, *, model=None, params=None,
                     or _autoscale_settling():
                 # waiting on a scheduled drain/deploy, or for the
                 # autoscaler to retire back to min_replicas
-                time.sleep(_IDLE_SLEEP_S)
+                clock.sleep(_IDLE_SLEEP_S)
                 if autoscaling:
                     sup.tick()
                     run.ticks += 1
     finally:
-        run.wall_s = time.monotonic() - t0
+        run.wall_s = clock.now() - t0
         if hasattr(sup, "replica_metrics"):
             # final autoscaler poll, stamped into the log before the
             # close-time snapshots so signals precede the counters they
             # must reconcile with
             run.signals = FleetMetrics(sup).signals()
-            registry.emit_record({"kind": "signals", "wall": time.time(),
+            registry.emit_record({"kind": "signals", "wall": clock.wall(),
                                   "values": run.signals})
         sup.close()             # flushes the final counter snapshot
         if scratch is not None:
@@ -372,6 +379,16 @@ def run_scenario(scenario: Scenario, *, model=None, params=None,
     run.results = dict(sup.completed)
     run.counters = registry.counters()
     run.engine_restarts = sup.restarts
+    # the engines' RetraceWatchdogs mirror every counted recompile into
+    # the shared registry; surface the total and fail loudly — a storm
+    # that the resilience layer papered over (restart + re-warm) must
+    # not pass a load test silently
+    run.retraces = int(run.counters.get("retraces", 0))
+    if run.retraces:
+        log_event(_LOG, "scenario_retraces", scenario=scenario.name,
+                  retraces=run.retraces, level="error")
+        registry.event("scenario_retraces", scenario=scenario.name,
+                       retraces=run.retraces)
     if scenario.slo:
         run.slo = evaluate_slos(mem.records,
                                 SLOSpec.from_dict(scenario.slo))

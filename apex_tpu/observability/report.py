@@ -240,6 +240,12 @@ def _serving_incidents(events: List[dict]) -> Optional[dict]:
         name = e.get("event")
         if name in SERVING_INCIDENT_COUNTERS:
             counts[name] = counts.get(name, 0) + 1
+        elif name == "retrace":
+            # RetraceWatchdog mirror — surfaced in the incident counts
+            # but kept OUT of the strict one-inc-per-event mapping: a
+            # single event can cover a batched _cache_size jump, so the
+            # ``retraces`` counter may run ahead of the event count.
+            counts[name] = counts.get(name, 0) + 1
         elif name == "request_shed":
             reason = str(e.get("reason", "?"))
             shed[reason] = shed.get(reason, 0) + 1

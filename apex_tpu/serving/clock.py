@@ -1,0 +1,159 @@
+"""The serving clock seam — every time read in ``serving/`` and
+``loadtest/`` goes through this module.
+
+The Router/supervisor/scheduler stack is pure host-side logic, but until
+this module existed it read ``time.monotonic()``/``time.time()`` directly
+in ~50 places, welding the control plane to real wall-clock time. That
+made every fleet experiment pay for real seconds (drain grace periods,
+autoscaler cooldowns, canary windows) and made systematic exploration of
+interleavings impossible — a schedule explorer cannot enumerate "what if
+the cooldown expired before the drain finished" when the clock is the
+kernel's.
+
+Three reads, one seam:
+
+- :func:`now` — the monotonic clock: durations, deadlines, cooldowns,
+  drain grace. Never steps backwards; not meaningful across processes.
+- :func:`wall` — the epoch clock: the ``"wall"`` stamp on telemetry
+  records so events correlate across hosts and runs.
+- :func:`sleep` — open-loop pacing (the loadtest runner's arrival gaps,
+  the supervisor's restart backoff).
+
+By default they delegate to :class:`SystemClock` (the real ``time``
+module — production behavior is byte-identical). Under
+:func:`use_clock` a :class:`VirtualClock` substitutes: time advances
+only when the driver says so (``clock.advance(5.0)``), sleeps return
+instantly after advancing, and a million-tick fleet scenario runs in
+milliseconds of real time. This is the first leg of the ROADMAP
+"million-user scheduling lab": the model checker
+(:mod:`apex_tpu.analysis.mc`) and a future discrete-event simulator
+both drive the REAL fleet code through this seam.
+
+The seam is enforced statically: lint rule APX011
+(:mod:`apex_tpu.analysis.rules.apx011_wall_clock`) fails the tier-1
+gate on any direct ``time.time``/``time.monotonic``/``perf_counter``
+read in ``serving/`` or ``loadtest/`` outside this module.
+
+Thread-safety: the active clock is swapped under a lock, and
+:class:`VirtualClock` serializes its own state — supervisor watchdog
+threads may read it while the driver advances it.
+"""
+
+from __future__ import annotations
+
+import threading
+import time as _time  # the ONE sanctioned wall-clock import in serving/
+from contextlib import contextmanager
+from typing import Iterator, Optional
+
+__all__ = ["Clock", "SystemClock", "VirtualClock",
+           "now", "wall", "sleep", "get_clock", "use_clock"]
+
+
+class Clock:
+    """The time interface serving code programs against."""
+
+    def now(self) -> float:
+        """Monotonic seconds — durations, deadlines, cooldowns."""
+        raise NotImplementedError
+
+    def wall(self) -> float:
+        """Epoch seconds — the ``"wall"`` stamp on telemetry records."""
+        raise NotImplementedError
+
+    def sleep(self, seconds: float) -> None:
+        """Pause the caller for ``seconds`` (virtually or for real)."""
+        raise NotImplementedError
+
+
+class SystemClock(Clock):
+    """Production clock: delegates to the real ``time`` module."""
+
+    def now(self) -> float:
+        return _time.monotonic()
+
+    def wall(self) -> float:
+        return _time.time()
+
+    def sleep(self, seconds: float) -> None:
+        if seconds > 0:
+            _time.sleep(seconds)
+
+
+class VirtualClock(Clock):
+    """Deterministic clock for simulation and model checking.
+
+    Time advances ONLY via :meth:`advance` (or a :meth:`sleep`, which
+    models the caller waiting by advancing the clock and returning
+    immediately). ``start``/``epoch`` pin the initial monotonic and
+    wall readings so replays are bit-identical run to run.
+    """
+
+    def __init__(self, start: float = 1000.0,
+                 epoch: float = 1_700_000_000.0):
+        self._lock = threading.Lock()
+        self._now = float(start)
+        self._epoch_offset = float(epoch) - float(start)
+
+    def now(self) -> float:
+        with self._lock:
+            return self._now
+
+    def wall(self) -> float:
+        with self._lock:
+            return self._now + self._epoch_offset
+
+    def sleep(self, seconds: float) -> None:
+        self.advance(seconds)
+
+    def advance(self, seconds: float) -> float:
+        """Move time forward by ``seconds`` (negative is refused — the
+        monotonic contract holds for virtual time too). Returns the new
+        reading."""
+        if seconds < 0:
+            raise ValueError(f"cannot advance a clock by {seconds}")
+        with self._lock:
+            self._now += float(seconds)
+            return self._now
+
+
+_lock = threading.Lock()
+_active: Clock = SystemClock()
+
+
+def get_clock() -> Clock:
+    """The process-wide active clock (a :class:`SystemClock` unless a
+    driver installed a virtual one via :func:`use_clock`)."""
+    return _active
+
+
+def now() -> float:
+    """Monotonic seconds from the active clock."""
+    return _active.now()
+
+
+def wall() -> float:
+    """Epoch seconds from the active clock."""
+    return _active.wall()
+
+
+def sleep(seconds: float) -> None:
+    """Sleep on the active clock (instant under a virtual clock)."""
+    _active.sleep(seconds)
+
+
+@contextmanager
+def use_clock(clock: Optional[Clock]) -> Iterator[Clock]:
+    """Install ``clock`` as the active clock for the ``with`` body,
+    restoring the previous clock on exit. ``None`` means a fresh
+    :class:`SystemClock`. Reentrant; the restore nests correctly."""
+    global _active
+    installed = clock if clock is not None else SystemClock()
+    with _lock:
+        previous = _active
+        _active = installed
+    try:
+        yield installed
+    finally:
+        with _lock:
+            _active = previous

@@ -51,7 +51,6 @@ Architecture (docs/serving.md has the full walkthrough):
 
 from __future__ import annotations
 
-import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence
 
@@ -91,6 +90,7 @@ from apex_tpu.serving.request import (
     RequestResult,
 )
 from apex_tpu.lora import UnknownAdapterError
+from apex_tpu.serving import clock
 from apex_tpu.serving.prefix import (
     adapter_salt,
     prefix_hash_chain,
@@ -962,7 +962,7 @@ class InferenceEngine:
                 f"prompt ({request.prompt_len}) + max_new_tokens "
                 f"({request.max_new_tokens}) exceeds the engine's max_len "
                 f"({self.config.max_len})")
-        now = time.monotonic()
+        now = clock.now()
         if not resubmission:
             self.metrics.inc("requests_submitted")
         aid = request.sampling.adapter_id
@@ -1014,7 +1014,7 @@ class InferenceEngine:
         if queued is not None:
             request, submit_ts = queued
             self._finish(request, [], FINISH_CANCELLED, submit_ts=submit_ts,
-                         now=time.monotonic())
+                         now=clock.now())
             return True
         for rec in (*self._active.values(), *self._prefilling.values()):
             if rec.request.request_id == request_id:
@@ -1030,7 +1030,7 @@ class InferenceEngine:
         if self._closed:
             raise RuntimeError("engine is closed")
         finished: List[RequestResult] = []
-        now = time.monotonic()
+        now = clock.now()
         self._expire(now, finished)
         self._evict_cancelled(finished)
         self._chunk_tokens_tick = 0
@@ -1141,12 +1141,12 @@ class InferenceEngine:
             rec = self._active[slot]
             if rec.cancelled:
                 finished.append(self._retire(
-                    rec, FINISH_CANCELLED, time.monotonic()))
+                    rec, FINISH_CANCELLED, clock.now()))
         for slot in list(self._prefilling):
             rec = self._prefilling[slot]
             if rec.cancelled:
                 finished.append(self._abandon_prefill(
-                    rec, FINISH_CANCELLED, time.monotonic()))
+                    rec, FINISH_CANCELLED, clock.now()))
 
     def _plan_prefix(self, request: Request):
         """Match ``request``'s page-aligned prompt prefix against the
@@ -1230,7 +1230,7 @@ class InferenceEngine:
         batch = self.scheduler.pop_admissible(
             self.slots.free_count, decoding=bool(self._active),
             predicate=self._make_page_predicate(), shed=shed)
-        now = time.monotonic()
+        now = clock.now()
         for request, submit_ts in shed:
             finished.append(self._shed_pages(request, submit_ts, now))
         for request, submit_ts in batch:
@@ -1267,7 +1267,7 @@ class InferenceEngine:
             batch = self.scheduler.pop_admissible(
                 1, decoding=False, predicate=self._make_page_predicate(),
                 shed=shed)
-            now = time.monotonic()
+            now = clock.now()
             for request, submit_ts in shed:
                 finished.append(self._shed_pages(request, submit_ts, now))
             if not batch:
@@ -1304,7 +1304,7 @@ class InferenceEngine:
     def _prefill_into(self, request: Request, slot: int, submit_ts: float,
                       finished: List[RequestResult]) -> None:
         rec = _Active(request, slot, submit_ts)
-        rec.prefill_start = time.monotonic()
+        rec.prefill_start = clock.now()
         sp = request.sampling
         # resolve the adapter row NOW (non-strict: an id unloaded while
         # queued degrades to the null row — base output — rather than
@@ -1419,7 +1419,7 @@ class InferenceEngine:
                 self.pages.intern_prefix(
                     chain,
                     [int(p) for p in self._page_table_h[slot][:len(chain)]])
-        rec.prefill_end = time.monotonic()
+        rec.prefill_end = clock.now()
         rec.tokens.append(first)
         rec.last_token = first
         # token #1 lands with the prefill result — TTFT is submit -> here
@@ -1432,7 +1432,7 @@ class InferenceEngine:
         self._sync_slot(rec)
         done = self._finish_reason(rec, first)
         if done is not None:
-            finished.append(self._retire(rec, done, time.monotonic()))
+            finished.append(self._retire(rec, done, clock.now()))
 
     def _begin_chunked_prefill(self, request: Request, slot: int,
                                submit_ts: float) -> Optional[_Active]:
@@ -1446,7 +1446,7 @@ class InferenceEngine:
         from decode with no program or shape change. Returns None when
         an intern-eviction race requeued the request (FCFS front)."""
         rec = _Active(request, slot, submit_ts)
-        rec.prefill_start = time.monotonic()
+        rec.prefill_start = clock.now()
         rec.adapter_ix = self._adapter_index(request.sampling.adapter_id,
                                              strict=False)
         if self.pages is not None:
@@ -1559,7 +1559,7 @@ class InferenceEngine:
         self.metrics.inc("prefill_chunks")
         self._chunk_tokens_tick += chunk_len
         if rec.prefill_pos < request.prompt_len:
-            rec.chunk_marks.append(time.monotonic())
+            rec.chunk_marks.append(clock.now())
         else:
             self._complete_chunked_prefill(rec, first, finished)
         return chunk_len
@@ -1589,7 +1589,7 @@ class InferenceEngine:
                     self.pages.intern_prefix(
                         rec.chain,
                         [int(p) for p in rec.page_row[:len(rec.chain)]])
-        rec.prefill_end = time.monotonic()
+        rec.prefill_end = clock.now()
         rec.tokens.append(first)
         rec.last_token = first
         # token #1 is emitted by THIS tick's final chunk — TTFT stamps
@@ -1602,7 +1602,7 @@ class InferenceEngine:
         self._sync_slot(rec)
         done = self._finish_reason(rec, first)
         if done is not None:
-            finished.append(self._retire(rec, done, time.monotonic()))
+            finished.append(self._retire(rec, done, clock.now()))
 
     def _abandon_prefill(self, rec: _Active, reason: str,
                          now: float) -> RequestResult:
@@ -1702,7 +1702,7 @@ class InferenceEngine:
             nxt, finite = self._faults.corrupt_decode(nxt, finite)
         self.metrics.inc("decode_steps")
         self.metrics.observe("decode_batch_size", len(self._active))
-        now = time.monotonic()
+        now = clock.now()
         if self._spec:
             self._accept_windows(nxt, finite, now, finished)
             return
@@ -1789,7 +1789,7 @@ class InferenceEngine:
         worst case, so the extend cannot fail — the defensive branch
         retires the slot as an error rather than corrupting a foreign
         page, and counts the shed so the monitor surfaces it."""
-        now = time.monotonic()
+        now = clock.now()
         for slot in sorted(self._active):
             rec = self._active[slot]
             # a speculative step appends K/V for the whole verify
@@ -1846,7 +1846,7 @@ class InferenceEngine:
         emit_span(self.metrics, SPAN_QUARANTINE,
                   trace_id=rec.request.trace_id,
                   request_id=rec.request.request_id,
-                  start_s=now, end_s=now, wall=time.time(),
+                  start_s=now, end_s=now, wall=clock.wall(),
                   replica_id=self.replica_id, detail=cause)
         return self._retire(rec, FINISH_ERROR, now, scrub=True)
 
@@ -1908,7 +1908,7 @@ class InferenceEngine:
                       trace_id=rec.request.trace_id,
                       request_id=rec.request.request_id,
                       start_s=rec.prefill_end, end_s=now,
-                      wall=time.time(), replica_id=self.replica_id,
+                      wall=clock.wall(), replica_id=self.replica_id,
                       proposed=rec.spec_proposed,
                       accepted=rec.spec_accepted)
         return self._finish(
@@ -1959,7 +1959,7 @@ class InferenceEngine:
         emit_request_spans(
             self.metrics, trace_id=request.trace_id,
             request_id=request.request_id, submit_ts=submit_ts, now=now,
-            wall=time.time(), prefill_start=prefill_start,
+            wall=clock.wall(), prefill_start=prefill_start,
             prefill_end=prefill_end, replica_id=self.replica_id,
             prefill_segments=prefill_segments, detail=detail)
         for name, value in (("request_queue_s", result.queue_s),
@@ -1974,7 +1974,7 @@ class InferenceEngine:
             self.metrics.observe("request_ttft_s", result.ttft_s)
         if result.tpot_s is not None:
             self.metrics.observe("request_tpot_s", result.tpot_s)
-        self.metrics.emit_record(result.record(wall=time.time()))
+        self.metrics.emit_record(result.record(wall=clock.wall()))
         if reason in (FINISH_REJECTED, FINISH_TIMEOUT, FINISH_CANCELLED,
                       FINISH_ERROR):
             extra = {"reason": detail} if detail else {}

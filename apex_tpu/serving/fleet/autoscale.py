@@ -37,11 +37,11 @@ engine, or jax.
 
 from __future__ import annotations
 
-import time
 from dataclasses import dataclass
 from typing import List, Optional, Tuple
 
 from apex_tpu.observability.fleet_metrics import FleetMetrics
+from apex_tpu.serving import clock
 from apex_tpu.utils.logging import get_logger, log_event
 
 __all__ = ["AutoscaleConfig", "Autoscaler"]
@@ -195,7 +195,7 @@ class Autoscaler:
         else None. Safe to call every tick — the poll interval is
         enforced internally."""
         if now is None:
-            now = time.monotonic()
+            now = clock.now()
         if (self._last_poll is not None
                 and now - self._last_poll < self.config.poll_interval_s):
             return None
@@ -251,7 +251,7 @@ class Autoscaler:
             "reason": reason,
             "n_replicas": fleet.n_replicas,
             "signals": excerpt,
-            "wall": time.time()})
+            "wall": clock.wall()})
         return direction
 
     @staticmethod

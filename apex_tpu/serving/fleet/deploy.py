@@ -45,7 +45,6 @@ key-for-key.
 
 from __future__ import annotations
 
-import time
 from dataclasses import dataclass
 from typing import Any, List, Optional, Set
 
@@ -54,6 +53,7 @@ from apex_tpu.checkpoint import (
     ShardedCheckpointManager,
 )
 from apex_tpu.observability.registry import percentile
+from apex_tpu.serving import clock
 from apex_tpu.serving.fleet.router import (
     REPLICA_ACTIVE,
     REPLICA_FAILED,
@@ -208,7 +208,7 @@ class Deployment:
 
     def _record(self, fleet, action: str, **fields) -> None:
         rec = {"kind": "deploy", "action": action,
-               "target": self.describe(), "wall": time.time()}
+               "target": self.describe(), "wall": clock.wall()}
         rec.update(fields)
         fleet.metrics.emit_record(rec)
 
@@ -228,7 +228,7 @@ class Deployment:
         Raises (after recording ``deploy_rejected``) when the
         checkpoint fails its fsck or the adapter cannot load — no
         replica has been touched yet in either case."""
-        now = time.monotonic()
+        now = clock.now()
         if self.adapter_id is not None:
             self._start_adapter(fleet, now)
             return

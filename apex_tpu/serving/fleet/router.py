@@ -63,7 +63,6 @@ reconciling the two key-for-key.
 
 from __future__ import annotations
 
-import time
 from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -76,6 +75,7 @@ from apex_tpu.observability.trace import (
     SPAN_SHED,
     emit_span,
 )
+from apex_tpu.serving import clock
 from apex_tpu.serving.engine import EngineConfig
 from apex_tpu.serving.prefix import (
     adapter_salt,
@@ -527,7 +527,7 @@ deploy.Deployment`, or None if :meth:`deploy` was never called."""
         terminally, by the replica, with its ``replica_id``)."""
         if self._closed:
             raise RuntimeError("fleet is closed")
-        now = time.monotonic()
+        now = clock.now()
         candidates = self.dispatch_set()
         if not candidates:
             self._shed_fleet(request, now)
@@ -579,7 +579,7 @@ deploy.Deployment`, or None if :meth:`deploy` was never called."""
             queue_s=now - start, total_s=now - start,
             trace_id=request.trace_id)
         self.completed[request.request_id] = result
-        wall = time.time()
+        wall = clock.wall()
         # front-door shed: one shed phase span, no replica_id (the
         # request never reached one)
         emit_span(self.metrics, SPAN_SHED, trace_id=request.trace_id,
@@ -603,7 +603,7 @@ deploy.Deployment`, or None if :meth:`deploy` was never called."""
     def cancel(self, request_id: int) -> bool:
         """Cancel wherever the request currently lives: the migration
         backlog, or (sticky) the replica it was dispatched to."""
-        now = time.monotonic()
+        now = clock.now()
         tr = self._tracked.get(request_id)
         if tr is None:
             return False
@@ -638,9 +638,9 @@ deploy.Deployment`, or None if :meth:`deploy` was never called."""
             if replica.state == REPLICA_FAILED:
                 continue
             replica.supervisor.tick()
-            self._harvest_replica(replica, time.monotonic())
+            self._harvest_replica(replica, clock.now())
         self._advance_drains()
-        now = time.monotonic()
+        now = clock.now()
         if self._deployment is not None and not self._deployment.done:
             self._deployment.step(self, now)
         if self.autoscaler is not None:
@@ -721,7 +721,7 @@ deploy.Deployment`, or None if :meth:`deploy` was never called."""
     def _migrate_from(self, replica: _Replica) -> None:
         """Detach the draining replica's non-terminal work as token-exact
         continuations and queue them for peers."""
-        now = time.monotonic()
+        now = clock.now()
         conts = replica.supervisor.detach_for_migration()
         self._harvest_replica(replica, now)   # detach may retire some
         for cont, recovered in conts:
@@ -745,7 +745,7 @@ deploy.Deployment`, or None if :meth:`deploy` was never called."""
             emit_span(self.metrics, SPAN_MIGRATION,
                       trace_id=cont.trace_id,
                       request_id=cont.request_id, start_s=now,
-                      end_s=now, wall=time.time(),
+                      end_s=now, wall=clock.wall(),
                       from_replica=replica.replica_id,
                       tokens_carried=len(recovered))
             self._backlog.append(cont)
@@ -777,7 +777,7 @@ deploy.Deployment`, or None if :meth:`deploy` was never called."""
             except (QueueFullError, DeadlineExpiredError,
                     EngineUnavailableError):
                 # recorded terminally by the replica — harvest below
-                self._harvest_replica(replica, time.monotonic())
+                self._harvest_replica(replica, clock.now())
                 continue
             tr.replica_id = replica.replica_id
             self._count_dispatch(replica)
@@ -880,7 +880,7 @@ deploy.Deployment`, or None if :meth:`deploy` was never called."""
         (the registry moves to ``retired_replica_metrics`` so merged
         fleet totals keep reconciling with the parent)."""
         rid = replica.replica_id
-        self._harvest_replica(replica, time.monotonic())
+        self._harvest_replica(replica, clock.now())
         self._engine_restarts_base += replica.supervisor.restarts
         replica.supervisor.close()
         self.replicas.remove(replica)
@@ -1033,7 +1033,7 @@ deploy.Deployment`, or None if :meth:`deploy` was never called."""
             trace_id=tr.request.trace_id)
         self.completed[rid] = result
         self.metrics.inc(f"requests_{reason}")
-        wall = time.time()
+        wall = clock.wall()
         # no replica will ever finish this request (it died in the
         # migration backlog), so the fleet owns its timeline: one coarse
         # phase span over the whole fleet-tracked lifetime

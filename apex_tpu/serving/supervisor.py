@@ -47,11 +47,11 @@ by the supervisor itself.
 
 from __future__ import annotations
 
-import time
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence
 
 from apex_tpu.observability import MetricsRegistry
+from apex_tpu.serving import clock
 from apex_tpu.observability.trace import (
     SPAN_DECODE,
     SPAN_SHED,
@@ -306,7 +306,7 @@ class EngineSupervisor:
         many replicas the request visited."""
         if self._closed:
             raise RuntimeError("supervisor is closed")
-        now = time.monotonic()
+        now = clock.now()
         self._poll_breaker(now)
         if self.breaker_state == BREAKER_OPEN:
             self._shed(request, "breaker", now, resubmission=resubmission)
@@ -357,7 +357,7 @@ class EngineSupervisor:
             queue_s=now - start, total_s=now - start,
             replica_id=self.replica_id, trace_id=request.trace_id)
         self.completed[request.request_id] = result
-        wall = time.time()
+        wall = clock.wall()
         # one shed phase span covering the request's whole (rejected)
         # lifetime — span-sum == total_s for admission sheds too
         emit_span(self.metrics, SPAN_SHED, trace_id=request.trace_id,
@@ -381,7 +381,7 @@ class EngineSupervisor:
 
     def cancel(self, request_id: int) -> bool:
         """Cancel a queued, in-flight, or restart-pending request."""
-        now = time.monotonic()
+        now = clock.now()
         for i, cont in enumerate(self._backlog):
             if cont.request_id == request_id:
                 del self._backlog[i]
@@ -403,11 +403,11 @@ class EngineSupervisor:
         if self._closed:
             raise RuntimeError("supervisor is closed")
         before = set(self.completed)
-        now = time.monotonic()
+        now = clock.now()
         self._poll_breaker(now)
         self._drain_backlog()
         compiles = self.engine.prefill_compiles + self.engine.decode_compiles
-        t0 = time.monotonic()
+        t0 = clock.now()
         failure: Optional[str] = None
         try:
             self.engine.tick()
@@ -415,7 +415,7 @@ class EngineSupervisor:
             failure = f"{type(exc).__name__}: {exc}"
         else:
             hung = self.supervisor.hung_tick_s
-            elapsed = time.monotonic() - t0
+            elapsed = clock.now() - t0
             # warmup ticks are exempt: a bounded, expected XLA compile
             # (fresh engine, new prefill bucket) is not a hang
             compiled = (self.engine.prefill_compiles
@@ -429,7 +429,7 @@ class EngineSupervisor:
             self._consecutive_failures = 0
             if self.breaker_state == BREAKER_HALF_OPEN:
                 self._breaker_to(BREAKER_CLOSED)
-            self._harvest(time.monotonic())
+            self._harvest(clock.now())
         return [self.completed[rid] for rid in sorted(
             set(self.completed) - before)]
 
@@ -484,7 +484,7 @@ class EngineSupervisor:
         results survive as-is, queued requests requeue for free, and
         every in-flight request re-prefills from prompt + generated
         tokens (bounded by its retry budget)."""
-        now = time.monotonic()
+        now = clock.now()
         old = self.engine
         self._harvest(now)       # anything terminal before the fault
         queued = {r.request_id for r, _ in old.scheduler.snapshot()}
@@ -556,7 +556,7 @@ class EngineSupervisor:
                 self.engine.submit(cont, resubmission=True)
             except (QueueFullError, DeadlineExpiredError):
                 # terminal in the engine (recorded there) — harvest below
-                self._harvest(time.monotonic())
+                self._harvest(clock.now())
 
     def _retire_supervised(self, tr: _Tracked, reason: str, now: float,
                            detail: Optional[str] = None) -> RequestResult:
@@ -573,7 +573,7 @@ class EngineSupervisor:
             trace_id=tr.request.trace_id)
         self.completed[rid] = result
         self.metrics.inc(f"requests_{reason}")
-        wall = time.time()
+        wall = clock.wall()
         # the engine incarnation that held this request died without
         # finishing it, so the supervisor owns the timeline: one coarse
         # phase span over the whole supervised lifetime (``decode`` when
@@ -604,7 +604,7 @@ class EngineSupervisor:
         prev = self.breaker_state
         self.breaker_state = state
         if state == BREAKER_OPEN:
-            self._breaker_opened_ts = time.monotonic()
+            self._breaker_opened_ts = clock.now()
             counter, event = "breaker_opens", "breaker_open"
         elif state == BREAKER_HALF_OPEN:
             counter, event = "breaker_half_opens", "breaker_half_open"
@@ -680,7 +680,7 @@ class EngineSupervisor:
         being handed over. After this call the supervisor tracks nothing;
         the caller is expected to :meth:`close` and rebuild it. Migration
         is not a failure: per-request restart budgets are NOT charged."""
-        now = time.monotonic()
+        now = clock.now()
         self._harvest(now)
         inflight = {req.request_id: toks
                     for req, toks, _ in self.engine.inflight()}

@@ -319,6 +319,250 @@ class TestAPX008MutableState:
         assert codes(src, "APX008") == []
 
 
+def codes_at(src, path, only):
+    """Like :func:`codes` but with an explicit module path — the
+    path-scoped rules (APX011/APX012) key off where the file lives."""
+    rules = [r for r in all_rules() if r.code == only]
+    return [f.code for f in analyze_source(textwrap.dedent(src), path,
+                                           rules)]
+
+
+class TestAPX009RecordContract:
+    def test_positive_emit_without_counter(self):
+        src = """
+            def emit(metrics):
+                metrics.emit_record({"kind": "widget", "n": 1})
+        """
+        assert codes(src, "APX009") == ["APX009"]
+
+    def test_positive_dict_via_variable(self):
+        src = """
+            def emit(metrics):
+                rec = {"kind": "widget"}
+                rec.update(n=1)
+                metrics.emit_record(rec)
+        """
+        assert codes(src, "APX009") == ["APX009"]
+
+    def test_negative_counter_in_module(self):
+        src = """
+            def emit(metrics):
+                metrics.inc("widgets")
+                metrics.emit_record({"kind": "widget", "n": 1})
+        """
+        assert codes(src, "APX009") == []
+
+    def test_negative_typed_result_record_skipped(self):
+        # result.record() is the typed RequestResult path — reconciled
+        # by construction, not a dict-literal contract site
+        src = """
+            def emit(metrics, result):
+                metrics.emit_record(result.record(wall=0.0))
+        """
+        assert codes(src, "APX009") == []
+
+    def _tree(self, tmp_path, report_src):
+        from apex_tpu.analysis.engine import AnalysisConfig, analyze_paths
+        from apex_tpu.analysis.rules.apx009_record_contract import (
+            APX009RecordContract,
+        )
+        pkg = tmp_path / "pkg"
+        obs = tmp_path / "observability"
+        pkg.mkdir()
+        obs.mkdir()
+        (pkg / "emitter.py").write_text(textwrap.dedent("""
+            def emit(metrics):
+                metrics.inc("widgets")
+                metrics.emit_record({"kind": "widget"})
+        """))
+        (obs / "report.py").write_text(report_src)
+        cfg = AnalysisConfig(root=str(tmp_path))
+        return analyze_paths([str(pkg), str(obs)], cfg,
+                             [APX009RecordContract()])
+
+    def test_cross_file_kind_unknown_to_report(self, tmp_path):
+        found = self._tree(tmp_path, 'KINDS = ("request", "scenario")\n')
+        assert [f.code for f in found] == ["APX009"]
+        assert "unknown to observability/report.py" in found[0].message
+
+    def test_cross_file_kind_reconciled(self, tmp_path):
+        found = self._tree(tmp_path, 'KINDS = ("request", "widget")\n')
+        assert found == []
+
+
+class TestAPX010ScenarioSchema:
+    def _tree(self, tmp_path, scenario_src, runner_src):
+        from apex_tpu.analysis.engine import AnalysisConfig, analyze_paths
+        from apex_tpu.analysis.rules.apx010_scenario_schema import (
+            APX010ScenarioSchema,
+        )
+        lt = tmp_path / "loadtest"
+        lt.mkdir()
+        (lt / "scenario.py").write_text(textwrap.dedent(scenario_src))
+        (lt / "runner.py").write_text(textwrap.dedent(runner_src))
+        cfg = AnalysisConfig(root=str(tmp_path))
+        return analyze_paths([str(lt)], cfg, [APX010ScenarioSchema()])
+
+    _DRIFTED = """
+        class Scenario:
+            name: str
+            seed: int = 0
+            extra: int = 0
+
+            @property
+            def total_requests(self):
+                return 0
+
+            @classmethod
+            def from_dict(cls, data):
+                known = {"name", "seed", "ghost"}
+                return cls()
+    """
+
+    _ALIGNED = """
+        class Scenario:
+            name: str
+            seed: int = 0
+
+            @property
+            def total_requests(self):
+                return 0
+
+            @classmethod
+            def from_dict(cls, data):
+                known = {"name", "seed"}
+                return cls()
+    """
+
+    def test_positive_schema_drift_both_directions(self, tmp_path):
+        found = self._tree(tmp_path, self._DRIFTED,
+                           "def run(scenario):\n    return scenario.name\n")
+        msgs = [f.message for f in found]
+        assert len(found) == 2
+        assert any("'ghost'" in m for m in msgs)
+        assert any("'extra'" in m for m in msgs)
+
+    def test_positive_runner_reads_missing_attr(self, tmp_path):
+        found = self._tree(
+            tmp_path, self._ALIGNED,
+            "def run(scenario):\n"
+            "    n = scenario.total_requests\n"
+            "    return scenario.bogus\n")
+        assert [f.code for f in found] == ["APX010"]
+        assert "scenario.bogus" in found[0].message
+
+    def test_negative_aligned_surfaces(self, tmp_path):
+        found = self._tree(
+            tmp_path, self._ALIGNED,
+            "def run(scenario):\n"
+            "    return scenario.name, scenario.seed, "
+            "scenario.total_requests\n")
+        assert found == []
+
+    def test_real_tree_is_clean(self):
+        # the live scenario/runner pair must satisfy its own contract
+        import apex_tpu
+
+        from apex_tpu.analysis.engine import analyze_paths
+        from apex_tpu.analysis.rules.apx010_scenario_schema import (
+            APX010ScenarioSchema,
+        )
+        lt = os.path.join(os.path.dirname(apex_tpu.__file__), "loadtest")
+        assert analyze_paths([lt], rules=[APX010ScenarioSchema()]) == []
+
+
+class TestAPX011WallClock:
+    def test_positive_direct_reads_in_serving(self):
+        src = """
+            import time
+            def poll():
+                t0 = time.monotonic()
+                time.sleep(0.1)
+                return time.time() - t0
+        """
+        got = codes_at(src, "apex_tpu/serving/foo.py", "APX011")
+        assert got == ["APX011"] * 3
+
+    def test_positive_alias_resolved_in_loadtest(self):
+        src = """
+            import time as _t
+            def stamp():
+                return _t.perf_counter()
+        """
+        assert codes_at(src, "apex_tpu/loadtest/foo.py",
+                        "APX011") == ["APX011"]
+
+    def test_negative_clock_module_is_exempt(self):
+        src = """
+            import time
+            def now():
+                return time.monotonic()
+        """
+        assert codes_at(src, "apex_tpu/serving/clock.py", "APX011") == []
+
+    def test_negative_outside_scoped_trees(self):
+        src = """
+            import time
+            def now():
+                return time.monotonic()
+        """
+        assert codes_at(src, "apex_tpu/checkpoint/retry.py",
+                        "APX011") == []
+
+    def test_negative_clock_seam_usage(self):
+        src = """
+            from apex_tpu.serving import clock
+            def poll():
+                clock.sleep(0.1)
+                return clock.now()
+        """
+        assert codes_at(src, "apex_tpu/serving/foo.py", "APX011") == []
+
+
+class TestAPX012CounterBypass:
+    def test_positive_bare_paired_counter(self):
+        src = """
+            def retire(self, rid):
+                self.metrics.inc("replica_scale_downs")
+        """
+        got = codes_at(src, "apex_tpu/serving/fleet/foo.py", "APX012")
+        assert got == ["APX012"]
+
+    def test_negative_event_co_sited(self):
+        src = """
+            def retire(self, rid):
+                self.metrics.inc("replica_scale_downs")
+                self.metrics.event("replica_scale_down", replica_id=rid)
+        """
+        assert codes_at(src, "apex_tpu/serving/fleet/foo.py",
+                        "APX012") == []
+
+    def test_negative_unpaired_counter_is_fine(self):
+        # dispatch counters are deliberately high-frequency/unpaired
+        src = """
+            def dispatch(self):
+                self.metrics.inc("fleet_dispatches")
+        """
+        assert codes_at(src, "apex_tpu/serving/fleet/foo.py",
+                        "APX012") == []
+
+    def test_negative_outside_serving(self):
+        src = """
+            def retire(self):
+                self.metrics.inc("replica_scale_downs")
+        """
+        assert codes_at(src, "apex_tpu/loadtest/foo.py", "APX012") == []
+
+    def test_rule_set_matches_mc_invariants(self):
+        # the lint rule and the runtime invariant must police the same
+        # counter<->event pairs
+        from apex_tpu.analysis.rules.apx012_counter_bypass import (
+            _PAIRED_COUNTERS,
+        )
+        inv = pytest.importorskip("apex_tpu.analysis.mc.invariants")
+        assert _PAIRED_COUNTERS == frozenset(inv.COUNTER_EVENTS)
+
+
 # ---------------------------------------------------------------------------
 # suppression, baseline, config, CLI
 # ---------------------------------------------------------------------------
@@ -479,6 +723,78 @@ class TestConfigAndCLI:
         rc = cli_main([str(root / "pkg")])
         out = capsys.readouterr().out
         assert rc == 1 and "APX000" in out
+
+    def test_cli_prune_baseline_drops_dead_entries(self, tmp_path, capsys):
+        root = self._project(tmp_path)
+        cli_main([str(root / "pkg"), "--write-baseline"])
+        self._justify(root)
+        # fix the offending code: the baseline entry is now dead weight
+        (root / "pkg" / "mod.py").write_text("x = 1\n")
+        rc = cli_main([str(root / "pkg"), "--prune-baseline"])
+        captured = capsys.readouterr()
+        assert rc == 0
+        assert "pruned 1 stale baseline entry (0 kept)" in captured.out
+        assert json.loads((root / "bl.json").read_text())["entries"] == []
+        # and the stale warning is gone on the next normal run
+        rc = cli_main([str(root / "pkg")])
+        assert rc == 0 and "stale" not in capsys.readouterr().err
+
+    def test_cli_prune_keeps_live_entries(self, tmp_path, capsys):
+        root = self._project(tmp_path)
+        cli_main([str(root / "pkg"), "--write-baseline"])
+        self._justify(root)
+        rc = cli_main([str(root / "pkg"), "--prune-baseline"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "pruned 0 stale" in out and "(1 kept)" in out
+        assert len(json.loads(
+            (root / "bl.json").read_text())["entries"]) == 1
+
+    def test_cli_prune_without_baseline_file_is_usage_error(
+            self, tmp_path, capsys):
+        root = self._project(tmp_path)   # bl.json configured, not written
+        rc = cli_main([str(root / "pkg"), "--prune-baseline"])
+        captured = capsys.readouterr()
+        assert rc == 2
+        assert "no baseline file to prune" in captured.err
+
+
+class TestTomlReader:
+    """``_read_toml_table`` prefers stdlib tomllib (py3.11+) and falls
+    back to the mini reader on 3.10 — whose documented gap is backslash
+    escapes in basic strings (returned verbatim, not decoded)."""
+
+    def _table(self, tmp_path, body):
+        from apex_tpu.analysis.engine import _read_toml_table
+        p = tmp_path / "pyproject.toml"
+        p.write_text("[tool.apex_tpu.analysis]\n" + textwrap.dedent(body))
+        return _read_toml_table(str(p), "tool.apex_tpu.analysis")
+
+    def test_plain_values_agree_across_readers(self, tmp_path):
+        table = self._table(tmp_path, """\
+            paths = ["pkg", "tools"]
+            baseline = "bl.json"
+            exclude = []
+        """)
+        assert table == {"paths": ["pkg", "tools"],
+                         "baseline": "bl.json", "exclude": []}
+
+    def test_escaped_string_values(self, tmp_path):
+        # TOML basic strings decode \\t to a TAB; the mini reader does
+        # not decode escapes — this test pins the divergence down so
+        # config values stay escape-free until the gap matters
+        table = self._table(tmp_path, 'baseline = "bl\\tname.json"\n')
+        try:
+            import tomllib  # noqa: F401  (py3.11+: the real parser)
+            assert table["baseline"] == "bl\tname.json"
+        except ImportError:
+            assert table["baseline"] == "bl\\tname.json"
+
+    def test_missing_file_and_table_are_empty(self, tmp_path):
+        from apex_tpu.analysis.engine import _read_toml_table
+        assert _read_toml_table(str(tmp_path / "nope.toml"),
+                                "tool.apex_tpu.analysis") == {}
+        assert self._table(tmp_path, "") == {}
 
 
 # ---------------------------------------------------------------------------
