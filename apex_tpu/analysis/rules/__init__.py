@@ -27,6 +27,7 @@ from apex_tpu.analysis.rules.apx010_scenario_schema import (
 )
 from apex_tpu.analysis.rules.apx011_wall_clock import APX011WallClock
 from apex_tpu.analysis.rules.apx012_counter_bypass import APX012CounterBypass
+from apex_tpu.analysis.rules.apx013_trigger_table import APX013TriggerTable
 
 _RULE_CLASSES = [
     APX001PrngReuse,
@@ -41,6 +42,7 @@ _RULE_CLASSES = [
     APX010ScenarioSchema,
     APX011WallClock,
     APX012CounterBypass,
+    APX013TriggerTable,
 ]
 
 __all__ = ["all_rules"] + [c.__name__ for c in _RULE_CLASSES]

@@ -1,0 +1,79 @@
+"""APX013 — incident counter maps drifting from the flight-recorder
+trigger table.
+
+``observability/report.py``'s ``*_INCIDENT_COUNTERS`` maps are the
+monitor's reconcile contract: every key is an incident-class event the
+report counts key-for-key against a registry counter.  The
+:class:`~apex_tpu.observability.recorder.FlightRecorder` promises a
+postmortem bundle for exactly that class of event
+(``recorder.TRIGGER_EVENTS``).  An incident the monitor reconciles but
+the recorder sleeps through is the failure mode this rule exists for: a
+new subsystem adds ``foo_melted`` to an incident map, the report
+dutifully counts it, and the first real meltdown leaves no bundle —
+the evidence the counter was supposed to guarantee.
+
+Detection: in ``observability/report.py``, every constant string key of
+a top-level ``NAME = {...}`` assignment where ``NAME`` ends with
+``_INCIDENT_COUNTERS`` must be a member of the runtime
+``TRIGGER_EVENTS`` frozenset (imported from the installed
+``apex_tpu.observability.recorder`` — pure stdlib, safe at lint time).
+The recorder builds ``TRIGGER_EVENTS`` from those same maps by
+construction, so the real tree is clean by definition; the rule
+catches a map edited in a checkout that bypasses the recorder import
+(or a trigger table someone hand-pruned).  The inverse direction is
+deliberately allowed: recorder-only extras like ``retrace`` trigger
+bundles without a strict counter pairing.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from apex_tpu.analysis.engine import Finding, ModuleContext, Rule, RuleVisitor
+
+_MAP_SUFFIX = "_INCIDENT_COUNTERS"
+
+
+def _scoped(path: str) -> bool:
+    return ("/" + path.replace("\\", "/")).endswith(
+        "/observability/report.py")
+
+
+def _trigger_events() -> frozenset:
+    from apex_tpu.observability.recorder import TRIGGER_EVENTS
+    return TRIGGER_EVENTS
+
+
+class APX013TriggerTable(Rule):
+    code = "APX013"
+    name = "trigger-table"
+    description = ("*_INCIDENT_COUNTERS event missing from the flight "
+                   "recorder's TRIGGER_EVENTS — incidents the monitor "
+                   "reconciles must dump a postmortem bundle")
+
+    def check(self, module: ModuleContext) -> List[Finding]:
+        if not _scoped(module.path):
+            return []
+        triggers = _trigger_events()
+        v = RuleVisitor(self, module)
+        for node in module.tree.body:
+            if not (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id.endswith(_MAP_SUFFIX)
+                    and isinstance(node.value, ast.Dict)):
+                continue
+            map_name = node.targets[0].id
+            for key in node.value.keys:
+                if not (isinstance(key, ast.Constant)
+                        and isinstance(key.value, str)):
+                    continue
+                if key.value not in triggers:
+                    v.report(key, (
+                        f'incident event "{key.value}" ({map_name}) is '
+                        f"not a FlightRecorder trigger — add it to the "
+                        f"recorder's trigger table so the incident the "
+                        f"monitor reconciles also leaves a postmortem "
+                        f"bundle"))
+        return v.findings

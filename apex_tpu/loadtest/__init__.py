@@ -31,7 +31,9 @@ from apex_tpu.loadtest.scenario import (
     FleetSpec,
     LoadPhase,
     ModelSpec,
+    RecorderSpec,
     Scenario,
+    SentinelSpec,
 )
 
 __all__ = [
@@ -41,6 +43,8 @@ __all__ = [
     "EngineKnobs",
     "FaultSchedule",
     "FleetSpec",
+    "SentinelSpec",
+    "RecorderSpec",
     "TrafficGenerator",
     "ScheduledRequest",
     "ScenarioRun",

@@ -112,6 +112,12 @@ class ScenarioRun:
     #: the RetraceWatchdogs every engine wraps its step programs in —
     #: must be 0; a storm fails the run even when every SLO passes
     retraces: int = 0
+    #: postmortem bundles the scenario's FlightRecorder dumped (empty
+    #: when no ``recorder`` block, or when nothing incident-class fired)
+    bundles: List[dict] = field(default_factory=list)
+    #: where those bundles landed on disk — next to the run log (empty
+    #: for in-memory runs with no ``log_path``)
+    bundle_paths: List[str] = field(default_factory=list)
 
     @property
     def ok(self) -> bool:
@@ -175,13 +181,19 @@ def _build_serving(scenario: Scenario, model, params,
         fl = scenario.fleet
         autoscale = AutoscaleConfig(**scenario.autoscale.config_kwargs()) \
             if scenario.autoscale is not None else None
+        sentinel = None
+        if scenario.sentinel is not None:
+            from apex_tpu.observability.sentinel import SentinelConfig
+
+            sentinel = SentinelConfig(
+                **scenario.sentinel.config_kwargs())
         return ReplicaFleet(
             model, params, engine_cfg, supervisor=sup_cfg,
             fleet=FleetConfig(n_replicas=fl.n_replicas,
                               migrate_on_drain=fl.migrate_on_drain,
                               probe_on_rebuild=fl.probe_on_rebuild),
             metrics=metrics, faults=faults, adapters=adapters,
-            autoscale=autoscale)
+            autoscale=autoscale, sentinel=sentinel)
     return EngineSupervisor(model, params, engine_cfg,
                             supervisor=sup_cfg, metrics=metrics,
                             faults=faults, adapters=adapters)
@@ -252,6 +264,26 @@ def run_scenario(scenario: Scenario, *, model=None, params=None,
     registry.add_sink(mem)
     if log_path is not None:
         registry.add_sink(JsonlSink(log_path))
+    recorder = None
+    if scenario.recorder is not None:
+        import os
+
+        from apex_tpu.observability.recorder import FlightRecorder
+
+        # bundles land next to the run log, named after it; a run with
+        # no log keeps them in memory (ScenarioRun.bundles)
+        bundle_dir = bundle_prefix = None
+        if log_path is not None:
+            bundle_dir = os.path.dirname(os.path.abspath(log_path))
+            bundle_prefix = os.path.splitext(
+                os.path.basename(log_path))[0]
+        recorder = FlightRecorder(
+            bundle_dir=bundle_dir,
+            bundle_prefix=bundle_prefix or scenario.name,
+            **scenario.recorder.recorder_kwargs())
+        # attached before the scenario record so the rings hold the
+        # run's self-description too
+        registry.add_sink(recorder)
     # the log's self-description: name + seed for provenance, the SLO
     # spec so the monitor (and --from-log re-scoring) can render a
     # verdict without the scenario file at hand
@@ -262,6 +294,8 @@ def run_scenario(scenario: Scenario, *, model=None, params=None,
 
     schedule = TrafficGenerator(scenario).schedule()
     sup = _build_serving(scenario, model, params, registry)
+    if recorder is not None:
+        recorder.attach(sup, registry)
     run = ScenarioRun(scenario=scenario, schedule=schedule, results={},
                       records=mem.records, counters={}, wall_s=0.0,
                       log_path=log_path)
@@ -378,6 +412,9 @@ def run_scenario(scenario: Scenario, *, model=None, params=None,
             scratch.cleanup()   # the deployed weights live in the fleet
     run.results = dict(sup.completed)
     run.counters = registry.counters()
+    if recorder is not None:
+        run.bundles = list(recorder.bundles)
+        run.bundle_paths = list(recorder.bundle_paths)
     run.engine_restarts = sup.restarts
     # the engines' RetraceWatchdogs mirror every counted recompile into
     # the shared registry; surface the total and fail loudly — a storm

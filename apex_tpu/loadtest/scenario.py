@@ -38,7 +38,8 @@ from typing import Any, Dict, Optional, Tuple
 from apex_tpu.observability.slo import SLO_METRICS
 
 __all__ = ["ModelSpec", "EngineKnobs", "LoadPhase", "FaultSchedule",
-           "FleetSpec", "AutoscaleSpec", "DeploySpec", "Scenario"]
+           "FleetSpec", "AutoscaleSpec", "DeploySpec", "SentinelSpec",
+           "RecorderSpec", "Scenario"]
 
 #: keys accepted in a scenario's ``"supervisor"`` section — mirrors the
 #: :class:`~apex_tpu.serving.SupervisorConfig` fields so a typo fails at
@@ -662,6 +663,162 @@ class DeploySpec:
 
 
 @dataclass(frozen=True)
+class SentinelSpec:
+    """Optional ``"sentinel"`` scenario block: run the fleet under a
+    :class:`~apex_tpu.observability.DriftSentinel` polling
+    ``FleetMetrics.signals()`` from the tick (docs/observability.md#
+    drift-sentinel). Fields mirror
+    :class:`~apex_tpu.observability.SentinelConfig` (kept jax-free
+    here; the runner builds the config) so a typo fails at scenario
+    load. Requires a ``"fleet"`` block — the sentinel rides the fleet
+    tick."""
+
+    poll_interval_s: float = 0.25
+    warmup_polls: int = 8
+    ewma_alpha: float = 0.2
+    z_threshold: float = 4.0
+    hysteresis_polls: int = 2
+    cooldown_s: float = 10.0
+    min_abs_dev: float = 1e-3
+    snapshot_every_polls: int = 4
+    signals: Tuple[str, ...] = ("ttft_p99_s", "tpot_p99_s",
+                                "goodput_window", "queue_depth",
+                                "spec_accept_rate")
+
+    def __post_init__(self):
+        # mirror SentinelConfig's validation so a bad scenario fails at
+        # parse time, not at fleet construction mid-run
+        if self.poll_interval_s <= 0:
+            raise ValueError(
+                f"sentinel poll_interval_s must be > 0, got "
+                f"{self.poll_interval_s}")
+        if self.warmup_polls < 1:
+            raise ValueError(
+                f"sentinel warmup_polls must be >= 1, got "
+                f"{self.warmup_polls}")
+        if not (0.0 < self.ewma_alpha <= 1.0):
+            raise ValueError(
+                f"sentinel ewma_alpha must be in (0, 1], got "
+                f"{self.ewma_alpha}")
+        if self.z_threshold <= 0:
+            raise ValueError(
+                f"sentinel z_threshold must be > 0, got "
+                f"{self.z_threshold}")
+        if self.hysteresis_polls < 1:
+            raise ValueError(
+                f"sentinel hysteresis_polls must be >= 1, got "
+                f"{self.hysteresis_polls}")
+        if self.cooldown_s < 0:
+            raise ValueError(
+                f"sentinel cooldown_s must be >= 0, got "
+                f"{self.cooldown_s}")
+        if self.min_abs_dev <= 0:
+            raise ValueError(
+                f"sentinel min_abs_dev must be > 0, got "
+                f"{self.min_abs_dev}")
+        if self.snapshot_every_polls < 0:
+            raise ValueError(
+                f"sentinel snapshot_every_polls must be >= 0, got "
+                f"{self.snapshot_every_polls}")
+        if not self.signals:
+            raise ValueError(
+                "sentinel signals must name at least one signal")
+
+    def config_kwargs(self) -> Dict[str, Any]:
+        """Constructor kwargs for ``SentinelConfig``."""
+        return {
+            "poll_interval_s": self.poll_interval_s,
+            "warmup_polls": self.warmup_polls,
+            "ewma_alpha": self.ewma_alpha,
+            "z_threshold": self.z_threshold,
+            "hysteresis_polls": self.hysteresis_polls,
+            "cooldown_s": self.cooldown_s,
+            "min_abs_dev": self.min_abs_dev,
+            "snapshot_every_polls": self.snapshot_every_polls,
+            "signals": self.signals,
+        }
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "SentinelSpec":
+        d = dict(data)
+        kw: Dict[str, Any] = {}
+        for key in ("warmup_polls", "hysteresis_polls",
+                    "snapshot_every_polls"):
+            if key in d:
+                kw[key] = int(d.pop(key))
+        for key in ("poll_interval_s", "ewma_alpha", "z_threshold",
+                    "cooldown_s", "min_abs_dev"):
+            if key in d:
+                kw[key] = float(d.pop(key))
+        if "signals" in d:
+            kw["signals"] = tuple(str(s) for s in d.pop("signals"))
+        if d:
+            raise ValueError(f"unknown sentinel keys {sorted(d)}")
+        return cls(**kw)
+
+    def to_dict(self) -> Dict[str, Any]:
+        defaults = SentinelSpec()
+        out = {k: v for k, v in self.config_kwargs().items()
+               if v != getattr(defaults, k)}
+        if "signals" in out:
+            out["signals"] = list(out["signals"])
+        return out
+
+
+@dataclass(frozen=True)
+class RecorderSpec:
+    """Optional ``"recorder"`` scenario block: attach a
+    :class:`~apex_tpu.observability.FlightRecorder` to the run's
+    registry so any incident-class event dumps a postmortem bundle next
+    to the run log (docs/observability.md#flight-recorder). Fields
+    mirror the recorder's constructor knobs."""
+
+    events_capacity: int = 256
+    records_capacity: int = 256
+    gauges_capacity: int = 64
+    max_bundles: int = 1
+
+    def __post_init__(self):
+        for knob in ("events_capacity", "records_capacity",
+                     "gauges_capacity"):
+            if getattr(self, knob) < 1:
+                raise ValueError(
+                    f"recorder {knob} must be >= 1, "
+                    f"got {getattr(self, knob)}")
+        if self.max_bundles < 0:
+            raise ValueError(
+                f"recorder max_bundles must be >= 0, got "
+                f"{self.max_bundles}")
+
+    def recorder_kwargs(self) -> Dict[str, Any]:
+        """Constructor kwargs for ``FlightRecorder`` (the runner adds
+        ``bundle_dir``/``bundle_prefix`` from the run-log path)."""
+        return {
+            "events_capacity": self.events_capacity,
+            "records_capacity": self.records_capacity,
+            "gauges_capacity": self.gauges_capacity,
+            "max_bundles": self.max_bundles,
+        }
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "RecorderSpec":
+        d = dict(data)
+        kw: Dict[str, Any] = {}
+        for key in ("events_capacity", "records_capacity",
+                    "gauges_capacity", "max_bundles"):
+            if key in d:
+                kw[key] = int(d.pop(key))
+        if d:
+            raise ValueError(f"unknown recorder keys {sorted(d)}")
+        return cls(**kw)
+
+    def to_dict(self) -> Dict[str, Any]:
+        defaults = RecorderSpec()
+        return {k: v for k, v in self.recorder_kwargs().items()
+                if v != getattr(defaults, k)}
+
+
+@dataclass(frozen=True)
 class Scenario:
     """One complete load-test description; see the module docstring.
 
@@ -685,6 +842,8 @@ class Scenario:
     fleet: Optional[FleetSpec] = None
     autoscale: Optional[AutoscaleSpec] = None
     deploy: Optional[DeploySpec] = None
+    sentinel: Optional[SentinelSpec] = None
+    recorder: Optional[RecorderSpec] = None
     slo: Dict[str, float] = field(default_factory=dict)
     tolerance: float = 0.25
     max_wall_s: float = 300.0
@@ -758,6 +917,9 @@ class Scenario:
                     f"lie in the autoscale band "
                     f"[{self.autoscale.min_replicas}, "
                     f"{self.autoscale.max_replicas}]")
+        if self.sentinel is not None and self.fleet is None:
+            raise ValueError("a 'sentinel' block needs a 'fleet' block "
+                             "(the sentinel rides the fleet tick)")
         if self.deploy is not None:
             if self.fleet is None:
                 raise ValueError("a 'deploy' block needs a 'fleet' block")
@@ -785,7 +947,8 @@ class Scenario:
     def from_dict(cls, data: Dict[str, Any]) -> "Scenario":
         known = {"name", "seed", "description", "model", "engine",
                  "supervisor", "phases", "faults", "fleet", "autoscale",
-                 "deploy", "slo", "tolerance", "max_wall_s"}
+                 "deploy", "sentinel", "recorder", "slo", "tolerance",
+                 "max_wall_s"}
         unknown = set(data) - known
         if unknown:
             raise ValueError(
@@ -807,6 +970,10 @@ class Scenario:
                        if data.get("autoscale") is not None else None),
             deploy=(DeploySpec.from_dict(data["deploy"])
                     if data.get("deploy") is not None else None),
+            sentinel=(SentinelSpec.from_dict(data["sentinel"])
+                      if data.get("sentinel") is not None else None),
+            recorder=(RecorderSpec.from_dict(data["recorder"])
+                      if data.get("recorder") is not None else None),
             slo={str(k): float(v)
                  for k, v in data.get("slo", {}).items()},
             tolerance=float(data.get("tolerance", 0.25)),
@@ -831,6 +998,10 @@ class Scenario:
             out["autoscale"] = self.autoscale.to_dict()
         if self.deploy is not None:
             out["deploy"] = self.deploy.to_dict()
+        if self.sentinel is not None:
+            out["sentinel"] = self.sentinel.to_dict()
+        if self.recorder is not None:
+            out["recorder"] = self.recorder.to_dict()
         if self.slo:
             out["slo"] = dict(self.slo)
         return out

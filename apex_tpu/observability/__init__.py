@@ -32,7 +32,18 @@ subsystem of a pre-training stack; this package is that subsystem here.
   (:mod:`~apex_tpu.observability.fleet_metrics`) — per-replica metric
   views merged into one fleet snapshot plus the polled ``signals()``
   dict (goodput window, queue depth, p99 TTFT/TPOT, occupancy,
-  per-adapter share) that feeds the autoscaler.
+  per-adapter share) that feeds the autoscaler and the drift sentinel.
+- :class:`FlightRecorder` (:mod:`~apex_tpu.observability.recorder`) —
+  bounded ring buffers of recent telemetry attached as a registry sink;
+  any incident-class event (:data:`TRIGGER_EVENTS`) dumps a
+  self-contained JSON postmortem bundle rendered by
+  ``python -m apex_tpu.monitor bundle``.
+- :class:`DriftSentinel` / :class:`SentinelConfig`
+  (:mod:`~apex_tpu.observability.sentinel`) — online EWMA + robust
+  z-score drift detection over ``FleetMetrics.signals()``, emitting
+  typed ``kind="anomaly"`` records with paired ``anomalies_*``
+  counters (and the periodic ``kind="gauge_snapshot"`` trajectory
+  feed) from the fleet tick.
 """
 
 from apex_tpu.observability.registry import (
@@ -75,6 +86,14 @@ from apex_tpu.observability.fleet_metrics import (
     ReplicaRegistry,
     merge_histograms,
 )
+from apex_tpu.observability.recorder import (
+    TRIGGER_EVENTS,
+    FlightRecorder,
+)
+from apex_tpu.observability.sentinel import (
+    DriftSentinel,
+    SentinelConfig,
+)
 
 __all__ = [
     "MetricsRegistry",
@@ -107,4 +126,8 @@ __all__ = [
     "FleetMetrics",
     "ReplicaRegistry",
     "merge_histograms",
+    "FlightRecorder",
+    "TRIGGER_EVENTS",
+    "DriftSentinel",
+    "SentinelConfig",
 ]

@@ -186,6 +186,20 @@ class FleetMetrics:
                 gauges[f'{name}{{replica="{rid}"}}'] = value
         return gauges
 
+    def labeled_histograms(self) -> Dict[str, HistogramSnapshot]:
+        """The merged (fleet-wide, unlabeled) histograms plus each LIVE
+        replica's own under ``name{replica="i"}`` labels — same labeling
+        convention as :meth:`labeled_gauges`, so the Prometheus export
+        carries both the fleet summary and the per-replica split of the
+        same family (a drifting replica is visible next to the merged
+        p99 that hides it)."""
+        hists: Dict[str, HistogramSnapshot] = dict(
+            self.merged_histograms())
+        for rid, reg in sorted(self.fleet.replica_metrics.items()):
+            for name, snap in reg.histograms().items():
+                hists[f'{name}{{replica="{rid}"}}'] = snap
+        return hists
+
     def snapshot(self) -> dict:
         """One merged, JSON-ready view: global counters (the parent's —
         replica sums plus fleet-level keys), the per-replica counter
@@ -273,6 +287,12 @@ class FleetMetrics:
             "window_s": window_s,
             "ttft_p99_s": _p99("request_ttft_s"),
             "tpot_p99_s": _p99("request_tpot_s"),
+            # speculative-decoding health over the recent window (None
+            # when no engine speculates — absence, not a zero rate)
+            "spec_accept_rate": (
+                (lambda s: sum(s.recent) / len(s.recent)
+                 if s is not None and s.recent else None)(
+                     hists.get("spec_accept_rate"))),
             "slot_occupancy": (active_slots / total_slots
                                if total_slots else None),
             "kv_page_occupancy": (pages_in_use / pages_total
@@ -290,7 +310,9 @@ class FleetMetrics:
     def write_prometheus(self, path: str) -> None:
         """Render the merged view to ``path`` in Prometheus textfile
         format (atomic replace): global counters as ``_total``, labeled
-        per-replica + fleet gauges, merged histograms as summaries."""
+        per-replica + fleet gauges, and histograms as label-aware
+        summary families — the merged (unlabeled) series next to each
+        replica's ``{replica="i"}`` split."""
         sink = PrometheusTextfileSink(path)
         wall = time.time()
         snap = self.snapshot()
@@ -299,5 +321,6 @@ class FleetMetrics:
         sink.write({"kind": "gauges", "wall": wall,
                     "values": snap["gauges"]})
         sink.write({"kind": "histograms", "wall": wall,
-                    "values": snap["histograms"]})
+                    "values": {name: h.as_dict() for name, h
+                               in self.labeled_histograms().items()}})
         sink.flush()

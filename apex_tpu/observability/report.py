@@ -67,7 +67,8 @@ from apex_tpu.observability.trace import (
 __all__ = ["read_records", "build_report", "render_report", "main",
            "SERVING_INCIDENT_COUNTERS", "SERVING_SHED_COUNTERS",
            "FLEET_INCIDENT_COUNTERS", "CHECKPOINT_INCIDENT_COUNTERS",
-           "DEPLOY_ACTION_COUNTERS", "AUTOSCALE_ACTION_COUNTERS"]
+           "DEPLOY_ACTION_COUNTERS", "AUTOSCALE_ACTION_COUNTERS",
+           "SENTINEL_INCIDENT_COUNTERS", "render_bundle"]
 
 #: number of windows in the throughput/MFU trajectory
 _TRAJECTORY_WINDOWS = 5
@@ -145,6 +146,18 @@ CHECKPOINT_INCIDENT_COUNTERS = {
     "checkpoint_verify_failed": "ckpt_verify_failures",
     "checkpoint_deleted_corrupt": "ckpt_deleted_corrupt",
     "checkpoint_partial_cleaned": "ckpt_partials_cleaned",
+}
+
+#: drift-sentinel incident event -> registry counter — the
+#: :class:`apex_tpu.observability.sentinel.DriftSentinel` fires each
+#: ``anomaly`` event co-sited with one ``anomalies_total`` increment
+#: (plus a per-signal ``anomalies_<signal>`` split), so the monitor's
+#: anomalies section reconciles key-for-key with the counter snapshot.
+#: Every key here is, by APX013, a flight-recorder trigger. Note the
+#: recorder's own ``bundle_dumped`` event is deliberately NOT an
+#: incident counter key: a dump must never trigger another dump.
+SENTINEL_INCIDENT_COUNTERS = {
+    "anomaly": "anomalies_total",
 }
 
 
@@ -434,6 +447,72 @@ def _checkpoint_section(events: List[dict], counters: Dict[str, int],
             "timings": timings}
 
 
+def _anomaly_section(records: List[dict],
+                     counters: Dict[str, int]) -> Optional[dict]:
+    """Fold drift-sentinel ``kind="anomaly"`` records into the
+    monitor's anomalies section: per-signal counts (reconciling
+    key-for-key with the ``anomalies_<signal>`` counters and the total
+    with :data:`SENTINEL_INCIDENT_COUNTERS` — same emission sites) and
+    the anomaly timeline. ``None`` for a pre-sentinel log, or a
+    sentinel run that stayed healthy (counters present but zero still
+    renders, so a clean sentinel run is visible as clean)."""
+    rows = [r for r in records if r.get("kind") == "anomaly"]
+    sentinel_counters = {name: n for name, n in counters.items()
+                         if name == "anomalies_total"
+                         or name.startswith("anomalies_")}
+    if not rows and not sentinel_counters:
+        return None
+    by_signal: Dict[str, int] = {}
+    for r in rows:
+        sig = str(r.get("signal", "?"))
+        by_signal[sig] = by_signal.get(sig, 0) + 1
+    return {
+        "count": len(rows),
+        "by_signal": by_signal,
+        "counters": sentinel_counters,
+        "timeline": [{k: r.get(k) for k in
+                      ("signal", "value", "baseline", "z", "wall")
+                      if k in r} for r in rows],
+    }
+
+
+def _bundle_section(records: List[dict],
+                    counters: Dict[str, int]) -> Optional[dict]:
+    """Fold flight-recorder ``kind="bundle"`` records into the
+    monitor's bundles section: one row per postmortem dump (trigger,
+    file path, ring size at dump time), reconciling key-for-key with
+    the ``bundles_dumped`` counter — the recorder emits record, event
+    and increment from the same site. ``None`` for a pre-recorder log
+    or a recorder run that never dumped (a zero counter still renders:
+    "armed, nothing fired" is a result)."""
+    rows = [r for r in records if r.get("kind") == "bundle"]
+    dumped = counters.get("bundles_dumped")
+    if not rows and dumped is None:
+        return None
+    return {
+        "count": len(rows),
+        "counter": 0 if dumped is None else dumped,
+        "dumps": [{k: r.get(k) for k in
+                   ("bundle_seq", "trigger", "path", "events", "wall")
+                   if k in r} for r in rows],
+    }
+
+
+def _gauge_trajectory(records: List[dict]) -> List[dict]:
+    """The ``kind="gauge_snapshot"`` samples the drift sentinel stamps
+    every N polls — the live occupancy/queue trajectory ``--follow``
+    renders between terminal-request rows. Empty for pre-sentinel logs
+    (readers must tolerate their absence, like every other section)."""
+    out = []
+    for r in records:
+        if r.get("kind") != "gauge_snapshot":
+            continue
+        sig = r.get("signals")
+        if isinstance(sig, dict):
+            out.append({"wall": r.get("wall"), **sig})
+    return out
+
+
 def build_report(path: str,
                  slo_spec: Optional[Dict[str, float]] = None) -> dict:
     """Fold one JSONL metric log into a report dict.
@@ -497,6 +576,9 @@ def build_report(path: str,
             if any(isinstance(r.get("adapter_id"), str) for r in requests)
             else None),
         "checkpoints": _checkpoint_section(events, counters, histograms),
+        "anomalies": _anomaly_section(records, counters),
+        "bundles": _bundle_section(records, counters),
+        "gauge_trajectory": _gauge_trajectory(records),
         "timeline": sorted(events, key=lambda e: e.get("seq", 0)),
         "scenario": ({k: scenario[k] for k in ("name", "seed")
                       if k in scenario} if scenario else None),
@@ -806,6 +888,58 @@ def render_report(report: dict) -> str:
             split = " ".join(f"{k}={v}" for k, v in sorted(
                 inc["shed_by_reason"].items()))
             lines.append(f"  request_shed: {split}")
+    anomalies = report.get("anomalies")
+    if anomalies:
+        split = " ".join(f"{k}={v}" for k, v in sorted(
+            anomalies["by_signal"].items())) or "(none fired)"
+        lines += ["", f"drift anomalies ({anomalies['count']}):",
+                  f"  {split}"]
+        lines += [f"  {name} = {n}" for name, n in sorted(
+            anomalies["counters"].items())]
+        for a in anomalies["timeline"][:10]:
+            wall = a.get("wall")
+            stamp = f"[wall={wall:.3f}] " if isinstance(
+                wall, (int, float)) else ""
+            lines.append(
+                f"  {stamp}{a.get('signal', '?')} "
+                f"value={_fmt(a.get('value'))} "
+                f"baseline={_fmt(a.get('baseline'))} "
+                f"z={_fmt(a.get('z'))}")
+        if len(anomalies["timeline"]) > 10:
+            lines.append(
+                f"  ... {len(anomalies['timeline']) - 10} more")
+    bundles = report.get("bundles")
+    if bundles:
+        lines += ["", f"postmortem bundles ({bundles['count']} dumped, "
+                      f"bundles_dumped = {bundles['counter']}):"]
+        if not bundles["dumps"]:
+            lines.append("  (recorder armed — nothing fired)")
+        for b in bundles["dumps"]:
+            wall = b.get("wall")
+            stamp = f"[wall={wall:.3f}] " if isinstance(
+                wall, (int, float)) else ""
+            lines.append(
+                f"  {stamp}#{b.get('bundle_seq', '?')} "
+                f"trigger={b.get('trigger', '?')} "
+                f"events={b.get('events', '?')}"
+                + (f" -> {b['path']}" if b.get("path") else ""))
+    gauge_traj = report.get("gauge_trajectory")
+    if gauge_traj:
+        lines += ["", f"signal trajectory ({len(gauge_traj)} "
+                      "gauge snapshots):"]
+        for key_, label in (("queue_depth", "queue depth"),
+                            ("slot_occupancy", "slot occupancy"),
+                            ("ttft_p99_s", "ttft p99 (s)"),
+                            ("goodput_window", "windowed goodput")):
+            pts = [g.get(key_) for g in gauge_traj]
+            if not any(isinstance(p, (int, float)) for p in pts):
+                continue
+            shown = pts[-8:]
+            arrow = " -> ".join(
+                _fmt(p) if isinstance(p, (int, float)) else "-"
+                for p in shown)
+            prefix = "... " if len(pts) > len(shown) else ""
+            lines.append(f"  {label:<18} {prefix}{arrow}")
     for key, label in (("throughput_trajectory", "tokens/s trajectory"),
                        ("mfu_trajectory", "mfu trajectory")):
         traj = report[key]
@@ -878,7 +1012,181 @@ def _follow(path: str, *, spec: Optional[Dict[str, float]], as_json: bool,
     return 0
 
 
+#: timeline rows printed either side of the trigger in the bundle view
+_BUNDLE_TIMELINE_CONTEXT = 8
+
+
+def render_bundle(bundle: dict) -> str:
+    """Render a flight-recorder postmortem bundle as a text page: the
+    trigger, a timeline window around it (ring events + typed records
+    merged in ``seq`` order, trigger marked), the signal trajectories
+    from the gauge-snapshot ring, per-replica engine digests, and a
+    suspect attribution (the trigger's replica if it names one, else
+    the digest that looks least healthy). Defensive like every reader
+    here: bundles outlive the recorders that wrote them."""
+    trigger = bundle.get("trigger") or {}
+    lines = [f"== apex_tpu postmortem bundle "
+             f"(schema {bundle.get('schema', '?')}) ==",
+             f"wall: {bundle.get('wall', '?')}  "
+             f"trigger: {trigger.get('event', '(manual dump)')}"]
+    caps = bundle.get("capacities") or {}
+    if caps:
+        lines.append(
+            "rings: " + " ".join(f"{k}={v}" for k, v in sorted(
+                caps.items())))
+    cfg = bundle.get("config") or {}
+    if cfg.get("fingerprint"):
+        lines.append(f"config fingerprint: {cfg['fingerprint']}")
+
+    # -- timeline window around the trigger (events + typed records) --
+    rows = [dict(r) for r in (bundle.get("events") or [])]
+    rows += [dict(r) for r in (bundle.get("records") or [])]
+    rows.sort(key=lambda r: r.get("seq", 0))
+    trig_ix = None
+    if trigger:
+        for i, r in enumerate(rows):
+            if r.get("seq") == trigger.get("seq") and \
+                    r.get("event") == trigger.get("event"):
+                trig_ix = i
+    lo = 0 if trig_ix is None else max(
+        0, trig_ix - _BUNDLE_TIMELINE_CONTEXT)
+    hi = len(rows) if trig_ix is None else min(
+        len(rows), trig_ix + _BUNDLE_TIMELINE_CONTEXT + 1)
+    lines += ["", f"timeline around trigger "
+                  f"({len(rows)} ring records, showing {hi - lo}):"]
+    if lo > 0:
+        lines.append(f"  ... {lo} earlier")
+    for i in range(lo, hi):
+        r = rows[i]
+        mark = ">>" if i == trig_ix else "  "
+        label = r.get("event") or r.get("kind", "?")
+        extra = " ".join(
+            f"{k}={_fmt(v) if isinstance(v, float) else v}"
+            for k, v in sorted(r.items())
+            if k not in ("kind", "event", "seq", "ts", "wall")
+            and not isinstance(v, (dict, list)))
+        lines.append(f"{mark}[seq={r.get('seq', '?')} "
+                     f"wall={r.get('wall', 0):.3f}] {label} "
+                     f"{extra}".rstrip())
+    if hi < len(rows):
+        lines.append(f"  ... {len(rows) - hi} later")
+
+    # -- signal trajectories from the gauge-snapshot ring --
+    snaps = [r.get("signals") for r in
+             (bundle.get("gauge_snapshots") or [])
+             if isinstance(r.get("signals"), dict)]
+    if snaps:
+        lines += ["", f"signal trajectories ({len(snaps)} snapshots):"]
+        keys = sorted({k for s in snaps for k in s})
+        for key in keys:
+            pts = [s.get(key) for s in snaps]
+            if not any(isinstance(p, (int, float)) for p in pts):
+                continue
+            arrow = " -> ".join(
+                _fmt(p) if isinstance(p, (int, float)) else "-"
+                for p in pts[-8:])
+            lines.append(f"  {key:<18} {arrow}")
+    last = bundle.get("signals")
+    if isinstance(last, dict):
+        lines += ["", "last signals snapshot:"]
+        lines.append("  " + " ".join(
+            f"{k}={_fmt(v) if isinstance(v, float) else v}"
+            for k, v in sorted(last.items())
+            if not isinstance(v, (dict, list))))
+
+    # -- per-replica digests + suspect attribution --
+    replicas = bundle.get("replicas") or []
+    suspect = None
+    suspect_why = None
+    if isinstance(trigger.get("replica_id"), int):
+        suspect = trigger["replica_id"]
+        suspect_why = "named by trigger"
+    if replicas:
+        lines += ["", f"replica digests ({len(replicas)}):"]
+    for d in replicas:
+        rid = d.get("replica_id")
+        head = (f"  replica {rid}" if rid is not None else "  engine")
+        head += (f" [{d['state']}]" if d.get("state") else "")
+        breaker = d.get("breaker")
+        unhealthy = (breaker not in (None, "closed")
+                     or (d.get("restarts") or 0) > 0)
+        if suspect is None and unhealthy and rid is not None:
+            suspect = rid
+            suspect_why = (f"breaker={breaker}" if breaker != "closed"
+                           else f"restarts={d.get('restarts')}")
+        lines.append(
+            head + f": breaker={breaker} restarts={d.get('restarts')} "
+            f"queued={d.get('queued')} active={d.get('active')} "
+            f"inflight={d.get('inflight')}")
+        slots = d.get("slots")
+        if isinstance(slots, dict):
+            lines.append(
+                f"    slots: free={slots.get('free')} "
+                f"active={slots.get('active')} "
+                f"occupancy={_fmt(slots.get('occupancy'))}")
+        pages = d.get("pages")
+        if isinstance(pages, dict):
+            lines.append(
+                f"    pages: free={pages.get('free')} "
+                f"in_use={pages.get('in_use')} "
+                f"interned={pages.get('interned')} "
+                f"occupancy={_fmt(pages.get('occupancy'))} "
+                f"evictions={pages.get('evictions')}")
+        comp = d.get("compiles")
+        if isinstance(comp, dict):
+            lines.append(
+                f"    compiles: prefill={comp.get('prefill')} "
+                f"decode={comp.get('decode')} "
+                f"chunk={comp.get('chunk')} "
+                f"retraces={comp.get('decode_retraces')}")
+        for r in (d.get("requests") or [])[:8]:
+            lines.append(
+                f"    inflight request {r.get('request_id', '?')}: "
+                f"generated={r.get('generated', '?')} "
+                f"submit_ts={_fmt(r.get('submit_ts'))}"
+                + (f" adapter={r['adapter_id']}"
+                   if r.get("adapter_id") else ""))
+    lines += ["", "suspect: "
+              + (f"replica {suspect} ({suspect_why})"
+                 if suspect is not None
+                 else "(none — no replica named or unhealthy)")]
+    return "\n".join(lines)
+
+
+def _bundle_main(argv: List[str]) -> int:
+    """``python -m apex_tpu.monitor bundle <path> [--json]``."""
+    parser = argparse.ArgumentParser(
+        prog="python -m apex_tpu.monitor bundle",
+        description="Render a flight-recorder postmortem bundle "
+                    "(the *-bundle-N.json files a FlightRecorder dumps "
+                    "next to the run log).")
+    parser.add_argument("path", help="path to a bundle .json file")
+    parser.add_argument("--json", action="store_true",
+                        help="print the raw bundle JSON instead of the "
+                             "rendered page")
+    args = parser.parse_args(argv)
+    try:
+        with open(args.path, "r", encoding="utf-8") as f:
+            bundle = json.load(f)
+    except (OSError, ValueError) as exc:
+        print(f"apex_tpu.monitor: cannot read bundle {args.path}: "
+              f"{exc}", file=sys.stderr)
+        return 2
+    if not isinstance(bundle, dict):
+        print(f"apex_tpu.monitor: {args.path} is not a bundle object",
+              file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(bundle, indent=2, sort_keys=True, default=str))
+    else:
+        print(render_bundle(bundle))
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:]) if argv is None else list(argv)
+    if argv and argv[0] == "bundle":
+        return _bundle_main(argv[1:])
     parser = argparse.ArgumentParser(
         prog="python -m apex_tpu.monitor",
         description="Print a run report from a JSONL metric log written "

@@ -147,14 +147,21 @@ class PrometheusTextfileSink:
                 lines.append(f"# TYPE {family} gauge")
             lines.append(f"{metric} {value}")
         for name, summ in sorted((self._histograms or {}).items()):
-            metric = _prom_name(name)
-            lines.append(f"# TYPE {metric} summary")
-            lines.append(f"{metric}_count {summ.get('count', 0)}")
-            lines.append(f"{metric}_sum {summ.get('sum', 0.0)}")
+            base, labels = _split_labels(name)
+            family = _prom_name(base)
+            if family not in typed:
+                typed.add(family)
+                lines.append(f"# TYPE {family} summary")
+            lines.append(f"{family}_count{labels} {summ.get('count', 0)}")
+            lines.append(f"{family}_sum{labels} {summ.get('sum', 0.0)}")
             for q in ("p50", "p95"):
                 if q in summ:
-                    lines.append(
-                        f'{metric}{{quantile="0.{q[1:]}"}} {summ[q]}')
+                    # fold quantile into the existing label block: a
+                    # labeled series must stay one series per label set
+                    quantile = f'quantile="0.{q[1:]}"'
+                    block = (f"{labels[:-1]},{quantile}}}" if labels
+                             else f"{{{quantile}}}")
+                    lines.append(f"{family}{block} {summ[q]}")
         tmp = self.path + ".tmp"
         with open(tmp, "w", encoding="utf-8") as f:
             f.write("\n".join(lines) + ("\n" if lines else ""))

@@ -338,7 +338,8 @@ class ReplicaFleet:
                  fleet: Optional[FleetConfig] = None,
                  metrics: Optional[MetricsRegistry] = None,
                  faults=None, router: Optional[Router] = None,
-                 engine_factory=None, adapters=None, autoscale=None):
+                 engine_factory=None, adapters=None, autoscale=None,
+                 sentinel=None):
         self._model = model
         self._params = params
         #: shared LoRA :class:`~apex_tpu.lora.AdapterStore` — every
@@ -427,6 +428,21 @@ class ReplicaFleet:
                     f"{cfg.max_replicas}] bounds")
         else:
             self.autoscaler = None
+        if sentinel is not None:
+            from apex_tpu.observability.sentinel import (
+                DriftSentinel,
+                SentinelConfig,
+            )
+            if isinstance(sentinel, DriftSentinel):
+                self.sentinel: Optional[DriftSentinel] = sentinel
+            elif isinstance(sentinel, SentinelConfig):
+                self.sentinel = DriftSentinel(sentinel)
+            else:
+                raise TypeError(
+                    f"sentinel must be a SentinelConfig or DriftSentinel, "
+                    f"got {type(sentinel).__name__}")
+        else:
+            self.sentinel = None
 
     def _build_supervisor(self, replica_id: int,
                           service_s: Optional[float] = None
@@ -645,6 +661,10 @@ deploy.Deployment`, or None if :meth:`deploy` was never called."""
             self._deployment.step(self, now)
         if self.autoscaler is not None:
             self.autoscaler.maybe_scale(self, now)
+        if self.sentinel is not None:
+            # after the autoscaler so a scale decision's effect on queue
+            # depth and the anomaly that provoked it share a tick stamp
+            self.sentinel.maybe_poll(self, now)
         return [self.completed[rid] for rid in sorted(
             set(self.completed) - before)]
 

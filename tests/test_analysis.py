@@ -563,6 +563,61 @@ class TestAPX012CounterBypass:
         assert _PAIRED_COUNTERS == frozenset(inv.COUNTER_EVENTS)
 
 
+class TestAPX013TriggerTable:
+    """Every ``*_INCIDENT_COUNTERS`` key in the monitor must be a
+    flight-recorder trigger — an incident the monitor reconciles but
+    the recorder sleeps through leaves no postmortem."""
+
+    def test_positive_ghost_incident_event(self):
+        src = """
+            GHOST_INCIDENT_COUNTERS = {
+                "foo_melted": "foo_meltdowns",
+            }
+        """
+        got = codes_at(src, "apex_tpu/observability/report.py",
+                       "APX013")
+        assert got == ["APX013"]
+
+    def test_negative_real_trigger_events_pass(self):
+        src = """
+            SERVING_INCIDENT_COUNTERS = {
+                "engine_restart": "engine_restarts",
+                "tick_failure": "tick_failures",
+            }
+        """
+        assert codes_at(src, "apex_tpu/observability/report.py",
+                        "APX013") == []
+
+    def test_negative_non_incident_maps_ignored(self):
+        # only *_INCIDENT_COUNTERS assignments are the contract; other
+        # dicts (shed reasons, render tables) may name non-triggers
+        src = """
+            SERVING_SHED_COUNTERS = {
+                "queue_full": "requests_shed_queue_full",
+            }
+        """
+        assert codes_at(src, "apex_tpu/observability/report.py",
+                        "APX013") == []
+
+    def test_negative_scoped_to_monitor_module(self):
+        src = """
+            MY_INCIDENT_COUNTERS = {"foo_melted": "x"}
+        """
+        assert codes_at(src, "apex_tpu/serving/foo.py", "APX013") == []
+
+    def test_real_tree_is_clean(self):
+        """The committed monitor module passes its own lint — the
+        recorder builds TRIGGER_EVENTS from these maps by
+        construction."""
+        import apex_tpu.observability.report as report_mod
+        with open(report_mod.__file__, encoding="utf-8") as f:
+            src = f.read()
+        rules = [r for r in all_rules() if r.code == "APX013"]
+        found = analyze_source(
+            src, "apex_tpu/observability/report.py", rules)
+        assert [f.code for f in found] == []
+
+
 # ---------------------------------------------------------------------------
 # suppression, baseline, config, CLI
 # ---------------------------------------------------------------------------

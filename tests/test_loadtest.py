@@ -343,6 +343,61 @@ class TestAutoscaleDeploySchema:
         assert scn.deploy.adapter_id == "canary"
 
 
+class TestSentinelRecorderSchema:
+    """The PR 18 scenario blocks: the drift sentinel and the flight
+    recorder, validated at parse time like every other block."""
+
+    def test_sentinel_round_trip(self):
+        d = _scenario_dict(
+            fleet={"n_replicas": 2},
+            sentinel={"poll_interval_s": 0.1, "warmup_polls": 4,
+                      "z_threshold": 5.0, "min_abs_dev": 2.0,
+                      "signals": ["queue_depth", "ttft_p99_s"]})
+        scn = Scenario.from_dict(d)
+        assert scn.sentinel.z_threshold == 5.0
+        assert scn.sentinel.signals == ("queue_depth", "ttft_p99_s")
+        assert Scenario.from_dict(scn.to_dict()).to_dict() == scn.to_dict()
+        # the runner builds SentinelConfig from exactly these kwargs
+        from apex_tpu.observability.sentinel import SentinelConfig
+        cfg = SentinelConfig(**scn.sentinel.config_kwargs())
+        assert cfg.min_abs_dev == 2.0
+
+    def test_recorder_round_trip(self):
+        d = _scenario_dict(recorder={"events_capacity": 32,
+                                     "max_bundles": 2})
+        scn = Scenario.from_dict(d)
+        assert scn.recorder.max_bundles == 2
+        assert Scenario.from_dict(scn.to_dict()).to_dict() == scn.to_dict()
+        from apex_tpu.observability import FlightRecorder
+        rec = FlightRecorder(**scn.recorder.recorder_kwargs())
+        assert rec.events.maxlen == 32 and rec.max_bundles == 2
+
+    def test_sentinel_unknown_key_rejected(self):
+        with pytest.raises(ValueError, match="unknown sentinel keys"):
+            Scenario.from_dict(_scenario_dict(
+                fleet={"n_replicas": 1}, sentinel={"vibes": 1}))
+
+    def test_recorder_unknown_key_rejected(self):
+        with pytest.raises(ValueError, match="unknown recorder keys"):
+            Scenario.from_dict(_scenario_dict(recorder={"vibes": 1}))
+
+    def test_sentinel_needs_fleet_block(self):
+        with pytest.raises(ValueError, match="needs a 'fleet' block"):
+            Scenario.from_dict(_scenario_dict(
+                sentinel={"z_threshold": 4.0}))
+
+    def test_sentinel_validation_mirrors_runtime_config(self):
+        with pytest.raises(ValueError, match="ewma_alpha"):
+            Scenario.from_dict(_scenario_dict(
+                fleet={"n_replicas": 1}, sentinel={"ewma_alpha": 0.0}))
+        with pytest.raises(ValueError, match="signals"):
+            Scenario.from_dict(_scenario_dict(
+                fleet={"n_replicas": 1}, sentinel={"signals": []}))
+        with pytest.raises(ValueError, match="events_capacity"):
+            Scenario.from_dict(_scenario_dict(
+                recorder={"events_capacity": 0}))
+
+
 # ---------------------------------------------------------------------------
 # generator determinism (satellite: asserted across two runs)
 
@@ -720,6 +775,34 @@ class TestSmokeScenario:
         assert by["recovery_s"]["measured"] == pytest.approx(recovery)
 
 
+class TestRecorderInRunner:
+    def test_clean_run_arms_recorder_dumps_nothing(self, small,
+                                                   tmp_path):
+        """A recorder-armed clean run ends with ZERO bundles and the
+        bundles counter declared at zero — arming the recorder is free
+        on a healthy run (ring boundedness itself is asserted in
+        test_observability's TestFlightRecorder)."""
+        model, params = small
+        scn = Scenario.from_dict(_scenario_dict(
+            name="mini-clean", recorder={"events_capacity": 8,
+                                         "records_capacity": 8,
+                                         "gauges_capacity": 4}))
+        log = str(tmp_path / "clean.jsonl")
+        run = run_scenario(scn, model=model, params=params,
+                           log_path=log)
+        assert not run.aborted
+        assert run.bundles == [] and run.bundle_paths == []
+        assert run.counters["bundles_dumped"] == 0
+        # no bundle file appeared next to the log
+        import glob as _glob
+        assert _glob.glob(str(tmp_path / "*-bundle-*.json")) == []
+        # the report's bundle section says armed-but-quiet
+        report = build_report(log)
+        assert report["bundles"] is not None
+        assert report["bundles"]["count"] == 0
+        assert "nothing fired" in render_report(report)
+
+
 # ---------------------------------------------------------------------------
 # full scenarios: slow tier
 
@@ -781,3 +864,67 @@ class TestFullScenarios:
         _assert_reconciles(report)
         assert report["counters"]["prefill_chunks"] == \
             sum(r.prefill_chunks or 0 for r in done)
+
+    def test_latency_drift_fires_sentinel_and_dumps_one_bundle(
+            self, small, tmp_path, capsys):
+        """Acceptance (PR 18): the committed latency_drift scenario —
+        decode hangs degrade the fleet mid-surge with no hard failure —
+        makes the sentinel fire ``kind="anomaly"`` with counters
+        reconciling key-for-key, dumps EXACTLY ONE bundle next to the
+        run log, and ``monitor bundle`` renders it (human and --json)
+        with the trigger inside the frozen ring window."""
+        model, params = small
+        scn = Scenario.load(
+            os.path.join(SCENARIO_DIR, "latency_drift.json"))
+        log = str(tmp_path / "drift.jsonl")
+        run = run_scenario(scn, model=model, params=params,
+                           log_path=log)
+        assert not run.aborted
+        # the drift never hard-failed anything...
+        assert run.engine_restarts == 0
+        assert run.counters["requests_error"] == 0
+        assert run.ok, run.slo.as_dict()
+        # ...yet the sentinel caught it, reconciling key-for-key
+        counters = run.counters
+        assert counters["anomalies_total"] >= 1
+        assert counters["anomalies_queue_depth"] == \
+            counters["anomalies_total"]
+        report = build_report(log)
+        _assert_reconciles(report)
+        anomalies = report["anomalies"]
+        assert anomalies is not None
+        assert anomalies["count"] == counters["anomalies_total"]
+        assert anomalies["counters"]["anomalies_total"] == \
+            counters["anomalies_total"]
+        assert anomalies["by_signal"] == {
+            "queue_depth": counters["anomalies_total"]}
+        # exactly one bundle, dumped next to the run log
+        assert counters["bundles_dumped"] == 1
+        assert len(run.bundles) == 1
+        expected = str(tmp_path / "drift-bundle-1.json")
+        assert run.bundle_paths == [expected]
+        assert report["bundles"]["count"] == 1
+        assert report["bundles"]["dumps"][0]["trigger"] == "anomaly"
+        text = render_report(report)
+        assert "drift anomalies" in text
+        assert "postmortem bundles (1 dumped" in text
+        # the gauge trajectory fed the report
+        assert len(report["gauge_trajectory"]) >= 3
+        assert "signal trajectory" in text
+
+        # the bundle is self-contained and renders in both modes with
+        # the trigger inside the ring window it froze
+        bundle = json.loads(open(expected).read())
+        assert bundle["trigger"]["event"] == "anomaly"
+        assert bundle["trigger"]["signal"] == "queue_depth"
+        assert any(e.get("event") == "anomaly"
+                   for e in bundle["events"])
+        assert len(bundle["replicas"]) == 2
+        from apex_tpu.observability.report import main as monitor_main
+
+        assert monitor_main(["bundle", expected]) == 0
+        human = capsys.readouterr().out
+        assert "trigger: anomaly" in human and ">>" in human
+        assert monitor_main(["bundle", expected, "--json"]) == 0
+        assert json.loads(capsys.readouterr().out)["kind"] == \
+            "flight_bundle"

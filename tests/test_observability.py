@@ -19,11 +19,15 @@ import pytest
 from apex_tpu.amp.scaler import LossScaler
 from apex_tpu.analysis.retrace import RetraceWatchdog
 from apex_tpu.observability import (
+    TRIGGER_EVENTS,
+    DriftSentinel,
+    FlightRecorder,
     InMemorySink,
     JsonlSink,
     MetricsRegistry,
     PrometheusTextfileSink,
     ProfilerCapture,
+    SentinelConfig,
     StepMetrics,
     StepTimer,
     build_report,
@@ -711,3 +715,428 @@ class TestReportBackCompat:
 
         records = read_records(self.PRE_PR16)
         assert check_span_conservation(records) == []
+
+    PRE_PR18 = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "data", "pre_pr18_run.jsonl")
+
+    def test_pre_pr18_log_without_anomaly_bundle_still_renders(self):
+        """A committed pre-flight-recorder log (PR-17 vintage: fleet +
+        autoscale rows present, NO ``kind="anomaly"`` /
+        ``kind="bundle"`` / ``kind="gauge_snapshot"`` rows, no
+        ``anomalies_*`` / ``bundles_dumped`` / ``gauge_snapshots``
+        counters, torn last line) builds and renders with no drift or
+        bundle section — the new sections only appear when their rows
+        or counters exist."""
+        report = build_report(self.PRE_PR18)
+        assert report["requests"]["count"] == 3
+        assert report["anomalies"] is None
+        assert report["bundles"] is None
+        assert report["gauge_trajectory"] == []
+        text = render_report(report)
+        assert "drift anomalies" not in text
+        assert "postmortem bundles" not in text
+        assert "signal trajectory" not in text
+        # the era's own sections are untouched by the new readers
+        assert "autoscale decisions" in text
+
+    def test_pre_pr18_log_span_check_still_conserves(self):
+        from apex_tpu.observability.report import read_records
+        from apex_tpu.observability.trace import check_span_conservation
+
+        records = read_records(self.PRE_PR18)
+        assert check_span_conservation(records) == []
+
+
+class TestFlightRecorder:
+    """The bounded-ring recorder + incident bundle dumper."""
+
+    def _registry_with_recorder(self, **kwargs):
+        rec = FlightRecorder(**kwargs)
+        reg = MetricsRegistry([rec])
+        rec.attach(None, reg)
+        return reg, rec
+
+    def test_rings_bounded_o_capacity(self):
+        """Memory stays O(capacity) no matter how long the run is —
+        the ring length never exceeds maxlen and keeps the NEWEST
+        records."""
+        reg, rec = self._registry_with_recorder(
+            events_capacity=4, records_capacity=3, gauges_capacity=2,
+            triggers=frozenset())
+        for i in range(50):
+            reg.event("tick", i=i)
+            reg.emit_record({"kind": "request", "request_id": i})
+            reg.emit_record({"kind": "gauge_snapshot", "signals": {},
+                             "i": i})
+        assert len(rec.events) == 4 and rec.events.maxlen == 4
+        assert [e["i"] for e in rec.events] == [46, 47, 48, 49]
+        assert len(rec.records) == 3
+        assert [r["request_id"] for r in rec.records] == [47, 48, 49]
+        assert len(rec.gauge_snapshots) == 2
+
+    def test_incident_event_triggers_exactly_one_dump(self):
+        """Any TRIGGER_EVENTS member flowing through the sink dumps a
+        bundle; the max_bundles=1 latch makes later incidents no-ops."""
+        reg, rec = self._registry_with_recorder(max_bundles=1)
+        reg.event("heartbeat")            # not incident-class
+        assert rec.bundles == []
+        reg.event("engine_restart", replica_id=0)
+        assert len(rec.bundles) == 1
+        reg.event("engine_restart", replica_id=1)
+        reg.event("replica_quarantine", replica_id=1)
+        assert len(rec.bundles) == 1      # latched
+        assert reg.counters()["bundles_dumped"] == 1
+        bundle = rec.bundles[0]
+        assert bundle["schema"] == 1
+        assert bundle["trigger"]["event"] == "engine_restart"
+        # the trigger itself sits inside the ring window it froze
+        assert any(e.get("event") == "engine_restart"
+                   for e in bundle["events"])
+
+    def test_bundle_dumped_is_not_a_trigger(self):
+        """The dump's own co-sited event must never re-trigger a dump
+        (and is statically excluded from the trigger table)."""
+        assert "bundle_dumped" not in TRIGGER_EVENTS
+        reg, rec = self._registry_with_recorder(max_bundles=5)
+        reg.event("engine_restart")
+        assert len(rec.bundles) == 1      # one incident, one bundle
+
+    def test_bundle_counters_snapshot_precedes_own_increment(self):
+        """The bundle freezes the counters as they were AT the incident
+        — its own ``bundles_dumped`` increment lands after the
+        snapshot."""
+        reg, rec = self._registry_with_recorder()
+        reg.inc("engine_restarts")
+        reg.event("engine_restart")
+        bundle = rec.bundles[0]
+        assert bundle["counters"]["engine_restarts"] == 1
+        assert bundle["counters"]["bundles_dumped"] == 0
+        assert reg.counters()["bundles_dumped"] == 1
+
+    def test_bundle_reconciles_key_for_key(self):
+        """Dumping follows the reconcile contract: one counter inc
+        co-sited with one ``bundle_dumped`` event and one
+        ``kind="bundle"`` record."""
+        mem = InMemorySink()
+        rec = FlightRecorder()
+        reg = MetricsRegistry([mem, rec])
+        rec.attach(None, reg)
+        reg.event("tick_failure")
+        events = [e for e in mem.of_kind("event")
+                  if e["event"] == "bundle_dumped"]
+        records = mem.of_kind("bundle")
+        assert len(events) == 1 == len(records)
+        assert reg.counters()["bundles_dumped"] == 1
+        assert records[0]["trigger"] == "tick_failure"
+
+    def test_bundle_file_is_self_contained_json(self, tmp_path):
+        """With a bundle_dir the dump lands as one deterministic-named
+        JSON file, loadable with nothing but the stdlib."""
+        rec = FlightRecorder(bundle_dir=str(tmp_path),
+                             bundle_prefix="myrun")
+        reg = MetricsRegistry([rec])
+        rec.attach(None, reg)
+        reg.emit_record({"kind": "signals",
+                         "values": {"queue_depth": 7}})
+        reg.event("deploy_rollback")
+        path = tmp_path / "myrun-bundle-1.json"
+        assert rec.bundle_paths == [str(path)]
+        bundle = json.loads(path.read_text())
+        assert bundle["kind"] == "flight_bundle"
+        assert bundle["trigger"]["event"] == "deploy_rollback"
+        assert bundle["signals"] == {"queue_depth": 7}
+
+    def test_dump_never_raises_on_torn_target(self):
+        """Postmortem evidence is best-effort: a digest target that
+        explodes mid-incident degrades the digest, not the serving
+        path."""
+        class Torn:
+            @property
+            def replicas(self):
+                raise RuntimeError("mid-rebuild")
+
+        rec = FlightRecorder()
+        reg = MetricsRegistry([rec])
+        rec.attach(Torn(), reg)
+        reg.event("engine_restart")       # must not raise
+        assert len(rec.bundles) == 1
+        assert rec.bundles[0]["replicas"] == []
+
+    def test_capacity_validation(self):
+        with pytest.raises(ValueError):
+            FlightRecorder(events_capacity=0)
+        with pytest.raises(ValueError):
+            FlightRecorder(max_bundles=-1)
+
+    def test_retrace_watchdog_event_triggers_dump(self):
+        """Satellite: a real RetraceWatchdog recompile is an
+        incident-class trigger — the shape-drift postmortem survives
+        even though retrace counters batch."""
+        rec = FlightRecorder()
+        reg = MetricsRegistry([rec])
+        rec.attach(None, reg)
+        f = jax.jit(lambda x: x * 2)
+        wd = RetraceWatchdog(f, budget=None, metrics=reg)
+        wd(jnp.ones((2,)))
+        wd(jnp.ones((3,)))       # retrace -> trigger
+        assert len(rec.bundles) == 1
+        assert rec.bundles[0]["trigger"]["event"] == "retrace"
+
+    def test_trigger_table_covers_every_incident_map(self):
+        """LOCK: TRIGGER_EVENTS must be a superset of every key of
+        every ``*_INCIDENT_COUNTERS`` map the monitor reconciles —
+        the inclusion APX013 re-checks tree-wide."""
+        from apex_tpu.observability import report as report_mod
+
+        for name in dir(report_mod):
+            if not name.endswith("_INCIDENT_COUNTERS"):
+                continue
+            for event in getattr(report_mod, name):
+                assert event in TRIGGER_EVENTS, (
+                    f"{name} key {event!r} missing from TRIGGER_EVENTS")
+        assert "retrace" in TRIGGER_EVENTS   # recorder-only extra
+
+
+class TestDriftSentinel:
+    """The pure EWMA/robust-z detector core, then the fleet seam."""
+
+    def _drive(self, sentinel, values, start=0.0, dt=1.0):
+        fired = []
+        for i, v in enumerate(values):
+            fired.extend(sentinel.observe({"queue_depth": v},
+                                          start + i * dt))
+        return fired
+
+    def test_warmup_gate_holds_fire(self):
+        s = DriftSentinel(SentinelConfig(
+            warmup_polls=5, hysteresis_polls=1, min_abs_dev=0.5,
+            signals=("queue_depth",)))
+        # a huge excursion during warmup is baseline-learning, not news
+        assert self._drive(s, [0, 0, 100, 0]) == []
+
+    def test_spike_fires_after_hysteresis(self):
+        s = DriftSentinel(SentinelConfig(
+            warmup_polls=3, hysteresis_polls=2, z_threshold=4.0,
+            min_abs_dev=0.5, cooldown_s=100.0,
+            signals=("queue_depth",)))
+        fired = self._drive(s, [1, 1, 1, 1, 30, 30, 30])
+        assert len(fired) == 1            # breach #2 arms it, once
+        a = fired[0]
+        assert a["signal"] == "queue_depth" and a["value"] == 30.0
+        assert a["z"] >= 4.0 and a["baseline"] < 2.0
+
+    def test_single_breach_is_not_an_anomaly(self):
+        """hysteresis_polls=2: one outlier poll (a scheduling blip)
+        stays quiet."""
+        s = DriftSentinel(SentinelConfig(
+            warmup_polls=3, hysteresis_polls=2, min_abs_dev=0.5,
+            signals=("queue_depth",)))
+        assert self._drive(s, [1, 1, 1, 1, 30, 1, 1, 30, 1]) == []
+
+    def test_breaches_do_not_corrupt_baseline(self):
+        """Breach values are evidence about the incident, not the
+        baseline: after the excursion the baseline still reflects the
+        healthy level."""
+        s = DriftSentinel(SentinelConfig(
+            warmup_polls=3, hysteresis_polls=2, min_abs_dev=0.5,
+            cooldown_s=0.0, signals=("queue_depth",)))
+        self._drive(s, [1, 1, 1, 1, 30, 30])
+        assert s._trackers["queue_depth"].mean < 2.0
+
+    def test_direction_a_good_day_never_fires(self):
+        """goodput_window degrades DOWN: a jump above baseline is an
+        improvement, not an anomaly."""
+        s = DriftSentinel(SentinelConfig(
+            warmup_polls=3, hysteresis_polls=1, min_abs_dev=0.01,
+            signals=("goodput_window",)))
+        fired = []
+        for i, v in enumerate([0.5, 0.5, 0.5, 0.5, 1.0, 1.0]):
+            fired.extend(s.observe({"goodput_window": v}, float(i)))
+        assert fired == []
+        # ...while the same magnitude downward fires
+        s2 = DriftSentinel(SentinelConfig(
+            warmup_polls=3, hysteresis_polls=1, min_abs_dev=0.01,
+            signals=("goodput_window",)))
+        fired2 = []
+        for i, v in enumerate([0.5, 0.5, 0.5, 0.5, 0.0]):
+            fired2.extend(s2.observe({"goodput_window": v}, float(i)))
+        assert len(fired2) == 1
+
+    def test_cooldown_suppresses_refire(self):
+        s = DriftSentinel(SentinelConfig(
+            warmup_polls=3, hysteresis_polls=1, z_threshold=4.0,
+            min_abs_dev=0.5, cooldown_s=100.0,
+            signals=("queue_depth",)))
+        fired = self._drive(s, [1, 1, 1, 1, 30, 35, 40, 45])
+        assert len(fired) == 1            # one excursion, one anomaly
+
+    def test_none_and_missing_signals_are_skipped(self):
+        s = DriftSentinel(SentinelConfig(
+            warmup_polls=2, hysteresis_polls=1, min_abs_dev=0.5,
+            signals=("queue_depth", "ttft_p99_s")))
+        # None (idle window) and absent keys never touch the tracker
+        for i in range(6):
+            s.observe({"queue_depth": 1.0, "ttft_p99_s": None},
+                      float(i))
+        assert s._trackers["ttft_p99_s"].samples == 0
+        assert s._trackers["queue_depth"].samples == 6
+
+    def test_min_abs_dev_floors_flat_baselines(self):
+        """A perfectly flat baseline has dev=0 — without the floor the
+        first real wiggle would divide by ~zero and fire on noise."""
+        s = DriftSentinel(SentinelConfig(
+            warmup_polls=3, hysteresis_polls=1, z_threshold=4.0,
+            min_abs_dev=2.0, signals=("queue_depth",)))
+        # wiggles of |x - 0| < 2*4 stay under threshold
+        assert self._drive(s, [0, 0, 0, 0, 3, 4, 3, 5, 0]) == []
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError):
+            SentinelConfig(ewma_alpha=0.0)
+        with pytest.raises(ValueError):
+            SentinelConfig(warmup_polls=0)
+        with pytest.raises(ValueError):
+            SentinelConfig(signals=())
+
+    class _FakeSupervisor:
+        queued_count = 0
+        active_count = 0
+        queued_prompt_tokens = 0
+
+    class _FakeReplica:
+        def __init__(self):
+            self.supervisor = TestDriftSentinel._FakeSupervisor()
+
+    class _FakeConfig:
+        max_slots = 4
+
+    class _FakeFleet:
+        """Duck-typed just far enough for FleetMetrics: the sentinel's
+        fleet seam is the interface, not the ReplicaFleet class."""
+
+        def __init__(self, registry):
+            self.metrics = registry
+            self.replica_metrics = {0: MetricsRegistry()}
+            self.replicas = [TestDriftSentinel._FakeReplica()]
+            self.config = TestDriftSentinel._FakeConfig()
+            self.inflight_count = 0
+
+        def dispatch_set(self):
+            return list(self.replicas)
+
+    def test_maybe_poll_declares_and_reconciles_counters(self):
+        """The fleet seam: counters declared up front (snapshots carry
+        the keys at zero), poll gating by interval, anomaly emission
+        co-sited counter+event+record, periodic gauge_snapshot."""
+        mem = InMemorySink()
+        reg = MetricsRegistry([mem])
+        fleet = self._FakeFleet(reg)
+        s = DriftSentinel(SentinelConfig(
+            poll_interval_s=1.0, warmup_polls=2, hysteresis_polls=1,
+            z_threshold=4.0, min_abs_dev=0.5, snapshot_every_polls=2,
+            signals=("queue_depth",)))
+        assert s.maybe_poll(fleet, 0.0) == []
+        counters = reg.counters()
+        assert counters["anomalies_total"] == 0
+        assert counters["anomalies_queue_depth"] == 0
+        assert counters["gauge_snapshots"] == 0
+        # inside the interval: gated, no poll consumed
+        assert s.maybe_poll(fleet, 0.5) == [] and s.polls == 1
+        s.maybe_poll(fleet, 1.0)          # poll 2 -> gauge_snapshot
+        assert reg.counters()["gauge_snapshots"] == 1
+        snaps = mem.of_kind("gauge_snapshot")
+        assert len(snaps) == 1
+        assert "queue_depth" in snaps[0]["signals"]
+        # now degrade: queue_depth jumps fleet-wide
+        self._FakeSupervisor.queued_count = 40
+        try:
+            fired = s.maybe_poll(fleet, 2.0)
+        finally:
+            self._FakeSupervisor.queued_count = 0
+        assert len(fired) == 1
+        counters = reg.counters()
+        assert counters["anomalies_total"] == 1
+        assert counters["anomalies_queue_depth"] == 1
+        events = [e for e in mem.of_kind("event")
+                  if e["event"] == "anomaly"]
+        records = mem.of_kind("anomaly")
+        assert len(events) == 1 == len(records)
+        assert records[0]["signal"] == "queue_depth"
+
+
+class TestBundleRendering:
+    """``python -m apex_tpu.monitor bundle <path>`` — the postmortem
+    reader."""
+
+    def _dump_bundle(self, tmp_path):
+        rec = FlightRecorder(bundle_dir=str(tmp_path),
+                             bundle_prefix="t")
+        reg = MetricsRegistry([rec])
+        rec.attach(None, reg)
+        reg.emit_record({"kind": "gauge_snapshot", "wall": 1.0,
+                         "signals": {"queue_depth": 0,
+                                     "ttft_p99_s": 0.1}})
+        reg.emit_record({"kind": "gauge_snapshot", "wall": 2.0,
+                         "signals": {"queue_depth": 9,
+                                     "ttft_p99_s": 0.4}})
+        reg.emit_record({"kind": "request", "request_id": 0,
+                         "wall": 2.5})
+        reg.event("anomaly", signal="queue_depth", value=9.0, z=5.0)
+        return rec.bundle_paths[0]
+
+    def test_render_marks_trigger_inside_timeline(self, tmp_path):
+        from apex_tpu.observability.report import render_bundle
+
+        path = self._dump_bundle(tmp_path)
+        text = render_bundle(json.loads(open(path).read()))
+        assert "postmortem bundle" in text
+        assert "trigger: anomaly" in text
+        # the trigger row is matched in the merged ring timeline
+        assert ">>" in text
+        assert "queue_depth" in text and "0 -> 9" in text
+
+    def test_monitor_bundle_cli_human_and_json(self, tmp_path, capsys):
+        from apex_tpu.observability.report import main as monitor_main
+
+        path = self._dump_bundle(tmp_path)
+        assert monitor_main(["bundle", path]) == 0
+        human = capsys.readouterr().out
+        assert "trigger: anomaly" in human
+        assert monitor_main(["bundle", path, "--json"]) == 0
+        bundle = json.loads(capsys.readouterr().out)
+        assert bundle["kind"] == "flight_bundle"
+        assert bundle["trigger"]["event"] == "anomaly"
+
+    def test_monitor_bundle_cli_bad_path_exits_2(self, tmp_path):
+        from apex_tpu.observability.report import main as monitor_main
+
+        assert monitor_main(["bundle",
+                             str(tmp_path / "missing.json")]) == 2
+        torn = tmp_path / "torn.json"
+        torn.write_text("{not json")
+        assert monitor_main(["bundle", str(torn)]) == 2
+
+
+class TestLabeledHistogramExport:
+    """FleetMetrics' labeled histograms through the Prometheus sink:
+    one TYPE line per family, per-replica label splits, quantiles
+    folded into the label block."""
+
+    def test_one_type_line_per_family_with_label_splits(self, tmp_path):
+        path = tmp_path / "prom.txt"
+        sink = PrometheusTextfileSink(str(path))
+        summ = {"count": 3, "sum": 0.6, "p50": 0.2, "p95": 0.3}
+        sink.write({"kind": "histograms", "values": {
+            "request_ttft_s": dict(summ),
+            'request_ttft_s{replica="0"}': dict(summ),
+            'request_ttft_s{replica="1"}': dict(summ)}})
+        sink.flush()
+        text = path.read_text()
+        assert text.count("# TYPE apex_tpu_request_ttft_s summary") == 1
+        assert "apex_tpu_request_ttft_s_count 3" in text
+        assert 'apex_tpu_request_ttft_s_count{replica="0"} 3' in text
+        assert 'apex_tpu_request_ttft_s_sum{replica="1"} 0.6' in text
+        # quantile merged into the replica label block, not appended
+        assert ('apex_tpu_request_ttft_s{replica="0",quantile="0.50"} '
+                "0.2") in text
+        assert 'apex_tpu_request_ttft_s{quantile="0.95"} 0.3' in text
