@@ -44,6 +44,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-faults", action="store_true",
                    help="drop fault/poisoned-deploy events from the "
                         "schedule vocabulary")
+    p.add_argument("--preempt", action="store_true",
+                   help="add the preempt/resume/quota_exceeded events "
+                        "(arrivals get priority classes, one tenant is "
+                        "quota-capped)")
     p.add_argument("--mutate", choices=sorted(MUTATIONS), default=None,
                    help="inject a named protocol bug (the mutation "
                         "gate: the checker must catch it)")
@@ -66,7 +70,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     cfg = MCConfig(replicas=args.replicas, depth=args.depth,
                    schedules=args.schedules, seed=args.seed,
-                   faults=not args.no_faults, mutation=args.mutate)
+                   faults=not args.no_faults, preempt=args.preempt,
+                   mutation=args.mutate)
 
     if args.replay is not None:
         res = replay(cfg, args.replay, args.indices)

@@ -40,7 +40,17 @@ _WEIGHTED_KINDS = (
     ("fault", 2),
 )
 
-EVENT_KINDS = tuple(kind for kind, _ in _WEIGHTED_KINDS)
+#: priority/quota kinds (ISSUE 20), appended AFTER the base vocabulary
+#: and only drawn when ``preempt=True`` — with the flag off the
+#: generator's draw table is byte-identical to the pre-priority one,
+#: so existing (seed, depth) reproductions keep replaying the same run
+_PREEMPT_KINDS = (
+    ("preempt", 2),
+    ("resume", 2),
+    ("quota_exceeded", 2),
+)
+
+EVENT_KINDS = tuple(kind for kind, _ in _WEIGHTED_KINDS + _PREEMPT_KINDS)
 
 
 @dataclass(frozen=True)
@@ -59,13 +69,17 @@ class Event:
 
 def generate_schedule(seed: int, depth: int, *,
                       faults: bool = True,
+                      preempt: bool = False,
                       kinds: Optional[Sequence[str]] = None) -> List[Event]:
     """The seeded schedule: ``depth`` weighted draws from the event
     vocabulary. ``faults=False`` drops the fault/poisoned-deploy kinds
-    (the bug-free baseline run); ``kinds`` restricts the alphabet (the
-    exhaustive mode drives this)."""
+    (the bug-free baseline run); ``preempt=True`` adds the
+    priority-preemption/quota kinds (and makes the harness stamp
+    priority classes on arrivals); ``kinds`` restricts the alphabet
+    (the exhaustive mode drives this)."""
     rng = random.Random(seed)
-    table = [(k, w) for k, w in _WEIGHTED_KINDS
+    vocab = _WEIGHTED_KINDS + (_PREEMPT_KINDS if preempt else ())
+    table = [(k, w) for k, w in vocab
              if (kinds is None or k in kinds)
              and (faults or k not in ("fault", "deploy_poisoned"))]
     population = [k for k, _ in table]

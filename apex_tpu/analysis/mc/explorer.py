@@ -66,6 +66,8 @@ class ExploreResult:
                 cmd += f" --mutate {self.cfg.mutation}"
             if not self.cfg.faults:
                 cmd += " --no-faults"
+            if self.cfg.preempt:
+                cmd += " --preempt"
             lines.append(f"  replay: {cmd}")
         return "\n".join(lines)
 
@@ -109,7 +111,8 @@ def explore(cfg: MCConfig) -> ExploreResult:
     seed-replayable reproduction."""
     for i in range(cfg.schedules):
         seed = cfg.seed + i
-        schedule = generate_schedule(seed, cfg.depth, faults=cfg.faults)
+        schedule = generate_schedule(seed, cfg.depth, faults=cfg.faults,
+                                     preempt=cfg.preempt)
         res = run_schedule(cfg, schedule, seed=seed)
         if res.ok:
             continue
@@ -129,7 +132,8 @@ def replay(cfg: MCConfig, seed: int,
     """Re-run the schedule named by ``seed`` (optionally restricted to
     the minimized ``indices``) — the other half of the reproduction
     contract printed by :class:`ExploreResult`."""
-    schedule = generate_schedule(seed, cfg.depth, faults=cfg.faults)
+    schedule = generate_schedule(seed, cfg.depth, faults=cfg.faults,
+                                 preempt=cfg.preempt)
     if indices is not None:
         schedule = [schedule[i] for i in indices]
     return run_schedule(cfg, schedule, seed=seed)

@@ -20,6 +20,10 @@ the mutation gate (tests prove the checker actually catches them):
 ``double_terminal_drain`` makes a draining supervisor emit a second
 terminal record for the first continuation it hands over — the classic
 exactly-once violation a drain/migration race would produce.
+``double_terminal_preempt`` does the same on the preempt/resume path:
+the parked-request drain records its first continuation as terminal
+while the resume goes on to finish again (requires ``preempt`` mode so
+schedules actually park something).
 """
 
 from __future__ import annotations
@@ -47,7 +51,14 @@ from apex_tpu.serving.fleet.router import (
     FleetConfig,
     ReplicaFleet,
 )
-from apex_tpu.serving.request import FINISH_LENGTH, Request, RequestResult
+from apex_tpu.serving.fleet.quota import QuotaConfig, TenantQuota
+from apex_tpu.serving.request import (
+    FINISH_LENGTH,
+    PRIORITIES,
+    Request,
+    RequestResult,
+    SamplingParams,
+)
 from apex_tpu.serving.supervisor import EngineSupervisor
 from apex_tpu.testing_faults import (
     ServingFaultInjector,
@@ -69,6 +80,7 @@ class MCConfig:
     schedules: int = 50
     seed: int = 0
     faults: bool = True
+    preempt: bool = False
     mutation: Optional[str] = None
     max_replicas: int = 4
     max_queue: int = 4
@@ -123,8 +135,37 @@ def _mutate_double_terminal(stack: contextlib.ExitStack) -> None:
         lambda: setattr(EngineSupervisor, "detach_for_migration", orig))
 
 
+def _mutate_double_terminal_preempt(stack: contextlib.ExitStack) -> None:
+    """The preempt-path exactly-once bug: when the supervisor drains a
+    parked (preempted) request into its resume continuation, it ALSO
+    records the first one as terminal (``length``) with its parked
+    partial tokens — while the continuation goes on to finish again.
+    The resume path itself is untouched (the drain still runs, tracking
+    maps stay consistent), so only the telemetry contract breaks: one
+    request id, two terminal records, counters that no longer sum."""
+    orig = EngineSupervisor._drain_parked
+
+    def buggy(sup, now):
+        parked = list(getattr(sup.engine, "_parked", ()))[:1]
+        orig(sup, now)
+        for request, tokens, _submit_ts in parked:
+            res = RequestResult(
+                request_id=request.request_id,
+                prompt_len=request.prompt_len, tokens=list(tokens),
+                finish_reason=FINISH_LENGTH, queue_s=0.0, total_s=0.0,
+                replica_id=sup.replica_id,
+                priority=request.sampling.priority)
+            sup.metrics.inc(f"requests_{FINISH_LENGTH}")
+            sup.metrics.emit_record(res.record(wall=clock.wall()))
+
+    EngineSupervisor._drain_parked = buggy
+    stack.callback(
+        lambda: setattr(EngineSupervisor, "_drain_parked", orig))
+
+
 MUTATIONS = {
     "double_terminal_drain": _mutate_double_terminal,
+    "double_terminal_preempt": _mutate_double_terminal_preempt,
 }
 
 
@@ -164,12 +205,20 @@ class FleetHarness:
             page_size=cfg.page_size,
             scheduler=SchedulerConfig(max_queue=cfg.max_queue,
                                       max_prefills_per_tick=1))
+        # preempt mode adds a rate-and-inflight-capped tenant so the
+        # quota_exceeded event has a door to bounce off; every other
+        # tenant (the adapterless "base" arrivals) stays unlimited,
+        # keeping the base vocabulary's behaviour untouched
+        quotas = QuotaConfig(tenants={"t0": TenantQuota(
+            rate_rps=1.0, burst=2, max_inflight=2)}) \
+            if cfg.preempt else None
         self.fleet = ReplicaFleet(
             self.model, self.params, engine_config,
             fleet=FleetConfig(n_replicas=cfg.replicas),
             metrics=self.registry,
             faults=self.injectors,
             engine_factory=factory,
+            quotas=quotas,
             autoscale=AutoscaleConfig(
                 min_replicas=1, max_replicas=cfg.max_replicas,
                 poll_interval_s=0.1, cooldown_s=0.3,
@@ -193,21 +242,33 @@ class FleetHarness:
         self._tick_once()
         return "tick"
 
-    def _submit(self, ev: Event, deadline_s: Optional[float]) -> str:
+    def _submit(self, ev: Event, deadline_s: Optional[float], *,
+                adapter_id: Optional[str] = None) -> str:
         prompt = [1 + ev.b % 7] + [2] * (ev.a % 4)
         max_new = 1 + (ev.a + ev.b) % 5
         rid = self._next_rid
         self._next_rid += 1
+        tag = ""
+        kwargs = {}
+        if self.cfg.preempt:
+            # stamp a class (and optionally a tenant) only in preempt
+            # mode — the base vocabulary keeps default-sampled requests,
+            # so pre-priority (seed, depth) runs replay bit-for-bit
+            priority = PRIORITIES[ev.b % len(PRIORITIES)]
+            kwargs["sampling"] = SamplingParams(
+                adapter_id=adapter_id, priority=priority)
+            tag = f" class={priority}" + \
+                (f" tenant={adapter_id}" if adapter_id else "")
         req = Request(prompt=prompt, max_new_tokens=max_new,
                       request_id=rid, arrival_ts=clock.now(),
-                      deadline_s=deadline_s)
+                      deadline_s=deadline_s, **kwargs)
         self.expected[rid] = (list(req.prompt), max_new)
         try:
             self.fleet.submit(req)
         except Exception as exc:   # shed/rejected: recorded terminally
-            return (f"arrive r{rid} -> rejected at the door "
+            return (f"arrive r{rid}{tag} -> rejected at the door "
                     f"({type(exc).__name__})")
-        return f"arrive r{rid} prompt={len(prompt)} max_new={max_new}"
+        return f"arrive r{rid}{tag} prompt={len(prompt)} max_new={max_new}"
 
     def _ev_arrive(self, ev: Event) -> str:
         return self._submit(ev, None)
@@ -298,6 +359,54 @@ class FleetHarness:
 
     def _ev_deploy_poisoned(self, ev: Event) -> str:
         return self._deploy(ev, poisoned=True)
+
+    def _ev_preempt(self, ev: Event) -> str:
+        if not self.cfg.preempt:
+            return "preempt: no-op (preempt mode off)"
+        # one tick first: arrivals admit at tick time, so without it a
+        # preempt right after an arrive would always find empty slots
+        self._tick_once()
+        active = [r for r in self.fleet.replicas
+                  if r.state == REPLICA_ACTIVE]
+        if not active:
+            return "preempt: no-op (no active replica)"
+        replica = active[ev.a % len(active)]
+        # never interactive: no class outranks it, so in production
+        # nothing can preempt it — the checker verifies the mechanism
+        # on the classes the ladder actually parks. Try the drawn class
+        # first, fall back to the other preemptible one, so the event
+        # parks whenever ANY preemptible slot is running
+        first = 1 + ev.b % (len(PRIORITIES) - 1)
+        parked, cls = 0, None
+        for idx in (first, 3 - first):
+            cls = PRIORITIES[idx]
+            parked = replica.supervisor.preempt_class(
+                cls, cause="schedule")
+            if parked:
+                break
+        return (f"preempt replica {replica.replica_id} class={cls} "
+                f"-> parked {parked}")
+
+    def _ev_resume(self, ev: Event) -> str:
+        if not self.cfg.preempt:
+            return "resume: no-op (preempt mode off)"
+        # resume is the supervisor's own tick-time drain of parked
+        # continuations — the event just guarantees one happens here
+        self._tick_once()
+        return "resume: tick (drain parked continuations)"
+
+    def _ev_quota_exceeded(self, ev: Event) -> str:
+        if not self.cfg.preempt:
+            return "quota_exceeded: no-op (preempt mode off)"
+        # a same-instant burst from the capped tenant: past the bucket
+        # burst (2) and inflight cap (2), the tail is shed at the door
+        n = 3 + ev.a % 2
+        lines = [self._submit(Event("arrive", a=(ev.a + i) % 8,
+                                    b=(ev.b + i) % 8),
+                              None, adapter_id="t0")
+                 for i in range(n)]
+        shed = sum("rejected at the door" in line for line in lines)
+        return f"quota_exceeded: burst {n} as tenant t0 -> {shed} shed"
 
     def _ev_fault(self, ev: Event) -> str:
         if not self.injectors:

@@ -27,11 +27,17 @@ typed record, and terminal request record flows through it):
   leaves that state within a bounded horizon of tick events.
 - ``counter_reconcile`` — every fleet lifecycle counter
   (``replica_drains``, ``replica_scale_*``, ``deploys_*``,
-  ``canary_promotions``, ...) equals, key for key, the count of its
-  same-named incident events; the ``deploys_*`` family additionally
-  equals the count of typed ``kind="deploy"`` records claiming each
-  action, and applied autoscale decisions never exceed the scale
-  counters they summarize.
+  ``canary_promotions``, ``requests_preempted``, ``requests_resumed``,
+  ...) equals, key for key, the count of its same-named incident
+  events; the ``deploys_*`` family additionally equals the count of
+  typed ``kind="deploy"`` records claiming each action,
+  ``requests_shed_quota`` equals the count of ``request_shed`` events
+  claiming ``reason="quota"``, and applied autoscale decisions never
+  exceed the scale counters they summarize.
+- ``no_starvation`` — at quiescence every harness-submitted request id
+  (including preempted-then-resumed and quota-deferred ones) appears in
+  ``fleet.completed``: lower classes may wait arbitrarily under load,
+  but a bounded settle horizon always retires them terminally.
 """
 
 from __future__ import annotations
@@ -61,6 +67,11 @@ COUNTER_EVENTS = {
     "deploys_rolled_back": "deploy_rollback",
     "deploys_rejected": "deploy_rejected",
     "canary_promotions": "canary_promoted",
+    "requests_preempted": "request_preempted",
+    "requests_resumed": "request_resumed",
+    "requests_deferred_quota": "request_quota_deferred",
+    "brownouts_escalated": "brownout_escalate",
+    "brownouts_recovered": "brownout_recover",
 }
 
 #: deploys_* counter -> the typed kind="deploy" record action it counts
@@ -143,6 +154,14 @@ class InvariantChecker:
                 "exactly_once",
                 f"requests_submitted={submitted} but terminal counters "
                 f"sum to {terminal}", -1, dedup_key="counter-sum")
+        missing = [rid for rid in sorted(self.h.expected)
+                   if rid not in self.h.fleet.completed]
+        if missing:
+            self._report(
+                "no_starvation",
+                f"{len(missing)} request(s) never reached a terminal "
+                f"result by quiescence: {missing[:8]}", -1,
+                dedup_key="starved")
         return self.violations[before:]
 
     def _check_exactly_once(self, step: int) -> None:
@@ -286,6 +305,15 @@ class InvariantChecker:
                     f"counter {counter}={have} but {want} typed "
                     f"kind=\"deploy\" action={action!r} records", step,
                     dedup_key=("deploy", counter))
+        shed_quota = sum(1 for r in self._events("request_shed")
+                         if r.get("reason") == "quota")
+        have = counters.get("requests_shed_quota", 0)
+        if have != shed_quota:
+            self._report(
+                "counter_reconcile",
+                f"counter requests_shed_quota={have} but {shed_quota} "
+                f"'request_shed' events claim reason='quota'", step,
+                dedup_key="requests_shed_quota")
         autoscale = self._records("autoscale")
         for action, counter in (("scale_up", "replica_scale_ups"),
                                 ("scale_down", "replica_scale_downs")):

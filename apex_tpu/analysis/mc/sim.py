@@ -47,6 +47,7 @@ import math
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+from apex_tpu.observability.trace import SPAN_PREEMPT, emit_span
 from apex_tpu.serving import clock
 from apex_tpu.serving.request import (
     FINISH_CANCELLED,
@@ -55,6 +56,7 @@ from apex_tpu.serving.request import (
     FINISH_REJECTED,
     FINISH_TIMEOUT,
     FINISH_ERROR,
+    PRIORITY_RANK,
     Request,
     RequestResult,
 )
@@ -67,7 +69,8 @@ __all__ = ["SimModelConfig", "SimModel", "SimPagePool", "SimEngine",
 #: engine's so final snapshots carry every key
 _SIM_COUNTERS = ("requests_submitted", "requests_eos", "requests_length",
                  "requests_cancelled", "requests_timeout",
-                 "requests_rejected", "requests_error")
+                 "requests_rejected", "requests_error",
+                 "requests_preempted")
 
 
 def sim_token(first_prompt_token: int, position: int) -> int:
@@ -193,6 +196,13 @@ class SimEngine:
         self.completed: Dict[int, RequestResult] = {}
         self._queue: List[Tuple[Request, float]] = []
         self._active: Dict[int, _SimActive] = {}
+        #: preempted requests: (request, generated_tokens, submit_ts) —
+        #: same shape the real engine parks (pages already released)
+        self._parked: List[Tuple[Request, List[int], float]] = []
+        #: set True by the supervisor (it drains take_parked each tick);
+        #: gates engine-initiated preemption, same as the real engine
+        self.resume_consumer = False
+        self._floor: Optional[str] = None
         self.scheduler = _SimScheduler(self)
         self.prefill_compiles = 0
         self.decode_compiles = 0
@@ -212,9 +222,30 @@ class SimEngine:
     def queued_tokens(self) -> int:
         return sum(req.prompt_len for req, _ in self._queue)
 
+    def queued_depth_by_class(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for req, _ in self._queue:
+            p = req.sampling.priority
+            out[p] = out.get(p, 0) + 1
+        return out
+
+    def queued_tokens_by_class(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for req, _ in self._queue:
+            p = req.sampling.priority
+            out[p] = out.get(p, 0) + req.prompt_len
+        return out
+
+    @property
+    def parked_count(self) -> int:
+        return len(self._parked)
+
     def inflight(self) -> List:
-        return [(rec.request, list(rec.tokens), rec.submit_ts)
-                for _, rec in sorted(self._active.items())]
+        out = [(rec.request, list(rec.tokens), rec.submit_ts)
+               for _, rec in sorted(self._active.items())]
+        out.extend((req, list(toks), ts)
+                   for req, toks, ts in self._parked)
+        return out
 
     # -- request lifecycle ------------------------------------------------
 
@@ -255,6 +286,11 @@ class SimEngine:
         if rec is not None:
             rec.cancelled = True
             return True
+        for i, (req, toks, ts) in enumerate(self._parked):
+            if req.request_id == request_id:
+                del self._parked[i]
+                self._finish(req, toks, FINISH_CANCELLED, ts, clock.now())
+                return True
         return False
 
     def tick(self) -> List[RequestResult]:
@@ -267,6 +303,7 @@ class SimEngine:
         now = clock.now()
         self._expire(now)
         self._evict_cancelled(now)
+        self._maybe_preempt(now)
         self._admit(now)
         if self._active:
             if self._faults is not None:
@@ -287,6 +324,7 @@ class SimEngine:
             self.pool.free(rec.pages)
         self._active.clear()
         self._queue.clear()
+        self._parked.clear()    # pages already released at park time
 
     # -- the phases -------------------------------------------------------
 
@@ -298,6 +336,10 @@ class SimEngine:
         for rid, rec in list(self._active.items()):
             if self._deadline_over(rec.request, rec.submit_ts, now):
                 self._retire_active(rid, FINISH_TIMEOUT, now)
+        for req, toks, ts in list(self._parked):
+            if self._deadline_over(req, ts, now):
+                self._parked.remove((req, toks, ts))
+                self._finish(req, toks, FINISH_TIMEOUT, ts, now)
 
     @staticmethod
     def _deadline_over(req: Request, submit_ts: float, now: float) -> bool:
@@ -311,12 +353,94 @@ class SimEngine:
             if rec.cancelled:
                 self._retire_active(rid, FINISH_CANCELLED, now)
 
+    def _admissible(self) -> List[int]:
+        """Queue indices dispatchable under the admission floor, in
+        class-then-FCFS order (strict priority, same policy as the real
+        scheduler; the sim has no aging — schedules are short)."""
+        floor = PRIORITY_RANK.get(self._floor) if self._floor else None
+        order = []
+        for i, (req, _) in enumerate(self._queue):
+            rank = PRIORITY_RANK[req.sampling.priority]
+            if floor is not None and rank > floor:
+                continue
+            order.append((rank, i))
+        return [i for _, i in sorted(order)]
+
+    def _maybe_preempt(self, now: float) -> None:
+        """Engine-initiated preemption, mirroring the real engine: when
+        the highest-class queued request is blocked on slots, park ONE
+        strictly-lower-class active slot (most tokens-cheap victim
+        first). Gated on ``resume_consumer`` — only a supervisor that
+        drains ``take_parked()`` may trigger it."""
+        if not self.resume_consumer or not self._active:
+            return
+        order = self._admissible()
+        if not order:
+            return
+        if len(self._active) < self.config.max_slots:
+            return
+        head_rank = PRIORITY_RANK[
+            self._queue[order[0]][0].sampling.priority]
+        victims = [
+            (PRIORITY_RANK[rec.request.sampling.priority],
+             -len(rec.tokens), rid)
+            for rid, rec in self._active.items()
+            if PRIORITY_RANK[rec.request.sampling.priority] > head_rank
+            and not rec.cancelled]
+        if not victims:
+            return
+        _, _, rid = max(victims)
+        self._park(rid, now, cause="schedule")
+
+    def _park(self, rid: int, now: float, *, cause: str) -> None:
+        rec = self._active.pop(rid)
+        self.pool.free(rec.pages)
+        self._parked.append((rec.request, list(rec.tokens),
+                             rec.submit_ts))
+        self.metrics.inc("requests_preempted")
+        self.metrics.event("request_preempted",
+                           request_id=rid, cause=cause,
+                           priority=rec.request.sampling.priority,
+                           tokens_parked=len(rec.tokens))
+        emit_span(self.metrics, SPAN_PREEMPT,
+                  trace_id=rec.request.trace_id, request_id=rid,
+                  start_s=now, end_s=now, wall=clock.wall(),
+                  replica_id=self.replica_id, detail=cause,
+                  tokens_parked=len(rec.tokens),
+                  priority=rec.request.sampling.priority)
+
+    def park_class(self, priority: str, *, cause: str = "brownout") -> int:
+        """Park EVERY active slot of ``priority``; the caller owns the
+        ``take_parked()`` drain (same contract as the real engine)."""
+        parked = 0
+        for rid in sorted(self._active):
+            rec = self._active[rid]
+            if rec.request.sampling.priority != priority or rec.cancelled:
+                continue
+            self._park(rid, clock.now(), cause=cause)
+            parked += 1
+        return parked
+
+    def take_parked(self) -> List[Tuple[Request, List[int], float]]:
+        out, self._parked = self._parked, []
+        return out
+
+    def set_admission_floor(self, priority: Optional[str]) -> None:
+        self._floor = priority
+
+    @property
+    def admission_floor(self) -> Optional[str]:
+        return self._floor
+
     def _admit(self, now: float) -> None:
         admitted = 0
         cap = self.config.scheduler.max_prefills_per_tick
         while (self._queue and len(self._active) < self.config.max_slots
                and admitted < cap):
-            req, ts = self._queue.pop(0)
+            order = self._admissible()
+            if not order:
+                break
+            req, ts = self._queue.pop(order[0])
             pages = self.pool.pages_for(req)
             self.pool.alloc(pages)
             self._active[req.request_id] = _SimActive(req, ts, pages)
@@ -357,7 +481,8 @@ class SimEngine:
             ttft_s=(now - submit_ts) if tokens else None,
             replica_id=self.replica_id,
             adapter_id=request.sampling.adapter_id,
-            trace_id=request.trace_id)
+            trace_id=request.trace_id,
+            priority=request.sampling.priority)
         self.completed[request.request_id] = result
         self.metrics.inc(f"requests_{reason}")
         self.metrics.emit_record(result.record(wall=clock.wall()))

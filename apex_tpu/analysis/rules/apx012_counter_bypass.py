@@ -33,6 +33,9 @@ _PAIRED_COUNTERS = frozenset({
     "replica_scale_ups", "replica_scale_downs",
     "deploys_started", "deploys_completed", "deploys_rolled_back",
     "deploys_rejected", "canary_promotions",
+    "requests_preempted", "requests_resumed",
+    "requests_deferred_quota",
+    "brownouts_escalated", "brownouts_recovered",
 })
 
 

@@ -55,7 +55,7 @@ class ScheduledRequest:
         return (round(self.at_s, 9), self.phase, tuple(r.prompt),
                 r.max_new_tokens, r.eos_token, r.deadline_s,
                 r.sampling.temperature, r.sampling.top_k, r.sampling.seed,
-                r.sampling.adapter_id)
+                r.sampling.adapter_id, r.sampling.priority)
 
 
 def _choose(rng: random.Random, mix: Dict[int, float]) -> int:
@@ -132,12 +132,18 @@ class TrafficGenerator:
             drawn = rng.choices(
                 ids, weights=[phase.adapter_mix[a] for a in ids])[0]
             adapter_id = None if drawn == "base" else drawn
+        # priority is a FIXED per-phase knob, not a draw — stamping a
+        # class consumes no randomness, so pre-priority scenarios keep
+        # byte-identical schedules
+        priority = phase.priority if phase.priority is not None \
+            else SamplingParams().priority
         if greedy_draw < phase.greedy_fraction:
-            sampling = SamplingParams(adapter_id=adapter_id)   # greedy
+            sampling = SamplingParams(adapter_id=adapter_id,
+                                      priority=priority)       # greedy
         else:
             sampling = SamplingParams(
                 temperature=temp, top_k=top_k if top_k > 0 else None,
-                seed=seed, adapter_id=adapter_id)
+                seed=seed, adapter_id=adapter_id, priority=priority)
         return Request(prompt=prompt, max_new_tokens=max_new,
                        sampling=sampling, eos_token=phase.eos_token,
                        deadline_s=deadline)

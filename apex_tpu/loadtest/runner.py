@@ -187,13 +187,29 @@ def _build_serving(scenario: Scenario, model, params,
 
             sentinel = SentinelConfig(
                 **scenario.sentinel.config_kwargs())
+        quotas = None
+        if scenario.quotas is not None:
+            from apex_tpu.serving.fleet import QuotaConfig, TenantQuota
+
+            quotas = QuotaConfig(
+                tenants={k: TenantQuota(**v)
+                         for k, v in scenario.quotas.tenants.items()},
+                default=(TenantQuota(**scenario.quotas.default)
+                         if scenario.quotas.default is not None else None))
+        brownout = None
+        if scenario.brownout is not None:
+            from apex_tpu.serving.fleet import BrownoutConfig
+
+            brownout = BrownoutConfig(
+                **scenario.brownout.config_kwargs())
         return ReplicaFleet(
             model, params, engine_cfg, supervisor=sup_cfg,
             fleet=FleetConfig(n_replicas=fl.n_replicas,
                               migrate_on_drain=fl.migrate_on_drain,
                               probe_on_rebuild=fl.probe_on_rebuild),
             metrics=metrics, faults=faults, adapters=adapters,
-            autoscale=autoscale, sentinel=sentinel)
+            autoscale=autoscale, sentinel=sentinel,
+            quotas=quotas, brownout=brownout)
     return EngineSupervisor(model, params, engine_cfg,
                             supervisor=sup_cfg, metrics=metrics,
                             faults=faults, adapters=adapters)

@@ -39,7 +39,12 @@ from apex_tpu.observability.slo import SLO_METRICS
 
 __all__ = ["ModelSpec", "EngineKnobs", "LoadPhase", "FaultSchedule",
            "FleetSpec", "AutoscaleSpec", "DeploySpec", "SentinelSpec",
-           "RecorderSpec", "Scenario"]
+           "RecorderSpec", "QuotaSpec", "BrownoutSpec", "Scenario"]
+
+#: priority classes a phase may stamp on its traffic — mirrors
+#: ``apex_tpu.serving.PRIORITIES`` (string literals here so scenario
+#: loading stays jax-free, same pattern as ``OK_FINISH_REASONS``)
+_PRIORITIES = ("interactive", "standard", "batch")
 
 #: keys accepted in a scenario's ``"supervisor"`` section — mirrors the
 #: :class:`~apex_tpu.serving.SupervisorConfig` fields so a typo fails at
@@ -265,6 +270,11 @@ class LoadPhase:
     shared_prefix_len: int = 0
     prompt_period: int = 0
     adapter_mix: Dict[str, float] = field(default_factory=dict)
+    #: priority class every request in this phase carries (a FIXED
+    #: per-phase knob, deliberately not a random mix: no extra generator
+    #: draw, so pre-priority scenarios reproduce byte-identical
+    #: schedules). None = the engine default ("standard").
+    priority: Optional[str] = None
 
     def __post_init__(self):
         if self.n_requests < 1:
@@ -321,6 +331,10 @@ class LoadPhase:
                 raise ValueError(
                     f"phase {self.name!r}: adapter_mix weight for "
                     f"{aid!r} must be > 0, got {w}")
+        if self.priority is not None and self.priority not in _PRIORITIES:
+            raise ValueError(
+                f"phase {self.name!r}: priority must be one of "
+                f"{_PRIORITIES}, got {self.priority!r}")
 
     @property
     def max_total_len(self) -> int:
@@ -331,6 +345,7 @@ class LoadPhase:
         d = dict(data)
         name = str(d.pop("name", "phase"))
         eos = d.pop("eos_token", None)
+        prio = d.pop("priority", None)
         phase = cls(
             name=name,
             n_requests=int(d.pop("n_requests")),
@@ -350,7 +365,8 @@ class LoadPhase:
             shared_prefix_len=int(d.pop("shared_prefix_len", 0)),
             prompt_period=int(d.pop("prompt_period", 0)),
             adapter_mix={str(k): float(v)
-                         for k, v in d.pop("adapter_mix", {}).items()})
+                         for k, v in d.pop("adapter_mix", {}).items()},
+            priority=str(prio) if prio is not None else None)
         if d:
             raise ValueError(
                 f"phase {name!r}: unknown keys {sorted(d)}")
@@ -380,6 +396,8 @@ class LoadPhase:
             out["prompt_period"] = self.prompt_period
         if self.adapter_mix:
             out["adapter_mix"] = dict(self.adapter_mix)
+        if self.priority is not None:
+            out["priority"] = self.priority
         return out
 
 
@@ -818,6 +836,178 @@ class RecorderSpec:
                 if v != getattr(defaults, k)}
 
 
+#: keys accepted in a quota tenant entry — mirrors
+#: :class:`~apex_tpu.serving.fleet.TenantQuota`
+_TENANT_QUOTA_KEYS = frozenset({
+    "rate_rps", "burst", "max_inflight", "max_pages", "soft"})
+
+
+def _tenant_quota_entry(data: Dict[str, Any], what: str) -> Dict[str, Any]:
+    """Validate + coerce one tenant-quota dict (mirrors ``TenantQuota``
+    validation so a bad scenario fails at parse time, jax-free)."""
+    unknown = set(data) - _TENANT_QUOTA_KEYS
+    if unknown:
+        raise ValueError(
+            f"unknown {what} keys {sorted(unknown)}; known: "
+            f"{sorted(_TENANT_QUOTA_KEYS)}")
+    entry: Dict[str, Any] = {}
+    for key in ("rate_rps", "burst"):
+        if key in data:
+            entry[key] = float(data[key])
+    for key in ("max_inflight", "max_pages"):
+        if key in data:
+            entry[key] = int(data[key])
+    if "soft" in data:
+        entry["soft"] = bool(data["soft"])
+    if entry.get("rate_rps", 0.0) < 0:
+        raise ValueError(
+            f"{what}: rate_rps must be >= 0, got {entry['rate_rps']}")
+    if entry.get("burst", 1.0) < 1.0:
+        raise ValueError(
+            f"{what}: burst must be >= 1, got {entry['burst']}")
+    for key in ("max_inflight", "max_pages"):
+        if entry.get(key, 0) < 0:
+            raise ValueError(
+                f"{what}: {key} must be >= 0, got {entry[key]}")
+    return entry
+
+
+@dataclass(frozen=True)
+class QuotaSpec:
+    """Optional ``"quotas"`` scenario block: run the fleet front door
+    behind a per-tenant :class:`~apex_tpu.serving.fleet.QuotaLedger`
+    (docs/serving.md#priority-preemption-and-quotas). ``tenants`` maps
+    tenant keys (adapter ids, or ``"base"``) to
+    :class:`~apex_tpu.serving.fleet.TenantQuota` kwargs; ``default``
+    applies to tenants not named. Kept jax-free here — the runner
+    builds the ledger. Requires a ``"fleet"`` block."""
+
+    tenants: Dict[str, Dict[str, Any]] = field(default_factory=dict)
+    default: Optional[Dict[str, Any]] = None
+
+    def __post_init__(self):
+        for key, entry in self.tenants.items():
+            if not isinstance(key, str) or not key:
+                raise ValueError(
+                    f"quota tenant keys must be non-empty strings, "
+                    f"got {key!r}")
+            _tenant_quota_entry(entry, f"quota tenant {key!r}")
+        if self.default is not None:
+            _tenant_quota_entry(self.default, "quota default")
+        if not self.tenants and self.default is None:
+            raise ValueError(
+                "a 'quotas' block must name at least one tenant or a "
+                "default")
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "QuotaSpec":
+        d = dict(data)
+        spec = cls(
+            tenants={str(k): _tenant_quota_entry(
+                dict(v), f"quota tenant {k!r}")
+                for k, v in d.pop("tenants", {}).items()},
+            default=(_tenant_quota_entry(dict(d.pop("default")),
+                                         "quota default")
+                     if d.get("default") is not None
+                     else d.pop("default", None)))
+        if d:
+            raise ValueError(f"unknown quotas keys {sorted(d)}")
+        return spec
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        if self.tenants:
+            out["tenants"] = {k: dict(v) for k, v in self.tenants.items()}
+        if self.default is not None:
+            out["default"] = dict(self.default)
+        return out
+
+
+@dataclass(frozen=True)
+class BrownoutSpec:
+    """Optional ``"brownout"`` scenario block: run the fleet under a
+    :class:`~apex_tpu.serving.fleet.BrownoutController` that walks the
+    staged-degradation ladder off the live signals poll
+    (docs/serving.md#priority-preemption-and-quotas). Fields mirror
+    :class:`~apex_tpu.serving.fleet.BrownoutConfig` (kept jax-free
+    here; the runner builds the config) so a typo fails at scenario
+    load. Requires a ``"fleet"`` block — the controller rides the
+    fleet tick."""
+
+    poll_interval_s: float = 0.25
+    queue_depth_high: float = 8.0
+    queue_depth_low: float = 2.0
+    hot_polls: int = 2
+    cool_polls: int = 2
+    clamp_max_new_tokens: int = 32
+    max_rung: int = 4
+
+    def __post_init__(self):
+        # mirror BrownoutConfig's validation so a bad scenario fails at
+        # parse time, not at fleet construction mid-run
+        if self.poll_interval_s <= 0:
+            raise ValueError(
+                f"brownout poll_interval_s must be > 0, got "
+                f"{self.poll_interval_s}")
+        if self.queue_depth_high <= 0:
+            raise ValueError(
+                f"brownout queue_depth_high must be > 0, got "
+                f"{self.queue_depth_high}")
+        if not 0 <= self.queue_depth_low < self.queue_depth_high:
+            raise ValueError(
+                f"brownout queue_depth_low ({self.queue_depth_low}) "
+                f"must be in [0, queue_depth_high="
+                f"{self.queue_depth_high})")
+        if self.hot_polls < 1:
+            raise ValueError(
+                f"brownout hot_polls must be >= 1, got {self.hot_polls}")
+        if self.cool_polls < 1:
+            raise ValueError(
+                f"brownout cool_polls must be >= 1, got "
+                f"{self.cool_polls}")
+        if self.clamp_max_new_tokens < 1:
+            raise ValueError(
+                f"brownout clamp_max_new_tokens must be >= 1, got "
+                f"{self.clamp_max_new_tokens}")
+        if not 0 <= self.max_rung <= 4:
+            raise ValueError(
+                f"brownout max_rung must be in [0, 4], got "
+                f"{self.max_rung}")
+
+    def config_kwargs(self) -> Dict[str, Any]:
+        """Constructor kwargs for ``BrownoutConfig``."""
+        return {
+            "poll_interval_s": self.poll_interval_s,
+            "queue_depth_high": self.queue_depth_high,
+            "queue_depth_low": self.queue_depth_low,
+            "hot_polls": self.hot_polls,
+            "cool_polls": self.cool_polls,
+            "clamp_max_new_tokens": self.clamp_max_new_tokens,
+            "max_rung": self.max_rung,
+        }
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "BrownoutSpec":
+        d = dict(data)
+        kw: Dict[str, Any] = {}
+        for key in ("hot_polls", "cool_polls", "clamp_max_new_tokens",
+                    "max_rung"):
+            if key in d:
+                kw[key] = int(d.pop(key))
+        for key in ("poll_interval_s", "queue_depth_high",
+                    "queue_depth_low"):
+            if key in d:
+                kw[key] = float(d.pop(key))
+        if d:
+            raise ValueError(f"unknown brownout keys {sorted(d)}")
+        return cls(**kw)
+
+    def to_dict(self) -> Dict[str, Any]:
+        defaults = BrownoutSpec()
+        return {k: v for k, v in self.config_kwargs().items()
+                if v != getattr(defaults, k)}
+
+
 @dataclass(frozen=True)
 class Scenario:
     """One complete load-test description; see the module docstring.
@@ -844,6 +1034,8 @@ class Scenario:
     deploy: Optional[DeploySpec] = None
     sentinel: Optional[SentinelSpec] = None
     recorder: Optional[RecorderSpec] = None
+    quotas: Optional[QuotaSpec] = None
+    brownout: Optional[BrownoutSpec] = None
     slo: Dict[str, float] = field(default_factory=dict)
     tolerance: float = 0.25
     max_wall_s: float = 300.0
@@ -920,6 +1112,12 @@ class Scenario:
         if self.sentinel is not None and self.fleet is None:
             raise ValueError("a 'sentinel' block needs a 'fleet' block "
                              "(the sentinel rides the fleet tick)")
+        if self.quotas is not None and self.fleet is None:
+            raise ValueError("a 'quotas' block needs a 'fleet' block "
+                             "(quotas gate the fleet front door)")
+        if self.brownout is not None and self.fleet is None:
+            raise ValueError("a 'brownout' block needs a 'fleet' block "
+                             "(the controller rides the fleet tick)")
         if self.deploy is not None:
             if self.fleet is None:
                 raise ValueError("a 'deploy' block needs a 'fleet' block")
@@ -947,8 +1145,8 @@ class Scenario:
     def from_dict(cls, data: Dict[str, Any]) -> "Scenario":
         known = {"name", "seed", "description", "model", "engine",
                  "supervisor", "phases", "faults", "fleet", "autoscale",
-                 "deploy", "sentinel", "recorder", "slo", "tolerance",
-                 "max_wall_s"}
+                 "deploy", "sentinel", "recorder", "quotas", "brownout",
+                 "slo", "tolerance", "max_wall_s"}
         unknown = set(data) - known
         if unknown:
             raise ValueError(
@@ -974,6 +1172,10 @@ class Scenario:
                       if data.get("sentinel") is not None else None),
             recorder=(RecorderSpec.from_dict(data["recorder"])
                       if data.get("recorder") is not None else None),
+            quotas=(QuotaSpec.from_dict(data["quotas"])
+                    if data.get("quotas") is not None else None),
+            brownout=(BrownoutSpec.from_dict(data["brownout"])
+                      if data.get("brownout") is not None else None),
             slo={str(k): float(v)
                  for k, v in data.get("slo", {}).items()},
             tolerance=float(data.get("tolerance", 0.25)),
@@ -1002,6 +1204,10 @@ class Scenario:
             out["sentinel"] = self.sentinel.to_dict()
         if self.recorder is not None:
             out["recorder"] = self.recorder.to_dict()
+        if self.quotas is not None:
+            out["quotas"] = self.quotas.to_dict()
+        if self.brownout is not None:
+            out["brownout"] = self.brownout.to_dict()
         if self.slo:
             out["slo"] = dict(self.slo)
         return out

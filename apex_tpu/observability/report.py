@@ -85,6 +85,10 @@ SERVING_INCIDENT_COUNTERS = {
     "breaker_open": "breaker_opens",
     "breaker_half_open": "breaker_half_opens",
     "breaker_closed": "breaker_closes",
+    # priority preemption (PR 20): a park and its token-exact resume
+    # are each one event + one counter increment at the same site
+    "request_preempted": "requests_preempted",
+    "request_resumed": "requests_resumed",
 }
 
 #: ``request_shed`` events carry a ``reason`` field; each reason maps to
@@ -95,6 +99,7 @@ SERVING_SHED_COUNTERS = {
     "fleet": "requests_shed_fleet",
     "pages_exhausted": "requests_shed_pages",
     "unknown_adapter": "requests_shed_adapter",
+    "quota": "requests_shed_quota",
 }
 
 #: fleet incident event -> registry counter — same one-increment-per-
@@ -112,6 +117,10 @@ FLEET_INCIDENT_COUNTERS = {
     "deploy_rollback": "deploys_rolled_back",
     "deploy_rejected": "deploys_rejected",
     "canary_promoted": "canary_promotions",
+    # brownout ladder + per-tenant quotas (PR 20)
+    "brownout_escalate": "brownouts_escalated",
+    "brownout_recover": "brownouts_recovered",
+    "request_quota_deferred": "requests_deferred_quota",
 }
 
 #: ``kind="deploy"`` record action -> registry counter — each typed
@@ -216,9 +225,16 @@ def _request_summary(requests: List[dict]) -> Optional[dict]:
     if not requests:
         return None
     by_reason: Dict[str, int] = {}
+    by_priority: Dict[str, int] = {}
     for r in requests:
         reason = str(r.get("finish_reason", "?"))
         by_reason[reason] = by_reason.get(reason, 0) + 1
+        # priority class split (PR 20) — only rows that declare a class
+        # count, so a pre-priority log folds to an empty dict and the
+        # renderer skips the line entirely
+        prio = r.get("priority")
+        if prio is not None:
+            by_priority[str(prio)] = by_priority.get(str(prio), 0) + 1
 
     def _field(key):
         return _stats([r[key] for r in requests
@@ -227,6 +243,7 @@ def _request_summary(requests: List[dict]) -> Optional[dict]:
     return {
         "count": len(requests),
         "by_finish_reason": by_reason,
+        "by_priority": by_priority,
         "new_tokens": sum(int(r.get("new_tokens", 0)) for r in requests),
         "queue_s": _field("queue_s"),
         "prefill_s": _field("prefill_s"),
@@ -391,6 +408,36 @@ def _autoscale_section(records: List[dict],
         "decisions": [{k: r.get(k) for k in
                        ("action", "replica_id", "reason", "n_replicas",
                         "wall") if k in r} for r in rows],
+    }
+
+
+def _brownout_section(records: List[dict],
+                      counters: Dict[str, int]) -> Optional[dict]:
+    """Fold ``kind="brownout"`` ladder-transition records into the
+    monitor's brownout section: per-action counts (reconciling
+    key-for-key with the ``brownouts_escalated``/``brownouts_recovered``
+    counters — same emission sites), the final rung after the last
+    transition, and the transition timeline. ``None`` for a pre-brownout
+    log or a run that never left rung 0 — the back-compat fixtures must
+    render without this section."""
+    rows = [r for r in records if r.get("kind") == "brownout"]
+    if not rows:
+        return None
+    by_action: Dict[str, int] = {}
+    for r in rows:
+        action = str(r.get("action", "?"))
+        by_action[action] = by_action.get(action, 0) + 1
+    return {
+        "count": len(rows),
+        "by_action": by_action,
+        "counters": {c: counters.get(c, 0)
+                     for c in ("brownouts_escalated",
+                               "brownouts_recovered")},
+        "final_rung": rows[-1].get("rung"),
+        "final_rung_name": rows[-1].get("rung_name"),
+        "transitions": [{k: r.get(k) for k in
+                         ("action", "rung", "rung_name", "pressure",
+                          "parked", "wall") if k in r} for r in rows],
     }
 
 
@@ -568,6 +615,7 @@ def build_report(path: str,
         "spans": _span_section(records),
         "signals": _signals_section(records),
         "autoscale": _autoscale_section(records, counters),
+        "brownout": _brownout_section(records, counters),
         "deploys": _deploy_section(records, counters),
         # per-tenant SLO attribution, only when the run carried adapter
         # traffic (a base-only or pre-LoRA log renders no tenant table)
@@ -637,8 +685,12 @@ def render_report(report: dict) -> str:
             req["by_finish_reason"].items()))
         lines += ["", f"serving requests ({req['count']}, "
                       f"{req['new_tokens']} tokens generated):",
-                  f"  finish: {reasons}",
-                  _render_stat_line("queue", req["queue_s"], "s"),
+                  f"  finish: {reasons}"]
+        if req.get("by_priority"):
+            split = " ".join(f"{k}={v}" for k, v in sorted(
+                req["by_priority"].items()))
+            lines.append(f"  priority: {split}")
+        lines += [_render_stat_line("queue", req["queue_s"], "s"),
                   _render_stat_line("prefill", req["prefill_s"], "s"),
                   _render_stat_line("decode", req["decode_s"], "s"),
                   _render_stat_line("total", req["total_s"], "s"),
@@ -794,6 +846,27 @@ def render_report(report: dict) -> str:
         if len(autoscale["decisions"]) > 10:
             lines.append(
                 f"  ... {len(autoscale['decisions']) - 10} more")
+    brownout = report.get("brownout")
+    if brownout:
+        split = " ".join(f"{k}={v}"
+                         for k, v in sorted(brownout["by_action"].items()))
+        final = brownout.get("final_rung_name")
+        lines += ["", f"brownout ladder ({brownout['count']} transitions):",
+                  f"  {split}"
+                  + (f"  final_rung={final}" if final is not None else "")]
+        for t in brownout["transitions"][:10]:
+            wall = t.get("wall")
+            stamp = f"[wall={wall:.3f}] " if isinstance(
+                wall, (int, float)) else ""
+            lines.append(
+                f"  {stamp}{t.get('action', '?')} "
+                f"-> rung {t.get('rung', '?')} "
+                f"({t.get('rung_name', '?')}) "
+                f"pressure={_fmt(t.get('pressure'))} "
+                f"parked={t.get('parked', 0)}")
+        if len(brownout["transitions"]) > 10:
+            lines.append(
+                f"  ... {len(brownout['transitions']) - 10} more")
     deploys = report.get("deploys")
     if deploys:
         split = " ".join(f"{k}={v}"

@@ -53,6 +53,11 @@ SLO_METRICS: Dict[str, tuple] = {
     "goodput": ("min", "fraction of submitted requests finishing "
                        "eos/length (completions per unit of offered "
                        "load — what shedding is supposed to protect)"),
+    "goodput_interactive": ("min", "goodput over interactive-class "
+                                   "requests only (what the brownout "
+                                   "ladder and preemption protect); "
+                                   "None when the log has no "
+                                   "priority-stamped interactive rows"),
     "error_budget": ("max", "fraction of submitted requests finishing "
                             "error (quarantine, retry exhaustion)"),
     "recovery_s": ("max", "worst gap from a disruption (engine_restart "
@@ -107,6 +112,14 @@ read_records` output) into measured values for every
     tpots = _vals(requests, "tpot_s")
     latencies = _vals(ok, "total_s")
 
+    # per-class goodput: only rows that DECLARE the class count (a
+    # pre-priority log measures None, so old logs never fail the new
+    # objective unless a scenario explicitly declares it)
+    interactive = [r for r in requests
+                   if r.get("priority") == "interactive"]
+    interactive_ok = [r for r in interactive
+                      if r.get("finish_reason") in OK_FINISH_REASONS]
+
     metrics: Dict[str, Optional[float]] = {
         "ttft_p50_s": _pct(ttfts, 50),
         "ttft_p99_s": _pct(ttfts, 99),
@@ -114,6 +127,8 @@ read_records` output) into measured values for every
         "tpot_p99_s": _pct(tpots, 99),
         "latency_p99_s": _pct(latencies, 99),
         "goodput": len(ok) / len(requests) if requests else None,
+        "goodput_interactive": (len(interactive_ok) / len(interactive)
+                                if interactive else None),
         "error_budget": len(errors) / len(requests) if requests else None,
     }
 

@@ -16,9 +16,10 @@ spans into the :class:`~apex_tpu.observability.MetricsRegistry` as
   construction and exactly-once holds under supervisor restarts (a dead
   engine incarnation emits neither a record nor spans).
 - **mark spans** (:data:`MARK_SPANS` — ``spec_verify``, ``migration``,
-  ``quarantine``) annotate the timeline (speculation totals, a
-  migration handoff, a quarantine scrub) and are excluded from the
-  conservation sum — they overlap the phases they explain.
+  ``quarantine``, ``preempt``, ``resume``) annotate the timeline
+  (speculation totals, a migration handoff, a quarantine scrub, a
+  priority preemption park and its later resume) and are excluded from
+  the conservation sum — they overlap the phases they explain.
 
 Every span increments a ``spans_<name>`` counter, so the final counters
 snapshot reconciles key-for-key with the span rows in the log —
@@ -36,6 +37,7 @@ from typing import Dict, List, Optional, Sequence
 __all__ = [
     "SPAN_QUEUED", "SPAN_PREFILL", "SPAN_DECODE", "SPAN_SHED",
     "SPAN_SPEC_VERIFY", "SPAN_MIGRATION", "SPAN_QUARANTINE",
+    "SPAN_PREEMPT", "SPAN_RESUME",
     "PHASE_SPANS", "MARK_SPANS", "SPAN_COUNTER_PREFIX",
     "new_trace_id", "emit_span", "emit_request_spans",
     "build_timelines", "format_timeline", "check_span_conservation",
@@ -48,11 +50,21 @@ SPAN_DECODE = "decode"
 SPAN_SHED = "shed"
 PHASE_SPANS = (SPAN_QUEUED, SPAN_PREFILL, SPAN_DECODE, SPAN_SHED)
 
-#: mark spans: overlapping annotations, excluded from the conservation sum
+#: mark spans: overlapping annotations, excluded from the conservation sum.
+#: ``preempt`` is a zero-width mark the engine stamps when it parks a
+#: running slot for a higher class; ``resume`` is its zero-width partner
+#: the supervisor stamps when the parked request's continuation is
+#: resubmitted — both carry the request's ORIGINAL trace_id, so a
+#: preempted request's timeline reads queued/prefill/decode with the
+#: park/resume gap annotated, and conservation stays exact (the terminal
+#: record is emitted by the finishing incarnation from its own clock).
 SPAN_SPEC_VERIFY = "spec_verify"
 SPAN_MIGRATION = "migration"
 SPAN_QUARANTINE = "quarantine"
-MARK_SPANS = (SPAN_SPEC_VERIFY, SPAN_MIGRATION, SPAN_QUARANTINE)
+SPAN_PREEMPT = "preempt"
+SPAN_RESUME = "resume"
+MARK_SPANS = (SPAN_SPEC_VERIFY, SPAN_MIGRATION, SPAN_QUARANTINE,
+              SPAN_PREEMPT, SPAN_RESUME)
 
 #: every emitted span increments ``f"{SPAN_COUNTER_PREFIX}{name}"``
 SPAN_COUNTER_PREFIX = "spans_"
@@ -182,7 +194,7 @@ def format_timeline(request_id: int, spans: Sequence[dict],
         if s.get("replica_id") is not None:
             extra += f"  replica={s['replica_id']}"
         for key in ("chunk", "proposed", "accepted", "from_replica",
-                    "tokens_carried"):
+                    "tokens_carried", "tokens_parked", "priority"):
             if key in s:
                 extra += f"  {key}={s[key]}"
         lines.append(f"  +{start:9.4f}s  {s.get('span', '?'):<11}"

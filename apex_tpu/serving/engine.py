@@ -70,6 +70,7 @@ from apex_tpu.models.generation import (
 )
 from apex_tpu.observability import MetricsRegistry
 from apex_tpu.observability.trace import (
+    SPAN_PREEMPT,
     SPAN_QUARANTINE,
     SPAN_SPEC_VERIFY,
     emit_request_spans,
@@ -86,6 +87,7 @@ from apex_tpu.serving.request import (
     FINISH_LENGTH,
     FINISH_REJECTED,
     FINISH_TIMEOUT,
+    PRIORITY_RANK,
     Request,
     RequestResult,
 )
@@ -139,7 +141,13 @@ _COUNTERS = ("requests_submitted", "requests_eos", "requests_length",
              # programs run under prefill_token_budget — reconciled
              # against the per-request prefill_chunks record field and
              # the prefill_tokens_per_tick histogram's observation sum
-             "prefill_chunks")
+             "prefill_chunks",
+             # priority preemption (docs/serving.md#priority-preemption-
+             # and-quotas): running slots parked for a higher class (or a
+             # brownout rung) — reconciled against request_preempted
+             # events key-for-key; parks are not terminal, so this never
+             # enters the finish-reason sum
+             "requests_preempted")
 
 
 @dataclass
@@ -403,6 +411,19 @@ class InferenceEngine:
         #: step never sees them; the slot's real page row lives on the
         #: rec until the final chunk lands (see _begin_chunked_prefill)
         self._prefilling: Dict[int, _Active] = {}
+        #: preempted (parked) requests as (request, generated_tokens,
+        #: submit_ts) — host-side token cursors with slot and pages
+        #: released; the supervisor drains them via take_parked() into
+        #: restart-style continuations that resume TOKEN-EXACT (sampling
+        #: keys on absolute position, docs/serving.md#priority-
+        #: preemption-and-quotas)
+        self._parked: List = []
+        #: set True by a caller that drains take_parked() every tick
+        #: (the EngineSupervisor). Without a consumer the engine never
+        #: preempts on its own — a parked request would have nowhere to
+        #: resume. park_class() is exempt: an explicit call owns the
+        #: drain responsibility.
+        self.resume_consumer = False
         self._chunk_tokens_tick = 0   # prefill tokens run this tick
         self._vocab = c.vocab_size
 
@@ -923,17 +944,51 @@ class InferenceEngine:
         of long prompts is more work than its depth suggests)."""
         return self.scheduler.queued_tokens
 
+    @property
+    def parked_count(self) -> int:
+        """Preempted requests awaiting resume — non-terminal work the
+        supervisor's idle checks must count."""
+        return len(self._parked)
+
+    def take_parked(self) -> List:
+        """Drain the parked (preempted) requests as ``(request,
+        generated_tokens, submit_ts)`` tuples — the supervisor turns each
+        into a restart-style continuation (original prompt + generated
+        prefix, remaining budget, same request/trace ids and deadline
+        clock) and resubmits it when capacity allows."""
+        parked, self._parked = self._parked, []
+        return parked
+
+    def queued_tokens_by_class(self) -> Dict[str, int]:
+        """Queued prompt tokens per priority class (scheduler
+        passthrough) — the supervisor's per-class shed pricing input."""
+        return self.scheduler.queued_tokens_by_class()
+
+    def queued_depth_by_class(self) -> Dict[str, int]:
+        """Queue depth per priority class (scheduler passthrough)."""
+        return self.scheduler.depth_by_class()
+
+    def set_admission_floor(self, priority: Optional[str]) -> None:
+        """Scheduler passthrough: pause dispatch of classes below
+        ``priority`` (the brownout ladder's admission rungs)."""
+        self.scheduler.set_admission_floor(priority)
+
     def inflight(self) -> List:
         """Snapshot of active (admitted, non-terminal) requests as
         ``(request, generated_tokens, submit_ts)`` tuples in slot order —
         what the supervisor re-prefills after an engine restart.
         Mid-chunked-prefill requests are included with NO tokens: a
         restart re-prefills them from the prompt through the same admit
-        path (their chunk progress died with the engine's pages)."""
+        path (their chunk progress died with the engine's pages).
+        Parked (preempted) requests are included WITH their tokens: a
+        restart resumes them exactly like the supervisor's ordinary
+        take_parked() drain would have."""
         recs = [(rec.request, list(rec.tokens), rec.submit_ts)
                 for _, rec in sorted(self._active.items())]
         recs += [(rec.request, [], rec.submit_ts)
                  for rec in self._prefilling.values()]
+        recs += [(request, list(tokens), submit_ts)
+                 for request, tokens, submit_ts in self._parked]
         return recs
 
     # -- request lifecycle ------------------------------------------------
@@ -1016,6 +1071,14 @@ class InferenceEngine:
             self._finish(request, [], FINISH_CANCELLED, submit_ts=submit_ts,
                          now=clock.now())
             return True
+        for i, (request, tokens, submit_ts) in enumerate(self._parked):
+            if request.request_id == request_id:
+                # a parked request holds no slot or pages — it terminates
+                # immediately, keeping the tokens generated before the park
+                del self._parked[i]
+                self._finish(request, tokens, FINISH_CANCELLED,
+                             submit_ts=submit_ts, now=clock.now())
+                return True
         for rec in (*self._active.values(), *self._prefilling.values()):
             if rec.request.request_id == request_id:
                 rec.cancelled = True
@@ -1033,6 +1096,7 @@ class InferenceEngine:
         now = clock.now()
         self._expire(now, finished)
         self._evict_cancelled(finished)
+        self._maybe_preempt(now)
         self._chunk_tokens_tick = 0
         if self.config.prefill_token_budget is None:
             self._admit(finished)
@@ -1102,6 +1166,7 @@ class InferenceEngine:
         self._closed = True
         self._active.clear()
         self._prefilling.clear()
+        self._parked.clear()
         self.slots.reset()
         if self.pages is not None:
             # the page free list resets WITH the slot pool — a rebuild
@@ -1124,6 +1189,20 @@ class InferenceEngine:
         for request, submit_ts in self.scheduler.expire(now):
             finished.append(self._finish(
                 request, [], FINISH_TIMEOUT, submit_ts=submit_ts, now=now))
+        if self._parked:
+            # a park never stops the deadline clock — parked requests
+            # expire exactly like queued ones, keeping their partial
+            # tokens in the result
+            kept = []
+            for request, tokens, submit_ts in self._parked:
+                d = request.deadline_s
+                if d is not None and now - submit_ts > d:
+                    finished.append(self._finish(
+                        request, tokens, FINISH_TIMEOUT,
+                        submit_ts=submit_ts, now=now))
+                else:
+                    kept.append((request, tokens, submit_ts))
+            self._parked = kept
         for slot in sorted(self._active):
             rec = self._active[slot]
             d = rec.request.deadline_s
@@ -1225,12 +1304,96 @@ class InferenceEngine:
 
         return predicate
 
+    def _maybe_preempt(self, now: float) -> None:
+        """Park ONE lowest-class running slot when a strictly-higher-class
+        queued head is blocked on slots or pages (the tentpole's
+        preemption rule). Runs before admission so the freed slot/pages
+        can admit the head in the same tick; one park per tick converges
+        without thrashing (the parked continuation re-queues in its own
+        class lane, where strict priority keeps it behind the traffic
+        that displaced it). The head's TRUE class decides — a batch head
+        aged up to standard rank may dispatch ahead of standard, but it
+        never preempts anyone."""
+        if not self.resume_consumer or not self._active:
+            return
+        head = self.scheduler.head(now=now)
+        if head is None:
+            return
+        head_rank = PRIORITY_RANK[head[0].sampling.priority]
+        blocked = self.slots.free_count == 0
+        if not blocked and self.pages is not None:
+            pred = self._make_page_predicate()
+            blocked = pred(head[0]) == "defer"
+        if not blocked:
+            return
+        victim, victim_key = None, None
+        for slot in sorted(self._active):
+            rec = self._active[slot]
+            rank = PRIORITY_RANK[rec.request.sampling.priority]
+            if rank <= head_rank:
+                continue
+            # lowest class first; among peers the one with the least
+            # generated work (cheapest re-prefill), ids breaking ties
+            key = (rank, -len(rec.tokens), rec.request.request_id)
+            if victim_key is None or key > victim_key:
+                victim, victim_key = rec, key
+        if victim is not None:
+            self._park(victim, now, cause="priority")
+
+    def _park(self, rec: _Active, now: float, *, cause: str) -> None:
+        """Preempt one ACTIVE slot: release the slot and its pages
+        (shared prefix pages outlive it, refcounted — exactly the
+        `_retire` release sequence) but emit NO terminal record and NO
+        phase spans — a park is not an outcome. The host-side cursor
+        (request, generated tokens, submit_ts) moves to the parked list
+        for the supervisor's continuation path; a zero-width ``preempt``
+        mark span annotates the timeline under the request's original
+        trace_id."""
+        slot = rec.slot
+        del self._active[slot]
+        self.slots.release(slot)
+        if self.pages is not None:
+            self.pages.release_slot(slot)
+            self._reserved_pages -= rec.reserved_pages
+            self._page_table_h[slot, :] = self.pages.n_pages
+        self._clear_slot(slot)
+        self._parked.append((rec.request, list(rec.tokens), rec.submit_ts))
+        self.metrics.inc("requests_preempted")
+        log_event(_LOG, "request_preempted",
+                  request_id=rec.request.request_id, cause=cause,
+                  priority=rec.request.sampling.priority,
+                  tokens_parked=len(rec.tokens))
+        self.metrics.event("request_preempted",
+                           request_id=rec.request.request_id, cause=cause,
+                           priority=rec.request.sampling.priority,
+                           tokens_parked=len(rec.tokens))
+        emit_span(self.metrics, SPAN_PREEMPT,
+                  trace_id=rec.request.trace_id,
+                  request_id=rec.request.request_id,
+                  start_s=now, end_s=now, wall=clock.wall(),
+                  replica_id=self.replica_id, detail=cause,
+                  tokens_parked=len(rec.tokens),
+                  priority=rec.request.sampling.priority)
+
+    def park_class(self, priority: str, *, cause: str = "brownout") -> int:
+        """Park EVERY active slot of ``priority`` (the brownout ladder's
+        "preempt batch slots" rung); returns the number parked. The
+        caller owns the take_parked() drain. Mid-chunked-prefill slots
+        are not parked — their progress lives in half-filled pages, not
+        a host cursor; the admission floor already stops new ones."""
+        now = clock.now()
+        victims = [self._active[s] for s in sorted(self._active)
+                   if self._active[s].request.sampling.priority == priority]
+        for rec in victims:
+            self._park(rec, now, cause=cause)
+        return len(victims)
+
     def _admit(self, finished: List[RequestResult]) -> None:
         shed: List = []
+        now = clock.now()
         batch = self.scheduler.pop_admissible(
             self.slots.free_count, decoding=bool(self._active),
-            predicate=self._make_page_predicate(), shed=shed)
-        now = clock.now()
+            predicate=self._make_page_predicate(), shed=shed, now=now)
         for request, submit_ts in shed:
             finished.append(self._shed_pages(request, submit_ts, now))
         for request, submit_ts in batch:
@@ -1264,10 +1427,10 @@ class InferenceEngine:
                         self.config.scheduler.max_prefills_per_tick)
         while spent < budget and admitted < limit and self.scheduler.depth:
             shed: List = []
+            now = clock.now()
             batch = self.scheduler.pop_admissible(
                 1, decoding=False, predicate=self._make_page_predicate(),
-                shed=shed)
-            now = clock.now()
+                shed=shed, now=now)
             for request, submit_ts in shed:
                 finished.append(self._shed_pages(request, submit_ts, now))
             if not batch:
@@ -1948,7 +2111,8 @@ class InferenceEngine:
             replica_id=self.replica_id,
             adapter_id=request.sampling.adapter_id,
             trace_id=request.trace_id,
-            prefill_chunks=prefill_chunks)
+            prefill_chunks=prefill_chunks,
+            priority=request.sampling.priority)
         self.completed[request.request_id] = result
         self.metrics.inc(f"requests_{reason}")
         # the span timeline, stamped at the SAME terminal choke point and

@@ -22,6 +22,11 @@ restarts with automatic rollback
 """
 
 from apex_tpu.serving.fleet.autoscale import AutoscaleConfig, Autoscaler
+from apex_tpu.serving.fleet.brownout import (
+    BROWNOUT_RUNGS,
+    BrownoutConfig,
+    BrownoutController,
+)
 from apex_tpu.serving.fleet.deploy import (
     DEPLOY_CANARY,
     DEPLOY_COMPLETE,
@@ -43,6 +48,12 @@ from apex_tpu.serving.fleet.router import (
     FleetUnavailableError,
     ReplicaFleet,
     Router,
+)
+from apex_tpu.serving.fleet.quota import (
+    QuotaConfig,
+    QuotaExceededError,
+    QuotaLedger,
+    TenantQuota,
 )
 from apex_tpu.serving.fleet.sharded import ShardedEngine
 
@@ -68,4 +79,11 @@ __all__ = [
     "DEPLOY_COMPLETE",
     "DEPLOY_ROLLED_BACK",
     "DEPLOY_REJECTED",
+    "TenantQuota",
+    "QuotaConfig",
+    "QuotaLedger",
+    "QuotaExceededError",
+    "BrownoutConfig",
+    "BrownoutController",
+    "BROWNOUT_RUNGS",
 ]

@@ -87,6 +87,9 @@ from apex_tpu.serving.request import (
     FINISH_EOS,
     FINISH_LENGTH,
     FINISH_REJECTED,
+    FINISH_TIMEOUT,
+    PRIORITY_RANK,
+    PRIORITY_STANDARD,
     Request,
     RequestResult,
     SamplingParams,
@@ -122,7 +125,11 @@ _FLEET_COUNTERS = ("fleet_dispatches", "replica_drains", "replica_rebuilds",
                    "replica_scale_ups", "replica_scale_downs",
                    "deploys_started", "deploys_completed",
                    "deploys_rolled_back", "deploys_rejected",
-                   "canary_promotions")
+                   "canary_promotions",
+                   # per-tenant quotas + the brownout ladder (ISSUE 20):
+                   # same counter<->event pairing contract
+                   "requests_shed_quota", "requests_deferred_quota",
+                   "brownouts_escalated", "brownouts_recovered")
 
 
 class FleetUnavailableError(EngineUnavailableError):
@@ -339,7 +346,7 @@ class ReplicaFleet:
                  metrics: Optional[MetricsRegistry] = None,
                  faults=None, router: Optional[Router] = None,
                  engine_factory=None, adapters=None, autoscale=None,
-                 sentinel=None):
+                 sentinel=None, quotas=None, brownout=None):
         self._model = model
         self._params = params
         #: shared LoRA :class:`~apex_tpu.lora.AdapterStore` — every
@@ -443,6 +450,38 @@ class ReplicaFleet:
                     f"got {type(sentinel).__name__}")
         else:
             self.sentinel = None
+        if quotas is not None:
+            from apex_tpu.serving.fleet.quota import QuotaConfig, QuotaLedger
+            if isinstance(quotas, QuotaLedger):
+                self.quota: Optional[QuotaLedger] = quotas
+            elif isinstance(quotas, QuotaConfig):
+                self.quota = QuotaLedger(quotas)
+            else:
+                raise TypeError(
+                    f"quotas must be a QuotaConfig or QuotaLedger, "
+                    f"got {type(quotas).__name__}")
+        else:
+            self.quota = None
+        #: rid -> (tenant, pages) the quota ledger holds for it —
+        #: committed at dispatch, released at the terminal state
+        self._quota_held: Dict[int, Tuple[str, int]] = {}
+        #: backlogged rids waiting on a soft quota (re-checked per tick)
+        self._quota_deferred: set = set()
+        if brownout is not None:
+            from apex_tpu.serving.fleet.brownout import (
+                BrownoutConfig,
+                BrownoutController,
+            )
+            if isinstance(brownout, BrownoutController):
+                self.brownout: Optional[BrownoutController] = brownout
+            elif isinstance(brownout, BrownoutConfig):
+                self.brownout = BrownoutController(brownout)
+            else:
+                raise TypeError(
+                    f"brownout must be a BrownoutConfig or "
+                    f"BrownoutController, got {type(brownout).__name__}")
+        else:
+            self.brownout = None
 
     def _build_supervisor(self, replica_id: int,
                           service_s: Optional[float] = None
@@ -544,6 +583,25 @@ deploy.Deployment`, or None if :meth:`deploy` was never called."""
         if self._closed:
             raise RuntimeError("fleet is closed")
         now = clock.now()
+        tenant = pages = None
+        if self.quota is not None:
+            from apex_tpu.serving.fleet.quota import (
+                QUOTA_DEFER,
+                QUOTA_SHED,
+                QuotaLedger,
+            )
+            tenant = QuotaLedger.tenant(request)
+            pages = self._quota_pages(request)
+            verdict, limit = self.quota.verdict(tenant, now, pages=pages)
+            if verdict == QUOTA_SHED:
+                self._shed_quota(request, tenant, limit, now)   # raises
+            if verdict == QUOTA_DEFER:
+                self._defer_quota(request, tenant, limit, now)
+                return request.request_id
+        if self.brownout is not None:
+            # at the clamp rung and above, batch submits get a bounded
+            # token budget (same ids/deadline/trace — accounting intact)
+            request = self.brownout.clamp(request)
         candidates = self.dispatch_set()
         if not candidates:
             self._shed_fleet(request, now)
@@ -573,7 +631,80 @@ deploy.Deployment`, or None if :meth:`deploy` was never called."""
         tr.replica_id = replica.replica_id
         self._count_dispatch(replica)
         self.router.note_dispatch(replica.replica_id, chain)
+        if self.quota is not None and tenant is not None:
+            self.quota.commit(tenant, now, pages=pages or 0)
+            self._quota_held[request.request_id] = (tenant, pages or 0)
         return request.request_id
+
+    # -- per-tenant quotas -------------------------------------------------
+
+    def _quota_pages(self, request: Request) -> int:
+        """Worst-case KV page footprint the engine's admission will
+        reserve (0 on non-paged layouts — the page cap is then inert)."""
+        if self.config.kv_layout != "paged":
+            return 0
+        ps = self.config.page_size
+        return -(-request.total_len // ps)
+
+    def _quota_release(self, request_id: int) -> None:
+        """Return a terminal request's quota holdings (idempotent)."""
+        held = self._quota_held.pop(request_id, None)
+        if held is not None and self.quota is not None:
+            self.quota.release(held[0], pages=held[1])
+        self._quota_deferred.discard(request_id)
+
+    def _shed_quota(self, request: Request, tenant: str,
+                    limit: Optional[str], now: float) -> None:
+        """Hard quota exceeded: terminal ``rejected`` record + the typed
+        ``requests_shed_quota`` counter + ``request_shed`` (reason
+        ``quota``) event, then raise — the same contract as
+        :meth:`_shed_fleet`, scoped to one tenant."""
+        from apex_tpu.serving.fleet.quota import QuotaExceededError
+        self.metrics.inc("requests_submitted")
+        self.metrics.inc("requests_shed_quota")
+        self.metrics.inc(f"requests_{FINISH_REJECTED}")
+        start = request.arrival_ts if request.arrival_ts is not None \
+            else now
+        result = RequestResult(
+            request_id=request.request_id, prompt_len=request.prompt_len,
+            tokens=[], finish_reason=FINISH_REJECTED,
+            queue_s=now - start, total_s=now - start,
+            adapter_id=request.sampling.adapter_id,
+            trace_id=request.trace_id,
+            priority=request.sampling.priority)
+        self.completed[request.request_id] = result
+        wall = clock.wall()
+        emit_span(self.metrics, SPAN_SHED, trace_id=request.trace_id,
+                  request_id=request.request_id, start_s=start,
+                  end_s=now, wall=wall, detail="quota")
+        self.metrics.emit_record(result.record(wall=wall))
+        log_event(_LOG, "request_shed", request_id=request.request_id,
+                  reason="quota", tenant=tenant, limit=limit)
+        self.metrics.event("request_shed", request_id=request.request_id,
+                           reason="quota", tenant=tenant, limit=limit)
+        raise QuotaExceededError(
+            f"request {request.request_id} shed: tenant {tenant!r} is "
+            f"over its {limit} quota")
+
+    def _defer_quota(self, request: Request, tenant: str,
+                     limit: Optional[str], now: float) -> None:
+        """Soft quota exceeded: throttle instead of shed — the request
+        joins the fleet backlog (counted submitted NOW, dispatched as a
+        resubmission later) and is re-checked against the ledger every
+        tick until its bucket refills or its deadline expires."""
+        self.metrics.inc("requests_submitted")
+        self.metrics.inc("requests_deferred_quota")
+        tr = _FleetTracked(request, now, self._order)
+        self._order += 1
+        self._tracked[request.request_id] = tr
+        self._quota_deferred.add(request.request_id)
+        self._backlog.append(request)
+        log_event(_LOG, "request_quota_deferred",
+                  request_id=request.request_id, tenant=tenant,
+                  limit=limit)
+        self.metrics.event("request_quota_deferred",
+                           request_id=request.request_id, tenant=tenant,
+                           limit=limit)
 
     def _count_dispatch(self, replica: _Replica) -> None:
         replica.dispatches += 1
@@ -593,7 +724,9 @@ deploy.Deployment`, or None if :meth:`deploy` was never called."""
             request_id=request.request_id, prompt_len=request.prompt_len,
             tokens=[], finish_reason=FINISH_REJECTED,
             queue_s=now - start, total_s=now - start,
-            trace_id=request.trace_id)
+            adapter_id=request.sampling.adapter_id,
+            trace_id=request.trace_id,
+            priority=request.sampling.priority)
         self.completed[request.request_id] = result
         wall = clock.wall()
         # front-door shed: one shed phase span, no replica_id (the
@@ -627,6 +760,7 @@ deploy.Deployment`, or None if :meth:`deploy` was never called."""
             if cont.request_id == request_id:
                 del self._backlog[i]
                 self._tracked.pop(request_id)
+                self._quota_release(request_id)
                 self._retire_fleet(tr, "cancelled", now)
                 return True
         if tr.replica_id is None:
@@ -665,6 +799,10 @@ deploy.Deployment`, or None if :meth:`deploy` was never called."""
             # after the autoscaler so a scale decision's effect on queue
             # depth and the anomaly that provoked it share a tick stamp
             self.sentinel.maybe_poll(self, now)
+        if self.brownout is not None:
+            # last: the ladder reacts to pressure the autoscaler could
+            # not absorb (bounds hit, or building too slowly)
+            self.brownout.maybe_step(self, now)
         return [self.completed[rid] for rid in sorted(
             set(self.completed) - before)]
 
@@ -772,14 +910,52 @@ deploy.Deployment`, or None if :meth:`deploy` was never called."""
         self._dispatch_backlog()
 
     def _dispatch_backlog(self) -> None:
-        """Re-home migrated continuations on the least-loaded peer with
-        queue room; whatever cannot be placed yet stays backlogged (and
-        keeps being retried every tick — never dropped)."""
+        """Re-home backlogged work — migrated continuations and
+        quota-deferred submits — on the least-loaded peer with queue
+        room, in priority order (rank, then arrival order) so a
+        backlogged interactive request never waits behind batch.
+        Deferred entries are re-checked against the quota ledger (and
+        their deadline) first; whatever cannot be placed yet stays
+        backlogged and keeps being retried every tick — never dropped."""
+        if not self._backlog:
+            return
+        self._backlog.sort(key=lambda c: (
+            PRIORITY_RANK.get(c.sampling.priority,
+                              PRIORITY_RANK[PRIORITY_STANDARD]),
+            self._tracked[c.request_id].order
+            if c.request_id in self._tracked else 0))
         kept: List[Request] = []
         for cont in self._backlog:
-            tr = self._tracked.get(cont.request_id)
+            rid = cont.request_id
+            tr = self._tracked.get(rid)
             if tr is None:
                 continue        # cancelled while backlogged
+            now = clock.now()
+            deferred = rid in self._quota_deferred
+            tenant = pages = None
+            if deferred:
+                start = cont.arrival_ts if cont.arrival_ts is not None \
+                    else tr.first_submit_ts
+                if cont.deadline_s is not None \
+                        and now - start > cont.deadline_s:
+                    # a throttled request whose bucket never refilled in
+                    # time — terminal, never silently dropped
+                    self._tracked.pop(rid)
+                    self._quota_release(rid)
+                    self._retire_fleet(tr, FINISH_TIMEOUT, now)
+                    continue
+                if self.quota is not None:
+                    from apex_tpu.serving.fleet.quota import (
+                        QUOTA_ADMIT,
+                        QuotaLedger,
+                    )
+                    tenant = QuotaLedger.tenant(cont)
+                    pages = self._quota_pages(cont)
+                    verdict, _ = self.quota.verdict(tenant, now,
+                                                    pages=pages)
+                    if verdict != QUOTA_ADMIT:
+                        kept.append(cont)
+                        continue
             candidates = [r for r in self.dispatch_set()
                           if Router.depth(r)
                           < self.config.scheduler.max_queue]
@@ -802,6 +978,11 @@ deploy.Deployment`, or None if :meth:`deploy` was never called."""
             tr.replica_id = replica.replica_id
             self._count_dispatch(replica)
             self.router.note_dispatch(replica.replica_id, chain)
+            if deferred:
+                self._quota_deferred.discard(rid)
+                if self.quota is not None and tenant is not None:
+                    self.quota.commit(tenant, now, pages=pages or 0)
+                    self._quota_held[rid] = (tenant, pages or 0)
         self._backlog = kept
 
     def _advance_drains(self) -> None:
@@ -1027,6 +1208,7 @@ deploy.Deployment`, or None if :meth:`deploy` was never called."""
                 if rid in sup.completed]
         for rid in sorted(done, key=lambda r: self._tracked[r].order):
             tr = self._tracked.pop(rid)
+            self._quota_release(rid)
             res = sup.completed[rid]
             if tr.prefix or tr.migrations:
                 res = RequestResult(
@@ -1050,7 +1232,9 @@ deploy.Deployment`, or None if :meth:`deploy` was never called."""
             request_id=rid, prompt_len=tr.request.prompt_len,
             tokens=list(tr.prefix), finish_reason=reason,
             total_s=now - tr.first_submit_ts,
-            trace_id=tr.request.trace_id)
+            adapter_id=tr.request.sampling.adapter_id,
+            trace_id=tr.request.trace_id,
+            priority=tr.request.sampling.priority)
         self.completed[rid] = result
         self.metrics.inc(f"requests_{reason}")
         wall = clock.wall()

@@ -24,7 +24,21 @@ from apex_tpu.observability.trace import new_trace_id
 __all__ = ["SamplingParams", "Request", "RequestResult",
            "FINISH_EOS", "FINISH_LENGTH", "FINISH_CANCELLED",
            "FINISH_TIMEOUT", "FINISH_REJECTED", "FINISH_ERROR",
-           "FINISH_REASONS"]
+           "FINISH_REASONS",
+           "PRIORITY_INTERACTIVE", "PRIORITY_STANDARD", "PRIORITY_BATCH",
+           "PRIORITIES", "PRIORITY_RANK"]
+
+#: priority classes a request can declare (SamplingParams.priority) —
+#: dispatch order under contention (docs/serving.md#priority-preemption-
+#: and-quotas). Rank 0 is the most latency-sensitive; the scheduler
+#: dispatches strictly by rank (FCFS inside a class) and the engine may
+#: preempt a lower class to admit a blocked higher one.
+PRIORITY_INTERACTIVE = "interactive"    # user-facing, never degraded first
+PRIORITY_STANDARD = "standard"          # the default class
+PRIORITY_BATCH = "batch"                # best-effort, first to brownout
+PRIORITIES = (PRIORITY_INTERACTIVE, PRIORITY_STANDARD, PRIORITY_BATCH)
+#: class -> dispatch rank (lower dispatches first)
+PRIORITY_RANK = {p: i for i, p in enumerate(PRIORITIES)}
 
 #: terminal outcomes a request can reach (RequestResult.finish_reason)
 FINISH_EOS = "eos"              # emitted its eos_token
@@ -54,12 +68,19 @@ class SamplingParams:
     :class:`~apex_tpu.lora.AdapterStore` (docs/serving.md#multi-lora);
     ``None`` is base-model traffic (the bank's zero adapter). An id the
     engine doesn't know fast-fails at ``submit()`` with
-    :class:`~apex_tpu.lora.UnknownAdapterError`."""
+    :class:`~apex_tpu.lora.UnknownAdapterError`.
+
+    ``priority`` is the request's scheduling class (one of
+    :data:`PRIORITIES`). It orders dispatch under contention and selects
+    which traffic the brownout ladder degrades first; it never changes
+    WHAT tokens a request produces, only WHEN they are produced
+    (docs/serving.md#priority-preemption-and-quotas)."""
 
     temperature: float = 0.0
     top_k: Optional[int] = None
     seed: int = 0
     adapter_id: Optional[str] = None
+    priority: str = PRIORITY_STANDARD
 
     def __post_init__(self):
         if self.temperature < 0.0:
@@ -72,6 +93,10 @@ class SamplingParams:
             raise ValueError(
                 f"adapter_id must be None or a non-empty string, "
                 f"got {self.adapter_id!r}")
+        if self.priority not in PRIORITY_RANK:
+            raise ValueError(
+                f"priority must be one of {PRIORITIES}, "
+                f"got {self.priority!r}")
 
 
 @dataclass
@@ -167,6 +192,11 @@ ReplicaFleet`; ``None`` on a single-engine deployment or a fleet-level
     (docs/serving.md#chunked-prefill) — ``None`` on the monolithic
     path, and omitted from the JSONL record so pre-chunking readers
     keep working unchanged.
+
+    ``priority`` echoes the request's scheduling class so per-class
+    goodput can be sliced straight from the request records (the
+    ``priority_storm`` gate's ``goodput_interactive`` SLO); ``None``
+    on pre-priority producers and omitted from the JSONL when ``None``.
     """
 
     request_id: int
@@ -183,6 +213,7 @@ ReplicaFleet`; ``None`` on a single-engine deployment or a fleet-level
     adapter_id: Optional[str] = None
     trace_id: Optional[str] = None
     prefill_chunks: Optional[int] = None
+    priority: Optional[str] = None
 
     @property
     def new_tokens(self) -> int:
@@ -222,6 +253,8 @@ ReplicaFleet`; ``None`` on a single-engine deployment or a fleet-level
             rec["tpot_s"] = self.tpot_s
         if self.prefill_chunks is not None:
             rec["prefill_chunks"] = self.prefill_chunks
+        if self.priority is not None:
+            rec["priority"] = self.priority
         tps = self.tokens_per_s
         if tps is not None:
             rec["tokens_per_s"] = tps

@@ -54,6 +54,7 @@ from apex_tpu.observability import MetricsRegistry
 from apex_tpu.serving import clock
 from apex_tpu.observability.trace import (
     SPAN_DECODE,
+    SPAN_RESUME,
     SPAN_SHED,
     emit_span,
 )
@@ -65,6 +66,7 @@ from apex_tpu.serving.request import (
     FINISH_LENGTH,
     FINISH_REJECTED,
     FINISH_TIMEOUT,
+    PRIORITY_RANK,
     Request,
     RequestResult,
 )
@@ -86,7 +88,8 @@ BREAKER_HALF_OPEN = "half_open"  # probing: next tick decides
 #: section reconciles these against the event stream key-for-key
 _SUP_COUNTERS = ("engine_restarts", "tick_failures", "requests_recovered",
                  "breaker_opens", "breaker_half_opens", "breaker_closes",
-                 "requests_shed_breaker", "requests_shed_deadline")
+                 "requests_shed_breaker", "requests_shed_deadline",
+                 "requests_resumed")
 
 
 class EngineUnavailableError(RuntimeError):
@@ -193,6 +196,10 @@ class EngineSupervisor:
         self._tracked: Dict[int, _Tracked] = {}
         #: restart continuations waiting for queue room in the new engine
         self._backlog: List[Request] = []
+        #: backlog ids that are PREEMPTION resumes (not restart
+        #: recoveries) — tagged so the successful resubmit emits the
+        #: ``requests_resumed`` counter / zero-width resume mark span
+        self._resuming: set = set()
         self._order = 0
         self._closed = False
         self.restarts = 0
@@ -225,8 +232,15 @@ class EngineSupervisor:
             # only forwarded when set, so custom engine factories that
             # predate multi-LoRA keep their narrower signature
             kwargs["adapters"] = self._adapters
-        return self._engine_factory(self._model, self._params, self.config,
-                                    **kwargs)
+        eng = self._engine_factory(self._model, self._params, self.config,
+                                   **kwargs)
+        # this supervisor drains take_parked() every tick, so the engine
+        # may preempt: a parked request is guaranteed a resume path
+        try:
+            eng.resume_consumer = True
+        except AttributeError:
+            pass   # custom factories that predate preemption
+        return eng
 
     # -- introspection ----------------------------------------------------
 
@@ -288,6 +302,62 @@ class EngineSupervisor:
         excess = self.queued_prompt_tokens - waiting * self._avg_prompt_tokens
         return max(0.0, excess) * self._prefill_s_per_token
 
+    def _queued_ahead(self, priority: str):
+        """``(depth, token_excess_s)`` of the queued work that would
+        dispatch AT OR BEFORE ``priority`` under strict-priority order —
+        the class-aware inputs to the deadline-shed projection, so an
+        interactive submit is not priced against a deep batch backlog
+        that would never run ahead of it. Falls back to the all-class
+        totals for engines that predate priority lanes."""
+        rank = PRIORITY_RANK.get(priority)
+        depth_by = getattr(self.engine, "queued_depth_by_class", None)
+        tokens_by = getattr(self.engine, "queued_tokens_by_class", None)
+        if rank is None or depth_by is None or tokens_by is None:
+            return (self.engine.queued_count + len(self._backlog),
+                    self.queued_token_excess_s)
+        waiting = sum(n for p, n in depth_by().items()
+                      if PRIORITY_RANK[p] <= rank)
+        tokens = sum(n for p, n in tokens_by().items()
+                     if PRIORITY_RANK[p] <= rank)
+        for r in self._backlog:
+            if PRIORITY_RANK.get(r.sampling.priority, 0) <= rank:
+                waiting += 1
+                tokens += r.prompt_len
+        if self._prefill_s_per_token is None \
+                or self._avg_prompt_tokens is None:
+            return waiting, 0.0
+        excess = tokens - waiting * self._avg_prompt_tokens
+        return waiting, max(0.0, excess) * self._prefill_s_per_token
+
+    def queued_token_excess_s_for(self, priority: str) -> float:
+        """Class-aware :attr:`queued_token_excess_s`: only the queued
+        tokens of same-or-higher classes count (ISSUE 20 satellite —
+        a batch backlog must not inflate the shed estimate for an
+        interactive submit)."""
+        return self._queued_ahead(priority)[1]
+
+    # -- priority control (brownout ladder / fleet passthroughs) ----------
+
+    def set_admission_floor(self, priority: Optional[str]) -> None:
+        """Pause dispatch of classes below ``priority`` (engine/scheduler
+        passthrough); ``None`` restores all classes."""
+        fn = getattr(self.engine, "set_admission_floor", None)
+        if fn is not None:
+            fn(priority)
+
+    def preempt_class(self, priority: str, *, cause: str = "brownout") -> int:
+        """Park every active slot of ``priority`` and immediately queue
+        their resume continuations (the brownout ladder's "preempt batch
+        slots" rung). Returns the number parked."""
+        fn = getattr(self.engine, "park_class", None)
+        if fn is None:
+            return 0
+        n = fn(priority, cause=cause)
+        if n:
+            self._drain_parked(clock.now())
+            self._drain_backlog()
+        return n
+
     # -- admission --------------------------------------------------------
 
     def submit(self, request: Request, *, resubmission: bool = False) -> int:
@@ -314,12 +384,12 @@ class EngineSupervisor:
                 and request.deadline_s is not None
                 and self._service_s is not None):
             # projected wait before this request even starts: everything
-            # already in line, at the observed per-request service rate
-            waiting = self.engine.queued_count + len(self._backlog)
-            # depth x average service, plus the token-aware surcharge
-            # for a line of unusually long prompts (0.0 until measured)
-            projected = (waiting * self._service_s
-                         + self.queued_token_excess_s)
+            # in line that would dispatch at-or-before its class, at the
+            # observed per-request service rate, plus the token-aware
+            # surcharge for unusually long prompts (0.0 until measured)
+            waiting, excess_s = self._queued_ahead(
+                request.sampling.priority)
+            projected = waiting * self._service_s + excess_s
             start = request.arrival_ts if request.arrival_ts is not None \
                 else now
             remaining = request.deadline_s - (now - start)
@@ -355,7 +425,10 @@ class EngineSupervisor:
             request_id=request.request_id, prompt_len=request.prompt_len,
             tokens=[], finish_reason=FINISH_REJECTED,
             queue_s=now - start, total_s=now - start,
-            replica_id=self.replica_id, trace_id=request.trace_id)
+            replica_id=self.replica_id,
+            adapter_id=request.sampling.adapter_id,
+            trace_id=request.trace_id,
+            priority=request.sampling.priority)
         self.completed[request.request_id] = result
         wall = clock.wall()
         # one shed phase span covering the request's whole (rejected)
@@ -385,6 +458,7 @@ class EngineSupervisor:
         for i, cont in enumerate(self._backlog):
             if cont.request_id == request_id:
                 del self._backlog[i]
+                self._resuming.discard(request_id)
                 tr = self._tracked.pop(request_id)
                 self._retire_supervised(tr, FINISH_CANCELLED, now)
                 return True
@@ -429,7 +503,13 @@ class EngineSupervisor:
             self._consecutive_failures = 0
             if self.breaker_state == BREAKER_HALF_OPEN:
                 self._breaker_to(BREAKER_CLOSED)
-            self._harvest(clock.now())
+            after = clock.now()
+            self._harvest(after)
+            # preempted slots parked this tick become resume
+            # continuations NOW — re-queued in their own class lane so
+            # strict priority keeps them behind the displacing traffic
+            self._drain_parked(after)
+            self._drain_backlog()
         return [self.completed[rid] for rid in sorted(
             set(self.completed) - before)]
 
@@ -500,6 +580,9 @@ class EngineSupervisor:
                            queued=len(queued))
         self.engine = self._build_engine()
         self._backlog = []
+        # a pending resume swept into the rebuild becomes a plain
+        # restart continuation — the resume mark fires at most once
+        self._resuming.clear()
         exhausted = self.restarts > self.supervisor.max_engine_restarts
         for rid in sorted(self._tracked,
                           key=lambda r: self._tracked[r].order):
@@ -548,15 +631,58 @@ class EngineSupervisor:
             request_id=req.request_id, arrival_ts=start,
             trace_id=req.trace_id)
 
+    def _drain_parked(self, now: float) -> None:
+        """Turn preempted (parked) requests into restart-style resume
+        continuations: fold the generated tokens into the tracked
+        prefix, rebuild the request with the remaining budget and the
+        ORIGINAL ids/deadline clock, and queue it for resubmission.
+        Preemption is not a failure: restart budgets are NOT charged
+        and ``requests_recovered`` does not fire — the resume has its
+        own counter/event pair, emitted at successful resubmit."""
+        take = getattr(self.engine, "take_parked", None)
+        if take is None:
+            return
+        for request, tokens, _submit_ts in take():
+            tr = self._tracked.get(request.request_id)
+            if tr is None:
+                continue   # cancelled/retired while parked
+            tr.prefix += tokens
+            cont = self._continuation(tr, now)
+            if cont is None:
+                continue   # retired (length/timeout) inside
+            self._resuming.add(request.request_id)
+            self._backlog.append(cont)
+
     def _drain_backlog(self) -> None:
         while self._backlog and (self.engine.queued_count
                                  < self.config.scheduler.max_queue):
             cont = self._backlog.pop(0)
+            rid = cont.request_id
+            resuming = rid in self._resuming
+            self._resuming.discard(rid)
             try:
                 self.engine.submit(cont, resubmission=True)
             except (QueueFullError, DeadlineExpiredError):
                 # terminal in the engine (recorded there) — harvest below
                 self._harvest(clock.now())
+            else:
+                if resuming:
+                    now = clock.now()
+                    tr = self._tracked.get(rid)
+                    carried = len(tr.prefix) if tr is not None else 0
+                    self.metrics.inc("requests_resumed")
+                    log_event(_LOG, "request_resumed", request_id=rid,
+                              tokens_carried=carried)
+                    self.metrics.event("request_resumed", request_id=rid,
+                                       tokens_carried=carried)
+                    # zero-width mark on the request's ORIGINAL trace —
+                    # excluded from phase conservation (MARK_SPANS), the
+                    # bookend of the park's ``preempt`` mark
+                    emit_span(self.metrics, SPAN_RESUME,
+                              trace_id=cont.trace_id, request_id=rid,
+                              start_s=now, end_s=now, wall=clock.wall(),
+                              replica_id=self.replica_id,
+                              tokens_carried=carried)
 
     def _retire_supervised(self, tr: _Tracked, reason: str, now: float,
                            detail: Optional[str] = None) -> RequestResult:
@@ -570,7 +696,9 @@ class EngineSupervisor:
             request_id=rid, prompt_len=tr.request.prompt_len,
             tokens=list(tr.prefix), finish_reason=reason,
             total_s=now - tr.first_submit_ts, replica_id=self.replica_id,
-            trace_id=tr.request.trace_id)
+            adapter_id=tr.request.sampling.adapter_id,
+            trace_id=tr.request.trace_id,
+            priority=tr.request.sampling.priority)
         self.completed[rid] = result
         self.metrics.inc(f"requests_{reason}")
         wall = clock.wall()
@@ -641,7 +769,11 @@ class EngineSupervisor:
                     decode_s=res.decode_s,
                     total_s=now - tr.first_submit_ts,
                     ttft_s=None if tr.prefix else res.ttft_s,
-                    tpot_s=res.tpot_s, replica_id=res.replica_id)
+                    tpot_s=res.tpot_s, replica_id=res.replica_id,
+                    adapter_id=tr.request.sampling.adapter_id,
+                    trace_id=tr.request.trace_id,
+                    prefill_chunks=res.prefill_chunks,
+                    priority=tr.request.sampling.priority)
             self.completed[rid] = res
             service = res.prefill_s + res.decode_s
             if service > 0 and res.finish_reason in (FINISH_EOS,
@@ -695,6 +827,7 @@ class EngineSupervisor:
             self._tracked.pop(rid)
             out.append((cont, list(tr.prefix)))
         self._backlog = []
+        self._resuming.clear()
         return out
 
     # -- lifecycle --------------------------------------------------------
